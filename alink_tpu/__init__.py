@@ -1,0 +1,21 @@
+"""alink_tpu — a TPU-native batch+stream ML algorithm platform.
+
+A from-scratch re-design (JAX/XLA/Pallas/pjit) of the capability surface of
+Alink (Alibaba's Flink-based ML platform): deferred operator DAGs, a
+scikit-style Pipeline layer, ~30 algorithm families, distributed iterative
+training on device meshes, and deep-learning train/predict — with XLA
+collectives over ICI/DCN replacing Flink shuffles, and batched jit-compiled
+mappers replacing per-row JVM inference.
+"""
+
+__version__ = "0.1.0"
+
+from .common import (  # noqa: F401
+    AlinkTypes,
+    DenseMatrix,
+    DenseVector,
+    MTable,
+    Params,
+    SparseVector,
+    TableSchema,
+)

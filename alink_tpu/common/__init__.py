@@ -1,0 +1,33 @@
+from .exceptions import (
+    AkException,
+    AkIllegalArgumentException,
+    AkIllegalDataException,
+    AkIllegalOperationException,
+    AkIllegalStateException,
+    AkColumnNotFoundException,
+    AkUnsupportedOperationException,
+    AkExecutionErrorException,
+    AkPreconditions,
+)
+from .linalg import (
+    DenseMatrix,
+    DenseVector,
+    SparseVector,
+    Vector,
+    parse_vector,
+    format_vector,
+    stack_vectors,
+)
+from .mtable import AlinkTypes, MTable, TableSchema
+from .params import (
+    ParamInfo,
+    Params,
+    WithParams,
+    Validator,
+    MinValidator,
+    MaxValidator,
+    RangeValidator,
+    InValidator,
+    ArrayLengthValidator,
+    NotNullValidator,
+)

@@ -1,0 +1,80 @@
+"""Exception hierarchy with error-code semantics.
+
+Capability parity with the reference's ``common/exceptions`` package
+(``AkIllegalOperationException`` etc., reference: core/src/main/java/com/alibaba/alink/
+common/exceptions/), re-expressed as a small Python hierarchy.
+"""
+
+from __future__ import annotations
+
+
+class AkException(Exception):
+    """Base for all framework errors; carries a stable error code."""
+
+    code = "AK_ERROR"
+
+    def __init__(self, message: str = ""):
+        super().__init__(f"[{self.code}] {message}")
+        self.message = message
+
+
+class AkIllegalArgumentException(AkException, ValueError):
+    code = "AK_ILLEGAL_ARGUMENT"
+
+
+class AkIllegalOperationException(AkException):
+    code = "AK_ILLEGAL_OPERATION"
+
+
+class AkIllegalDataException(AkException):
+    code = "AK_ILLEGAL_DATA"
+
+
+class AkIllegalStateException(AkException):
+    code = "AK_ILLEGAL_STATE"
+
+
+class AkColumnNotFoundException(AkException, KeyError):
+    code = "AK_COLUMN_NOT_FOUND"
+
+
+class AkUnsupportedOperationException(AkException, NotImplementedError):
+    code = "AK_UNSUPPORTED_OPERATION"
+
+
+class AkExecutionErrorException(AkException):
+    """Analog of AkFlinkExecutionErrorException: failure while running the DAG."""
+
+    code = "AK_EXECUTION_ERROR"
+
+
+class AkUnclassifiedErrorException(AkException):
+    code = "AK_UNCLASSIFIED"
+
+
+class AkParseErrorException(AkException):
+    code = "AK_PARSE_ERROR"
+
+
+class AkPluginNotExistException(AkException):
+    code = "AK_PLUGIN_NOT_EXIST"
+
+
+class AkPreconditions:
+    """Guard helpers mirroring the reference's AkPreconditions."""
+
+    @staticmethod
+    def check_state(condition: bool, message: str = "illegal state"):
+        if not condition:
+            raise AkIllegalStateException(message)
+
+    @staticmethod
+    def check_argument(condition: bool, message: str = "illegal argument"):
+        if not condition:
+            raise AkIllegalArgumentException(message)
+
+    @staticmethod
+    def check_not_null(value, message: str = "value is null"):
+        if value is None:
+            raise AkIllegalArgumentException(message)
+        return value

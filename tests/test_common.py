@@ -1,0 +1,166 @@
+import numpy as np
+import pytest
+
+from alink_tpu.common import (
+    AkIllegalArgumentException,
+    AlinkTypes,
+    DenseVector,
+    MTable,
+    ParamInfo,
+    Params,
+    SparseVector,
+    TableSchema,
+    WithParams,
+    MinValidator,
+    RangeValidator,
+    parse_vector,
+    stack_vectors,
+)
+
+
+# ---------------------------------------------------------------------------
+# linalg
+# ---------------------------------------------------------------------------
+
+
+def test_dense_vector_algebra():
+    a = DenseVector([1.0, 2.0, 3.0])
+    b = DenseVector([4.0, 5.0, 6.0])
+    assert a.dot(b) == 32.0
+    assert a.plus(b) == DenseVector([5, 7, 9])
+    assert a.scale(2.0) == DenseVector([2, 4, 6])
+    assert a.size() == 3
+    assert str(a) == "1 2 3"
+
+
+def test_sparse_vector():
+    s = SparseVector(5, [3, 1], [4.0, 2.0])
+    assert s.get(1) == 2.0 and s.get(3) == 4.0 and s.get(0) == 0.0
+    assert s.size() == 5
+    d = s.to_dense()
+    assert d == DenseVector([0, 2, 0, 4, 0])
+    assert s.dot(DenseVector([1, 1, 1, 1, 1])) == 6.0
+    s2 = SparseVector(5, [1, 2], [10.0, 7.0])
+    assert s.dot(s2) == 20.0
+    assert str(s) == "$5$1:2 3:4"
+
+
+def test_parse_vector_codecs():
+    assert parse_vector("1.0 2.0 3.0") == DenseVector([1, 2, 3])
+    sv = parse_vector("$5$1:2.0 3:4.0")
+    assert isinstance(sv, SparseVector) and sv.n == 5
+    sv2 = parse_vector("1:2.0 3:4.0")
+    assert sv2.n == -1 and sv2.size() == 4
+    assert parse_vector([1.0, 2.0]) == DenseVector([1, 2])
+    # roundtrip
+    assert parse_vector(str(sv)) == sv
+
+
+def test_stack_vectors_mixed():
+    block = stack_vectors([DenseVector([1, 2]), SparseVector(2, [1], [5.0]), "3 4"])
+    np.testing.assert_array_equal(block, np.array([[1, 2], [0, 5], [3, 4]], dtype=np.float32))
+
+
+# ---------------------------------------------------------------------------
+# params
+# ---------------------------------------------------------------------------
+
+
+class HasMaxIter:
+    MAX_ITER = ParamInfo("maxIter", int, default=100, validator=MinValidator(1))
+
+
+class HasL1:
+    L_1 = ParamInfo("l1", float, default=0.0, validator=MinValidator(0.0))
+
+
+class FakeOp(WithParams, HasMaxIter, HasL1):
+    pass
+
+
+def test_params_defaults_and_fluent():
+    op = FakeOp()
+    assert op.get(FakeOp.MAX_ITER) == 100
+    op.set_max_iter(7).set_l_1(0.5)
+    assert op.max_iter == 7
+    assert op.get(FakeOp.L_1) == 0.5
+    with pytest.raises(AkIllegalArgumentException):
+        op.set_max_iter(0)
+    with pytest.raises(AkIllegalArgumentException):
+        op.set(FakeOp.MAX_ITER, "ten")
+
+
+def test_params_kwargs_ctor_and_json():
+    op = FakeOp(max_iter=5)
+    assert op.max_iter == 5
+    j = op.get_params().to_json()
+    p2 = Params.from_json(j)
+    assert p2.get(FakeOp.MAX_ITER) == 5
+
+
+def test_range_validator():
+    info = ParamInfo("ratio", float, validator=RangeValidator(0.0, 1.0))
+    info.validate(0.5)
+    with pytest.raises(AkIllegalArgumentException):
+        info.validate(1.5)
+
+
+# ---------------------------------------------------------------------------
+# MTable
+# ---------------------------------------------------------------------------
+
+
+def test_mtable_basic():
+    t = MTable({"a": [1.0, 2.0, 3.0], "b": ["x", "y", "z"]})
+    assert t.num_rows == 3
+    assert t.schema.types == [AlinkTypes.DOUBLE, AlinkTypes.STRING]
+    assert t.get_row(1) == (2.0, "y")
+    assert list(t.select(["b"]).rows()) == [("x",), ("y",), ("z",)]
+
+
+def test_mtable_from_rows_schema_parse():
+    t = MTable.from_rows([(1, "a"), (2, "b")], "id bigint, name string")
+    assert t.schema.types == [AlinkTypes.LONG, AlinkTypes.STRING]
+    assert t.col("id").dtype == np.int64
+
+
+def test_mtable_relational():
+    t = MTable({"a": np.arange(10, dtype=np.float64), "b": np.arange(10)[::-1].copy()})
+    assert t.filter_mask(t.col("a") > 6).num_rows == 3
+    assert t.sort_by("b").get_row(0)[0] == 9.0
+    s1, s2 = t.split_at(4)
+    assert s1.num_rows == 4 and s2.num_rows == 6
+    c = MTable.concat([s1, s2])
+    assert c.num_rows == 10
+    assert t.with_column("c", t.col("a") * 2).num_cols == 3
+    assert t.rename({"a": "x"}).names == ["x", "b"]
+
+
+def test_mtable_vector_column_to_block():
+    vecs = [DenseVector([1, 2]), DenseVector([3, 4])]
+    t = MTable({"f": vecs, "label": [0.0, 1.0]})
+    assert t.schema.type_of("f") == AlinkTypes.DENSE_VECTOR
+    block = t.to_numeric_block(["f", "label"])
+    np.testing.assert_array_equal(block, [[1, 2, 0], [3, 4, 1]])
+
+
+def test_mtable_payload_roundtrip():
+    t = MTable(
+        {
+            "a": [1.0, 2.0],
+            "s": ["p", "q"],
+            "v": [DenseVector([1, 2]), SparseVector(3, [0], [9.0])],
+        },
+        "a double, s string, v vector",
+    )
+    data, meta = t.to_payload()
+    t2 = MTable.from_payload(data, meta)
+    assert t2.schema == t.schema
+    assert list(t2.col("a")) == [1.0, 2.0]
+    assert t2.col("v")[1] == SparseVector(3, [0], [9.0])
+
+
+def test_mtable_display():
+    t = MTable({"a": [1.0], "b": ["hello"]})
+    s = t.to_display_string()
+    assert "a" in s and "hello" in s
