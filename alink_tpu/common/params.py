@@ -121,11 +121,12 @@ _UNSET = object()
 
 
 class ParamInfo:
-    """A typed parameter descriptor (reference: ParamInfoFactory chain,
+    """A typed parameter definition (reference: ParamInfoFactory chain,
     e.g. params/shared/linear/HasL1.java:14-24).
 
-    Acts as a Python descriptor: on a :class:`WithParams` subclass,
-    ``op.l1`` reads the value and ``LR.L1`` is the descriptor itself.
+    Declared as plain UPPER_CASE class attributes on :class:`WithParams`
+    subclasses; value reads go through ``WithParams.__getattr__``
+    (``op.l1``), while ``LR.L1`` is the ParamInfo itself.
     """
 
     def __init__(
@@ -150,16 +151,6 @@ class ParamInfo:
         self.validator = validator
         self.aliases = tuple(aliases)
         self.name_cn = name_cn
-
-    # descriptor protocol -------------------------------------------------
-    def __get__(self, obj, objtype=None):
-        if obj is None:
-            return self
-        return obj.get_params().get(self)
-
-    def __set_name__(self, owner, attr_name):
-        # allow `L1 = ParamInfo("l1", ...)` style declarations
-        pass
 
     def validate(self, value):
         if value is None:
@@ -224,8 +215,11 @@ class Params:
         return info in self._map
 
     def remove(self, info: "ParamInfo | str"):
-        name = info.name if isinstance(info, ParamInfo) else info
-        self._map.pop(name, None)
+        if isinstance(info, ParamInfo):
+            for key in (info.name, *info.aliases):
+                self._map.pop(key, None)
+        else:
+            self._map.pop(info, None)
         return self
 
     def merge(self, other: "Params") -> "Params":
@@ -282,23 +276,43 @@ class WithParams:
 
     def __init__(self, params: Optional[Params] = None, **kwargs):
         self._params = params.clone() if params is not None else Params()
-        infos = self.param_infos()
         for k, v in kwargs.items():
-            info = infos.get(k) or infos.get(_camel(k))
+            info = type(self)._resolve_info(k)
             if info is not None:
                 self._params.set(info, v)
             else:
                 self._params.set(k, v)
 
-    # -- reflection over declared ParamInfo descriptors -------------------
+    # -- reflection over declared ParamInfo attributes --------------------
     @classmethod
     def param_infos(cls) -> Dict[str, ParamInfo]:
+        cached = cls.__dict__.get("_param_infos_cache")
+        if cached is not None:
+            return cached
         out: Dict[str, ParamInfo] = {}
         for klass in reversed(cls.__mro__):
             for v in vars(klass).values():
                 if isinstance(v, ParamInfo):
                     out.setdefault(v.name, v)
+        cls._param_infos_cache = out
         return out
+
+    @classmethod
+    def _resolve_info(cls, key: str) -> Optional[ParamInfo]:
+        cache = cls.__dict__.get("_resolve_cache")
+        if cache is None:
+            cache = cls._resolve_cache = {}
+        if key in cache:
+            return cache[key]
+        infos = cls.param_infos()
+        info = infos.get(key) or infos.get(_camel(key))
+        if info is None:
+            for i in infos.values():
+                if key in i.aliases or _camel(key) in i.aliases:
+                    info = i
+                    break
+        cache[key] = info
+        return info
 
     def get_params(self) -> Params:
         return self._params
@@ -314,16 +328,14 @@ class WithParams:
         # fluent setters: set_xxx / setXxx
         if attr.startswith("set_") or (attr.startswith("set") and attr[3:4].isupper()):
             raw = attr[4:] if attr.startswith("set_") else attr[3].lower() + attr[4:]
-            infos = type(self).param_infos()
-            info = infos.get(raw) or infos.get(_camel(raw))
+            info = type(self)._resolve_info(raw)
             if info is not None:
                 def setter(value, _info=info):
                     self._params.set(_info, value)
                     return self
                 return setter
         # value access by snake_case param name
-        infos = type(self).param_infos()
-        info = infos.get(attr) or infos.get(_camel(attr))
+        info = type(self)._resolve_info(attr)
         if info is not None:
             return self._params.get(info)
         raise AttributeError(f"{type(self).__name__} has no attribute {attr!r}")

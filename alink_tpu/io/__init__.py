@@ -1,0 +1,1 @@
+from .ak import read_ak, write_ak

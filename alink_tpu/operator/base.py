@@ -1,0 +1,263 @@
+"""Operator DAG API — the framework's L3.
+
+Capability parity with the reference's operator layer (reference:
+core/src/main/java/com/alibaba/alink/operator/AlgoOperator.java:29,
+operator/batch/BatchOperator.java:67 — ``link``/``linkFrom`` DAG building,
+deferred execution triggered by ``execute``/``collect``/``print``, lazy sinks at
+BatchOperator.java:688-725, side outputs).
+
+Re-design: the DAG is a host-side graph of Python operator nodes over columnar
+:class:`MTable` values. Evaluation is pull-based and memoized — ``collect()``
+walks the upstream graph once, runs each node's ``_execute_impl`` (whose heavy
+math is jit-compiled JAX over device meshes), caches results, and flushes every
+pending lazy sink in the session, preserving the reference's "one job runs all
+pending sinks" contract without a Flink scheduler.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..common.env import MLEnvironmentFactory
+from ..common.exceptions import (
+    AkIllegalArgumentException,
+    AkIllegalOperationException,
+    AkIllegalStateException,
+)
+from ..common.mtable import MTable, TableSchema
+from ..common.params import ParamInfo, WithParams
+
+
+class AlgoOperator(WithParams):
+    """Base of Batch/Stream/Local operators: a DAG node producing one output
+    table and optional side-output tables."""
+
+    ML_ENVIRONMENT_ID = ParamInfo(
+        "MLEnvironmentId", int, default=0, desc="session id of the MLEnvironment"
+    )
+
+    def __init__(self, params=None, **kwargs):
+        super().__init__(params, **kwargs)
+        self._inputs: List[AlgoOperator] = []
+        self._output: Optional[MTable] = None
+        self._side_tables: List[MTable] = []
+        self._executed = False
+
+    # -- environment -------------------------------------------------------
+    @property
+    def env(self):
+        return MLEnvironmentFactory.get(self.get(AlgoOperator.ML_ENVIRONMENT_ID))
+
+    # -- DAG building ------------------------------------------------------
+    def link_from(self, *inputs: "AlgoOperator") -> "AlgoOperator":
+        self.check_op_size(len(inputs))
+        self._inputs = list(inputs)
+        self._executed = False
+        self._output = None
+        return self
+
+    linkFrom = link_from
+
+    def link(self, next_op: "AlgoOperator") -> "AlgoOperator":
+        return next_op.link_from(self)
+
+    # number of expected inputs; None = variadic
+    _min_inputs: Optional[int] = None
+    _max_inputs: Optional[int] = None
+
+    def check_op_size(self, n: int):
+        lo = self._min_inputs
+        hi = self._max_inputs
+        if lo is not None and n < lo:
+            raise AkIllegalOperationException(
+                f"{type(self).__name__} expects >= {lo} inputs, got {n}"
+            )
+        if hi is not None and n > hi:
+            raise AkIllegalOperationException(
+                f"{type(self).__name__} expects <= {hi} inputs, got {n}"
+            )
+
+    # -- execution ---------------------------------------------------------
+    def _execute_impl(self, *inputs: MTable):
+        """Compute this node. Return an MTable, or (MTable, [side MTables])."""
+        raise NotImplementedError(type(self).__name__)
+
+    def _evaluate(self) -> MTable:
+        if not self._executed:
+            ins = [op._evaluate() for op in self._inputs]
+            result = self._execute_impl(*ins)
+            if isinstance(result, tuple):
+                self._output, sides = result
+                self._side_tables = list(sides)
+            else:
+                self._output = result
+                self._side_tables = []
+            self._executed = True
+        return self._output
+
+    def _flush_lazy(self):
+        mgr = self.env.lazy_manager
+        for op in mgr.pending_ops():
+            mgr.fill(op, op._evaluate())
+
+    # -- results -----------------------------------------------------------
+    def get_output_table(self) -> MTable:
+        return self._evaluate()
+
+    def get_side_output(self, index: int) -> "AlgoOperator":
+        return SideOutputOp(self, index)
+
+    def get_side_output_count(self) -> int:
+        self._evaluate()
+        return len(self._side_tables)
+
+    # schema access (triggers upstream evaluation, see module docstring)
+    @property
+    def schema(self) -> TableSchema:
+        return self._evaluate().schema
+
+    def get_col_names(self) -> List[str]:
+        return self.schema.names
+
+    def get_col_types(self) -> List[str]:
+        return list(self.schema.types)
+
+    def collect(self) -> MTable:
+        out = self._evaluate()
+        self._flush_lazy()
+        return out
+
+    def collect_to_dataframe(self):
+        return self.collect().to_dataframe()
+
+    def first_n(self, n: int) -> MTable:
+        return self.collect().head(n)
+
+    def print(self, n: int = 20, title: Optional[str] = None) -> "AlgoOperator":
+        t = self.collect()
+        if title:
+            print(title)
+        print(t.to_display_string(max_rows=n))
+        return self
+
+    # -- lazy sinks --------------------------------------------------------
+    def lazy_collect(self, *callbacks: Callable[[MTable], None]) -> "AlgoOperator":
+        lazy = self.env.lazy_manager.gen_lazy(self)
+        for cb in callbacks:
+            lazy.add_callback(cb)
+        return self
+
+    def lazy_print(self, n: int = 20, title: Optional[str] = None) -> "AlgoOperator":
+        def _print(t: MTable):
+            if title:
+                print(title)
+            print(t.to_display_string(max_rows=n))
+
+        return self.lazy_collect(_print)
+
+    def execute(self):
+        """Force all pending lazy sinks in this session (reference:
+        BatchOperator.execute → triggerLazyEvaluation, BatchOperator.java:316-330)."""
+        self._flush_lazy()
+
+    # -- SQL-ish sugar (reference: AlgoOperator select/filter/groupBy/orderBy) --
+    def select(self, fields: "str | Sequence[str]") -> "AlgoOperator":
+        from .sql import SelectOp
+
+        return SelectOp(fields).link_from(self)
+
+    def filter(self, predicate: str) -> "AlgoOperator":
+        from .sql import FilterOp
+
+        return FilterOp(predicate).link_from(self)
+
+    where = filter
+
+    def distinct(self) -> "AlgoOperator":
+        from .sql import DistinctOp
+
+        return DistinctOp().link_from(self)
+
+    def order_by(self, field: str, limit: Optional[int] = None, ascending: bool = True):
+        from .sql import OrderByOp
+
+        return OrderByOp(field, limit, ascending).link_from(self)
+
+    orderBy = order_by
+
+    def group_by(self, group_cols: str, select_clause: str) -> "AlgoOperator":
+        from .sql import GroupByOp
+
+        return GroupByOp(group_cols, select_clause).link_from(self)
+
+    groupBy = group_by
+
+    def sample(self, ratio: float, seed: int = 0) -> "AlgoOperator":
+        from .sql import SampleOp
+
+        return SampleOp(ratio, seed).link_from(self)
+
+    def rename(self, mapping) -> "AlgoOperator":
+        from .sql import RenameOp
+
+        return RenameOp(mapping).link_from(self)
+
+    def apply_func(
+        self,
+        fn: Callable[[MTable], MTable],
+        name: str = "apply_func",
+    ) -> "AlgoOperator":
+        """Escape hatch: arbitrary MTable→MTable host function as a DAG node
+        (reference: udf/udtf ops)."""
+        return _FuncOp(fn, name).link_from(self)
+
+    def __repr__(self):
+        state = "executed" if self._executed else "deferred"
+        return f"{type(self).__name__}({state})"
+
+
+class SideOutputOp(AlgoOperator):
+    """Materialized view of a parent's i-th side output
+    (reference: BatchOperator.getSideOutput)."""
+
+    def __init__(self, parent: AlgoOperator, index: int):
+        super().__init__()
+        self._parent = parent
+        self._index = index
+        self._inputs = [parent]
+
+    def _execute_impl(self, parent_out: MTable) -> MTable:
+        sides = self._parent._side_tables
+        if self._index >= len(sides):
+            raise AkIllegalArgumentException(
+                f"side output {self._index} out of range ({len(sides)} available)"
+            )
+        return sides[self._index]
+
+
+class _FuncOp(AlgoOperator):
+    _min_inputs = 1
+
+    def __init__(self, fn, name):
+        super().__init__()
+        self._fn = fn
+        self._name = name
+
+    def _execute_impl(self, *inputs: MTable) -> MTable:
+        return self._fn(*inputs)
+
+
+class TableSourceOp(AlgoOperator):
+    """Wrap an existing MTable as a source node (reference:
+    operator/batch/source/TableSourceBatchOp.java)."""
+
+    _max_inputs = 0
+
+    def __init__(self, table: MTable, **kwargs):
+        super().__init__(**kwargs)
+        self._table = table
+
+    def _execute_impl(self) -> MTable:
+        return self._table

@@ -1,0 +1,77 @@
+from ..sql import (
+    DistinctOp,
+    FilterOp,
+    GroupByOp,
+    IntersectOp,
+    JoinOp,
+    MinusOp,
+    OrderByOp,
+    RenameOp,
+    SampleOp,
+    SelectOp,
+    UnionAllOp,
+    UnionOp,
+)
+from .base import (
+    AkSinkBatchOp,
+    AkSourceBatchOp,
+    BatchOperator,
+    CsvSinkBatchOp,
+    CsvSourceBatchOp,
+    FirstNBatchOp,
+    MemSourceBatchOp,
+    NumSeqSourceBatchOp,
+    RandomTableSourceBatchOp,
+    ShuffleBatchOp,
+    SplitBatchOp,
+    TableSourceBatchOp,
+)
+
+
+# Reference-style names for the SQL sugar ops (reference: operator/batch/sql/*.java)
+class SelectBatchOp(SelectOp, BatchOperator):
+    pass
+
+
+class WhereBatchOp(FilterOp, BatchOperator):
+    pass
+
+
+class FilterBatchOp(FilterOp, BatchOperator):
+    pass
+
+
+class DistinctBatchOp(DistinctOp, BatchOperator):
+    pass
+
+
+class OrderByBatchOp(OrderByOp, BatchOperator):
+    pass
+
+
+class GroupByBatchOp(GroupByOp, BatchOperator):
+    pass
+
+
+class UnionAllBatchOp(UnionAllOp, BatchOperator):
+    pass
+
+
+class UnionBatchOp(UnionOp, BatchOperator):
+    pass
+
+
+class IntersectBatchOp(IntersectOp, BatchOperator):
+    pass
+
+
+class MinusBatchOp(MinusOp, BatchOperator):
+    pass
+
+
+class JoinBatchOp(JoinOp, BatchOperator):
+    pass
+
+
+class SampleBatchOp(SampleOp, BatchOperator):
+    pass

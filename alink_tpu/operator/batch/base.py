@@ -1,0 +1,229 @@
+"""BatchOperator + batch sources/sinks.
+
+Capability parity with reference operator/batch/BatchOperator.java:67 (collect at
+:727-759, MemSink :548-594), operator/batch/source/*.java and sink/*.java.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ...common.exceptions import AkIllegalArgumentException
+from ...common.mtable import AlinkTypes, MTable, TableSchema
+from ...common.params import ParamInfo, MinValidator, RangeValidator
+from ..base import AlgoOperator, TableSourceOp
+
+
+class BatchOperator(AlgoOperator):
+    """Bounded-data operator (reference: operator/batch/BatchOperator.java)."""
+
+    def lazy_print_statistics(self, title: Optional[str] = None) -> "BatchOperator":
+        def _stats(t: MTable):
+            from ...stats.summarizer import summarize
+
+            if title:
+                print(title)
+            print(summarize(t).to_display_string())
+
+        return self.lazy_collect(_stats)
+
+    def lazy_collect_statistics(self, callback) -> "BatchOperator":
+        def _stats(t: MTable):
+            from ...stats.summarizer import summarize
+
+            callback(summarize(t))
+
+        return self.lazy_collect(_stats)
+
+    @staticmethod
+    def from_table(table: MTable) -> "TableSourceBatchOp":
+        return TableSourceBatchOp(table)
+
+
+class TableSourceBatchOp(TableSourceOp, BatchOperator):
+    pass
+
+
+class MemSourceBatchOp(BatchOperator):
+    """In-memory rows source (reference: operator/batch/source/MemSourceBatchOp.java)."""
+
+    _max_inputs = 0
+
+    def __init__(self, rows, schema: "str | TableSchema", **kwargs):
+        super().__init__(**kwargs)
+        self._table = MTable.from_rows(rows, schema)
+
+    def _execute_impl(self) -> MTable:
+        return self._table
+
+
+class CsvSourceBatchOp(BatchOperator):
+    """CSV file source (reference: operator/batch/source/CsvSourceBatchOp.java).
+
+    Columnar read via pandas; schema string drives dtypes. Vector-typed columns
+    are parsed through the vector string codec at access time, not here.
+    """
+
+    FILE_PATH = ParamInfo("filePath", str, optional=False)
+    SCHEMA_STR = ParamInfo("schemaStr", str, optional=False, aliases=("schema",))
+    FIELD_DELIMITER = ParamInfo("fieldDelimiter", str, default=",")
+    IGNORE_FIRST_LINE = ParamInfo("ignoreFirstLine", bool, default=False)
+    QUOTE_CHAR = ParamInfo("quoteChar", str, default='"')
+
+    _max_inputs = 0
+
+    def __init__(self, params=None, **kwargs):
+        super().__init__(params, **kwargs)
+
+    def _execute_impl(self) -> MTable:
+        import pandas as pd
+
+        schema = TableSchema.parse(self.get(self.SCHEMA_STR))
+        df = pd.read_csv(
+            self.get(self.FILE_PATH),
+            sep=self.get(self.FIELD_DELIMITER),
+            header=0 if self.get(self.IGNORE_FIRST_LINE) else None,
+            names=schema.names,
+            quotechar=self.get(self.QUOTE_CHAR),
+            skipinitialspace=True,
+        )
+        cols = {}
+        for n, t in zip(schema.names, schema.types):
+            s = df[n]
+            if AlinkTypes.is_vector(t):
+                from ...common.linalg import parse_vector
+
+                cols[n] = [parse_vector(str(v)) for v in s]
+            else:
+                cols[n] = s.to_numpy()
+        return MTable(cols, schema)
+
+
+class RandomTableSourceBatchOp(BatchOperator):
+    """Random numeric table (reference: operator/batch/source/RandomTableSourceBatchOp.java)."""
+
+    NUM_ROWS = ParamInfo("numRows", int, optional=False, validator=MinValidator(1))
+    NUM_COLS = ParamInfo("numCols", int, optional=False, validator=MinValidator(1))
+    ID_COL = ParamInfo("idCol", str, default=None)
+    OUTPUT_COLS = ParamInfo("outputCols", list, default=None)
+    SEED = ParamInfo("seed", int, default=0)
+
+    _max_inputs = 0
+
+    def _execute_impl(self) -> MTable:
+        n, d = self.get(self.NUM_ROWS), self.get(self.NUM_COLS)
+        rng = np.random.default_rng(self.get(self.SEED))
+        names = self.get(self.OUTPUT_COLS) or [f"col{i}" for i in range(d)]
+        cols = {name: rng.random(n) for name in names}
+        if self.get(self.ID_COL):
+            cols = {self.get(self.ID_COL): np.arange(n, dtype=np.int64), **cols}
+        return MTable(cols)
+
+
+class NumSeqSourceBatchOp(BatchOperator):
+    """Integer sequence source (reference: NumSeqSourceBatchOp.java)."""
+
+    _max_inputs = 0
+
+    def __init__(self, from_: int, to: int, col_name: str = "num", **kwargs):
+        super().__init__(**kwargs)
+        self._from, self._to, self._col = from_, to, col_name
+
+    def _execute_impl(self) -> MTable:
+        return MTable({self._col: np.arange(self._from, self._to + 1, dtype=np.int64)})
+
+
+class CsvSinkBatchOp(BatchOperator):
+    """CSV sink (reference: operator/batch/sink/CsvSinkBatchOp.java)."""
+
+    FILE_PATH = ParamInfo("filePath", str, optional=False)
+    FIELD_DELIMITER = ParamInfo("fieldDelimiter", str, default=",")
+    OVERWRITE_SINK = ParamInfo("overwriteSink", bool, default=False)
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    def _execute_impl(self, t: MTable) -> MTable:
+        path = self.get(self.FILE_PATH)
+        if os.path.exists(path) and not self.get(self.OVERWRITE_SINK):
+            raise AkIllegalArgumentException(
+                f"sink path {path} exists; set overwriteSink=True"
+            )
+        t.to_dataframe().to_csv(
+            path, sep=self.get(self.FIELD_DELIMITER), index=False, header=False
+        )
+        return t
+
+
+class AkSourceBatchOp(BatchOperator):
+    """.ak-file source (reference: AkSourceBatchOp.java; format at
+    common/io/filesystem/AkUtils.java:52-110)."""
+
+    FILE_PATH = ParamInfo("filePath", str, optional=False)
+
+    _max_inputs = 0
+
+    def _execute_impl(self) -> MTable:
+        from ...io.ak import read_ak
+
+        return read_ak(self.get(self.FILE_PATH))
+
+
+class AkSinkBatchOp(BatchOperator):
+    FILE_PATH = ParamInfo("filePath", str, optional=False)
+    OVERWRITE_SINK = ParamInfo("overwriteSink", bool, default=False)
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    def _execute_impl(self, t: MTable) -> MTable:
+        from ...io.ak import write_ak
+
+        path = self.get(self.FILE_PATH)
+        if os.path.exists(path) and not self.get(self.OVERWRITE_SINK):
+            raise AkIllegalArgumentException(
+                f"sink path {path} exists; set overwriteSink=True"
+            )
+        write_ak(path, t)
+        return t
+
+
+class SplitBatchOp(BatchOperator):
+    """Random split; main output = fraction, side output 0 = rest
+    (reference: operator/batch/dataproc/SplitBatchOp.java)."""
+
+    FRACTION = ParamInfo(
+        "fraction", float, optional=False, validator=RangeValidator(0.0, 1.0)
+    )
+    SEED = ParamInfo("randomSeed", int, default=0, aliases=("seed",))
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    def _execute_impl(self, t: MTable):
+        rng = np.random.default_rng(self.get(self.SEED))
+        mask = rng.random(t.num_rows) < self.get(self.FRACTION)
+        return t.filter_mask(mask), [t.filter_mask(~mask)]
+
+
+class ShuffleBatchOp(BatchOperator):
+    SEED = ParamInfo("randomSeed", int, default=0, aliases=("seed",))
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    def _execute_impl(self, t: MTable) -> MTable:
+        return t.shuffle(seed=self.get(self.SEED))
+
+
+class FirstNBatchOp(BatchOperator):
+    SIZE = ParamInfo("size", int, optional=False, validator=MinValidator(1))
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    def _execute_impl(self, t: MTable) -> MTable:
+        return t.head(self.get(self.SIZE))

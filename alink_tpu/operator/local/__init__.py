@@ -1,0 +1,31 @@
+"""LocalOperator family.
+
+Capability parity with reference operator/local/LocalOperator.java +
+AlinkLocalSession.java:20-45 (thread-pool execution without a cluster). In this
+framework batch execution is already in-process and pull-based, so LocalOperator
+shares the batch implementations; the distinction kept is semantic (eager,
+single-host, host thread-pool for embarrassingly parallel work).
+"""
+
+from ..batch import (
+    BatchOperator as _BatchOperator,
+    MemSourceBatchOp as _MemSource,
+    CsvSourceBatchOp as _CsvSource,
+    TableSourceBatchOp as _TableSource,
+)
+
+
+class LocalOperator(_BatchOperator):
+    pass
+
+
+class MemSourceLocalOp(_MemSource, LocalOperator):
+    pass
+
+
+class CsvSourceLocalOp(_CsvSource, LocalOperator):
+    pass
+
+
+class TableSourceLocalOp(_TableSource, LocalOperator):
+    pass

@@ -1,0 +1,349 @@
+"""Relational / SQL-ish operators over MTable.
+
+Capability parity with the reference's SQL layer (reference:
+core/src/main/java/com/alibaba/alink/operator/common/sql/ — a local SQL engine
+via Apache Calcite: MTableCalciteSqlExecutor.java, CalciteSelectMapper.java; plus
+the select/where/groupby/join/union/intersect/minus ops under
+operator/batch/sql/). Re-design: expressions are evaluated columnar through
+pandas (`DataFrame.eval`/`query`/`merge`) — the host-side relational plane; the
+numeric plane stays in JAX. Vector/tensor object columns pass through untouched.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..common.exceptions import AkIllegalArgumentException, AkParseErrorException
+from ..common.mtable import MTable, TableSchema
+from .base import AlgoOperator
+
+
+def _to_pandas(t: MTable):
+    import pandas as pd
+
+    # keep object columns (vectors etc.) as raw objects so they round-trip
+    data = {n: t.col(n) for n in t.names}
+    return pd.DataFrame(data)
+
+
+def _from_pandas(df, like: "MTable | Sequence[MTable] | None" = None) -> MTable:
+    from ..common.mtable import _NP_OF_TYPE, _infer_type
+
+    sources = [like] if isinstance(like, MTable) else list(like or ())
+    cols, names, types = {}, [], []
+    for c in df.columns:
+        name = str(c)
+        arr = df[c].to_numpy()
+        # preserve the source schema's type where the column survives unchanged
+        t = None
+        for src in sources:
+            if name in src.names:
+                t = src.schema.type_of(name)
+                np_t = _NP_OF_TYPE.get(t)
+                if np_t is not None and arr.dtype != object and arr.dtype.kind != "O":
+                    try:
+                        arr = arr.astype(np_t, copy=False)
+                    except (TypeError, ValueError):
+                        t = None
+                break
+        if t is None:
+            t = _infer_type(arr)
+        cols[name] = arr
+        names.append(name)
+        types.append(t)
+    return MTable(cols, TableSchema(names, types))
+
+
+_AGG_RE = re.compile(r"^\s*(\w+)\s*\(\s*(\*|[\w.]+)\s*\)\s*(?:as\s+(\w+))?\s*$", re.I)
+_AS_RE = re.compile(r"^(.*?)\s+as\s+(\w+)\s*$", re.I)
+
+
+def _split_top_level(s: str) -> List[str]:
+    parts, depth, cur = [], 0, []
+    for ch in s:
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+        if ch == "," and depth == 0:
+            parts.append("".join(cur).strip())
+            cur = []
+        else:
+            cur.append(ch)
+    if cur:
+        parts.append("".join(cur).strip())
+    return [p for p in parts if p]
+
+
+class SelectOp(AlgoOperator):
+    """``select("a, b as c, a*2 as d, *")`` projection + expressions
+    (reference: operator/batch/sql/SelectBatchOp.java + CalciteSelectMapper)."""
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    def __init__(self, fields, **kwargs):
+        super().__init__(**kwargs)
+        if isinstance(fields, str):
+            self._clauses = _split_top_level(fields)
+        else:
+            self._clauses = list(fields)
+
+    def _execute_impl(self, t: MTable) -> MTable:
+        out_cols: Dict[str, np.ndarray] = {}
+        out_names: List[str] = []
+        out_types: List[str] = []
+        df = None
+        for clause in self._clauses:
+            clause = clause.strip()
+            if clause == "*":
+                for n in t.names:
+                    out_cols[n] = t.col(n)
+                    out_names.append(n)
+                    out_types.append(t.schema.type_of(n))
+                continue
+            m = _AS_RE.match(clause)
+            expr, alias = (m.group(1).strip(), m.group(2)) if m else (clause, None)
+            if re.fullmatch(r"[\w.]+", expr) and expr in t.names:
+                name = alias or expr
+                out_cols[name] = t.col(expr)
+                out_names.append(name)
+                out_types.append(t.schema.type_of(expr))
+            else:
+                if df is None:
+                    df = _to_pandas(t)
+                try:
+                    series = df.eval(expr)
+                except Exception as e:
+                    raise AkParseErrorException(f"bad select expression {clause!r}: {e}")
+                name = alias or expr
+                arr = np.asarray(series.to_numpy() if hasattr(series, "to_numpy") else series)
+                out_cols[name] = arr
+                out_names.append(name)
+                from ..common.mtable import _infer_type
+
+                out_types.append(_infer_type(arr))
+        return MTable(out_cols, TableSchema(out_names, out_types))
+
+
+class FilterOp(AlgoOperator):
+    """``filter("a > 1 and category == 'x'")`` (reference: WhereBatchOp)."""
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    def __init__(self, predicate: str, **kwargs):
+        super().__init__(**kwargs)
+        self._predicate = predicate
+
+    def _execute_impl(self, t: MTable) -> MTable:
+        df = _to_pandas(t)
+        try:
+            mask = df.eval(self._predicate)
+        except Exception as e:
+            raise AkParseErrorException(f"bad filter predicate {self._predicate!r}: {e}")
+        return t.filter_mask(np.asarray(mask, dtype=bool))
+
+
+class DistinctOp(AlgoOperator):
+    _min_inputs = 1
+    _max_inputs = 1
+
+    def _execute_impl(self, t: MTable) -> MTable:
+        df = _to_pandas(t)
+        keep = ~df.duplicated()
+        return t.filter_mask(keep.to_numpy())
+
+
+class OrderByOp(AlgoOperator):
+    _min_inputs = 1
+    _max_inputs = 1
+
+    def __init__(self, field: str, limit: Optional[int] = None, ascending: bool = True, **kw):
+        super().__init__(**kw)
+        self._field, self._limit, self._ascending = field, limit, ascending
+
+    def _execute_impl(self, t: MTable) -> MTable:
+        out = t.sort_by(self._field, ascending=self._ascending)
+        return out.head(self._limit) if self._limit is not None else out
+
+
+class SampleOp(AlgoOperator):
+    _min_inputs = 1
+    _max_inputs = 1
+
+    def __init__(self, ratio: float, seed: int = 0, **kw):
+        super().__init__(**kw)
+        self._ratio, self._seed = ratio, seed
+
+    def _execute_impl(self, t: MTable) -> MTable:
+        return t.sample(self._ratio, seed=self._seed)
+
+
+class RenameOp(AlgoOperator):
+    _min_inputs = 1
+    _max_inputs = 1
+
+    def __init__(self, mapping, **kw):
+        super().__init__(**kw)
+        if isinstance(mapping, str):
+            # "a as x, b as y"
+            m = {}
+            for clause in _split_top_level(mapping):
+                mm = _AS_RE.match(clause)
+                if not mm:
+                    raise AkIllegalArgumentException(f"bad rename clause {clause!r}")
+                m[mm.group(1).strip()] = mm.group(2)
+            mapping = m
+        self._mapping = mapping
+
+    def _execute_impl(self, t: MTable) -> MTable:
+        return t.rename(self._mapping)
+
+
+_AGGS = {
+    "sum": "sum",
+    "avg": "mean",
+    "mean": "mean",
+    "min": "min",
+    "max": "max",
+    "count": "count",
+    "std": "std",
+    "stddev": "std",
+    "first": "first",
+    "last": "last",
+}
+
+
+class GroupByOp(AlgoOperator):
+    """``group_by("category", "category, avg(f0) as m, count(*) as c")``
+    (reference: GroupByBatchOp via Calcite)."""
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    def __init__(self, group_cols: str, select_clause: str, **kw):
+        super().__init__(**kw)
+        self._group_cols = [c.strip() for c in group_cols.split(",") if c.strip()]
+        self._select = _split_top_level(select_clause)
+
+    def _execute_impl(self, t: MTable) -> MTable:
+        import pandas as pd
+
+        df = _to_pandas(t)
+        gb = df.groupby(self._group_cols, sort=True, dropna=False)
+        out = {}
+        order = []
+        for clause in self._select:
+            if clause.strip() in self._group_cols:
+                order.append((clause.strip(), None))
+                continue
+            m = _AGG_RE.match(clause)
+            if not m:
+                raise AkParseErrorException(f"bad aggregate clause {clause!r}")
+            fn, col, alias = m.group(1).lower(), m.group(2), m.group(3)
+            if fn not in _AGGS:
+                raise AkParseErrorException(f"unknown aggregate {fn!r}")
+            name = alias or f"{fn}_{col}".replace("*", "all")
+            if col == "*":
+                series = gb.size()
+            else:
+                series = getattr(gb[col], _AGGS[fn])()
+            order.append((name, series))
+        frame = pd.DataFrame({n: s for n, s in order if s is not None})
+        frame = frame.reset_index()
+        keep = self._group_cols + [n for n, s in order if s is not None]
+        frame = frame[keep]
+        return _from_pandas(frame)
+
+
+class UnionAllOp(AlgoOperator):
+    """(reference: UnionAllBatchOp)"""
+
+    _min_inputs = 1
+
+    def _execute_impl(self, *tables: MTable) -> MTable:
+        return MTable.concat(list(tables))
+
+
+class UnionOp(AlgoOperator):
+    _min_inputs = 1
+
+    def _execute_impl(self, *tables: MTable) -> MTable:
+        t = MTable.concat(list(tables))
+        df = _to_pandas(t)
+        return t.filter_mask((~df.duplicated()).to_numpy())
+
+
+class IntersectOp(AlgoOperator):
+    _min_inputs = 2
+    _max_inputs = 2
+
+    def _execute_impl(self, a: MTable, b: MTable) -> MTable:
+        import pandas as pd
+
+        da, db = _to_pandas(a), _to_pandas(b)
+        merged = da.merge(db.drop_duplicates(), how="inner")
+        return _from_pandas(merged.drop_duplicates(), like=(a, b))
+
+
+class MinusAllOp(AlgoOperator):
+    """EXCEPT ALL semantics — left duplicates preserved (reference: MinusAllBatchOp)."""
+
+    _min_inputs = 2
+    _max_inputs = 2
+
+    def _execute_impl(self, a: MTable, b: MTable) -> MTable:
+        da, db = _to_pandas(a), _to_pandas(b)
+        key_cols = list(da.columns)
+        marked = da.merge(db.drop_duplicates(), on=key_cols, how="left", indicator=True)
+        keep = (marked["_merge"] == "left_only").to_numpy()
+        return a.filter_mask(keep)
+
+
+class MinusOp(MinusAllOp):
+    """EXCEPT semantics — result is deduplicated (reference: MinusBatchOp)."""
+
+    def _execute_impl(self, a: MTable, b: MTable) -> MTable:
+        out = super()._execute_impl(a, b)
+        keep = ~_to_pandas(out).duplicated()
+        return out.filter_mask(keep.to_numpy())
+
+
+class JoinOp(AlgoOperator):
+    """Equi-join (reference: JoinBatchOp / LeftOuterJoinBatchOp / ...)."""
+
+    _min_inputs = 2
+    _max_inputs = 2
+
+    def __init__(self, join_predicate: str, select_clause: str = "*", how: str = "inner", **kw):
+        super().__init__(**kw)
+        self._how = {"inner": "inner", "left": "left", "right": "right", "full": "outer"}[how]
+        self._pairs = self._parse_predicate(join_predicate)
+        self._select = select_clause
+
+    @staticmethod
+    def _parse_predicate(pred: str) -> List[Tuple[str, str]]:
+        pairs = []
+        for part in re.split(r"(?i)\s+and\s+", pred.strip()):
+            m = re.fullmatch(r"\s*(\w+)\s*=+\s*(\w+)\s*", part)
+            if not m:
+                raise AkParseErrorException(f"bad join predicate fragment {part!r}")
+            pairs.append((m.group(1), m.group(2)))
+        return pairs
+
+    def _execute_impl(self, a: MTable, b: MTable) -> MTable:
+        da, db = _to_pandas(a), _to_pandas(b)
+        left_keys = [l if l in a.names else r for l, r in self._pairs]
+        right_keys = [r if r in b.names else l for l, r in self._pairs]
+        merged = da.merge(
+            db, left_on=left_keys, right_on=right_keys, how=self._how,
+            suffixes=("", "_r"),
+        )
+        out = _from_pandas(merged, like=(a, b))
+        if self._select != "*":
+            return SelectOp(self._select)._execute_impl(out)
+        return out

@@ -1,0 +1,12 @@
+from .mesh import (
+    AXIS_DATA,
+    AXIS_EXPERT,
+    AXIS_MODEL,
+    AXIS_SEQ,
+    data_sharding,
+    default_mesh,
+    make_mesh,
+    num_devices,
+    pad_to_multiple,
+    replicated_sharding,
+)

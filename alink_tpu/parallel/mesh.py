@@ -1,0 +1,89 @@
+"""Device mesh management — the substrate for all distributed execution.
+
+Replaces the reference's Flink cluster topology (TaskManagers × slots; reference:
+core/src/main/java/com/alibaba/alink/common/MLEnvironment.java:45 holds the
+ExecutionEnvironment) with a ``jax.sharding.Mesh`` over TPU chips. Axis names
+are fixed framework-wide:
+
+- ``data``   — data parallelism (the reference's row partitioning across subtasks)
+- ``model``  — tensor/model parallelism (no reference equivalent; TPU-first addition)
+- ``seq``    — sequence/context parallelism for long sequences (TPU-first addition)
+
+Collectives ride ICI inside a slice and DCN across slices; XLA inserts them from
+sharding annotations — there is no hand-written transport here (contrast with the
+reference's hand-built chunked AllReduce, common/comqueue/communication/AllReduce.java:41).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+AXIS_DATA = "data"
+AXIS_MODEL = "model"
+AXIS_SEQ = "seq"
+AXIS_EXPERT = "expert"
+
+
+def default_mesh(devices=None):
+    """1-D data-parallel mesh over all local devices."""
+    import jax
+    from jax.sharding import Mesh
+
+    devices = devices if devices is not None else jax.devices()
+    return Mesh(np.asarray(devices), (AXIS_DATA,))
+
+
+def make_mesh(
+    mesh_shape: "dict[str, int] | Sequence[Tuple[str, int]]",
+    devices=None,
+):
+    """Build a named mesh, e.g. ``make_mesh({"data": 4, "model": 2})``.
+
+    The product of axis sizes must divide into the available device count;
+    axes of size 1 are kept so sharding rules can reference them uniformly.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    if isinstance(mesh_shape, dict):
+        items = list(mesh_shape.items())
+    else:
+        items = list(mesh_shape)
+    names = tuple(n for n, _ in items)
+    sizes = tuple(int(s) for _, s in items)
+    devices = devices if devices is not None else jax.devices()
+    total = int(np.prod(sizes))
+    if total > len(devices):
+        raise ValueError(
+            f"mesh {dict(items)} needs {total} devices, have {len(devices)}"
+        )
+    dev_array = np.asarray(devices[:total]).reshape(sizes)
+    return Mesh(dev_array, names)
+
+
+def data_sharding(mesh, *, axis: str = AXIS_DATA):
+    """NamedSharding that shards the leading (batch/row) dimension over `axis`."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P(axis))
+
+
+def replicated_sharding(mesh):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P())
+
+
+def num_devices(mesh=None) -> int:
+    import jax
+
+    return mesh.size if mesh is not None else len(jax.devices())
+
+
+def pad_to_multiple(n: int, k: int) -> int:
+    """Rows must pad to a multiple of the data-axis size (XLA needs static,
+    evenly divisible shards)."""
+    return ((n + k - 1) // k) * k

@@ -1,0 +1,1 @@
+from .summarizer import TableSummary, summarize
