@@ -1,0 +1,10 @@
+from .objfunc import (
+    ObjFunc,
+    hinge_obj,
+    huber_obj,
+    logistic_obj,
+    perceptron_obj,
+    softmax_obj,
+    squared_obj,
+)
+from .optimizers import OptimResult, optimize

@@ -1,0 +1,288 @@
+"""Distributed first/second-order optimizers.
+
+Capability parity with the reference's optimizer framework (reference:
+core/src/main/java/com/alibaba/alink/operator/common/optim/ — Lbfgs.java:33,79-101
+(two-loop recursion at :106+), Owlqn.java, Gd.java, Sgd.java, Newton.java,
+OptimizerFactory.java, with ICQ sub-steps optim/subfunc/* (Preallocate*,
+CalcGradient, CalcLosses, UpdateModel, IterTermination) and AllReduce between
+each).
+
+TPU-first re-design: the entire optimization — gradient, line search, history
+update, convergence — is ONE compiled XLA program: a ``lax.while_loop`` inside
+``shard_map`` over the data axis. Each iteration issues two ``psum`` collectives
+(gradient, line-search losses) over ICI; the line search evaluates all
+``num_search_step`` candidate steps in a single batched pass (the analog of the
+reference's CalcLosses vectorized loss evaluation). There are no per-step
+launches or barriers (the reference paid a Flink superstep + 2-shuffle
+AllReduce per gradient and per line search).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import numpy as np
+
+from ..parallel.mesh import AXIS_DATA, default_mesh
+from ..parallel.comqueue import shard_rows
+from .objfunc import ObjFunc
+
+
+class OptimResult(NamedTuple):
+    weights: np.ndarray
+    loss: float
+    grad_norm: float
+    num_iters: int
+
+
+_METHODS = ("lbfgs", "owlqn", "gd", "sgd", "newton")
+
+
+def optimize(
+    obj: ObjFunc,
+    X: np.ndarray,
+    y: np.ndarray,
+    w0: Optional[np.ndarray] = None,
+    sample_weights: Optional[np.ndarray] = None,
+    *,
+    mesh=None,
+    method: str = "lbfgs",
+    max_iter: int = 100,
+    l1: float = 0.0,
+    l2: float = 0.0,
+    tol: float = 1e-6,
+    learning_rate: float = 0.1,
+    history: int = 10,
+    num_search_step: int = 40,
+    batch_size: int = 0,
+) -> OptimResult:
+    """Minimize ``psum(obj.local_loss)/N + l1·|w| + l2/2·|w|²`` over the mesh."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    method = method.lower()
+    if method not in _METHODS:
+        raise ValueError(f"unknown optimizer {method!r}; expected one of {_METHODS}")
+    if method == "owlqn" and l1 == 0.0:
+        method = "lbfgs"
+    if l1 > 0.0 and method == "lbfgs":
+        method = "owlqn"
+
+    mesh = mesh or default_mesh()
+    n = X.shape[0]
+    if sample_weights is None:
+        sample_weights = np.ones(n, dtype=np.float32)
+    Xs, mask = shard_rows(mesh, np.asarray(X, np.float32), with_mask=True)
+    ys = shard_rows(mesh, np.asarray(y, np.float32))
+    wts = shard_rows(mesh, np.asarray(sample_weights, np.float32))
+    w_init = jnp.zeros(obj.num_params, jnp.float32) if w0 is None else jnp.asarray(
+        w0, jnp.float32
+    )
+
+    m = history
+    axis = AXIS_DATA
+
+    def body(Xl, yl, maskl, wtl, w_init):
+        wt_eff = wtl * maskl  # zero out padded rows
+        total_w = jax.lax.psum(wt_eff.sum(), axis)
+
+        def value_and_grad(w):
+            l, g = jax.value_and_grad(obj.local_loss)(w, Xl, yl, wt_eff)
+            L = jax.lax.psum(l, axis) / total_w
+            G = jax.lax.psum(g, axis) / total_w
+            L = L + 0.5 * l2 * (w @ w)
+            G = G + l2 * w
+            return L, G
+
+        def losses_at(cands):
+            # batched local losses for all candidate weight vectors: one psum
+            local = jax.vmap(lambda w: obj.local_loss(w, Xl, yl, wt_eff))(cands)
+            L = jax.lax.psum(local, axis) / total_w
+            return L + 0.5 * l2 * jnp.sum(cands * cands, axis=1)
+
+        def l1_term(w):
+            return l1 * jnp.abs(w).sum() if l1 > 0 else 0.0
+
+        # ---------------- OWLQN pseudo-gradient ---------------------------
+        def pseudo_grad(w, g):
+            gp, gm = g + l1, g - l1
+            pg = jnp.where(w > 0, gp, jnp.where(w < 0, gm, 0.0))
+            at_zero = jnp.where(gp < 0, gp, jnp.where(gm > 0, gm, 0.0))
+            return jnp.where(w == 0, at_zero, pg)
+
+        # ---------------- L-BFGS direction (two-loop) ---------------------
+        def two_loop(g, S, Y, k):
+            def bw(i, carry):
+                q, alphas = carry
+                j = k - i
+                valid = j >= 0
+                slot = jnp.mod(j, m)
+                sy = jnp.maximum(S[slot] @ Y[slot], 1e-10)
+                a = (S[slot] @ q) / sy
+                q = jnp.where(valid, q - a * Y[slot], q)
+                alphas = alphas.at[slot].set(jnp.where(valid, a, 0.0))
+                return q, alphas
+
+            q, alphas = jax.lax.fori_loop(1, m + 1, bw, (g, jnp.zeros(m)))
+            last = jnp.mod(k - 1, m)
+            sy = S[last] @ Y[last]
+            yy = Y[last] @ Y[last]
+            gamma = jnp.where(k > 0, jnp.maximum(sy, 1e-10) / jnp.maximum(yy, 1e-10), 1.0)
+            r = gamma * q
+
+            def fw(i, r):
+                j = k - m + i
+                valid = j >= 0
+                slot = jnp.mod(j, m)
+                sy = jnp.maximum(S[slot] @ Y[slot], 1e-10)
+                beta = (Y[slot] @ r) / sy
+                return jnp.where(valid, r + (alphas[slot] - beta) * S[slot], r)
+
+            r = jax.lax.fori_loop(0, m, fw, r)
+            return -r
+
+        # ---------------- line search (vectorized CalcLosses) -------------
+        steps = jnp.power(0.5, jnp.arange(num_search_step, dtype=jnp.float32))
+
+        def line_search(w, d, loss, g, orthant=None):
+            cands = w[None, :] + steps[:, None] * d[None, :]
+            if orthant is not None:
+                cands = jnp.where(cands * orthant[None, :] > 0, cands, 0.0)
+            L = losses_at(cands)
+            if l1 > 0:
+                L = L + l1 * jnp.abs(cands).sum(axis=1)
+            base = loss + l1_term(w)
+            armijo = base + 1e-4 * steps * (g @ d)
+            ok = L <= armijo
+            # first satisfying candidate, else the smallest step
+            idx = jnp.where(ok.any(), jnp.argmax(ok), num_search_step - 1)
+            return cands[idx], L[idx] - (l1 * jnp.abs(cands[idx]).sum() if l1 > 0 else 0.0)
+
+        # ---------------- main loops by method -----------------------------
+        if method in ("lbfgs", "owlqn"):
+            loss0, g0 = value_and_grad(w_init)
+
+            def cond(c):
+                k, w, loss, g, S, Y, done = c
+                return jnp.logical_and(k < max_iter, jnp.logical_not(done))
+
+            def step(c):
+                k, w, loss, g, S, Y, done = c
+                eff_g = pseudo_grad(w, g) if method == "owlqn" else g
+                d = two_loop(eff_g, S, Y, k)
+                # ensure descent direction on the pseudo-gradient
+                descent = eff_g @ d
+                d = jnp.where(descent < 0, d, -eff_g)
+                if method == "owlqn":
+                    orthant = jnp.where(w != 0, jnp.sign(w), -jnp.sign(eff_g))
+                    d = jnp.where(d * -eff_g >= 0, d, 0.0)  # orthant-aligned dir
+                    w_new, loss_new = line_search(w, d, loss, eff_g, orthant)
+                else:
+                    w_new, loss_new = line_search(w, d, loss, eff_g)
+                _, g_new = value_and_grad(w_new)
+                slot = jnp.mod(k, m)
+                S2 = S.at[slot].set(w_new - w)
+                Y2 = Y.at[slot].set(g_new - g)
+                gnorm = jnp.linalg.norm(
+                    pseudo_grad(w_new, g_new) if method == "owlqn" else g_new
+                )
+                done = jnp.logical_or(
+                    gnorm < tol, jnp.abs(loss - loss_new) < tol * jnp.maximum(1.0, jnp.abs(loss))
+                )
+                return k + 1, w_new, loss_new, g_new, S2, Y2, done
+
+            dim = obj.num_params
+            init = (
+                jnp.asarray(0),
+                w_init,
+                loss0,
+                g0,
+                jnp.zeros((m, dim)),
+                jnp.zeros((m, dim)),
+                jnp.asarray(False),
+            )
+            k, w, loss, g, _, _, _ = jax.lax.while_loop(cond, step, init)
+            return w, loss, jnp.linalg.norm(g), k
+
+        if method == "gd":
+            loss0, g0 = value_and_grad(w_init)
+
+            def cond(c):
+                k, w, loss, g, done = c
+                return jnp.logical_and(k < max_iter, jnp.logical_not(done))
+
+            def step(c):
+                k, w, loss, g, done = c
+                w_new, loss_new = line_search(w, -learning_rate * g, loss, g)
+                _, g_new = value_and_grad(w_new)
+                done = jnp.logical_or(
+                    jnp.linalg.norm(g_new) < tol,
+                    jnp.abs(loss - loss_new) < tol * jnp.maximum(1.0, jnp.abs(loss)),
+                )
+                return k + 1, w_new, loss_new, g_new, done
+
+            k, w, loss, g, _ = jax.lax.while_loop(
+                cond, step, (jnp.asarray(0), w_init, loss0, g0, jnp.asarray(False))
+            )
+            return w, loss, jnp.linalg.norm(g), k
+
+        if method == "sgd":
+            rows = Xl.shape[0]
+            bs = batch_size if batch_size > 0 else max(1, rows // 8)
+
+            def step(k, w):
+                start = (k * bs) % jnp.maximum(rows - bs + 1, 1)
+                Xb = jax.lax.dynamic_slice_in_dim(Xl, start, bs, 0)
+                yb = jax.lax.dynamic_slice_in_dim(yl, start, bs, 0)
+                wtb = jax.lax.dynamic_slice_in_dim(wt_eff, start, bs, 0)
+                l, g = jax.value_and_grad(obj.local_loss)(w, Xb, yb, wtb)
+                bw = jax.lax.psum(wtb.sum(), axis)
+                G = jax.lax.psum(g, axis) / jnp.maximum(bw, 1e-10) + l2 * w
+                eta = learning_rate / jnp.sqrt(1.0 + k)
+                return w - eta * G
+
+            w = jax.lax.fori_loop(0, max_iter, step, w_init)
+            loss, g = value_and_grad(w)
+            return w, loss, jnp.linalg.norm(g), jnp.asarray(max_iter)
+
+        # newton
+        def hess(w):
+            Hl = jax.hessian(obj.local_loss)(w, Xl, yl, wt_eff)
+            H = jax.lax.psum(Hl, axis) / total_w
+            return H + l2 * jnp.eye(obj.num_params)
+
+        loss0, g0 = value_and_grad(w_init)
+
+        def cond(c):
+            k, w, loss, g, done = c
+            return jnp.logical_and(k < max_iter, jnp.logical_not(done))
+
+        def step(c):
+            k, w, loss, g, done = c
+            H = hess(w)
+            d = -jnp.linalg.solve(H + 1e-8 * jnp.eye(obj.num_params), g)
+            w_new, loss_new = line_search(w, d, loss, g)
+            _, g_new = value_and_grad(w_new)
+            done = jnp.logical_or(
+                jnp.linalg.norm(g_new) < tol,
+                jnp.abs(loss - loss_new) < tol * jnp.maximum(1.0, jnp.abs(loss)),
+            )
+            return k + 1, w_new, loss_new, g_new, done
+
+        k, w, loss, g, _ = jax.lax.while_loop(
+            cond, step, (jnp.asarray(0), w_init, loss0, g0, jnp.asarray(False))
+        )
+        return w, loss, jnp.linalg.norm(g), k
+
+    f = jax.jit(
+        jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P(axis), P(axis), P(axis), P(axis), P()),
+            out_specs=P(),
+            check_vma=False,
+        )
+    )
+    w, loss, gnorm, k = jax.device_get(f(Xs, ys, mask, wts, w_init))
+    return OptimResult(np.asarray(w), float(loss), float(gnorm), int(k))

@@ -10,3 +10,11 @@ from .mesh import (
     pad_to_multiple,
     replicated_sharding,
 )
+from .collectives import (
+    all_gather,
+    all_reduce,
+    broadcast_from,
+    ppermute_ring,
+    reduce_scatter,
+)
+from .comqueue import ComContext, IterativeComQueue, shard_rows

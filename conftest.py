@@ -1,0 +1,35 @@
+"""Root pytest bootstrap: re-exec with a CPU multi-device JAX environment.
+
+The TPU container boots every interpreter with an axon PJRT plugin already
+registered and jax imported (sitecustomize), so env flips inside this process
+are too late. At configure time we stop pytest's fd capture (so the child
+inherits the real stdout) and re-exec pytest with:
+
+- ``JAX_PLATFORMS=cpu`` + 8 virtual CPU devices — the reference's
+  MiniCluster-with-N-TaskManagers test strategy mapped to a virtual mesh
+  (reference: test_utils/.../LocalEnvFactoryImpl.java:20-41),
+- ``PALLAS_AXON_POOL_IPS=""`` — stops sitecustomize from registering the
+  axon TPU plugin in the child.
+"""
+
+import os
+import sys
+
+
+def pytest_configure(config):
+    if os.environ.get("ALINK_TPU_TEST_ENV") == "1":
+        return
+    os.environ["ALINK_TPU_TEST_ENV"] = "1"
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    capman = config.pluginmanager.getplugin("capturemanager")
+    if capman is not None:
+        capman.stop_global_capturing()
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os.execv(sys.executable, [sys.executable, "-m", "pytest", *sys.argv[1:]])

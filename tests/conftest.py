@@ -1,17 +1,2 @@
-"""Test harness configuration.
-
-Mirrors the reference's multi-node-without-a-cluster strategy
-(reference: test_utils/src/main/java/com/alibaba/alink/testutil/envfactory/impl/
-LocalEnvFactoryImpl.java:20-41 — a Flink MiniCluster with N TaskManagers): here
-we force JAX onto the host CPU platform with 8 virtual devices so every
-distributed test exercises real mesh sharding + collectives in-process.
-"""
-
-import os
-
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+"""Test-dir conftest. The CPU multi-device environment bootstrap lives in the
+repo-root conftest.py (re-exec with JAX_PLATFORMS=cpu + 8 virtual devices)."""
