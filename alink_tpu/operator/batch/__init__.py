@@ -75,3 +75,38 @@ class JoinBatchOp(JoinOp, BatchOperator):
 
 class SampleBatchOp(SampleOp, BatchOperator):
     pass
+
+
+from .utils import MapBatchOp, ModelMapBatchOp
+from .clustering import (
+    KMeansModelInfoBatchOp,
+    KMeansPredictBatchOp,
+    KMeansTrainBatchOp,
+)
+from .linear import (
+    LassoRegPredictBatchOp,
+    LassoRegTrainBatchOp,
+    LinearRegPredictBatchOp,
+    LinearRegTrainBatchOp,
+    LinearSvmPredictBatchOp,
+    LinearSvmTrainBatchOp,
+    LogisticRegressionPredictBatchOp,
+    LogisticRegressionTrainBatchOp,
+    RidgeRegPredictBatchOp,
+    RidgeRegTrainBatchOp,
+    SoftmaxPredictBatchOp,
+    SoftmaxTrainBatchOp,
+)
+from .evaluation import (
+    EvalBinaryClassBatchOp,
+    EvalClusterBatchOp,
+    EvalMultiClassBatchOp,
+    EvalRegressionBatchOp,
+)
+from .feature import (
+    MinMaxScalerPredictBatchOp,
+    MinMaxScalerTrainBatchOp,
+    StandardScalerPredictBatchOp,
+    StandardScalerTrainBatchOp,
+    VectorAssemblerBatchOp,
+)

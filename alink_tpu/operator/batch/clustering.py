@@ -1,0 +1,248 @@
+"""Clustering operators — KMeans family.
+
+Capability parity with the reference (reference:
+core/src/main/java/com/alibaba/alink/operator/batch/clustering/
+KMeansTrainBatchOp.java:59 — IterativeComQueue + AllReduce at :104-110;
+KMeansPredictBatchOp + operator/common/clustering/kmeans/KMeansModelMapper.java;
+KMeansModelInfoBatchOp).
+
+TPU-first: Lloyd's iteration is ONE compiled XLA program — a ``lax.while_loop``
+inside ``shard_map``; assignments are a (n_local, k) distance matrix and the
+cluster sums are a single (k, n_local)×(n_local, d) matmul on the MXU, with one
+``psum`` per iteration for (sums, counts). k-means++ seeding runs host-side on
+a subsample (the reference's random-K init is also host-side).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+import numpy as np
+
+from ...common.exceptions import AkIllegalDataException
+from ...common.model import model_to_table, table_to_model
+from ...common.mtable import AlinkTypes, MTable
+from ...common.params import InValidator, MinValidator, ParamInfo
+from ...mapper import (
+    HasFeatureCols,
+    HasPredictionCol,
+    HasPredictionDetailCol,
+    HasReservedCols,
+    HasVectorCol,
+    RichModelMapper,
+    get_feature_block,
+)
+from ...parallel.comqueue import shard_rows
+from ...parallel.mesh import AXIS_DATA, default_mesh
+from .base import BatchOperator
+from .utils import ModelMapBatchOp
+
+
+class HasKMeansParams(HasVectorCol, HasFeatureCols):
+    K = ParamInfo("k", int, default=2, validator=MinValidator(2))
+    MAX_ITER = ParamInfo("maxIter", int, default=50, validator=MinValidator(1))
+    EPSILON = ParamInfo("epsilon", float, default=1e-4)
+    DISTANCE_TYPE = ParamInfo(
+        "distanceType", str, default="EUCLIDEAN",
+        validator=InValidator("EUCLIDEAN", "COSINE"),
+    )
+    RANDOM_SEED = ParamInfo("randomSeed", int, default=0, aliases=("seed",))
+
+
+def _kmeanspp_init(X: np.ndarray, k: int, seed: int) -> np.ndarray:
+    """Greedy k-means++ seeding on (a subsample of) the data, host-side:
+    each step draws 2+log2(k) candidates ∝ d² and keeps the one minimizing
+    the resulting potential — robust to unlucky single draws."""
+    rng = np.random.default_rng(seed)
+    n = X.shape[0]
+    if n > 10000:
+        X = X[rng.choice(n, 10000, replace=False)]
+        n = X.shape[0]
+    n_cand = 2 + int(np.log2(max(k, 2)))
+    centers = [X[rng.integers(n)]]
+    d2 = ((X - centers[0]) ** 2).sum(axis=1)
+    for _ in range(1, k):
+        total = d2.sum()
+        if total <= 0:
+            centers.append(X[rng.integers(n)])
+            continue
+        cand_idx = np.searchsorted(
+            np.cumsum(d2 / total), rng.random(n_cand)
+        ).clip(0, n - 1)
+        # candidate minimizing the new total potential wins
+        cand_d2 = np.minimum(
+            d2[None, :], ((X[None, :, :] - X[cand_idx, None, :]) ** 2).sum(axis=2)
+        )
+        best = int(np.argmin(cand_d2.sum(axis=1)))
+        centers.append(X[cand_idx[best]])
+        d2 = cand_d2[best]
+    return np.stack(centers).astype(np.float32)
+
+
+def _lloyd(mesh, X: np.ndarray, k: int, max_iter: int, tol: float,
+           cosine: bool, seed: int):
+    """The compiled Lloyd loop. Returns (centroids, num_iters, inertia)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    if cosine:
+        X = X / np.maximum(np.linalg.norm(X, axis=1, keepdims=True), 1e-12)
+    init = _kmeanspp_init(X, k, seed)
+    Xs, mask = shard_rows(mesh, X, with_mask=True)
+    axis = AXIS_DATA
+
+    def body(Xl, maskl, c0):
+        def assign(c, Xl):
+            if cosine:
+                cn = c / jnp.maximum(jnp.linalg.norm(c, axis=1, keepdims=True), 1e-12)
+                d = 1.0 - Xl @ cn.T
+            else:
+                d = (
+                    (Xl * Xl).sum(1, keepdims=True)
+                    - 2.0 * (Xl @ c.T)
+                    + (c * c).sum(1)[None, :]
+                )
+            return d
+
+        def cond(carry):
+            i, c, shift, _ = carry
+            return jnp.logical_and(i < max_iter, shift > tol)
+
+        def step(carry):
+            i, c, _, _ = carry
+            d = assign(c, Xl)
+            a = jnp.argmin(d, axis=1)
+            onehot = jax.nn.one_hot(a, k, dtype=Xl.dtype) * maskl[:, None]
+            sums = jax.lax.psum(onehot.T @ Xl, axis)        # (k, d) matmul on MXU
+            counts = jax.lax.psum(onehot.sum(0), axis)      # (k,)
+            c_new = jnp.where(counts[:, None] > 0, sums / counts[:, None], c)
+            if cosine:
+                c_new = c_new / jnp.maximum(
+                    jnp.linalg.norm(c_new, axis=1, keepdims=True), 1e-12
+                )
+            shift = jnp.abs(c_new - c).max()
+            inertia = jax.lax.psum(
+                (jnp.min(d, axis=1) * maskl).sum(), axis
+            )
+            return i + 1, c_new, shift, inertia
+
+        i, c, _, inertia = jax.lax.while_loop(
+            cond, step, (jnp.asarray(0), c0, jnp.asarray(jnp.inf), jnp.asarray(0.0))
+        )
+        return c, i, inertia
+
+    f = jax.jit(
+        jax.shard_map(
+            body, mesh=mesh, in_specs=(P(axis), P(axis), P()), out_specs=P(),
+            check_vma=False,
+        )
+    )
+    c, iters, inertia = jax.device_get(f(Xs, mask, jnp.asarray(init)))
+    return np.asarray(c), int(iters), float(inertia)
+
+
+class KMeansTrainBatchOp(BatchOperator, HasKMeansParams):
+    """(reference: operator/batch/clustering/KMeansTrainBatchOp.java)"""
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    def _execute_impl(self, t: MTable) -> MTable:
+        k = self.get(self.K)
+        X = get_feature_block(t, self).astype(np.float32)
+        if X.shape[0] < k:
+            raise AkIllegalDataException(
+                f"k={k} but only {X.shape[0]} rows of data"
+            )
+        mesh = self.env.mesh
+        cosine = self.get(self.DISTANCE_TYPE) == "COSINE"
+        c, iters, inertia = _lloyd(
+            mesh, X, k, self.get(self.MAX_ITER), self.get(self.EPSILON),
+            cosine, self.get(self.RANDOM_SEED),
+        )
+        meta = {
+            "modelName": "KMeansModel",
+            "k": k,
+            "distanceType": self.get(self.DISTANCE_TYPE),
+            "vectorCol": self.get(HasVectorCol.VECTOR_COL),
+            "featureCols": self.get(HasFeatureCols.FEATURE_COLS),
+            "numIters": iters,
+            "inertia": inertia,
+            "dim": int(c.shape[1]),
+        }
+        return model_to_table(meta, {"centroids": c})
+
+
+class KMeansModelMapper(RichModelMapper):
+    """(reference: operator/common/clustering/kmeans/KMeansModelMapper.java)"""
+
+    def load_model(self, model: MTable):
+        import jax
+        import jax.numpy as jnp
+
+        self.meta, arrays = table_to_model(model)
+        self.centroids = arrays["centroids"].astype(np.float32)
+        cosine = self.meta.get("distanceType") == "COSINE"
+
+        def assign(X, c):
+            if cosine:
+                Xn = X / jnp.maximum(jnp.linalg.norm(X, axis=1, keepdims=True), 1e-12)
+                cn = c / jnp.maximum(jnp.linalg.norm(c, axis=1, keepdims=True), 1e-12)
+                d = 1.0 - Xn @ cn.T
+            else:
+                d = (
+                    (X * X).sum(1, keepdims=True) - 2.0 * (X @ c.T)
+                    + (c * c).sum(1)[None, :]
+                )
+            return jnp.argmin(d, axis=1), d
+
+        # compile once at model load; reused across every predict call
+        self._assign_jit = jax.jit(assign)
+        return self
+
+    def _pred_type(self) -> str:
+        return AlinkTypes.LONG
+
+    def predict_block(self, t: MTable):
+        import jax
+
+        from .linear import _merge_feature_params
+
+        X = get_feature_block(
+            t, _merge_feature_params(self.get_params(), self.meta),
+            vector_size=self.meta["dim"],
+        ).astype(np.float32)
+        a, d = jax.device_get(self._assign_jit(X, self.centroids))
+        detail = None
+        if self.get(HasPredictionDetailCol.PREDICTION_DETAIL_COL):
+            detail = np.asarray(
+                [json.dumps({str(i): float(x) for i, x in enumerate(row)})
+                 for row in d], dtype=object,
+            )
+        return a.astype(np.int64), AlinkTypes.LONG, detail
+
+
+class KMeansPredictBatchOp(ModelMapBatchOp, HasPredictionCol,
+                           HasPredictionDetailCol, HasReservedCols):
+    """(reference: operator/batch/clustering/KMeansPredictBatchOp.java)"""
+
+    mapper_cls = KMeansModelMapper
+
+
+class KMeansModelInfoBatchOp(BatchOperator):
+    """Cluster sizes/centroids view (reference: KMeansModelInfoBatchOp.java)."""
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    def _execute_impl(self, model: MTable) -> MTable:
+        meta, arrays = table_to_model(model)
+        c = arrays["centroids"]
+        return MTable(
+            {
+                "clusterId": np.arange(c.shape[0], dtype=np.int64),
+                "center": [" ".join(format(v, "g") for v in row) for row in c],
+            }
+        )

@@ -1,0 +1,219 @@
+"""Evaluation operators.
+
+Capability parity with the reference's evaluation suite (reference:
+core/src/main/java/com/alibaba/alink/operator/common/evaluation/ — 6.4k LoC;
+operator/batch/evaluation/EvalBinaryClassBatchOp.java, EvalMultiClassBatchOp.java,
+EvalRegressionBatchOp.java, EvalClusterBatchOp.java; metrics containers
+BinaryClassMetrics etc.).
+
+Metrics are columnar numpy reductions; each op emits a one-row table of metric
+columns plus a JSON blob, and ``collect_metrics()`` returns a dict-like
+accessor mirroring the reference's ``collectMetrics()``.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ...common.exceptions import AkIllegalDataException
+from ...common.mtable import AlinkTypes, MTable
+from ...common.params import ParamInfo
+from .base import BatchOperator
+
+
+class Metrics(dict):
+    """Dict with attribute access: m.auc / m["auc"]."""
+
+    def __getattr__(self, k):
+        try:
+            return self[k]
+        except KeyError:
+            raise AttributeError(k)
+
+
+def _metrics_table(metrics: Dict) -> MTable:
+    flat = {k: v for k, v in metrics.items() if isinstance(v, (int, float, str))}
+    cols = {k: [v] for k, v in flat.items()}
+    cols["Data"] = [json.dumps(metrics, default=lambda o: np.asarray(o).tolist())]
+    return MTable(cols)
+
+
+class BaseEvalBatchOp(BatchOperator):
+    _min_inputs = 1
+    _max_inputs = 1
+
+    def collect_metrics(self) -> Metrics:
+        t = self.collect()
+        return Metrics(json.loads(t.col("Data")[0]))
+
+
+class EvalBinaryClassBatchOp(BaseEvalBatchOp):
+    """AUC / KS / accuracy / precision / recall / F1 / logloss
+    (reference: EvalBinaryClassBatchOp.java; metrics in
+    common/evaluation/BinaryClassMetrics.java)."""
+
+    LABEL_COL = ParamInfo("labelCol", str, optional=False)
+    PREDICTION_DETAIL_COL = ParamInfo("predictionDetailCol", str, optional=False)
+    POS_LABEL_VAL_STR = ParamInfo("positiveLabelValueString", str)
+
+    def _execute_impl(self, t: MTable) -> MTable:
+        y = np.asarray([str(v) for v in t.col(self.get(self.LABEL_COL))])
+        details = [json.loads(d) for d in t.col(self.get(self.PREDICTION_DETAIL_COL))]
+        labels = sorted({k for d in details for k in d})
+        if len(labels) != 2:
+            raise AkIllegalDataException(f"binary eval needs 2 labels, got {labels}")
+        pos = self.get(self.POS_LABEL_VAL_STR) or labels[0]
+        p = np.asarray([d.get(pos, 0.0) for d in details], np.float64)
+        yb = (y == pos).astype(np.int64)
+
+        # AUC by rank statistic (ties get average rank)
+        order = np.argsort(p, kind="stable")
+        ranks = np.empty_like(p)
+        sp = p[order]
+        # average ranks over ties
+        uniq, inv, counts = np.unique(sp, return_inverse=True, return_counts=True)
+        cum = np.cumsum(counts)
+        avg_rank = (cum - (counts - 1) / 2.0)
+        ranks[order] = avg_rank[inv]
+        n_pos, n_neg = yb.sum(), (1 - yb).sum()
+        if n_pos == 0 or n_neg == 0:
+            auc = float("nan")
+        else:
+            auc = (ranks[yb == 1].sum() - n_pos * (n_pos + 1) / 2) / (n_pos * n_neg)
+
+        pred = (p >= 0.5).astype(np.int64)
+        tp = int(((pred == 1) & (yb == 1)).sum())
+        fp = int(((pred == 1) & (yb == 0)).sum())
+        tn = int(((pred == 0) & (yb == 0)).sum())
+        fn = int(((pred == 0) & (yb == 1)).sum())
+        precision = tp / (tp + fp) if tp + fp else 0.0
+        recall = tp / (tp + fn) if tp + fn else 0.0
+        f1 = 2 * precision * recall / (precision + recall) if precision + recall else 0.0
+        eps = 1e-15
+        logloss = float(-(yb * np.log(p + eps) + (1 - yb) * np.log(1 - p + eps)).mean())
+
+        # KS: max |TPR - FPR| over thresholds
+        thr_order = np.argsort(-p, kind="stable")
+        tps = np.cumsum(yb[thr_order])
+        fps = np.cumsum(1 - yb[thr_order])
+        ks = float(np.max(np.abs(tps / max(n_pos, 1) - fps / max(n_neg, 1))))
+
+        return _metrics_table(
+            {
+                "AUC": float(auc),
+                "KS": ks,
+                "Accuracy": (tp + tn) / len(y),
+                "Precision": precision,
+                "Recall": recall,
+                "F1": f1,
+                "LogLoss": logloss,
+                "PositiveLabel": pos,
+                "ConfusionMatrix": [[tp, fp], [fn, tn]],
+            }
+        )
+
+
+class EvalMultiClassBatchOp(BaseEvalBatchOp):
+    """(reference: EvalMultiClassBatchOp.java)"""
+
+    LABEL_COL = ParamInfo("labelCol", str, optional=False)
+    PREDICTION_COL = ParamInfo("predictionCol", str, optional=False)
+
+    def _execute_impl(self, t: MTable) -> MTable:
+        y = np.asarray([str(v) for v in t.col(self.get(self.LABEL_COL))])
+        pred = np.asarray([str(v) for v in t.col(self.get(self.PREDICTION_COL))])
+        labels = sorted(set(y) | set(pred))
+        k = len(labels)
+        idx = {v: i for i, v in enumerate(labels)}
+        cm = np.zeros((k, k), np.int64)
+        for yi, pi in zip(y, pred):
+            cm[idx[yi], idx[pi]] += 1
+        acc = float(np.trace(cm)) / len(y)
+        prec, rec, f1s = [], [], []
+        for i in range(k):
+            tp = cm[i, i]
+            p_ = tp / cm[:, i].sum() if cm[:, i].sum() else 0.0
+            r_ = tp / cm[i, :].sum() if cm[i, :].sum() else 0.0
+            prec.append(p_)
+            rec.append(r_)
+            f1s.append(2 * p_ * r_ / (p_ + r_) if p_ + r_ else 0.0)
+        return _metrics_table(
+            {
+                "Accuracy": acc,
+                "MacroPrecision": float(np.mean(prec)),
+                "MacroRecall": float(np.mean(rec)),
+                "MacroF1": float(np.mean(f1s)),
+                "Labels": labels,
+                "ConfusionMatrix": cm.tolist(),
+            }
+        )
+
+
+class EvalRegressionBatchOp(BaseEvalBatchOp):
+    """(reference: EvalRegressionBatchOp.java)"""
+
+    LABEL_COL = ParamInfo("labelCol", str, optional=False)
+    PREDICTION_COL = ParamInfo("predictionCol", str, optional=False)
+
+    def _execute_impl(self, t: MTable) -> MTable:
+        y = np.asarray(t.col(self.get(self.LABEL_COL)), np.float64)
+        p = np.asarray(t.col(self.get(self.PREDICTION_COL)), np.float64)
+        err = y - p
+        mse = float((err**2).mean())
+        mae = float(np.abs(err).mean())
+        sst = float(((y - y.mean()) ** 2).sum())
+        r2 = 1.0 - float((err**2).sum()) / sst if sst > 0 else float("nan")
+        return _metrics_table(
+            {
+                "MSE": mse,
+                "RMSE": float(np.sqrt(mse)),
+                "MAE": mae,
+                "R2": r2,
+                "SSE": float((err**2).sum()),
+                "Count": int(len(y)),
+            }
+        )
+
+
+class EvalClusterBatchOp(BaseEvalBatchOp):
+    """Compactness / Calinski-Harabasz / silhouette-approx (reference:
+    EvalClusterBatchOp.java with common/evaluation/ClusterMetrics.java)."""
+
+    PREDICTION_COL = ParamInfo("predictionCol", str, optional=False)
+    VECTOR_COL = ParamInfo("vectorCol", str)
+    FEATURE_COLS = ParamInfo("featureCols", list)
+    LABEL_COL = ParamInfo("labelCol", str)
+
+    def _execute_impl(self, t: MTable) -> MTable:
+        from ...mapper import get_feature_block
+
+        X = get_feature_block(t.drop([self.get(self.PREDICTION_COL)]), self)
+        a = np.asarray(t.col(self.get(self.PREDICTION_COL)))
+        ids = sorted(set(a.tolist()))
+        k = len(ids)
+        centers = np.stack([X[a == c].mean(axis=0) for c in ids])
+        grand = X.mean(axis=0)
+        ssw = sum(((X[a == c] - centers[i]) ** 2).sum() for i, c in enumerate(ids))
+        ssb = sum((a == c).sum() * ((centers[i] - grand) ** 2).sum()
+                  for i, c in enumerate(ids))
+        n = X.shape[0]
+        ch = float((ssb / max(k - 1, 1)) / (ssw / max(n - k, 1))) if ssw > 0 else float("nan")
+        metrics = {
+            "K": k,
+            "Count": int(n),
+            "Compactness": float(ssw / n),
+            "CalinskiHarabasz": ch,
+            "ClusterSizes": [int((a == c).sum()) for c in ids],
+        }
+        if self.get(self.LABEL_COL):
+            # purity against ground-truth labels
+            y = np.asarray([str(v) for v in t.col(self.get(self.LABEL_COL))])
+            purity = sum(
+                max(np.sum(y[a == c] == lab) for lab in set(y[a == c]))
+                for c in ids
+            ) / n
+            metrics["Purity"] = float(purity)
+        return _metrics_table(metrics)

@@ -1,0 +1,53 @@
+"""Generic mapper-wrapping batch operators.
+
+Capability parity with reference operator/batch/utils/ModelMapBatchOp.java:62
+(model broadcast at :64,175) and MapBatchOp.java. The model "broadcast" is
+trivial here — the mapper loads the model MTable once and the batched jit
+kernel is replicated by XLA as needed.
+"""
+
+from __future__ import annotations
+
+from typing import Type
+
+from ...common.mtable import MTable
+from ..base import AlgoOperator
+from .base import BatchOperator
+
+
+class MapBatchOp(BatchOperator):
+    """Wrap a stateless Mapper class as an operator."""
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    mapper_cls: Type = None
+
+    def __init__(self, params=None, **kwargs):
+        super().__init__(params, **kwargs)
+
+    def _make_mapper(self, data_schema):
+        return self.mapper_cls(data_schema, self.get_params())
+
+    def _execute_impl(self, t: MTable) -> MTable:
+        return self._make_mapper(t.schema).map_table(t)
+
+
+class ModelMapBatchOp(BatchOperator):
+    """Wrap a ModelMapper class; ``link_from(model_op, data_op)``."""
+
+    _min_inputs = 2
+    _max_inputs = 2
+
+    mapper_cls: Type = None
+
+    def __init__(self, params=None, **kwargs):
+        super().__init__(params, **kwargs)
+
+    def _make_mapper(self, model_schema, data_schema):
+        return self.mapper_cls(model_schema, data_schema, self.get_params())
+
+    def _execute_impl(self, model: MTable, t: MTable) -> MTable:
+        mapper = self._make_mapper(model.schema, t.schema)
+        mapper.load_model(model)
+        return mapper.map_table(t)

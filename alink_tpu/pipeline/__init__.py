@@ -1,0 +1,19 @@
+from .base import EstimatorBase, ModelBase, PipelineStageBase, TransformerBase
+from .estimators import (
+    KMeans,
+    KMeansModel,
+    Lasso,
+    LinearModel,
+    LinearRegression,
+    LinearSvm,
+    LogisticRegression,
+    MinMaxScaler,
+    MinMaxScalerModel,
+    Ridge,
+    Softmax,
+    StandardScaler,
+    StandardScalerModel,
+    VectorAssembler,
+)
+from .local_predictor import LocalPredictor
+from .pipeline import Pipeline, PipelineModel

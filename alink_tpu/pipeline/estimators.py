@@ -1,0 +1,97 @@
+"""Concrete pipeline stages bound to their train/predict/map operators.
+
+Capability parity with the reference's generated pipeline classes (reference:
+pipeline/clustering/KMeans.java, pipeline/classification/LogisticRegression.java,
+LinearSvm.java, Softmax.java, pipeline/regression/LinearRegression.java /
+Ridge / Lasso, pipeline/dataproc/StandardScaler.java, MinMaxScaler.java,
+pipeline/dataproc/vector/VectorAssembler.java — thin Trainer/Transformer
+wrappers over the corresponding BatchOps).
+"""
+
+from __future__ import annotations
+
+from ..operator.batch import clustering as _clu
+from ..operator.batch import feature as _feat
+from ..operator.batch import linear as _lin
+from .base import EstimatorBase, ModelBase, TransformerBase
+
+
+# -- clustering --------------------------------------------------------------
+class KMeansModel(ModelBase):
+    _predict_op_cls = _clu.KMeansPredictBatchOp
+
+
+class KMeans(EstimatorBase, _clu.HasKMeansParams):
+    _train_op_cls = _clu.KMeansTrainBatchOp
+    _model_cls = KMeansModel
+    # predict-side params also accepted on the estimator
+    PREDICTION_COL = _clu.HasPredictionCol.PREDICTION_COL
+    PREDICTION_DETAIL_COL = _clu.HasPredictionDetailCol.PREDICTION_DETAIL_COL
+    RESERVED_COLS = _clu.HasReservedCols.RESERVED_COLS
+
+
+# -- linear models -----------------------------------------------------------
+class LinearModel(ModelBase):
+    _predict_op_cls = _lin.LinearModelPredictOp
+
+
+class _LinearEstimator(EstimatorBase, _lin.HasLinearTrainParams):
+    _model_cls = LinearModel
+    PREDICTION_COL = _lin.HasPredictionCol.PREDICTION_COL
+    PREDICTION_DETAIL_COL = _lin.HasPredictionDetailCol.PREDICTION_DETAIL_COL
+    RESERVED_COLS = _lin.HasReservedCols.RESERVED_COLS
+
+
+class LogisticRegression(_LinearEstimator):
+    _train_op_cls = _lin.LogisticRegressionTrainBatchOp
+
+
+class LinearSvm(_LinearEstimator):
+    _train_op_cls = _lin.LinearSvmTrainBatchOp
+
+
+class LinearRegression(_LinearEstimator):
+    _train_op_cls = _lin.LinearRegTrainBatchOp
+
+
+class Ridge(_LinearEstimator):
+    _train_op_cls = _lin.RidgeRegTrainBatchOp
+    LAMBDA = _lin.RidgeRegTrainBatchOp.LAMBDA
+
+
+class Lasso(_LinearEstimator):
+    _train_op_cls = _lin.LassoRegTrainBatchOp
+    LAMBDA = _lin.LassoRegTrainBatchOp.LAMBDA
+
+
+class Softmax(_LinearEstimator):
+    _train_op_cls = _lin.SoftmaxTrainBatchOp
+
+
+# -- feature engineering -----------------------------------------------------
+class StandardScalerModel(ModelBase):
+    _predict_op_cls = _feat.StandardScalerPredictBatchOp
+
+
+class StandardScaler(EstimatorBase, _feat.HasSelectedCols):
+    _train_op_cls = _feat.StandardScalerTrainBatchOp
+    _model_cls = StandardScalerModel
+    WITH_MEAN = _feat.StandardScalerTrainBatchOp.WITH_MEAN
+    WITH_STD = _feat.StandardScalerTrainBatchOp.WITH_STD
+
+
+class MinMaxScalerModel(ModelBase):
+    _predict_op_cls = _feat.MinMaxScalerPredictBatchOp
+
+
+class MinMaxScaler(EstimatorBase, _feat.HasSelectedCols):
+    _train_op_cls = _feat.MinMaxScalerTrainBatchOp
+    _model_cls = MinMaxScalerModel
+    MIN = _feat.MinMaxScalerTrainBatchOp.MIN
+    MAX = _feat.MinMaxScalerTrainBatchOp.MAX
+
+
+class VectorAssembler(TransformerBase, _feat.HasSelectedCols):
+    _map_op_cls = _feat.VectorAssemblerBatchOp
+    OUTPUT_COL = _feat.HasOutputCol.OUTPUT_COL
+    RESERVED_COLS = _feat.HasReservedCols.RESERVED_COLS

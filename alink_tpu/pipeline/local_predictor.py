@@ -1,0 +1,39 @@
+"""LocalPredictor — embedded row/batch serving without the DAG layer.
+
+Capability parity with reference pipeline/LocalPredictor.java:25-138 (embeds a
+MapperChain built from a saved pipeline model for in-process serving) and
+LocalPredictorLoader. Batched ``predict_table`` is the TPU-native hot path;
+``predict_row`` serves single requests through the same jit kernels.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from ..common.exceptions import AkIllegalArgumentException
+from ..common.mtable import MTable, TableSchema
+from .base import ModelBase, TransformerBase
+from .pipeline import PipelineModel
+
+
+class LocalPredictor:
+    def __init__(self, model: "PipelineModel | str", input_schema: "TableSchema | str"):
+        if isinstance(model, str):
+            model = PipelineModel.load(model)
+        self.pipeline_model = model
+        self.input_schema = (
+            TableSchema.parse(input_schema) if isinstance(input_schema, str)
+            else input_schema
+        )
+
+    def predict_table(self, t: MTable) -> MTable:
+        op = self.pipeline_model.transform(t)
+        return op.collect()
+
+    def predict_row(self, row: Sequence):
+        t = MTable.from_rows([row], self.input_schema)
+        return self.predict_table(t).get_row(0)
+
+    def get_output_schema(self) -> TableSchema:
+        probe = MTable.from_rows([], self.input_schema)
+        return self.pipeline_model.transform(probe).collect().schema

@@ -1,0 +1,260 @@
+"""Hyper-parameter tuning: grid/random search with CV or train/test split.
+
+Capability parity with the reference's tuning package (reference:
+core/src/main/java/com/alibaba/alink/pipeline/tuning/ — 3.5k LoC:
+GridSearchCV.java, GridSearchTVSplit.java, RandomSearchCV.java, ParamGrid.java,
+ParamDist.java, BinaryClassificationTuningEvaluator.java,
+RegressionTuningEvaluator.java, MultiClassClassificationTuningEvaluator.java,
+ClusterTuningEvaluator.java; BaseTuning.findBest / kFoldCv).
+
+Candidates are embarrassingly parallel over shared CV folds; evaluation reuses
+the Eval*BatchOp metric ops.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..common.exceptions import AkIllegalArgumentException
+from ..common.mtable import MTable
+from ..common.params import ParamInfo
+from ..operator.batch.base import TableSourceBatchOp
+from ..operator.batch.evaluation import (
+    EvalBinaryClassBatchOp,
+    EvalClusterBatchOp,
+    EvalMultiClassBatchOp,
+    EvalRegressionBatchOp,
+)
+from .base import EstimatorBase, PipelineStageBase
+from .pipeline import Pipeline, PipelineModel
+
+
+class ParamGrid:
+    """(reference: pipeline/tuning/ParamGrid.java)"""
+
+    def __init__(self):
+        self._items: List[Tuple[PipelineStageBase, ParamInfo, Sequence]] = []
+
+    def add_grid(self, stage: PipelineStageBase, info: "ParamInfo | str", values):
+        if isinstance(info, str):
+            resolved = type(stage)._resolve_info(info)
+            if resolved is None:
+                raise AkIllegalArgumentException(
+                    f"{type(stage).__name__} has no param {info!r}"
+                )
+            info = resolved
+        self._items.append((stage, info, list(values)))
+        return self
+
+    def candidates(self):
+        if not self._items:
+            return [()]
+        value_lists = [vals for _, _, vals in self._items]
+        combos = []
+        for values in itertools.product(*value_lists):
+            combos.append(
+                tuple((stage, info, v)
+                      for (stage, info, _), v in zip(self._items, values))
+            )
+        return combos
+
+
+class ParamDist:
+    """Random distributions (reference: pipeline/tuning/ParamDist.java)."""
+
+    def __init__(self):
+        self._items: List[Tuple[PipelineStageBase, ParamInfo, Callable]] = []
+
+    def add_dist(self, stage, info: "ParamInfo | str", sampler: "Callable | Sequence"):
+        if isinstance(info, str):
+            resolved = type(stage)._resolve_info(info)
+            if resolved is None:
+                raise AkIllegalArgumentException(
+                    f"{type(stage).__name__} has no param {info!r}"
+                )
+            info = resolved
+        if not callable(sampler):
+            choices = list(sampler)
+
+            def sampler(rng, _c=choices):
+                return _c[rng.integers(len(_c))]
+
+        self._items.append((stage, info, sampler))
+        return self
+
+    def sample(self, n: int, seed: int = 0):
+        rng = np.random.default_rng(seed)
+        return [
+            tuple((stage, info, sampler(rng)) for stage, info, sampler in self._items)
+            for _ in range(n)
+        ]
+
+
+class TuningEvaluator:
+    """metric extraction wrapper; larger_is_better decides argbest."""
+
+    eval_cls = None
+    metric_name: str = None
+    larger_is_better = True
+
+    def __init__(self, **eval_params):
+        self.eval_params = eval_params
+        metric = eval_params.pop("tuningMetric", None)
+        if metric:
+            self.metric_name = metric
+
+    def evaluate(self, predicted: MTable) -> float:
+        op = self.eval_cls(**self.eval_params).link_from(TableSourceBatchOp(predicted))
+        return float(op.collect_metrics()[self.metric_name])
+
+
+class BinaryClassificationTuningEvaluator(TuningEvaluator):
+    eval_cls = EvalBinaryClassBatchOp
+    metric_name = "AUC"
+
+
+class MultiClassClassificationTuningEvaluator(TuningEvaluator):
+    eval_cls = EvalMultiClassBatchOp
+    metric_name = "Accuracy"
+
+
+class RegressionTuningEvaluator(TuningEvaluator):
+    eval_cls = EvalRegressionBatchOp
+    metric_name = "RMSE"
+    larger_is_better = False
+
+
+class ClusterTuningEvaluator(TuningEvaluator):
+    eval_cls = EvalClusterBatchOp
+    metric_name = "CalinskiHarabasz"
+
+
+class TuningResult:
+    def __init__(self, best_model, best_params, reports):
+        self.best_model: PipelineModel = best_model
+        self.best_params = best_params
+        self.reports: List[Dict[str, Any]] = reports
+
+    def transform(self, data):
+        return self.best_model.transform(data)
+
+
+class _BaseSearch:
+    def __init__(self, estimator, evaluator: TuningEvaluator, num_folds: int = 3,
+                 train_ratio: Optional[float] = None, seed: int = 0):
+        self.estimator = estimator
+        self.evaluator = evaluator
+        self.num_folds = num_folds
+        self.train_ratio = train_ratio
+        self.seed = seed
+
+    def _candidates(self):
+        raise NotImplementedError
+
+    def fit(self, data) -> TuningResult:
+        t = data.collect() if not isinstance(data, MTable) else data
+        reports = []
+        best_score, best_combo = None, None
+        for combo in self._candidates():
+            for stage, info, v in combo:
+                stage.set(info, v)
+            scores = [self._score_split(t, tr, te) for tr, te in self._splits(t)]
+            score = float(np.mean(scores))
+            reports.append(
+                {
+                    "params": {f"{type(s).__name__}.{i.name}": v for s, i, v in combo},
+                    "score": score,
+                }
+            )
+            if best_score is None or (
+                score > best_score if self.evaluator.larger_is_better else score < best_score
+            ):
+                best_score, best_combo = score, combo
+        for stage, info, v in best_combo:
+            stage.set(info, v)
+        best_model = self._fit_full(t)
+        best_params = {f"{type(s).__name__}.{i.name}": v for s, i, v in best_combo}
+        return TuningResult(best_model, best_params, reports)
+
+    def _fit_full(self, t: MTable) -> PipelineModel:
+        est = self.estimator
+        if isinstance(est, Pipeline):
+            return est.fit(t)
+        model = est.fit(t)
+        return PipelineModel(model)
+
+    def _splits(self, t: MTable):
+        n = t.num_rows
+        rng = np.random.default_rng(self.seed)
+        perm = rng.permutation(n)
+        if self.train_ratio is not None:
+            cut = int(n * self.train_ratio)
+            yield perm[:cut], perm[cut:]
+            return
+        folds = np.array_split(perm, self.num_folds)
+        for i in range(self.num_folds):
+            test = folds[i]
+            train = np.concatenate([f for j, f in enumerate(folds) if j != i])
+            yield train, test
+
+    def _score_split(self, t: MTable, train_idx, test_idx) -> float:
+        train_t, test_t = t.take(train_idx), t.take(test_idx)
+        est = self.estimator
+        model = est.fit(train_t) if isinstance(est, Pipeline) else PipelineModel(
+            est.fit(train_t)
+        )
+        predicted = model.transform(test_t).collect()
+        return self.evaluator.evaluate(predicted)
+
+
+class GridSearchCV(_BaseSearch):
+    """(reference: pipeline/tuning/GridSearchCV.java)"""
+
+    def __init__(self, estimator, param_grid: ParamGrid, evaluator, num_folds=3,
+                 seed=0):
+        super().__init__(estimator, evaluator, num_folds=num_folds, seed=seed)
+        self.param_grid = param_grid
+
+    def _candidates(self):
+        return self.param_grid.candidates()
+
+
+class GridSearchTVSplit(_BaseSearch):
+    """(reference: pipeline/tuning/GridSearchTVSplit.java)"""
+
+    def __init__(self, estimator, param_grid: ParamGrid, evaluator,
+                 train_ratio=0.8, seed=0):
+        super().__init__(estimator, evaluator, train_ratio=train_ratio, seed=seed)
+        self.param_grid = param_grid
+
+    def _candidates(self):
+        return self.param_grid.candidates()
+
+
+class RandomSearchCV(_BaseSearch):
+    """(reference: pipeline/tuning/RandomSearchCV.java)"""
+
+    def __init__(self, estimator, param_dist: ParamDist, evaluator,
+                 num_candidates=10, num_folds=3, seed=0):
+        super().__init__(estimator, evaluator, num_folds=num_folds, seed=seed)
+        self.param_dist = param_dist
+        self.num_candidates = num_candidates
+
+    def _candidates(self):
+        return self.param_dist.sample(self.num_candidates, seed=self.seed)
+
+
+class RandomSearchTVSplit(_BaseSearch):
+    """(reference: pipeline/tuning/RandomSearchTVSplit.java)"""
+
+    def __init__(self, estimator, param_dist: ParamDist, evaluator,
+                 num_candidates=10, train_ratio=0.8, seed=0):
+        super().__init__(estimator, evaluator, train_ratio=train_ratio, seed=seed)
+        self.param_dist = param_dist
+        self.num_candidates = num_candidates
+
+    def _candidates(self):
+        return self.param_dist.sample(self.num_candidates, seed=self.seed)
