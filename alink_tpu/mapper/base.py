@@ -211,20 +211,56 @@ def get_feature_block(
     params: "Params | WithParams",
     dtype=np.float32,
     vector_size: Optional[int] = None,
+    exclude: Optional[Sequence[str]] = None,
 ) -> np.ndarray:
     """Resolve featureCols / vectorCol params into one dense (n, d) block —
-    the shared feature-assembly step of train and predict paths."""
+    the shared feature-assembly step of train and predict paths.
+
+    ``exclude`` names columns (label/weight/prediction) that must never enter
+    the default all-numeric-columns fallback."""
     p = params.get_params() if isinstance(params, WithParams) else params
     vec_col = p.get(HasVectorCol.VECTOR_COL)
-    feat_cols = p.get(HasFeatureCols.FEATURE_COLS)
     if vec_col:
         return t.to_numeric_block([vec_col], dtype=dtype, vector_size=vector_size)
-    if feat_cols:
-        return t.to_numeric_block(list(feat_cols), dtype=dtype)
-    # default: every numeric column
-    numeric = [n for n, tp in zip(t.names, t.schema.types) if AlinkTypes.is_numeric(tp)]
-    if not numeric:
+    return t.to_numeric_block(
+        resolve_feature_cols(t, params, exclude=exclude), dtype=dtype
+    )
+
+
+def default_feature_cols(
+    t: MTable,
+    exclude: Optional[Sequence[str]] = None,
+    include_vectors: bool = False,
+) -> List[str]:
+    """Every numeric (and optionally vector) column not in ``exclude`` — the
+    shared default-column scan for ops run without explicit featureCols."""
+    drop = set(exclude or ())
+    cols = [
+        n
+        for n, tp in zip(t.names, t.schema.types)
+        if (
+            AlinkTypes.is_numeric(tp)
+            or (include_vectors and AlinkTypes.is_vector(tp))
+        )
+        and n not in drop
+    ]
+    if not cols:
         raise AkIllegalArgumentException(
             "no featureCols/vectorCol set and no numeric columns found"
         )
-    return t.to_numeric_block(numeric, dtype=dtype)
+    return cols
+
+
+def resolve_feature_cols(
+    t: MTable,
+    params: "Params | WithParams",
+    exclude: Optional[Sequence[str]] = None,
+) -> List[str]:
+    """The featureCols actually used: the explicit param, else every numeric
+    column not in ``exclude``. Train ops store this resolved list in model meta
+    so predict binds to the same columns regardless of the predict table."""
+    p = params.get_params() if isinstance(params, WithParams) else params
+    feat_cols = p.get(HasFeatureCols.FEATURE_COLS)
+    if feat_cols:
+        return list(feat_cols)
+    return default_feature_cols(t, exclude=exclude)

@@ -32,6 +32,7 @@ from ...mapper import (
     HasVectorCol,
     RichModelMapper,
     get_feature_block,
+    resolve_feature_cols,
 )
 from ...parallel.comqueue import shard_rows
 from ...parallel.mesh import AXIS_DATA, default_mesh
@@ -123,13 +124,15 @@ def _lloyd(mesh, X: np.ndarray, k: int, max_iter: int, tol: float,
                     jnp.linalg.norm(c_new, axis=1, keepdims=True), 1e-12
                 )
             shift = jnp.abs(c_new - c).max()
-            inertia = jax.lax.psum(
-                (jnp.min(d, axis=1) * maskl).sum(), axis
-            )
-            return i + 1, c_new, shift, inertia
+            return i + 1, c_new, shift, jnp.asarray(0.0)
 
-        i, c, _, inertia = jax.lax.while_loop(
+        i, c, _, _ = jax.lax.while_loop(
             cond, step, (jnp.asarray(0), c0, jnp.asarray(jnp.inf), jnp.asarray(0.0))
+        )
+        # inertia against the FINAL centroids (the stored model), not the
+        # pre-update centroids of the last step
+        inertia = jax.lax.psum(
+            (jnp.min(assign(c, Xl), axis=1) * maskl).sum(), axis
         )
         return c, i, inertia
 
@@ -151,6 +154,11 @@ class KMeansTrainBatchOp(BatchOperator, HasKMeansParams):
 
     def _execute_impl(self, t: MTable) -> MTable:
         k = self.get(self.K)
+        feature_cols = (
+            None
+            if self.get(HasVectorCol.VECTOR_COL)
+            else resolve_feature_cols(t, self)
+        )
         X = get_feature_block(t, self).astype(np.float32)
         if X.shape[0] < k:
             raise AkIllegalDataException(
@@ -167,7 +175,7 @@ class KMeansTrainBatchOp(BatchOperator, HasKMeansParams):
             "k": k,
             "distanceType": self.get(self.DISTANCE_TYPE),
             "vectorCol": self.get(HasVectorCol.VECTOR_COL),
-            "featureCols": self.get(HasFeatureCols.FEATURE_COLS),
+            "featureCols": feature_cols,
             "numIters": iters,
             "inertia": inertia,
             "dim": int(c.shape[1]),

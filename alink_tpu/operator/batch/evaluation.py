@@ -21,6 +21,7 @@ import numpy as np
 from ...common.exceptions import AkIllegalDataException
 from ...common.mtable import AlinkTypes, MTable
 from ...common.params import ParamInfo
+from ...mapper import HasFeatureCols, HasVectorCol
 from .base import BatchOperator
 
 
@@ -178,19 +179,19 @@ class EvalRegressionBatchOp(BaseEvalBatchOp):
         )
 
 
-class EvalClusterBatchOp(BaseEvalBatchOp):
+class EvalClusterBatchOp(BaseEvalBatchOp, HasVectorCol, HasFeatureCols):
     """Compactness / Calinski-Harabasz / silhouette-approx (reference:
     EvalClusterBatchOp.java with common/evaluation/ClusterMetrics.java)."""
 
     PREDICTION_COL = ParamInfo("predictionCol", str, optional=False)
-    VECTOR_COL = ParamInfo("vectorCol", str)
-    FEATURE_COLS = ParamInfo("featureCols", list)
     LABEL_COL = ParamInfo("labelCol", str)
 
     def _execute_impl(self, t: MTable) -> MTable:
         from ...mapper import get_feature_block
 
-        X = get_feature_block(t.drop([self.get(self.PREDICTION_COL)]), self)
+        X = get_feature_block(
+            t, self, exclude=[self.get(self.PREDICTION_COL), self.get(self.LABEL_COL)]
+        )
         a = np.asarray(t.col(self.get(self.PREDICTION_COL)))
         ids = sorted(set(a.tolist()))
         k = len(ids)

@@ -19,6 +19,7 @@ from ...common.mtable import AlinkTypes, MTable
 from ...common.params import ParamInfo
 from ...mapper import (
     HasOutputCol,
+    default_feature_cols,
     HasReservedCols,
     HasSelectedCols,
     Mapper,
@@ -36,7 +37,9 @@ class VectorAssemblerMapper(Mapper, HasSelectedCols, HasOutputCol, HasReservedCo
         return self._append_result_schema(input_schema, [out], [AlinkTypes.DENSE_VECTOR])
 
     def map_table(self, t: MTable) -> MTable:
-        cols = self.get(HasSelectedCols.SELECTED_COLS) or t.names
+        cols = self.get(HasSelectedCols.SELECTED_COLS) or default_feature_cols(
+            t, include_vectors=True
+        )
         out = self.get(HasOutputCol.OUTPUT_COL) or "vec"
         block = t.to_numeric_block(list(cols), dtype=np.float64)
         vecs = [DenseVector(row) for row in block]
@@ -62,8 +65,7 @@ class StandardScalerTrainBatchOp(BatchOperator, HasSelectedCols):
 
     def _execute_impl(self, t: MTable) -> MTable:
         cols = list(self.get(HasSelectedCols.SELECTED_COLS) or
-                    [n for n, tp in zip(t.names, t.schema.types)
-                     if AlinkTypes.is_numeric(tp)])
+                    default_feature_cols(t))
         X = t.to_numeric_block(cols, dtype=np.float64)
         mean = X.mean(axis=0)
         std = X.std(axis=0, ddof=0)
@@ -114,8 +116,7 @@ class MinMaxScalerTrainBatchOp(BatchOperator, HasSelectedCols):
 
     def _execute_impl(self, t: MTable) -> MTable:
         cols = list(self.get(HasSelectedCols.SELECTED_COLS) or
-                    [n for n, tp in zip(t.names, t.schema.types)
-                     if AlinkTypes.is_numeric(tp)])
+                    default_feature_cols(t))
         X = t.to_numeric_block(cols, dtype=np.float64)
         meta = {
             "modelName": "MinMaxScalerModel",

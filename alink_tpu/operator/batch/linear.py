@@ -35,6 +35,7 @@ from ...mapper import (
     HasVectorCol,
     RichModelMapper,
     get_feature_block,
+    resolve_feature_cols,
 )
 from ...optim import (
     hinge_obj,
@@ -76,6 +77,14 @@ class BaseLinearModelTrainBatchOp(BatchOperator, HasLinearTrainParams):
 
     linear_model_type: str = None  # LR | SVM | LinearReg | Softmax
 
+    # Ridge/Lasso override these to alias their `lambda` param without
+    # mutating persistent op state between executions
+    def _effective_l1(self) -> float:
+        return self.get(self.L_1)
+
+    def _effective_l2(self) -> float:
+        return self.get(self.L_2)
+
     def _objective(self, dim: int, num_classes: int):
         t = self.linear_model_type
         if t == "LR":
@@ -90,7 +99,16 @@ class BaseLinearModelTrainBatchOp(BatchOperator, HasLinearTrainParams):
 
     def _execute_impl(self, t: MTable) -> MTable:
         label_col = self.get(self.LABEL_COL)
-        X = get_feature_block(t, self).astype(np.float32)
+        weight_col = self.get(self.WEIGHT_COL)
+        vec_col = self.get(HasVectorCol.VECTOR_COL)
+        if vec_col:
+            feature_cols = None
+            X = t.to_numeric_block([vec_col], dtype=np.float32)
+        else:
+            feature_cols = resolve_feature_cols(
+                t, self, exclude=[label_col, weight_col]
+            )
+            X = t.to_numeric_block(feature_cols, dtype=np.float32)
         n, d_raw = X.shape
         y_raw = t.col(label_col)
         is_classif = self.linear_model_type in ("LR", "SVM", "Softmax")
@@ -144,7 +162,7 @@ class BaseLinearModelTrainBatchOp(BatchOperator, HasLinearTrainParams):
             mesh=self.env.mesh,
             method=self.get(self.OPTIM_METHOD),
             max_iter=self.get(self.MAX_ITER),
-            l1=self.get(self.L_1), l2=self.get(self.L_2),
+            l1=self._effective_l1(), l2=self._effective_l2(),
             tol=self.get(self.EPSILON),
         )
 
@@ -169,7 +187,7 @@ class BaseLinearModelTrainBatchOp(BatchOperator, HasLinearTrainParams):
             "modelName": "LinearModel",
             "linearModelType": self.linear_model_type,
             "vectorCol": self.get(HasVectorCol.VECTOR_COL),
-            "featureCols": self.get(HasFeatureCols.FEATURE_COLS),
+            "featureCols": feature_cols,
             "labelCol": label_col,
             "labelType": t.schema.type_of(label_col),
             "labels": labels,
@@ -198,21 +216,21 @@ class RidgeRegTrainBatchOp(BaseLinearModelTrainBatchOp):
     linear_model_type = "LinearReg"
     LAMBDA = ParamInfo("lambda", float, default=0.1, validator=MinValidator(0.0))
 
-    def _execute_impl(self, t: MTable) -> MTable:
+    def _effective_l2(self) -> float:
         # lambda is Ridge's canonical knob; an explicitly set l2 wins
-        if not self._params.contains("l2"):
-            self._params.set(self.L_2, self.get(self.LAMBDA))
-        return super()._execute_impl(t)
+        if self._params.contains("l2"):
+            return self.get(self.L_2)
+        return self.get(self.LAMBDA)
 
 
 class LassoRegTrainBatchOp(BaseLinearModelTrainBatchOp):
     linear_model_type = "LinearReg"
     LAMBDA = ParamInfo("lambda", float, default=0.1, validator=MinValidator(0.0))
 
-    def _execute_impl(self, t: MTable) -> MTable:
-        if not self._params.contains("l1"):
-            self._params.set(self.L_1, self.get(self.LAMBDA))
-        return super()._execute_impl(t)
+    def _effective_l1(self) -> float:
+        if self._params.contains("l1"):
+            return self.get(self.L_1)
+        return self.get(self.LAMBDA)
 
 
 class SoftmaxTrainBatchOp(BaseLinearModelTrainBatchOp):
@@ -289,7 +307,12 @@ class LinearModelMapper(RichModelMapper):
         # binary LR / SVM: labels[0] is positive
         s = self._scores(t)
         s = s[:, 0] if s.ndim > 1 else s
-        prob_pos = 1.0 / (1.0 + np.exp(-s))
+        # numerically stable sigmoid (no overflow for large |s|)
+        prob_pos = np.where(
+            s >= 0,
+            1.0 / (1.0 + np.exp(-np.abs(s))),
+            np.exp(-np.abs(s)) / (1.0 + np.exp(-np.abs(s))),
+        )
         idx = np.where(prob_pos >= 0.5, 0, 1)
         pred = _np_labels(labels, label_type, idx)
         if detail_wanted:

@@ -18,7 +18,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..common.exceptions import AkIllegalArgumentException
+from ..common.exceptions import AkIllegalArgumentException, AkIllegalStateException
 from ..common.mtable import MTable
 from ..common.params import ParamInfo
 from ..operator.batch.base import TableSourceBatchOp
@@ -169,10 +169,18 @@ class _BaseSearch:
                     "score": score,
                 }
             )
+            if np.isnan(score):
+                # a fold with a degenerate metric must not lock in (or shadow)
+                # a candidate — NaN never compares better than anything
+                continue
             if best_score is None or (
                 score > best_score if self.evaluator.larger_is_better else score < best_score
             ):
                 best_score, best_combo = score, combo
+        if best_combo is None:
+            raise AkIllegalStateException(
+                "all tuning candidates scored NaN; check the evaluator/folds"
+            )
         for stage, info, v in best_combo:
             stage.set(info, v)
         best_model = self._fit_full(t)

@@ -1,0 +1,91 @@
+"""Benchmark driver. Prints ONE JSON line:
+{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+v1 workload: BASELINE config #2 — Softmax (multinomial LR) training on
+MNIST-shaped data (60k x 784, 10 classes), full distributed L-BFGS path
+(psum-allreduced gradients + vectorized line search, one compiled XLA program).
+Metric: training throughput in samples*iters/sec.
+
+Baseline: the reference runs the same workload through IterativeComQueue +
+chunked AllReduce on a Flink CPU cluster (reference:
+operator/common/linear/BaseLinearModelTrainBatchOp.java:758-812,
+common/comqueue/communication/AllReduce.java:41). The reference publishes no
+numbers (BASELINE.json "published": {}); we use a measured torch-CPU equivalent
+of its per-iteration full-batch gradient pass on this host as the stand-in
+baseline (same math, same data, best-effort vectorized).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def _synthetic_mnist(n=60_000, d=784, k=10, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, d).astype(np.float32)
+    true_w = rng.randn(d, k).astype(np.float32)
+    y = np.argmax(X @ true_w + rng.randn(n, k) * 0.1, axis=1).astype(np.float32)
+    return X, y
+
+
+def _baseline_torch_cpu(X, y, iters=10):
+    """Reference-equivalent full-batch softmax gradient pass on CPU (the
+    reference's CalcGradient hot loop, vectorized as favorably as possible)."""
+    import torch
+
+    Xt = torch.from_numpy(X)
+    yt = torch.from_numpy(y.astype(np.int64))
+    w = torch.zeros(X.shape[1], 10, requires_grad=True)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = torch.nn.functional.cross_entropy(Xt @ w, yt)
+        loss.backward()
+        with torch.no_grad():
+            w -= 0.1 * w.grad
+            w.grad.zero_()
+    dt = time.perf_counter() - t0
+    return X.shape[0] * iters / dt
+
+
+def main():
+    import jax
+
+    from alink_tpu.optim import optimize, softmax_obj
+
+    X, y = _synthetic_mnist()
+    obj = softmax_obj(X.shape[1], 10)
+
+    # Warmup-compile both programs, then time each; the difference cancels
+    # host->device staging + dispatch overhead, isolating steady-state
+    # per-iteration throughput (what the reference's per-superstep cost is).
+    def timed(max_iter):
+        optimize(obj, X, y, max_iter=max_iter, tol=0.0)  # compile warmup
+        t0 = time.perf_counter()
+        res = optimize(obj, X, y, max_iter=max_iter, tol=0.0)
+        return time.perf_counter() - t0, int(res.num_iters)
+
+    t_lo, it_lo = timed(30)
+    t_hi, it_hi = timed(60)
+    dt = max(t_hi - t_lo, 1e-9)
+    iters = max(it_hi - it_lo, 1)
+    value = X.shape[0] * iters / dt
+
+    baseline = _baseline_torch_cpu(X, y, iters=10)
+
+    print(
+        json.dumps(
+            {
+                "metric": "mnist_softmax_train_throughput",
+                "value": round(value, 1),
+                "unit": "samples*iters/sec",
+                "vs_baseline": round(value / baseline, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

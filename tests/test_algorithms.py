@@ -205,3 +205,22 @@ def test_weight_col():
     ).link_from(src)
     out = LogisticRegressionPredictBatchOp(predictionCol="p").link_from(train, src).collect()
     assert list(out.col("p")) == ["a", "a", "a"]
+
+
+def test_default_feature_cols_exclude_label():
+    # no featureCols set: the label (and weight) column must NOT be used as a
+    # feature, and the resolved columns are recorded in model meta
+    rng = np.random.RandomState(3)
+    X = rng.rand(80, 2).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] > 1.0).astype(np.float64)
+    t = MTable({"f0": X[:, 0], "f1": X[:, 1], "label": y})
+    src = TableSourceBatchOp(t)
+    train = LogisticRegressionTrainBatchOp(labelCol="label").link_from(src)
+    model = train.collect()
+    from alink_tpu.common.model import table_to_model
+
+    meta, _ = table_to_model(model)
+    assert meta["featureCols"] == ["f0", "f1"]
+    out = LogisticRegressionPredictBatchOp(predictionCol="p").link_from(train, src).collect()
+    acc = float(np.mean(np.asarray(out.col("p")) == y))
+    assert acc > 0.9
