@@ -1,0 +1,40 @@
+"""DL subsystem (L8) — in-process JAX replaces the reference's entire
+process-orchestration stack.
+
+The reference forms a TF cluster inside Flink TaskManagers (reference:
+core/src/main/java/com/alibaba/alink/common/dl/DLLauncherBatchOp.java:68,
+DLRunner.java:61, flink-ai-extended gRPC node/AM services + mmap SpscOffHeapQueue
+JVM<->Python data plane) and trains via TF Estimator (akdl/engine/train.py).
+On TPU none of that machinery exists: data is already in host memory next to
+the chips, the model is a flax module, and distribution is a `jax.sharding.Mesh`
+with dp/tp/sp axes — the deliberate architectural deletion documented in
+SURVEY.md §7.
+
+Public surface:
+- :mod:`modules`   — flax models: TransformerEncoder (BERT family), KerasSequential
+- :mod:`attention` — full + ring (sequence-parallel) attention
+- :mod:`sharding`  — parameter partition rules over the (data, model, seq) mesh
+- :mod:`train`     — optax train loop with micro-batching, eval, checkpoints
+- :mod:`tokenizer` — WordPiece-style tokenizer with corpus-built vocab
+"""
+
+from .attention import full_attention, ring_attention
+from .modules import BertConfig, TransformerEncoder, KerasSequential, parse_layers
+from .sharding import param_shardings, make_dl_mesh
+from .train import TrainConfig, train_model, predict_model
+from .tokenizer import Tokenizer
+
+__all__ = [
+    "BertConfig",
+    "TransformerEncoder",
+    "KerasSequential",
+    "parse_layers",
+    "full_attention",
+    "ring_attention",
+    "param_shardings",
+    "make_dl_mesh",
+    "TrainConfig",
+    "train_model",
+    "predict_model",
+    "Tokenizer",
+]

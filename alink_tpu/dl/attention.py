@@ -1,0 +1,144 @@
+"""Attention kernels: full (single-device / GSPMD) and ring (sequence-parallel).
+
+The reference has NO long-context machinery (SURVEY.md §5 "Long-context /
+sequence parallelism: absent") — this module is the TPU-first addition that
+makes sequence length a shardable dimension. Ring attention passes K/V shards
+around the ``seq`` mesh axis with ``ppermute`` (one ICI hop per step) while
+accumulating the softmax online, so no device ever materializes the full
+(S, S) score matrix or the full K/V.
+
+Design notes:
+- ``full_attention`` is plain jnp — under jit with head-sharded params XLA
+  partitions it over the ``model`` axis (tensor parallelism) for free.
+- ``ring_attention`` is a ``shard_map`` manual only over the ``seq`` axis
+  (``axis_names={'seq'}``): the data/model axes stay in GSPMD auto mode, so
+  dp and tp compose with it without hand-written collectives.
+- Online-softmax accumulation in fp32 regardless of input dtype (bf16 inputs
+  stay bf16 through the matmuls — MXU — but m/l/o accumulate fp32).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..parallel.mesh import AXIS_SEQ
+
+_NEG_INF = -1e30
+
+
+def full_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mask: Optional[jax.Array] = None,
+    *,
+    causal: bool = False,
+) -> jax.Array:
+    """Standard scaled dot-product attention.
+
+    q, k, v: (B, S, H, D); mask: (B, S) with 1 = valid key. Returns (B, S, H, D).
+    """
+    d = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(jnp.asarray(d, q.dtype))
+    s = s.astype(jnp.float32)
+    if mask is not None:
+        s = jnp.where(mask[:, None, None, :] > 0, s, _NEG_INF)
+    if causal:
+        sq, sk = q.shape[1], k.shape[1]
+        cm = jnp.tril(jnp.ones((sq, sk), bool))
+        s = jnp.where(cm[None, None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def _ring_body(q, k, v, mask, axis_name: str, causal: bool):
+    """Manual kernel: local q against the rotating ring of k/v shards."""
+    n = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+    b, sq, h, d = q.shape
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    # initial accumulators must carry the same varying-over-seq type as the
+    # loop outputs (check_vma-tracked), hence pvary
+    def _varying(x):
+        return jax.lax.pcast(x, axis_name, to="varying")
+
+    o0 = _varying(jnp.zeros((b, sq, h, d), jnp.float32))
+    m0 = _varying(jnp.full((b, h, sq), _NEG_INF, jnp.float32))
+    l0 = _varying(jnp.zeros((b, h, sq), jnp.float32))
+
+    def step(i, carry):
+        o, m, l, k, v, kmask = carry
+        # the shard we hold at step i originated at device (my - i) mod n
+        src = jnp.mod(my - i, n)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+        if kmask is not None:
+            s = jnp.where(kmask[:, None, None, :] > 0, s, _NEG_INF)
+        if causal:
+            sk = k.shape[1]
+            q_pos = my * sq + jnp.arange(sq)
+            k_pos = src * sk + jnp.arange(sk)
+            s = jnp.where(q_pos[None, None, :, None] >= k_pos[None, None, None, :],
+                          s, _NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        # guard fully-masked rows: exp(-inf - -inf) -> exp(0) must not fire
+        corr = jnp.exp(jnp.maximum(m - m_new, _NEG_INF))
+        p = jnp.exp(s - m_new[..., None])
+        l = l * corr + p.sum(axis=-1)
+        pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(q.dtype), v).astype(jnp.float32)
+        o = o * corr.transpose(0, 2, 1)[..., None] + pv
+        k = jax.lax.ppermute(k, axis_name, perm)
+        v = jax.lax.ppermute(v, axis_name, perm)
+        if kmask is not None:
+            kmask = jax.lax.ppermute(kmask, axis_name, perm)
+        return o, m_new, l, k, v, kmask
+
+    o, m, l, *_ = jax.lax.fori_loop(0, n, step, (o0, m0, l0, k, v, mask))
+    l = jnp.maximum(l, 1e-30)
+    return (o / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mask: Optional[jax.Array] = None,
+    *,
+    mesh=None,
+    axis: str = AXIS_SEQ,
+    causal: bool = False,
+) -> jax.Array:
+    """Sequence-parallel attention: q/k/v sharded (B, S/axis, H, D) over `axis`.
+
+    Runs as a shard_map manual over ONLY the seq axis; data/model sharding is
+    left to GSPMD (``axis_names={axis}``), so tensor-parallel heads and
+    data-parallel batch pass straight through.
+    """
+    if mesh is None or mesh.shape.get(axis, 1) == 1:
+        return full_attention(q, k, v, mask, causal=causal)
+
+    from jax.sharding import PartitionSpec as P
+
+    qkv_spec = P(None, axis, None, None)
+    if mask is not None:
+        f = jax.shard_map(
+            functools.partial(_ring_body, axis_name=axis, causal=causal),
+            mesh=mesh,
+            in_specs=(qkv_spec, qkv_spec, qkv_spec, P(None, axis)),
+            out_specs=qkv_spec,
+            axis_names={axis},
+        )
+        return f(q, k, v, mask)
+    f = jax.shard_map(
+        functools.partial(_ring_body, mask=None, axis_name=axis, causal=causal),
+        mesh=mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec),
+        out_specs=qkv_spec,
+        axis_names={axis},
+    )
+    return f(q, k, v)

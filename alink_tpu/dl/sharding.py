@@ -1,0 +1,105 @@
+"""Parameter/activation sharding rules over the (data, model, seq) mesh.
+
+Replaces the reference's TF_CONFIG chief/worker/ps distribution (reference:
+common/dl/DLRunner.java:95-100 role split; akdl/engine/train.py:16-40
+train_and_evaluate) with sharding annotations: XLA inserts the collectives.
+
+Rules are matched on flax param path names (see modules.py naming
+conventions):
+- attention qkv kernel  (D, 3, H*Dh)  -> shard last dim over `model` (head-parallel)
+- attention out kernel  (H*Dh, D)     -> shard first dim over `model`
+- mlp_in kernel         (D, F)        -> shard F over `model`
+- mlp_out kernel        (F, D)        -> shard F over `model`
+- tok_emb embedding     (V, D)        -> shard V over `model`
+- everything else replicated
+Batch dims of activations shard over `data`; sequence over `seq` when ring
+attention is enabled.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+from ..parallel.mesh import AXIS_DATA, AXIS_MODEL, AXIS_SEQ, make_mesh
+
+
+def make_dl_mesh(dp: int = 0, tp: int = 1, sp: int = 1, devices=None):
+    """Mesh with (data, model, seq) axes; dp=0 means "all remaining devices"."""
+    import jax as _jax
+
+    devices = devices if devices is not None else _jax.devices()
+    if dp <= 0:
+        dp = max(1, len(devices) // (tp * sp))
+    return make_mesh({AXIS_DATA: dp, AXIS_MODEL: tp, AXIS_SEQ: sp}, devices=devices)
+
+
+def _spec_for(path: str, shape) -> "jax.sharding.PartitionSpec":
+    from jax.sharding import PartitionSpec as P
+
+    nd = len(shape)
+    if path.endswith("qkv/kernel"):
+        return P(*([None] * (nd - 1)), AXIS_MODEL)
+    if path.endswith("out/kernel"):
+        return P(AXIS_MODEL, *([None] * (nd - 1)))
+    if path.endswith("mlp_in/kernel"):
+        return P(None, AXIS_MODEL)
+    if path.endswith("mlp_out/kernel"):
+        return P(AXIS_MODEL, None)
+    if path.endswith("qkv/bias") or path.endswith("mlp_in/bias"):
+        return P(*([None] * (nd - 1)), AXIS_MODEL) if nd >= 1 else P()
+    if path.endswith("tok_emb/embedding"):
+        return P(AXIS_MODEL, None)
+    return P()
+
+
+def param_shardings(params, mesh) -> Any:
+    """NamedSharding pytree for a flax param tree (same structure)."""
+    from jax.sharding import NamedSharding
+
+    flat = jax.tree_util.tree_flatten_with_path(params)
+    specs = {}
+
+    def to_spec(path_entries, leaf):
+        path = "/".join(
+            getattr(e, "key", getattr(e, "name", str(e))) for e in path_entries
+        )
+        spec = _spec_for(path, leaf.shape)
+        # the axis must exist in this mesh and divide the dim; replicate otherwise
+        for dim, ax in enumerate(spec):
+            if ax is None:
+                continue
+            if ax not in mesh.shape or leaf.shape[dim] % mesh.shape[ax] != 0:
+                return NamedSharding(mesh, jax.sharding.PartitionSpec())
+        return NamedSharding(mesh, spec)
+
+    return jax.tree_util.tree_map_with_path(to_spec, params)
+
+
+def batch_sharding(mesh, ndim: int, *, seq_axis: Optional[int] = None):
+    """Sharding for a batch array: dim0 over `data`, optional seq dim over `seq`."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    spec = [None] * ndim
+    spec[0] = AXIS_DATA
+    if seq_axis is not None and mesh.shape.get(AXIS_SEQ, 1) > 1:
+        spec[seq_axis] = AXIS_SEQ
+    return NamedSharding(mesh, P(*spec))
+
+
+def shard_batch(mesh, arr: np.ndarray, *, seq_axis: Optional[int] = None):
+    """Pad dim0 to the data-axis multiple and device_put with batch sharding.
+    Returns (sharded, n_valid)."""
+    import jax as _jax
+
+    n = arr.shape[0]
+    dp = mesh.shape[AXIS_DATA]
+    pad = (-n) % dp
+    if pad:
+        arr = np.concatenate([arr, np.repeat(arr[-1:], pad, axis=0)], axis=0)
+    return (
+        _jax.device_put(arr, batch_sharding(mesh, arr.ndim, seq_axis=seq_axis)),
+        n,
+    )

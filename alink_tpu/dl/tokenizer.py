@@ -1,0 +1,153 @@
+"""WordPiece-style tokenizer with corpus-built vocab.
+
+The reference ships pretrained BERT vocabularies through its resource-plugin
+downloader (reference: core/src/main/java/com/alibaba/alink/common/dl/
+BertResources.java:28,76-85). This build runs in a zero-egress environment, so
+the tokenizer can (a) load a local vocab file with the standard BERT format,
+or (b) build a frequency vocab from the training corpus — greedy
+longest-match-first WordPiece with ``##`` continuation, same algorithm family
+as the reference's BERT tokenization.
+"""
+
+from __future__ import annotations
+
+import collections
+import re
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+PAD, UNK, CLS, SEP, MASK = "[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"
+_SPECIALS = [PAD, UNK, CLS, SEP, MASK]
+
+_TOKEN_RE = re.compile(r"\w+|[^\w\s]", re.UNICODE)
+
+
+def _basic_tokens(text: str) -> List[str]:
+    return _TOKEN_RE.findall(text.lower())
+
+
+class Tokenizer:
+    def __init__(self, vocab: Dict[str, int], max_input_chars_per_word: int = 64):
+        self.vocab = vocab
+        self.inv = {i: t for t, i in vocab.items()}
+        self.max_chars = max_input_chars_per_word
+
+    # -- construction ------------------------------------------------------
+    @staticmethod
+    def from_vocab_file(path: str) -> "Tokenizer":
+        vocab = {}
+        with open(path, encoding="utf-8") as f:
+            for i, line in enumerate(f):
+                vocab[line.rstrip("\n")] = i
+        return Tokenizer(vocab)
+
+    @staticmethod
+    def build(texts: Sequence[str], vocab_size: int = 8000) -> "Tokenizer":
+        """Frequency vocab: whole words + single chars as fallback pieces."""
+        counter: collections.Counter = collections.Counter()
+        chars: collections.Counter = collections.Counter()
+        for t in texts:
+            for w in _basic_tokens(t):
+                counter[w] += 1
+                chars.update(w)
+        vocab = {s: i for i, s in enumerate(_SPECIALS)}
+        for ch, _ in chars.most_common():
+            if len(vocab) >= vocab_size:
+                break
+            if ch not in vocab:
+                vocab[ch] = len(vocab)
+            cont = "##" + ch
+            if len(vocab) < vocab_size and cont not in vocab:
+                vocab[cont] = len(vocab)
+        for w, _ in counter.most_common():
+            if len(vocab) >= vocab_size:
+                break
+            if w not in vocab:
+                vocab[w] = len(vocab)
+        return Tokenizer(vocab)
+
+    # -- encoding ----------------------------------------------------------
+    def _wordpiece(self, word: str) -> List[str]:
+        if len(word) > self.max_chars:
+            return [UNK]
+        pieces, start = [], 0
+        while start < len(word):
+            end = len(word)
+            cur = None
+            while start < end:
+                sub = word[start:end]
+                if start > 0:
+                    sub = "##" + sub
+                if sub in self.vocab:
+                    cur = sub
+                    break
+                end -= 1
+            if cur is None:
+                return [UNK]
+            pieces.append(cur)
+            start = end
+        return pieces
+
+    def tokenize(self, text: str) -> List[str]:
+        out = []
+        for w in _basic_tokens(text):
+            out.extend(self._wordpiece(w))
+        return out
+
+    def encode(
+        self,
+        text: str,
+        pair: Optional[str] = None,
+        max_len: int = 128,
+    ):
+        """Returns (input_ids, attention_mask, token_type_ids), BERT layout:
+        [CLS] a... [SEP] b... [SEP], padded to max_len."""
+        a = self.tokenize(text)
+        b = self.tokenize(pair) if pair is not None else []
+        budget = max_len - 2 - (1 if b else 0)
+        if b:
+            # longest-first truncation keeps both segments represented
+            while len(a) + len(b) > budget:
+                (a if len(a) >= len(b) else b).pop()
+        else:
+            a = a[:budget]
+        toks = [CLS] + a + [SEP] + (b + [SEP] if b else [])
+        types = [0] * (len(a) + 2) + [1] * (len(b) + 1 if b else 0)
+        ids = [self.vocab.get(t, self.vocab[UNK]) for t in toks]
+        mask = [1] * len(ids)
+        pad = max_len - len(ids)
+        ids += [self.vocab[PAD]] * pad
+        mask += [0] * pad
+        types += [0] * pad
+        return ids, mask, types
+
+    def encode_batch(
+        self, texts: Sequence[str], pairs: Optional[Sequence[str]] = None,
+        max_len: int = 128,
+    ):
+        """Vectorized batch encode -> dict of (n, max_len) int32 arrays."""
+        ids, masks, types = [], [], []
+        for i, t in enumerate(texts):
+            p = pairs[i] if pairs is not None else None
+            a, m, ty = self.encode(str(t), p if p is None else str(p), max_len)
+            ids.append(a)
+            masks.append(m)
+            types.append(ty)
+        return {
+            "input_ids": np.asarray(ids, np.int32),
+            "attention_mask": np.asarray(masks, np.int32),
+            "token_type_ids": np.asarray(types, np.int32),
+        }
+
+    # -- persistence -------------------------------------------------------
+    def to_list(self) -> List[str]:
+        return [self.inv[i] for i in range(len(self.inv))]
+
+    @staticmethod
+    def from_list(tokens: Sequence[str]) -> "Tokenizer":
+        return Tokenizer({t: i for i, t in enumerate(tokens)})
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self.vocab)

@@ -110,3 +110,14 @@ from .feature import (
     StandardScalerTrainBatchOp,
     VectorAssemblerBatchOp,
 )
+from .dl import (
+    BertTextClassifierPredictBatchOp,
+    BertTextClassifierTrainBatchOp,
+    BertTextPairClassifierTrainBatchOp,
+    BertTextRegressorPredictBatchOp,
+    BertTextRegressorTrainBatchOp,
+    KerasSequentialClassifierPredictBatchOp,
+    KerasSequentialClassifierTrainBatchOp,
+    KerasSequentialRegressorPredictBatchOp,
+    KerasSequentialRegressorTrainBatchOp,
+)

