@@ -42,12 +42,12 @@ def _spec_for(path: str, shape) -> "jax.sharding.PartitionSpec":
     nd = len(shape)
     if path.endswith("qkv/kernel"):
         return P(*([None] * (nd - 1)), AXIS_MODEL)
-    if path.endswith("out/kernel"):
-        return P(AXIS_MODEL, *([None] * (nd - 1)))
     if path.endswith("mlp_in/kernel"):
         return P(None, AXIS_MODEL)
     if path.endswith("mlp_out/kernel"):
         return P(AXIS_MODEL, None)
+    if path.endswith("attention/out/kernel"):
+        return P(AXIS_MODEL, *([None] * (nd - 1)))
     if path.endswith("qkv/bias") or path.endswith("mlp_in/bias"):
         return P(*([None] * (nd - 1)), AXIS_MODEL) if nd >= 1 else P()
     if path.endswith("tok_emb/embedding"):

@@ -164,8 +164,8 @@ def train_model(
     step = 0
     for epoch in range(cfg.num_epochs):
         order = rng.permutation(n_train)
-        if n_train < bs:  # pad tiny datasets up to one full batch
-            order = np.concatenate([order, order[: bs - n_train]])
+        if n_train < bs:  # tile tiny datasets up to one full batch
+            order = np.resize(order, bs)
         for s in range(steps_per_epoch):
             idx = order[s * bs:(s + 1) * bs]
             batch = {

@@ -264,3 +264,43 @@ def resolve_feature_cols(
     if feat_cols:
         return list(feat_cols)
     return default_feature_cols(t, exclude=exclude)
+
+
+def merge_feature_params(params: "Params | WithParams", meta: Dict) -> "Params":
+    """Model-stored feature binding, unless the user explicitly set either
+    featureCols or vectorCol on the predict op (explicit settings win whole) —
+    the shared predict-side counterpart of resolve_feature_cols."""
+    p = (params.get_params() if isinstance(params, WithParams) else params).clone()
+    if not p.contains("vectorCol") and not p.contains("featureCols"):
+        if meta.get("vectorCol"):
+            p.set("vectorCol", meta["vectorCol"])
+        elif meta.get("featureCols"):
+            p.set("featureCols", meta["featureCols"])
+    return p
+
+
+def np_labels(labels: List, label_type: str, idx: np.ndarray) -> np.ndarray:
+    """Decode argmax indices back to typed label values."""
+    arr = np.asarray(labels, dtype=object)[idx]
+    if label_type in (AlinkTypes.LONG, AlinkTypes.INT):
+        return arr.astype(np.int64)
+    if label_type in (AlinkTypes.DOUBLE, AlinkTypes.FLOAT):
+        return arr.astype(np.float64)
+    return arr.astype(str)
+
+
+def softmax_np(logits: np.ndarray) -> np.ndarray:
+    e = np.exp(logits - logits.max(axis=1, keepdims=True))
+    return e / e.sum(axis=1, keepdims=True)
+
+
+def detail_json(labels: List, probs: np.ndarray) -> np.ndarray:
+    """Per-row JSON {label: prob} detail strings (reference: RichModelMapper
+    prediction-detail column format)."""
+    import json as _json
+
+    return np.asarray(
+        [_json.dumps({str(labels[j]): float(pr[j]) for j in range(len(labels))})
+         for pr in probs],
+        dtype=object,
+    )

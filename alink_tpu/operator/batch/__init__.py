@@ -121,3 +121,20 @@ from .dl import (
     KerasSequentialRegressorPredictBatchOp,
     KerasSequentialRegressorTrainBatchOp,
 )
+from .tree import (
+    C45TrainBatchOp,
+    CartTrainBatchOp,
+    DecisionTreePredictBatchOp,
+    DecisionTreeRegPredictBatchOp,
+    DecisionTreeRegTrainBatchOp,
+    DecisionTreeTrainBatchOp,
+    GbdtPredictBatchOp,
+    GbdtRegPredictBatchOp,
+    GbdtRegTrainBatchOp,
+    GbdtTrainBatchOp,
+    Id3TrainBatchOp,
+    RandomForestPredictBatchOp,
+    RandomForestRegPredictBatchOp,
+    RandomForestRegTrainBatchOp,
+    RandomForestTrainBatchOp,
+)

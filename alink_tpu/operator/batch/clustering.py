@@ -216,10 +216,10 @@ class KMeansModelMapper(RichModelMapper):
     def predict_block(self, t: MTable):
         import jax
 
-        from .linear import _merge_feature_params
+        from ...mapper import merge_feature_params
 
         X = get_feature_block(
-            t, _merge_feature_params(self.get_params(), self.meta),
+            t, merge_feature_params(self.get_params(), self.meta),
             vector_size=self.meta["dim"],
         ).astype(np.float32)
         a, d = jax.device_get(self._assign_jit(X, self.centroids))

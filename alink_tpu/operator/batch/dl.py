@@ -36,8 +36,12 @@ from ...mapper import (
     HasReservedCols,
     HasVectorCol,
     RichModelMapper,
+    detail_json,
     get_feature_block,
+    merge_feature_params,
+    np_labels,
     resolve_feature_cols,
+    softmax_np,
 )
 from .base import BatchOperator
 from .utils import ModelMapBatchOp
@@ -53,15 +57,6 @@ def _params_from_bytes(buf: np.ndarray, template):
     from flax import serialization
 
     return serialization.from_bytes(template, buf.tobytes())
-
-
-def _np_labels(labels: List, label_type: str, idx: np.ndarray) -> np.ndarray:
-    arr = np.asarray(labels, dtype=object)[idx]
-    if label_type in (AlinkTypes.LONG, AlinkTypes.INT):
-        return arr.astype(np.int64)
-    if label_type in (AlinkTypes.DOUBLE, AlinkTypes.FLOAT):
-        return arr.astype(np.float64)
-    return arr.astype(str)
 
 
 class HasDLTrainParams:
@@ -174,32 +169,19 @@ class KerasSequentialModelMapper(RichModelMapper, HasFeatureCols, HasVectorCol):
         from ...dl.train import predict_model
 
         meta = self.meta
-        p = self.get_params().clone()
-        if not p.contains("vectorCol") and not p.contains("featureCols"):
-            if meta.get("vectorCol"):
-                p.set("vectorCol", meta["vectorCol"])
-            elif meta.get("featureCols"):
-                p.set("featureCols", meta["featureCols"])
+        p = merge_feature_params(self.get_params(), meta)
         X = get_feature_block(t, p, vector_size=meta["dim"]).astype(np.float32)
         logits = predict_model(self.model, self.params, {"x": X}, seq_axis=None)
         detail = None
         if meta["regression"]:
             return logits[:, 0].astype(np.float64), AlinkTypes.DOUBLE, None
-        probs = _softmax_np(logits)
+        probs = softmax_np(logits)
         idx = probs.argmax(axis=1)
         labels = meta["labels"]
-        pred = _np_labels(labels, meta.get("labelType", AlinkTypes.STRING), idx)
+        pred = np_labels(labels, meta.get("labelType", AlinkTypes.STRING), idx)
         if self.get(HasPredictionDetailCol.PREDICTION_DETAIL_COL):
-            detail = np.asarray(
-                [json.dumps({str(labels[j]): float(pr[j]) for j in range(len(labels))})
-                 for pr in probs], dtype=object,
-            )
+            detail = detail_json(labels, probs)
         return pred, self._pred_type(), detail
-
-
-def _softmax_np(logits: np.ndarray) -> np.ndarray:
-    e = np.exp(logits - logits.max(axis=1, keepdims=True))
-    return e / e.sum(axis=1, keepdims=True)
 
 
 class KerasSequentialClassifierPredictBatchOp(ModelMapBatchOp, HasPredictionCol,
@@ -393,16 +375,13 @@ class BertTextModelMapper(RichModelMapper):
         logits = predict_model(self.model, self.params, enc)
         if meta["regression"]:
             return logits[:, 0].astype(np.float64), AlinkTypes.DOUBLE, None
-        probs = _softmax_np(logits)
+        probs = softmax_np(logits)
         idx = probs.argmax(axis=1)
         labels = meta["labels"]
-        pred = _np_labels(labels, meta.get("labelType", AlinkTypes.STRING), idx)
+        pred = np_labels(labels, meta.get("labelType", AlinkTypes.STRING), idx)
         detail = None
         if self.get(HasPredictionDetailCol.PREDICTION_DETAIL_COL):
-            detail = np.asarray(
-                [json.dumps({str(labels[j]): float(pr[j]) for j in range(len(labels))})
-                 for pr in probs], dtype=object,
-            )
+            detail = detail_json(labels, probs)
         return pred, self._pred_type(), detail
 
 

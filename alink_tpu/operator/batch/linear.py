@@ -35,6 +35,8 @@ from ...mapper import (
     HasVectorCol,
     RichModelMapper,
     get_feature_block,
+    merge_feature_params,
+    np_labels,
     resolve_feature_cols,
 )
 from ...optim import (
@@ -237,18 +239,6 @@ class SoftmaxTrainBatchOp(BaseLinearModelTrainBatchOp):
     linear_model_type = "Softmax"
 
 
-def _merge_feature_params(params, meta):
-    """Model-stored feature binding, unless the user explicitly set either
-    featureCols or vectorCol on the predict op (explicit settings win whole)."""
-    p = params.clone()
-    if not p.contains("vectorCol") and not p.contains("featureCols"):
-        if meta.get("vectorCol"):
-            p.set("vectorCol", meta["vectorCol"])
-        elif meta.get("featureCols"):
-            p.set("featureCols", meta["featureCols"])
-    return p
-
-
 class LinearModelMapper(RichModelMapper):
     """(reference: operator/common/linear/LinearModelMapper.java +
     SoftmaxModelMapper.java)"""
@@ -273,7 +263,7 @@ class LinearModelMapper(RichModelMapper):
         import jax
 
         X = get_feature_block(
-            t, _merge_feature_params(self.get_params(), self.meta),
+            t, merge_feature_params(self.get_params(), self.meta),
             vector_size=self.meta["dim"],
         ).astype(np.float32)
         return np.asarray(
@@ -296,7 +286,7 @@ class LinearModelMapper(RichModelMapper):
             e = np.exp(logits - logits.max(axis=1, keepdims=True))
             probs = e / e.sum(axis=1, keepdims=True)
             idx = probs.argmax(axis=1)
-            pred = _np_labels(labels, label_type, idx)
+            pred = np_labels(labels, label_type, idx)
             if detail_wanted:
                 detail = np.asarray(
                     [json.dumps({str(labels[j]): float(pr[j]) for j in range(len(labels))})
@@ -314,22 +304,13 @@ class LinearModelMapper(RichModelMapper):
             np.exp(-np.abs(s)) / (1.0 + np.exp(-np.abs(s))),
         )
         idx = np.where(prob_pos >= 0.5, 0, 1)
-        pred = _np_labels(labels, label_type, idx)
+        pred = np_labels(labels, label_type, idx)
         if detail_wanted:
             detail = np.asarray(
                 [json.dumps({str(labels[0]): float(pp), str(labels[1]): float(1 - pp)})
                  for pp in prob_pos], dtype=object,
             )
         return pred, label_type, detail
-
-
-def _np_labels(labels: List, label_type: str, idx: np.ndarray) -> np.ndarray:
-    arr = np.asarray(labels, dtype=object)[idx]
-    if label_type in (AlinkTypes.LONG, AlinkTypes.INT):
-        return arr.astype(np.int64)
-    if label_type in (AlinkTypes.DOUBLE, AlinkTypes.FLOAT):
-        return arr.astype(np.float64)
-    return arr.astype(str)
 
 
 class LinearModelPredictOp(ModelMapBatchOp, HasPredictionCol,

@@ -1,0 +1,32 @@
+"""Tree ensembles: histogram GBDT + RandomForest + DecisionTree.
+
+Capability parity with the reference's tree stack (reference:
+core/src/main/java/com/alibaba/alink/operator/common/tree/ — 16.8k LoC;
+parallelcart/BaseGbdtTrainBatchOp.java:408 histogram boosting,
+EpsilonApproQuantile.java local quantile sketch, ConstructLocalHistogram.java,
+CalcFeatureGain.java split search, communication/AllReduceT.java +
+ReduceScatter.java histogram exchange; BaseRandomForestTrainBatchOp.java:221
+ICQ BSP forest growth over paralleltree/TreeObj).
+
+TPU-first re-design (SURVEY.md §7 flags this as the riskiest parity item):
+- quantile binning once up front (the EpsilonApproQuantile analog is an exact
+  global percentile pass — no sketch needed when the bin pass is one jit),
+- level-wise tree growth with STATIC shapes: at level l there are 2^l node
+  slots; per-level histogram build is a ``segment_sum`` over
+  node*B + bin ids inside ``shard_map`` over the data axis, summed across
+  devices with one ``psum`` (replacing ReduceScatter/AllReduceT),
+- split search is a vectorized cumsum-gain argmax over (nodes, features, bins),
+- the boosting outer loop runs on host; each level kernel compiles once and is
+  reused across all trees and iterations.
+"""
+
+from .binning import quantile_bins, apply_bins
+from .grow import TreeEnsemble, train_gbdt, train_forest
+
+__all__ = [
+    "quantile_bins",
+    "apply_bins",
+    "TreeEnsemble",
+    "train_gbdt",
+    "train_forest",
+]

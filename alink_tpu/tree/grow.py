@@ -1,0 +1,500 @@
+"""Level-wise histogram tree growth + GBDT / RandomForest training loops.
+
+(reference: operator/common/tree/parallelcart/BaseGbdtTrainBatchOp.java:408 —
+the boosting ICQ program; ConstructLocalHistogram.java — per-worker histogram;
+CalcFeatureGain.java — split search; communication/ReduceScatter.java —
+histogram exchange; BaseRandomForestTrainBatchOp.java:221 — forest BSP.)
+
+The per-level kernel is one jit+shard_map program: local ``segment_sum``
+histograms -> one ``psum`` (the ReduceScatter/AllReduceT analog) -> vectorized
+cumsum gain -> split argmax -> sample routing. It compiles once per tree level
+and is reused across every tree, boosting iteration, and class.
+
+Trees are perfect binary trees of fixed depth (static shapes): internal nodes
+in heap layout (2^D - 1), leaves 2^D. A node that doesn't split stores
+feature -1 — samples route left and both children inherit its statistics.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..parallel.mesh import AXIS_DATA, default_mesh
+from .binning import apply_bins, quantile_bins
+
+
+# ---------------------------------------------------------------------------
+# per-level split kernel
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=64)
+def _level_fn(mesh_key, num_nodes: int, num_bins: int, l2: float,
+              min_samples: float, min_gain: float):
+    """Build + cache the jitted level kernel for a given node count."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    mesh = _MESHES[mesh_key]
+    axis = AXIS_DATA
+    L, B = num_nodes, num_bins
+
+    def body(bins, g, h, c, node, fmask):
+        d = bins.shape[1]
+        ids = node[:, None] * B + bins  # (n, d) in [0, L*B)
+
+        def seg(vals):  # (n,) -> (d, L*B) -> (L, d, B)
+            out = jax.vmap(
+                lambda col: jax.ops.segment_sum(vals, col, num_segments=L * B),
+                in_axes=1,
+            )(ids)
+            return out.reshape(d, L, B).transpose(1, 0, 2)
+
+        hg = jax.lax.psum(seg(g), axis)
+        hh = jax.lax.psum(seg(h), axis)
+        hc = jax.lax.psum(seg(c), axis)
+
+        GL = jnp.cumsum(hg, axis=-1)
+        HL = jnp.cumsum(hh, axis=-1)
+        CL = jnp.cumsum(hc, axis=-1)
+        G = GL[..., -1:]
+        H = HL[..., -1:]
+        C = CL[..., -1:]
+        GR, HR, CR = G - GL, H - HL, C - CL
+
+        gain = (
+            GL * GL / (HL + l2)
+            + GR * GR / (HR + l2)
+            - G * G / (H + l2)
+        )
+        ok = (CL >= min_samples) & (CR >= min_samples)
+        # last bin position means "everything left" — not a split
+        ok = ok & (jnp.arange(B)[None, None, :] < B - 1)
+        gain = jnp.where(ok & (fmask[None, :, None] > 0), gain, -jnp.inf)
+
+        flat = gain.reshape(L, d * B)
+        best = jnp.argmax(flat, axis=1)
+        best_gain = jnp.take_along_axis(flat, best[:, None], 1)[:, 0]
+        feat = jnp.where(best_gain > min_gain, best // B, -1).astype(jnp.int32)
+        thr = jnp.where(best_gain > min_gain, best % B, B - 1).astype(jnp.int32)
+
+        # node parameter lookups per sample, then route
+        f_s = feat[node]  # (n,)
+        t_s = thr[node]
+        safe_f = jnp.maximum(f_s, 0)
+        x_bin = jnp.take_along_axis(bins, safe_f[:, None], 1)[:, 0]
+        go_left = (f_s < 0) | (x_bin <= t_s)
+        new_node = node * 2 + (1 - go_left.astype(jnp.int32))
+        return feat, thr, new_node
+
+    return jax.jit(
+        jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P(axis), P(axis), P(axis), P(axis), P(axis), P()),
+            out_specs=(P(), P(), P(axis)),
+            check_vma=False,
+        )
+    )
+
+
+@functools.lru_cache(maxsize=16)
+def _leaf_fn(mesh_key, num_leaves: int, l2: float):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    mesh = _MESHES[mesh_key]
+    axis = AXIS_DATA
+
+    def body(g, h, node):
+        sg = jax.lax.psum(
+            jax.ops.segment_sum(g, node, num_segments=num_leaves), axis
+        )
+        sh = jax.lax.psum(
+            jax.ops.segment_sum(h, node, num_segments=num_leaves), axis
+        )
+        return -sg / (sh + l2)
+
+    return jax.jit(
+        jax.shard_map(
+            body, mesh=mesh, in_specs=(P(axis), P(axis), P(axis)),
+            out_specs=P(), check_vma=False,
+        )
+    )
+
+
+# Kernels are cached by a structural mesh fingerprint (axes, shape, device
+# ids) so equivalent meshes share compiles and fresh-mesh-per-job services
+# don't grow the cache unboundedly; the registry keeps one representative
+# mesh per fingerprint (the compiled kernels close over it anyway).
+_MESHES: Dict[tuple, object] = {}
+
+
+def _mesh_key(mesh) -> tuple:
+    k = (
+        tuple(mesh.axis_names),
+        tuple(int(s) for s in mesh.devices.shape),
+        tuple(d.id for d in mesh.devices.flat),
+    )
+    _MESHES.setdefault(k, mesh)
+    return k
+
+
+@functools.lru_cache(maxsize=16)
+def _predict_fn(depth: int):
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def run(X, feats, thrs, leaves, base_score):
+        n = X.shape[0]
+
+        def one_tree(f, t, lv):
+            node = jnp.zeros(n, jnp.int32)
+            pos = jnp.zeros(n, jnp.int32)  # heap index of current node
+            for _ in range(depth):
+                fs = f[pos]
+                ts = t[pos]
+                safe = jnp.maximum(fs, 0)
+                x = jnp.take_along_axis(X, safe[:, None], 1)[:, 0]
+                left = (fs < 0) | (x <= ts)
+                node = node * 2 + (1 - left.astype(jnp.int32))
+                pos = 2 * pos + 1 + (1 - left.astype(jnp.int32))
+            return lv[:, node]  # (K, n)
+
+        scores = jax.vmap(one_tree)(feats, thrs, leaves)  # (T, K, n)
+        return scores.sum(0).T + base_score[None, :]
+
+    return run
+
+
+# ---------------------------------------------------------------------------
+# ensemble container
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class TreeEnsemble:
+    """Perfect-depth trees in heap layout. feats/thrs: (T, 2^D - 1);
+    leaves: (T, K, 2^D) — K output dims (1 for binary/regression)."""
+
+    depth: int
+    feats: np.ndarray
+    thrs: np.ndarray  # raw-value thresholds (x <= thr goes left)
+    leaves: np.ndarray
+    base_score: np.ndarray  # (K,)
+    task: str  # "regression" | "binary" | "multiclass"
+    labels: Optional[list] = None
+    feature_cols: Optional[list] = None
+    vector_col: Optional[str] = None
+
+    def raw_predict(self, X: np.ndarray) -> np.ndarray:
+        """(n, K) raw scores — sum of leaf values + base. The jitted traversal
+        takes the tree arrays as arguments (not constants) and is cached per
+        depth, so repeat predicts and different ensembles share one compile."""
+        import jax.numpy as jnp
+
+        run = _predict_fn(self.depth)
+        return np.asarray(
+            run(
+                jnp.asarray(X, jnp.float32),
+                jnp.asarray(self.feats),
+                jnp.asarray(self.thrs),
+                jnp.asarray(self.leaves),
+                jnp.asarray(self.base_score),
+            )
+        )
+
+    def to_arrays(self) -> Dict[str, np.ndarray]:
+        return {
+            "feats": self.feats,
+            "thrs": self.thrs,
+            "leaves": self.leaves,
+            "base_score": self.base_score,
+        }
+
+    @staticmethod
+    def from_arrays(meta: dict, arrays: Dict[str, np.ndarray]) -> "TreeEnsemble":
+        return TreeEnsemble(
+            depth=int(meta["depth"]),
+            feats=np.asarray(arrays["feats"], np.int32),
+            thrs=np.asarray(arrays["thrs"], np.float32),
+            leaves=np.asarray(arrays["leaves"], np.float32),
+            base_score=np.asarray(arrays["base_score"], np.float32),
+            task=meta["task"],
+            labels=meta.get("labels"),
+            feature_cols=meta.get("featureCols"),
+            vector_col=meta.get("vectorCol"),
+        )
+
+
+# ---------------------------------------------------------------------------
+# single-tree growth (shared by GBDT and forest)
+# ---------------------------------------------------------------------------
+
+
+def _grow_tree(bins_s, g_s, h_s, c_s, mesh, edges, depth, num_bins, l2,
+               min_samples, min_gain, fmask, n_local) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Grow one tree; returns (feat_heap (2^D-1,), thr_heap raw (2^D-1,),
+    leaf_node_ids (n,) device array of final leaf per sample)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mk = _mesh_key(mesh)
+    node = jax.device_put(
+        np.zeros(n_local, np.int32), NamedSharding(mesh, P(AXIS_DATA))
+    )
+    feat_heap = np.full(2 ** depth - 1, -1, np.int32)
+    thr_heap = np.zeros(2 ** depth - 1, np.float32)
+    fmask_j = jnp.asarray(fmask, jnp.float32)
+
+    for level in range(depth):
+        L = 2 ** level
+        fn = _level_fn(mk, L, num_bins, float(l2), float(min_samples),
+                       float(min_gain))
+        feat, thr, node = fn(bins_s, g_s, h_s, c_s, node, fmask_j)
+        feat = np.asarray(feat)
+        thr = np.asarray(thr)
+        base = 2 ** level - 1
+        feat_heap[base:base + L] = feat
+        # bin index -> raw threshold (edges[f, t] is the upper boundary of bin t)
+        raw = np.where(
+            feat >= 0,
+            edges[np.maximum(feat, 0), np.minimum(thr, edges.shape[1] - 1)],
+            np.inf,
+        )
+        thr_heap[base:base + L] = raw
+    return feat_heap, thr_heap, node
+
+
+def _shard(mesh, arr):
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return jax.device_put(arr, NamedSharding(mesh, P(AXIS_DATA)))
+
+
+def _pad_rows(arr, dp):
+    n = arr.shape[0]
+    pad = (-n) % dp
+    if pad:
+        pad_width = [(0, pad)] + [(0, 0)] * (arr.ndim - 1)
+        arr = np.pad(arr, pad_width)
+    return arr
+
+
+# ---------------------------------------------------------------------------
+# GBDT
+# ---------------------------------------------------------------------------
+
+
+def train_gbdt(
+    X: np.ndarray,
+    y: np.ndarray,
+    *,
+    task: str,
+    num_trees: int = 100,
+    depth: int = 5,
+    learning_rate: float = 0.1,
+    num_bins: int = 64,
+    l2: float = 1.0,
+    min_samples: float = 5.0,
+    min_gain: float = 0.0,
+    subsample: float = 1.0,
+    colsample: float = 1.0,
+    num_classes: int = 2,
+    seed: int = 0,
+    mesh=None,
+) -> TreeEnsemble:
+    """Histogram gradient boosting. task: regression | binary | multiclass."""
+    import jax.numpy as jnp
+
+    mesh = mesh or default_mesh()
+    dp = mesh.shape[AXIS_DATA]
+    rng = np.random.default_rng(seed)
+    n, d = X.shape
+    X32 = np.asarray(X, np.float32)
+
+    edges = quantile_bins(X32, num_bins)
+    bins = apply_bins(X32, edges)
+    valid = np.zeros(_pad_rows(bins, dp).shape[0], np.float32)
+    valid[:n] = 1.0
+    bins_s = _shard(mesh, _pad_rows(bins, dp))
+    n_pad = valid.shape[0]
+
+    K = num_classes if task == "multiclass" else 1
+    if task == "regression":
+        base = np.asarray([float(np.mean(y))], np.float32)
+    elif task == "binary":
+        p = float(np.clip(np.mean(y), 1e-6, 1 - 1e-6))
+        base = np.asarray([np.log(p / (1 - p))], np.float32)
+    else:
+        probs = np.bincount(y.astype(int), minlength=K) / n
+        base = np.log(np.clip(probs, 1e-6, None)).astype(np.float32)
+
+    F = np.tile(base[None, :], (n, 1)).astype(np.float32)  # raw scores (n, K)
+    y1 = np.asarray(y, np.float32)
+    if task == "multiclass":
+        y_onehot = np.eye(K, dtype=np.float32)[y.astype(int)]
+
+    feats_all, thrs_all, leaves_all = [], [], []
+    leaf_count = 2 ** depth
+
+    for it in range(num_trees):
+        if task == "regression":
+            g_all = (F[:, 0] - y1)[:, None]
+            h_all = np.ones((n, 1), np.float32)
+        elif task == "binary":
+            p = 1.0 / (1.0 + np.exp(-F[:, 0]))
+            g_all = (p - y1)[:, None]
+            h_all = np.maximum(p * (1 - p), 1e-6)[:, None]
+        else:
+            e = np.exp(F - F.max(axis=1, keepdims=True))
+            p = e / e.sum(axis=1, keepdims=True)
+            g_all = p - y_onehot
+            h_all = np.maximum(p * (1 - p), 1e-6)
+
+        sub = (rng.random(n) < subsample).astype(np.float32) if subsample < 1 \
+            else np.ones(n, np.float32)
+        fmask = (rng.random(d) < colsample).astype(np.float32) if colsample < 1 \
+            else np.ones(d, np.float32)
+        if fmask.sum() == 0:
+            fmask[rng.integers(d)] = 1.0
+
+        tree_feats = np.empty((K, 2 ** depth - 1), np.int32)
+        tree_thrs = np.empty((K, 2 ** depth - 1), np.float32)
+        tree_leaves = np.empty((K, leaf_count), np.float32)
+        for kcls in range(K):
+            g = _pad_rows((g_all[:, kcls] * sub), dp)
+            h = _pad_rows((h_all[:, kcls] * sub), dp)
+            c = _pad_rows(sub, dp) * valid
+            g_s, h_s, c_s = _shard(mesh, g * valid), _shard(mesh, h * valid), \
+                _shard(mesh, c)
+            fh, th, node = _grow_tree(
+                bins_s, g_s, h_s, c_s, mesh, edges, depth, num_bins, l2,
+                min_samples, min_gain, fmask, n_pad,
+            )
+            lf = _leaf_fn(_mesh_key(mesh), leaf_count, float(l2))
+            leaf_vals = np.asarray(lf(g_s, h_s, node)) * learning_rate
+            node_np = np.asarray(node)[:n]
+            F[:, kcls] += leaf_vals[node_np]
+            tree_feats[kcls] = fh
+            tree_thrs[kcls] = th
+            tree_leaves[kcls] = leaf_vals
+        # one "tree" per class per iteration, stored as K parallel trees
+        feats_all.append(tree_feats)
+        thrs_all.append(tree_thrs)
+        leaves_all.append(tree_leaves)
+
+    # flatten (iter, K) into T = num_trees*K trees each with its own K-slot
+    # leaf row (only its class slot nonzero) — keeps predict a plain sum
+    T = num_trees * K
+    feats = np.zeros((T, 2 ** depth - 1), np.int32)
+    thrs = np.zeros((T, 2 ** depth - 1), np.float32)
+    leaves = np.zeros((T, K, leaf_count), np.float32)
+    t = 0
+    for it in range(num_trees):
+        for kcls in range(K):
+            feats[t] = feats_all[it][kcls]
+            thrs[t] = thrs_all[it][kcls]
+            leaves[t, kcls] = leaves_all[it][kcls]
+            t += 1
+    return TreeEnsemble(depth, feats, thrs, leaves, base, task)
+
+
+# ---------------------------------------------------------------------------
+# RandomForest / DecisionTree
+# ---------------------------------------------------------------------------
+
+
+def train_forest(
+    X: np.ndarray,
+    y: np.ndarray,
+    *,
+    task: str,  # regression | binary | multiclass
+    num_trees: int = 10,
+    depth: int = 6,
+    num_bins: int = 64,
+    min_samples: float = 2.0,
+    min_gain: float = 0.0,
+    subsample: float = 1.0,
+    feature_fraction: Optional[float] = None,
+    num_classes: int = 2,
+    bootstrap: bool = True,
+    seed: int = 0,
+    mesh=None,
+) -> TreeEnsemble:
+    """Random forest via the same histogram kernels: trees fit targets directly
+    (g = -target, h = 1 -> leaf = mean target), variance-reduction splits.
+    Classification fits one-vs-all class indicators; predict averages and
+    argmaxes — the reference's per-class info-gain forest re-based on the
+    shared histogram machinery."""
+    mesh = mesh or default_mesh()
+    dp = mesh.shape[AXIS_DATA]
+    rng = np.random.default_rng(seed)
+    n, d = X.shape
+    X32 = np.asarray(X, np.float32)
+    edges = quantile_bins(X32, num_bins)
+    bins = apply_bins(X32, edges)
+    bins_pad = _pad_rows(bins, dp)
+    valid = np.zeros(bins_pad.shape[0], np.float32)
+    valid[:n] = 1.0
+    bins_s = _shard(mesh, bins_pad)
+    n_pad = valid.shape[0]
+
+    K = num_classes if task == "multiclass" else 1
+    if task == "regression":
+        targets = np.asarray(y, np.float32)[:, None]
+    elif task == "binary":
+        targets = np.asarray(y, np.float32)[:, None]
+    else:
+        targets = np.eye(K, dtype=np.float32)[np.asarray(y, int)]
+
+    if feature_fraction is None:
+        feature_fraction = 1.0 if num_trees == 1 else max(1.0 / d, np.sqrt(d) / d)
+
+    leaf_count = 2 ** depth
+    T = num_trees * K
+    feats = np.zeros((T, 2 ** depth - 1), np.int32)
+    thrs = np.zeros((T, 2 ** depth - 1), np.float32)
+    leaves = np.zeros((T, K, leaf_count), np.float32)
+
+    t = 0
+    for it in range(num_trees):
+        if bootstrap and num_trees > 1:
+            w = rng.multinomial(n, np.ones(n) / n).astype(np.float32)
+        elif subsample < 1:
+            w = (rng.random(n) < subsample).astype(np.float32)
+        else:
+            w = np.ones(n, np.float32)
+        fmask = (rng.random(d) < feature_fraction).astype(np.float32)
+        if fmask.sum() == 0:
+            fmask[rng.integers(d)] = 1.0
+        for kcls in range(K):
+            tgt = targets[:, kcls]
+            g = _pad_rows(-(tgt * w), dp)  # leaf = mean target, l2=0
+            h = _pad_rows(w, dp)
+            c = _pad_rows(w, dp)
+            g_s = _shard(mesh, g * valid)
+            h_s = _shard(mesh, h * valid)
+            c_s = _shard(mesh, c * valid)
+            fh, th, node = _grow_tree(
+                bins_s, g_s, h_s, c_s, mesh, edges, depth, num_bins,
+                1e-9, min_samples, min_gain, fmask, n_pad,
+            )
+            lf = _leaf_fn(_mesh_key(mesh), leaf_count, 1e-9)
+            leaf_vals = np.asarray(lf(g_s, h_s, node)) / num_trees
+            feats[t] = fh
+            thrs[t] = th
+            leaves[t, kcls] = leaf_vals
+            t += 1
+
+    base = np.zeros(K, np.float32)
+    return TreeEnsemble(depth, feats, thrs, leaves, base, task)

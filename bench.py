@@ -75,12 +75,13 @@ def main():
         return time.perf_counter() - t0
 
     run(WARMUP_STEPS)  # compile + cache warm
-    # delta between two run lengths cancels dispatch/sync overhead; best of 3
-    # trials rejects interference on the shared device
+    # delta between two run lengths cancels dispatch/sync overhead; taking the
+    # per-length minimum over trials rejects interference independently for
+    # each length (a plain min-of-deltas would select corrupted trials)
     eff_steps = TIMED_STEPS - TIMED_STEPS // 3
-    dt = min(
-        max(run(TIMED_STEPS) - run(TIMED_STEPS // 3), 1e-9) for _ in range(3)
-    )
+    t_hi = min(run(TIMED_STEPS) for _ in range(3))
+    t_lo = min(run(TIMED_STEPS // 3) for _ in range(3))
+    dt = max(t_hi - t_lo, 1e-9)
 
     samples_per_sec = batch * eff_steps / dt
     per_chip = samples_per_sec / n_chips

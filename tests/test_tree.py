@@ -1,0 +1,116 @@
+"""Tree ensemble tests (reference test model: operator/batch/classification/
+GbdtTrainBatchOpTest.java style — tiny data through real distributed train,
+assert predictions)."""
+
+import numpy as np
+
+from alink_tpu.common.mtable import MTable
+from alink_tpu.operator.batch.base import TableSourceBatchOp
+from alink_tpu.operator.batch import (
+    DecisionTreeTrainBatchOp,
+    DecisionTreePredictBatchOp,
+    GbdtPredictBatchOp,
+    GbdtRegPredictBatchOp,
+    GbdtRegTrainBatchOp,
+    GbdtTrainBatchOp,
+    RandomForestPredictBatchOp,
+    RandomForestTrainBatchOp,
+)
+
+
+def _cls_table(n=400, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, 4)
+    # nonlinear rule that needs axis-aligned splits
+    y = ((X[:, 0] > 0.5) & (X[:, 1] > 0.3)) | (X[:, 2] < 0.2)
+    return MTable(
+        {"a": X[:, 0], "b": X[:, 1], "c": X[:, 2], "d": X[:, 3],
+         "label": y.astype(np.int64)}
+    )
+
+
+def test_gbdt_binary():
+    t = _cls_table()
+    src = TableSourceBatchOp(t)
+    train = GbdtTrainBatchOp(
+        labelCol="label", numTrees=30, maxDepth=4, learningRate=0.2,
+    ).link_from(src)
+    pred = GbdtPredictBatchOp(predictionCol="p", predictionDetailCol="pd").link_from(
+        train, src
+    ).collect()
+    acc = np.mean(np.asarray(pred.col("p")) == np.asarray(t.col("label")))
+    assert acc > 0.95, acc
+    import json
+
+    d = json.loads(pred.col("pd")[0])
+    assert abs(sum(d.values()) - 1.0) < 1e-6
+
+
+def test_gbdt_multiclass():
+    rng = np.random.RandomState(1)
+    X = rng.rand(300, 3)
+    y = (X[:, 0] * 3).astype(np.int64)  # 3 classes by threshold
+    t = MTable({"a": X[:, 0], "b": X[:, 1], "c": X[:, 2], "label": y})
+    src = TableSourceBatchOp(t)
+    train = GbdtTrainBatchOp(
+        labelCol="label", numTrees=20, maxDepth=3, learningRate=0.3,
+    ).link_from(src)
+    pred = GbdtPredictBatchOp(predictionCol="p").link_from(train, src).collect()
+    acc = np.mean(np.asarray(pred.col("p")) == y)
+    assert acc > 0.93, acc
+
+
+def test_gbdt_regression():
+    rng = np.random.RandomState(2)
+    X = rng.rand(400, 3)
+    y = np.where(X[:, 0] > 0.5, 2.0, -1.0) + X[:, 1]
+    t = MTable({"a": X[:, 0], "b": X[:, 1], "c": X[:, 2], "y": y})
+    src = TableSourceBatchOp(t)
+    train = GbdtRegTrainBatchOp(
+        labelCol="y", numTrees=50, maxDepth=4, learningRate=0.2,
+    ).link_from(src)
+    pred = GbdtRegPredictBatchOp(predictionCol="p").link_from(train, src).collect()
+    mse = float(np.mean((np.asarray(pred.col("p")) - y) ** 2))
+    assert mse < 0.05, mse
+
+
+def test_random_forest():
+    t = _cls_table(seed=3)
+    src = TableSourceBatchOp(t)
+    train = RandomForestTrainBatchOp(
+        labelCol="label", numTrees=20, maxDepth=6,
+    ).link_from(src)
+    pred = RandomForestPredictBatchOp(predictionCol="p").link_from(
+        train, src
+    ).collect()
+    acc = np.mean(np.asarray(pred.col("p")) == np.asarray(t.col("label")))
+    assert acc > 0.9, acc
+
+
+def test_decision_tree():
+    t = _cls_table(seed=4)
+    src = TableSourceBatchOp(t)
+    train = DecisionTreeTrainBatchOp(labelCol="label", maxDepth=6).link_from(src)
+    pred = DecisionTreePredictBatchOp(predictionCol="p").link_from(
+        train, src
+    ).collect()
+    acc = np.mean(np.asarray(pred.col("p")) == np.asarray(t.col("label")))
+    assert acc > 0.9, acc
+
+
+def test_tree_model_roundtrip(tmp_path):
+    from alink_tpu.io.ak import read_ak, write_ak
+
+    t = _cls_table(seed=5)
+    src = TableSourceBatchOp(t)
+    model = GbdtTrainBatchOp(labelCol="label", numTrees=10, maxDepth=3).link_from(
+        src
+    ).collect()
+    path = str(tmp_path / "gbdt.ak")
+    write_ak(path, model)
+    m2 = read_ak(path)
+    p1 = GbdtPredictBatchOp(predictionCol="p").link_from(
+        TableSourceBatchOp(model), src).collect()
+    p2 = GbdtPredictBatchOp(predictionCol="p").link_from(
+        TableSourceBatchOp(m2), src).collect()
+    np.testing.assert_array_equal(p1.col("p"), p2.col("p"))
