@@ -303,6 +303,11 @@ class MTable:
     def head(self, n: int) -> "MTable":
         return self.take(np.arange(min(n, self.num_rows)))
 
+    def slice(self, start: int, stop: int) -> "MTable":
+        start = max(start, 0)
+        stop = min(stop, self.num_rows)
+        return self.take(np.arange(start, max(stop, start)))
+
     def sort_by(self, name: str, ascending: bool = True) -> "MTable":
         order = np.argsort(self.col(name), kind="stable")
         if not ascending:

@@ -1,0 +1,27 @@
+"""Embedding training — the APS (Alink Parameter Server) analog.
+
+The reference trains huge embeddings through a pull/push mini-batch parameter
+server: the model is partitioned by key across tasks, workers pull the rows a
+block needs, train locally, and push updates (reference:
+operator/common/aps/ApsEnv.java:39-370, ApsContext.java; used by
+operator/batch/huge/impl/Word2VecImpl.java:82-91 and the DeepWalk/Node2Vec/
+MetaPath2Vec/LINE ops).
+
+TPU re-design: there is no separate server process — the embedding table is a
+device array; "pull" is a gather, "push" is a scatter-add, and the whole
+mini-batch loop is ONE compiled XLA program (``fori_loop`` over pair blocks,
+``psum`` of scatter deltas across the data axis). Tables too big for one chip
+shard over the ``model`` axis and the same gather/scatter rides ICI.
+"""
+
+from .skipgram import SkipGramConfig, train_skipgram, build_vocab, make_pairs
+from .walks import random_walks, node2vec_walks
+
+__all__ = [
+    "SkipGramConfig",
+    "train_skipgram",
+    "build_vocab",
+    "make_pairs",
+    "random_walks",
+    "node2vec_walks",
+]

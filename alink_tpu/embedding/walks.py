@@ -1,0 +1,106 @@
+"""Random walks over graphs — corpus generators for DeepWalk/Node2Vec.
+
+(reference: operator/batch/graph/DeepWalkBatchOp + walkpath/ and
+storage/BaseCSRGraph.java random-walk storage; Node2Vec biased walks in
+operator/batch/graph/Node2VecBatchOp + huge/impl/Node2VecImpl.)
+
+Walks are generated host-side on a CSR adjacency (dynamic-length neighbor
+lists are the classic XLA-hostile shape — SURVEY.md §7 hard parts) and the
+resulting fixed-length walk matrix feeds the device-side skip-gram trainer.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+
+def build_csr(
+    src: np.ndarray, dst: np.ndarray, weights: Optional[np.ndarray] = None,
+    num_nodes: Optional[int] = None, directed: bool = False,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """(indptr, indices, weights) CSR from an edge list."""
+    if not directed:
+        src, dst = np.concatenate([src, dst]), np.concatenate([dst, src])
+        if weights is not None:
+            weights = np.concatenate([weights, weights])
+    n = int(num_nodes or (max(src.max(), dst.max()) + 1))
+    order = np.argsort(src, kind="stable")
+    src, dst = src[order], dst[order]
+    w = (weights[order] if weights is not None
+         else np.ones(len(src), np.float32))
+    indptr = np.zeros(n + 1, np.int64)
+    np.add.at(indptr, src + 1, 1)
+    indptr = np.cumsum(indptr)
+    return indptr, dst.astype(np.int64), w.astype(np.float32)
+
+
+def random_walks(
+    indptr: np.ndarray, indices: np.ndarray, weights: np.ndarray,
+    *, num_walks: int = 10, walk_length: int = 40, seed: int = 0,
+) -> np.ndarray:
+    """(num_nodes*num_walks, walk_length) uniform/weighted random walks.
+    Dead-end nodes repeat in place."""
+    rng = np.random.default_rng(seed)
+    n = len(indptr) - 1
+    starts = np.tile(np.arange(n), num_walks)
+    rng.shuffle(starts)
+    walks = np.empty((len(starts), walk_length), np.int64)
+    walks[:, 0] = starts
+    cur = starts.copy()
+    for t in range(1, walk_length):
+        deg = indptr[cur + 1] - indptr[cur]
+        r = rng.random(len(cur))
+        nxt = cur.copy()
+        has = deg > 0
+        # weighted pick: cumulative-weight inverse sampling per node
+        idx = np.nonzero(has)[0]
+        for i in idx:  # vectorized below for the uniform fast path
+            s, e = indptr[cur[i]], indptr[cur[i] + 1]
+            w = weights[s:e]
+            cw = np.cumsum(w)
+            j = np.searchsorted(cw, r[i] * cw[-1], side="right")
+            nxt[i] = indices[s + min(j, e - s - 1)]
+        walks[:, t] = nxt
+        cur = nxt
+    return walks
+
+
+def node2vec_walks(
+    indptr: np.ndarray, indices: np.ndarray, weights: np.ndarray,
+    *, num_walks: int = 10, walk_length: int = 40,
+    p: float = 1.0, q: float = 1.0, seed: int = 0,
+) -> np.ndarray:
+    """Biased second-order walks (Node2Vec): return prob ~ 1/p, in-out ~ 1/q."""
+    rng = np.random.default_rng(seed)
+    n = len(indptr) - 1
+    starts = np.tile(np.arange(n), num_walks)
+    rng.shuffle(starts)
+    walks = np.empty((len(starts), walk_length), np.int64)
+    walks[:, 0] = starts
+    neigh_sets = [set(indices[indptr[v]:indptr[v + 1]].tolist())
+                  for v in range(n)]
+    for wi in range(len(starts)):
+        prev = -1
+        cur = int(starts[wi])
+        for t in range(1, walk_length):
+            s, e = indptr[cur], indptr[cur + 1]
+            if s == e:
+                walks[wi, t] = cur
+                continue
+            nbrs = indices[s:e]
+            w = weights[s:e].astype(np.float64).copy()
+            if prev >= 0:
+                back = nbrs == prev
+                shared = np.fromiter(
+                    (x in neigh_sets[prev] for x in nbrs), bool, len(nbrs)
+                )
+                w[back] /= p
+                w[~back & ~shared] /= q
+            cw = np.cumsum(w)
+            j = np.searchsorted(cw, rng.random() * cw[-1], side="right")
+            nxt = int(nbrs[min(j, len(nbrs) - 1)])
+            walks[wi, t] = nxt
+            prev, cur = cur, nxt
+    return walks

@@ -138,3 +138,12 @@ from .tree import (
     RandomForestRegTrainBatchOp,
     RandomForestTrainBatchOp,
 )
+from .huge import (
+    DeepWalkBatchOp,
+    DeepWalkEmbeddingBatchOp,
+    Node2VecEmbeddingBatchOp,
+    Node2VecWalkBatchOp,
+    RandomWalkBatchOp,
+    Word2VecPredictBatchOp,
+    Word2VecTrainBatchOp,
+)

@@ -20,7 +20,6 @@ classical model.
 
 from __future__ import annotations
 
-import json
 from typing import Dict, List, Optional
 
 import numpy as np

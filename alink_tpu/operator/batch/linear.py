@@ -18,7 +18,6 @@ predicts on raw features.
 
 from __future__ import annotations
 
-import json
 from typing import List, Optional, Tuple
 
 import numpy as np
@@ -34,10 +33,12 @@ from ...mapper import (
     HasReservedCols,
     HasVectorCol,
     RichModelMapper,
+    detail_json,
     get_feature_block,
     merge_feature_params,
     np_labels,
     resolve_feature_cols,
+    softmax_np,
 )
 from ...optim import (
     hinge_obj,
@@ -282,16 +283,11 @@ class LinearModelMapper(RichModelMapper):
             return np.asarray(s, np.float64), AlinkTypes.DOUBLE, None
 
         if mtype == "Softmax":
-            logits = self._scores(t)
-            e = np.exp(logits - logits.max(axis=1, keepdims=True))
-            probs = e / e.sum(axis=1, keepdims=True)
+            probs = softmax_np(self._scores(t))
             idx = probs.argmax(axis=1)
             pred = np_labels(labels, label_type, idx)
             if detail_wanted:
-                detail = np.asarray(
-                    [json.dumps({str(labels[j]): float(pr[j]) for j in range(len(labels))})
-                     for pr in probs], dtype=object,
-                )
+                detail = detail_json(labels, probs)
             return pred, label_type, detail
 
         # binary LR / SVM: labels[0] is positive
@@ -306,10 +302,7 @@ class LinearModelMapper(RichModelMapper):
         idx = np.where(prob_pos >= 0.5, 0, 1)
         pred = np_labels(labels, label_type, idx)
         if detail_wanted:
-            detail = np.asarray(
-                [json.dumps({str(labels[0]): float(pp), str(labels[1]): float(1 - pp)})
-                 for pp in prob_pos], dtype=object,
-            )
+            detail = detail_json(labels, np.stack([prob_pos, 1 - prob_pos], 1))
         return pred, label_type, detail
 
 

@@ -11,7 +11,6 @@ via operator/common/tree/predictors/*).
 
 from __future__ import annotations
 
-import json
 from typing import List, Optional
 
 import numpy as np
@@ -105,11 +104,17 @@ class _BaseTreeTrainBatchOp(BatchOperator, HasTreeTrainParams):
                 **common,
             )
         else:
-            ff = self.get(self.FEATURE_SUBSAMPLING_RATIO)
+            # explicitly-set 1.0 means "all features"; unset means the
+            # sqrt(d)/d forest heuristic (resolved inside train_forest)
+            ff = (
+                self.get(self.FEATURE_SUBSAMPLING_RATIO)
+                if self._params.contains("featureSubsamplingRatio")
+                else None
+            )
             ens = train_forest(
                 X, y,
                 subsample=self.get(self.SUBSAMPLING_RATIO),
-                feature_fraction=None if ff >= 1.0 else ff,
+                feature_fraction=ff,
                 bootstrap=num_trees > 1,
                 **common,
             )

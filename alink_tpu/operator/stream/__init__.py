@@ -1,0 +1,25 @@
+"""Stream operator layer — micro-batch streaming runtime."""
+
+from .base import (
+    MapStreamOp,
+    ModelMapStreamOp,
+    StreamOperator,
+    TableSourceStreamOp,
+)
+from .evaluation import EvalBinaryClassStreamOp
+from .onlinelearning import (
+    BinaryClassModelFilterStreamOp,
+    FtrlPredictStreamOp,
+    FtrlTrainStreamOp,
+)
+
+__all__ = [
+    "MapStreamOp",
+    "ModelMapStreamOp",
+    "StreamOperator",
+    "TableSourceStreamOp",
+    "EvalBinaryClassStreamOp",
+    "BinaryClassModelFilterStreamOp",
+    "FtrlPredictStreamOp",
+    "FtrlTrainStreamOp",
+]

@@ -1,0 +1,85 @@
+"""Streaming evaluation — windowed metrics per micro-batch.
+
+(reference: operator/stream/evaluation/EvalBinaryClassStreamOp.java — windowed
+AUC/accuracy over a time window, emitting one metrics row per window.)
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Iterator
+
+import numpy as np
+
+from ...common.mtable import AlinkTypes, MTable, TableSchema
+from ...common.params import ParamInfo
+from .base import StreamOperator
+
+
+class EvalBinaryClassStreamOp(StreamOperator):
+    """One metrics row per micro-batch (window) + cumulative row."""
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    LABEL_COL = ParamInfo("labelCol", str, optional=False)
+    PREDICTION_DETAIL_COL = ParamInfo("predictionDetailCol", str, optional=False)
+    POSITIVE_LABEL = ParamInfo("positiveLabelValueString", str)
+
+    def _stream_impl(self, it: Iterator[MTable]) -> Iterator[MTable]:
+        label_col = self.get(self.LABEL_COL)
+        detail_col = self.get(self.PREDICTION_DETAIL_COL)
+        pos = self.get(self.POSITIVE_LABEL)
+        all_y, all_s = [], []
+        for i, chunk in enumerate(it):
+            y_raw = [str(v) for v in chunk.col(label_col)]
+            details = [json.loads(str(v)) for v in chunk.col(detail_col)]
+            p = pos if pos is not None else sorted(details[0].keys())[-1]
+            scores = np.asarray([d.get(p, 0.0) for d in details])
+            y = np.asarray([1.0 if v == p else 0.0 for v in y_raw])
+            all_y.append(y)
+            all_s.append(scores)
+            yield self._metrics_row("window", i, y, scores)
+
+        if all_y:
+            yield self._metrics_row(
+                "all", -1, np.concatenate(all_y), np.concatenate(all_s)
+            )
+
+    @staticmethod
+    def _metrics_row(kind: str, window: int, y, s) -> MTable:
+        pred = (s >= 0.5).astype(float)
+        acc = float(np.mean(pred == y))
+        auc = _auc(y, s)
+        stat = json.dumps({"Accuracy": acc, "AUC": auc, "Count": int(len(y))})
+        return MTable(
+            {
+                "Statistics": np.asarray([kind], object),
+                "WindowId": np.asarray([window], np.int64),
+                "Data": np.asarray([stat], object),
+            },
+            TableSchema(
+                ["Statistics", "WindowId", "Data"],
+                [AlinkTypes.STRING, AlinkTypes.LONG, AlinkTypes.STRING],
+            ),
+        )
+
+
+def _auc(y: np.ndarray, s: np.ndarray) -> float:
+    n_pos = int(y.sum())
+    n_neg = len(y) - n_pos
+    if n_pos == 0 or n_neg == 0:
+        return float("nan")
+    order = np.argsort(s, kind="stable")
+    ranks = np.empty(len(s), np.float64)
+    sorted_s = s[order]
+    ranks[order] = np.arange(1, len(s) + 1)
+    # average ranks over ties
+    uniq, inv, counts = np.unique(sorted_s, return_inverse=True,
+                                  return_counts=True)
+    cum = np.cumsum(counts)
+    avg = (cum - (counts - 1) / 2.0)
+    ranks[order] = avg[inv]
+    return float(
+        (ranks[y == 1].sum() - n_pos * (n_pos + 1) / 2) / (n_pos * n_neg)
+    )

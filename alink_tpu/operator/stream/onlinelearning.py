@@ -1,0 +1,215 @@
+"""Online learning: FTRL train/predict streams + model quality filter.
+
+Capability parity (reference: operator/stream/onlinelearning/
+FtrlTrainStreamOp.java:63 — warm-start from a batch LR model via DirectReader
+at :67, unbounded feedback iteration at :133-178, fragment merge + ModelUpdater
+at :147,:265, periodic model snapshots; FtrlPredictStreamOp — model hot-swap;
+BinaryClassModelFilterStreamOp — only forwards models beating AUC/acc gates).
+
+TPU re-design: FTRL-proximal state (z, n) lives as device arrays; each
+micro-batch is one jitted update (the per-record Flink loop becomes a batched
+scan); snapshots are emitted as standard linear-model tables every
+``modelSaveInterval`` batches, feeding the same hot-swap predict path as batch
+models.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Iterator, List, Optional
+
+import numpy as np
+
+from ...common.exceptions import AkIllegalDataException
+from ...common.model import model_to_table, table_to_model
+from ...common.mtable import AlinkTypes, MTable
+from ...common.params import ParamInfo
+from ...mapper import (
+    HasFeatureCols,
+    HasPredictionCol,
+    HasPredictionDetailCol,
+    HasReservedCols,
+    HasVectorCol,
+    get_feature_block,
+)
+from ..batch.linear import LinearModelMapper
+from .base import ModelMapStreamOp, StreamOperator
+
+
+@functools.lru_cache(maxsize=8)
+def _ftrl_step_fn(alpha: float, beta: float, l1: float, l2: float):
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def step(z, n, X, y):
+        """One micro-batch of FTRL-proximal (per-coordinate), scanned row by
+        row like the reference's per-record updates."""
+
+        def weights(z, n):
+            sign = jnp.sign(z)
+            w = -(z - sign * l1) / ((beta + jnp.sqrt(n)) / alpha + l2)
+            return jnp.where(jnp.abs(z) <= l1, 0.0, w)
+
+        def one(carry, xy):
+            z, n = carry
+            x, yi = xy
+            w = weights(z, n)
+            p = jax.nn.sigmoid(x @ w)
+            g = (p - yi) * x
+            sigma = (jnp.sqrt(n + g * g) - jnp.sqrt(n)) / alpha
+            z = z + g - sigma * weights(z, n)
+            n = n + g * g
+            return (z, n), p
+
+        (z, n), preds = jax.lax.scan(one, (z, n), (X, y))
+        return z, n, weights(z, n), preds
+
+    return step
+
+
+class HasFtrlParams(HasVectorCol, HasFeatureCols):
+    LABEL_COL = ParamInfo("labelCol", str, optional=False)
+    ALPHA = ParamInfo("alpha", float, default=0.1)
+    BETA = ParamInfo("beta", float, default=1.0)
+    L_1 = ParamInfo("l1", float, default=0.0)
+    L_2 = ParamInfo("l2", float, default=0.0)
+    VECTOR_SIZE = ParamInfo("vectorSize", int, default=0)
+    MODEL_SAVE_INTERVAL = ParamInfo(
+        "modelSaveInterval", int, default=1,
+        desc="emit a model snapshot every k micro-batches",
+    )
+
+
+class FtrlTrainStreamOp(StreamOperator, HasFtrlParams):
+    """Streaming FTRL logistic regression; emits model snapshot tables.
+    Warm-starts from a batch-trained linear model when given one."""
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    def __init__(self, initial_model: Optional[MTable] = None, params=None,
+                 **kwargs):
+        super().__init__(params, **kwargs)
+        self._initial_model = initial_model
+
+    def _stream_impl(self, it: Iterator[MTable]) -> Iterator[MTable]:
+        import jax.numpy as jnp
+
+        alpha, beta = self.get(self.ALPHA), self.get(self.BETA)
+        l1, l2 = self.get(self.L_1), self.get(self.L_2)
+        step = _ftrl_step_fn(alpha, beta, l1, l2)
+        label_col = self.get(self.LABEL_COL)
+        interval = self.get(self.MODEL_SAVE_INTERVAL)
+
+        z = n = None
+        labels = None
+        meta0 = {}
+        if self._initial_model is not None:
+            meta0, arrays = table_to_model(self._initial_model)
+            w0 = np.concatenate(
+                [arrays["weights"].reshape(-1), arrays["intercept"].reshape(-1)]
+            )
+            labels = meta0.get("labels")
+            # invert the closed form at n=0 so weights(z, 0) == w0
+            z = jnp.asarray(-(w0 * (beta / alpha + l2)) - np.sign(w0) * l1)
+            n = jnp.zeros_like(z)
+
+        batch_no = 0
+        for chunk in it:
+            X = get_feature_block(
+                chunk, self, exclude=[label_col],
+                vector_size=self.get(self.VECTOR_SIZE) or None,
+            ).astype(np.float32)
+            Xb = np.concatenate([X, np.ones((X.shape[0], 1), np.float32)], 1)
+            y_raw = chunk.col(label_col)
+            if labels is None:
+                labels = sorted(set(np.asarray(y_raw).tolist()), key=str)
+                if len(labels) < 2:
+                    labels = labels + [None]
+            y = np.asarray(
+                [1.0 if v == labels[0] else 0.0 for v in y_raw], np.float32
+            )
+            if z is None:
+                d = Xb.shape[1]
+                z = jnp.zeros(d)
+                n = jnp.zeros(d)
+            if Xb.shape[1] != z.shape[0]:
+                raise AkIllegalDataException(
+                    f"feature dim {Xb.shape[1] - 1} != model dim {z.shape[0] - 1}"
+                )
+            z, n, w, _ = step(z, n, jnp.asarray(Xb), jnp.asarray(y))
+            batch_no += 1
+            if batch_no % interval == 0:
+                w_np = np.asarray(w)
+                meta = {
+                    "modelName": "LinearModel",
+                    "linearModelType": "LR",
+                    "vectorCol": self.get(HasVectorCol.VECTOR_COL),
+                    "featureCols": meta0.get("featureCols")
+                    if self._initial_model is not None
+                    else self.get(HasFeatureCols.FEATURE_COLS),
+                    "labelCol": label_col,
+                    "labelType": meta0.get("labelType", AlinkTypes.STRING)
+                    if self._initial_model is not None
+                    else chunk.schema.type_of(label_col),
+                    "labels": labels,
+                    "hasIntercept": True,
+                    "dim": int(z.shape[0] - 1),
+                    "batchNo": batch_no,
+                }
+                yield model_to_table(
+                    meta,
+                    {
+                        "weights": w_np[:-1].astype(np.float32),
+                        "intercept": np.asarray([w_np[-1]], np.float32),
+                    },
+                )
+
+
+class FtrlPredictStreamOp(ModelMapStreamOp, HasPredictionCol,
+                          HasPredictionDetailCol, HasReservedCols):
+    """link_from(model_stream, data_stream) — hot-swaps the newest model
+    (reference: FtrlPredictStreamOp + ModelStreamModelMapperAdapter)."""
+
+    mapper_cls = LinearModelMapper
+
+
+class BinaryClassModelFilterStreamOp(StreamOperator):
+    """Forward only model snapshots whose accuracy on the concurrent data
+    stream beats the threshold (reference: onlinelearning/
+    BinaryClassModelFilterStreamOp.java)."""
+
+    _min_inputs = 2
+    _max_inputs = 2
+
+    LABEL_COL = ParamInfo("labelCol", str, optional=False)
+    ACCURACY_THRESHOLD = ParamInfo("accuracyThreshold", float, default=0.5)
+
+    def _stream_impl(self, model_it, data_it) -> Iterator[MTable]:
+        label_col = self.get(self.LABEL_COL)
+        thresh = self.get(self.ACCURACY_THRESHOLD)
+        data_chunks: List[MTable] = []
+        for model in model_it:
+            # evaluate on the freshest data seen so far
+            try:
+                data_chunks.append(next(data_it))
+            except StopIteration:
+                pass
+            if not data_chunks:
+                yield model
+                continue
+            eval_t = data_chunks[-1]
+            mapper = LinearModelMapper(
+                model.schema, eval_t.schema,
+                self.get_params().clone().set("predictionCol", "__pred__"),
+            ).load_model(model)
+            pred = mapper.map_table(eval_t)
+            acc = float(
+                np.mean(
+                    np.asarray(pred.col("__pred__")).astype(str)
+                    == np.asarray(eval_t.col(label_col)).astype(str)
+                )
+            )
+            if acc >= thresh:
+                yield model

@@ -324,10 +324,11 @@ def train_gbdt(
 
     edges = quantile_bins(X32, num_bins)
     bins = apply_bins(X32, edges)
-    valid = np.zeros(_pad_rows(bins, dp).shape[0], np.float32)
+    bins_pad = _pad_rows(bins, dp)
+    n_pad = bins_pad.shape[0]
+    valid = np.zeros(n_pad, np.float32)
     valid[:n] = 1.0
-    bins_s = _shard(mesh, _pad_rows(bins, dp))
-    n_pad = valid.shape[0]
+    bins_s = _shard(mesh, bins_pad)
 
     K = num_classes if task == "multiclass" else 1
     if task == "regression":
@@ -469,7 +470,9 @@ def train_forest(
     t = 0
     for it in range(num_trees):
         if bootstrap and num_trees > 1:
-            w = rng.multinomial(n, np.ones(n) / n).astype(np.float32)
+            # bootstrap of subsample*n draws, so subsamplingRatio composes
+            n_draw = max(1, int(round(n * min(subsample, 1.0))))
+            w = rng.multinomial(n_draw, np.ones(n) / n).astype(np.float32)
         elif subsample < 1:
             w = (rng.random(n) < subsample).astype(np.float32)
         else:

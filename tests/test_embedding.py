@@ -1,0 +1,96 @@
+"""Word2Vec / walk-embedding tests (reference test model:
+operator/batch/nlp/Word2VecTrainBatchOpTest.java,
+graph/Node2VecWalkBatchOpTest.java)."""
+
+import numpy as np
+
+from alink_tpu.common.mtable import MTable, TableSchema
+from alink_tpu.common.mtable import AlinkTypes
+from alink_tpu.operator.batch.base import TableSourceBatchOp
+from alink_tpu.operator.batch import (
+    DeepWalkBatchOp,
+    DeepWalkEmbeddingBatchOp,
+    Node2VecWalkBatchOp,
+    Word2VecPredictBatchOp,
+    Word2VecTrainBatchOp,
+)
+
+
+def _corpus_table():
+    # two well-separated topic clusters
+    a = ["cat dog pet animal fur", "dog cat pet animal paw",
+         "pet cat dog animal tail"] * 12
+    b = ["stock market trade price money", "market stock price trade fund",
+         "trade market stock money price"] * 12
+    docs = a + b
+    return MTable({"doc": np.asarray(docs, object)},
+                  TableSchema(["doc"], [AlinkTypes.STRING]))
+
+
+def test_word2vec_clusters():
+    t = _corpus_table()
+    model = Word2VecTrainBatchOp(
+        selectedCol="doc", vectorSize=16, numIter=12, window=3,
+        learningRate=0.05, batchSize=256,
+    ).link_from(TableSourceBatchOp(t)).collect()
+    vecs = {w: np.asarray(v.data) for w, v in
+            zip(model.col("word"), model.col("vec"))}
+
+    def cos(a, b):
+        return float(np.dot(a, b) / (np.linalg.norm(a) * np.linalg.norm(b)))
+
+    # in-topic similarity beats cross-topic
+    assert cos(vecs["cat"], vecs["dog"]) > cos(vecs["cat"], vecs["market"])
+    assert cos(vecs["stock"], vecs["trade"]) > cos(vecs["stock"], vecs["pet"])
+
+
+def test_word2vec_predict():
+    t = _corpus_table()
+    src = TableSourceBatchOp(t)
+    model = Word2VecTrainBatchOp(
+        selectedCol="doc", vectorSize=8, numIter=3,
+    ).link_from(src)
+    pred = Word2VecPredictBatchOp(
+        selectedCol="doc", predictionCol="v"
+    ).link_from(model, src).collect()
+    v0 = np.asarray(pred.col("v")[0].data)
+    assert v0.shape == (8,) and np.all(np.isfinite(v0))
+
+
+def _edge_table():
+    # two triangles joined by one bridge edge
+    edges = [(0, 1), (1, 2), (2, 0), (3, 4), (4, 5), (5, 3), (2, 3)]
+    return MTable({
+        "src": np.asarray([f"n{a}" for a, _ in edges], object),
+        "dst": np.asarray([f"n{b}" for _, b in edges], object),
+    }, TableSchema(["src", "dst"], [AlinkTypes.STRING, AlinkTypes.STRING]))
+
+
+def test_deepwalk_walks():
+    t = _edge_table()
+    walks = DeepWalkBatchOp(
+        sourceCol="src", targetCol="dst", walkNum=4, walkLength=8,
+    ).link_from(TableSourceBatchOp(t)).collect()
+    assert walks.num_rows == 6 * 4
+    for p in walks.col("path"):
+        toks = str(p).split(" ")
+        assert len(toks) == 8
+        assert all(tok.startswith("n") for tok in toks)
+
+
+def test_node2vec_walks_and_embedding():
+    t = _edge_table()
+    walks = Node2VecWalkBatchOp(
+        sourceCol="src", targetCol="dst", walkNum=3, walkLength=6,
+        p=0.5, q=2.0,
+    ).link_from(TableSourceBatchOp(t)).collect()
+    assert walks.num_rows == 18
+
+    emb = DeepWalkEmbeddingBatchOp(
+        sourceCol="src", targetCol="dst", walkNum=8, walkLength=12,
+        vectorSize=8, numIter=4,
+    ).link_from(TableSourceBatchOp(t)).collect()
+    assert emb.num_rows == 6
+    vecs = {w: np.asarray(v.data) for w, v in
+            zip(emb.col("word"), emb.col("vec"))}
+    assert all(np.all(np.isfinite(v)) for v in vecs.values())

@@ -1,0 +1,102 @@
+"""Streaming runtime + online learning tests (reference model:
+pyalink ftrl_demo.ipynb — batch warm-start -> FTRL train stream -> hot-swap
+predict -> model filter -> windowed eval)."""
+
+import numpy as np
+
+from alink_tpu.common.mtable import MTable
+from alink_tpu.operator.batch.base import TableSourceBatchOp
+from alink_tpu.operator.batch import LogisticRegressionTrainBatchOp
+from alink_tpu.operator.stream import (
+    BinaryClassModelFilterStreamOp,
+    EvalBinaryClassStreamOp,
+    FtrlPredictStreamOp,
+    FtrlTrainStreamOp,
+    TableSourceStreamOp,
+)
+
+
+def _lr_table(n=600, seed=0, w=(2.0, -3.0), b=0.5):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, 2).astype(np.float64)
+    logits = X @ np.asarray(w) + b
+    y = (1 / (1 + np.exp(-logits)) > rng.rand(n)).astype(np.int64)
+    return MTable({"f0": X[:, 0], "f1": X[:, 1], "label": y})
+
+
+def test_stream_source_roundtrip():
+    t = _lr_table(100)
+    out = TableSourceStreamOp(t, numChunks=7).collect()
+    assert out.num_rows == 100
+    np.testing.assert_array_equal(out.col("label"), t.col("label"))
+
+
+def test_ftrl_train_and_predict():
+    t = _lr_table(800, seed=1)
+    stream = TableSourceStreamOp(t, numChunks=20)
+    train = FtrlTrainStreamOp(
+        featureCols=["f0", "f1"], labelCol="label", alpha=0.5,
+        modelSaveInterval=5,
+    ).link_from(stream)
+    pred = FtrlPredictStreamOp(
+        predictionCol="p", predictionDetailCol="pd"
+    ).link_from(train, TableSourceStreamOp(t, numChunks=20))
+    out = pred.collect()
+    acc = np.mean(
+        np.asarray(out.col("p")).astype(str)
+        == np.asarray(out.col("label")).astype(str)
+    )
+    assert acc > 0.8, acc
+
+
+def test_ftrl_warm_start():
+    t = _lr_table(400, seed=2)
+    batch_model = LogisticRegressionTrainBatchOp(
+        featureCols=["f0", "f1"], labelCol="label",
+    ).link_from(TableSourceBatchOp(t)).collect()
+    stream = TableSourceStreamOp(t, numChunks=10)
+    train = FtrlTrainStreamOp(
+        batch_model, featureCols=["f0", "f1"], labelCol="label",
+        modelSaveInterval=2,
+    ).link_from(stream)
+    models = list(train._stream())
+    assert len(models) == 5
+    # predict with the final snapshot beats chance comfortably
+    pred = FtrlPredictStreamOp(predictionCol="p").link_from(
+        TableSourceStreamOp(models[-1], numChunks=1),
+        TableSourceStreamOp(t, numChunks=4),
+    ).collect()
+    acc = np.mean(
+        np.asarray(pred.col("p")).astype(str)
+        == np.asarray(t.col("label")).astype(str)
+    )
+    assert acc > 0.8, acc
+
+
+def test_model_filter_and_eval():
+    t = _lr_table(600, seed=3)
+    train = FtrlTrainStreamOp(
+        featureCols=["f0", "f1"], labelCol="label", modelSaveInterval=3,
+    ).link_from(TableSourceStreamOp(t, numChunks=15))
+    filt = BinaryClassModelFilterStreamOp(
+        labelCol="label", accuracyThreshold=0.6,
+    ).link_from(train, TableSourceStreamOp(t, numChunks=15))
+    models = list(filt._stream())
+    assert len(models) >= 1
+
+    pred = FtrlPredictStreamOp(
+        predictionCol="p", predictionDetailCol="pd"
+    ).link_from(
+        FtrlTrainStreamOp(
+            featureCols=["f0", "f1"], labelCol="label", modelSaveInterval=3,
+        ).link_from(TableSourceStreamOp(t, numChunks=15)),
+        TableSourceStreamOp(t, numChunks=15),
+    )
+    ev = EvalBinaryClassStreamOp(
+        labelCol="label", predictionDetailCol="pd", positiveLabelValueString="1",
+    ).link_from(pred).collect()
+    import json
+
+    rows = [json.loads(v) for v in ev.col("Data")]
+    assert rows[-1]["Count"] > 0
+    assert 0.0 <= rows[-1]["AUC"] <= 1.0
