@@ -211,6 +211,11 @@ class KerasSequential(nn.Module):
                     x, deterministic=deterministic
                 )
             elif name in ("batchnorm", "batchnormalization"):
+                x = nn.BatchNorm(
+                    use_running_average=deterministic,
+                    dtype=self.dtype, name=f"norm_{i}",
+                )(x)
+            elif name in ("layernorm", "layernormalization"):
                 x = nn.LayerNorm(dtype=self.dtype, name=f"norm_{i}")(x)
             elif name == "flatten":
                 x = x.reshape(x.shape[0], -1)

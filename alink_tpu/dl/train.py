@@ -73,22 +73,40 @@ def _loss_fn(kind: str, regression: bool):
 
 def make_train_step(model, tx, loss_of):
     """One jitted optimizer step — shared by train_model, bench, and the
-    multichip dryrun. ``loss_of(logits, y) -> scalar``."""
+    multichip dryrun. ``loss_of(logits, y) -> scalar``.
+
+    ``variables`` is the full flax variables dict; non-"params" collections
+    (e.g. BatchNorm "batch_stats") are threaded through mutably and excluded
+    from the optimizer update. The optimizer state must be built over
+    ``variables["params"]`` only."""
     import jax
     import optax
 
     @jax.jit
-    def train_step(params, opt_state, batch, y, dkey=None):
+    def train_step(variables, opt_state, batch, y, dkey=None):
+        params = variables["params"]
+        stats = {k: v for k, v in variables.items() if k != "params"}
+        mutable = list(stats.keys())
+
         def loss(p):
             kwargs = {"rngs": {"dropout": dkey}} if dkey is not None else {}
-            logits = model.apply(
-                p, **batch, deterministic=dkey is None, **kwargs
-            )
-            return loss_of(logits, y)
+            if mutable:
+                logits, new_stats = model.apply(
+                    {"params": p, **stats}, **batch,
+                    deterministic=dkey is None, mutable=mutable, **kwargs
+                )
+            else:
+                logits = model.apply(
+                    {"params": p, **stats}, **batch,
+                    deterministic=dkey is None, **kwargs
+                )
+                new_stats = {}
+            return loss_of(logits, y), new_stats
 
-        l, g = jax.value_and_grad(loss)(params)
+        (l, new_stats), g = jax.value_and_grad(loss, has_aux=True)(params)
         updates, opt_state = tx.update(g, opt_state, params)
-        return optax.apply_updates(params, updates), opt_state, l
+        new_params = optax.apply_updates(params, updates)
+        return {"params": new_params, **dict(new_stats)}, opt_state, l
 
     return train_step
 
@@ -146,7 +164,7 @@ def train_model(
     params = jax.device_put(params, p_shard)
 
     tx = _make_optimizer(cfg, total_steps)
-    opt_state = tx.init(params)
+    opt_state = tx.init(params["params"])
     loss_of = _loss_fn(cfg.loss, regression)
 
     def in_shard(arr):

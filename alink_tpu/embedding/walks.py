@@ -49,19 +49,24 @@ def random_walks(
     walks = np.empty((len(starts), walk_length), np.int64)
     walks[:, 0] = starts
     cur = starts.copy()
+    uniform = bool(np.all(weights == weights[0])) if len(weights) else True
     for t in range(1, walk_length):
         deg = indptr[cur + 1] - indptr[cur]
         r = rng.random(len(cur))
         nxt = cur.copy()
         has = deg > 0
-        # weighted pick: cumulative-weight inverse sampling per node
-        idx = np.nonzero(has)[0]
-        for i in idx:  # vectorized below for the uniform fast path
-            s, e = indptr[cur[i]], indptr[cur[i] + 1]
-            w = weights[s:e]
-            cw = np.cumsum(w)
-            j = np.searchsorted(cw, r[i] * cw[-1], side="right")
-            nxt[i] = indices[s + min(j, e - s - 1)]
+        if uniform:
+            # uniform fast path: one vectorized gather for every active walk
+            off = np.minimum((r[has] * deg[has]).astype(np.int64), deg[has] - 1)
+            nxt[has] = indices[indptr[cur[has]] + off]
+        else:
+            # weighted pick: cumulative-weight inverse sampling per node
+            for i in np.nonzero(has)[0]:
+                s, e = indptr[cur[i]], indptr[cur[i] + 1]
+                w = weights[s:e]
+                cw = np.cumsum(w)
+                j = np.searchsorted(cw, r[i] * cw[-1], side="right")
+                nxt[i] = indices[s + min(j, e - s - 1)]
         walks[:, t] = nxt
         cur = nxt
     return walks

@@ -30,7 +30,7 @@ from ...mapper import (
     HasPredictionDetailCol,
     HasReservedCols,
     HasVectorCol,
-    get_feature_block,
+    resolve_feature_cols,
 )
 from ..batch.linear import LinearModelMapper
 from .base import ModelMapStreamOp, StreamOperator
@@ -103,30 +103,51 @@ class FtrlTrainStreamOp(StreamOperator, HasFtrlParams):
         interval = self.get(self.MODEL_SAVE_INTERVAL)
 
         z = n = None
-        labels = None
+        labels: Optional[list] = None
         meta0 = {}
+        vec_col = self.get(HasVectorCol.VECTOR_COL)
+        # resolved once (first chunk / initial model) and persisted in every
+        # snapshot so predict binds to the same columns
+        feat_cols = self.get(HasFeatureCols.FEATURE_COLS)
         if self._initial_model is not None:
             meta0, arrays = table_to_model(self._initial_model)
             w0 = np.concatenate(
                 [arrays["weights"].reshape(-1), arrays["intercept"].reshape(-1)]
             )
             labels = meta0.get("labels")
+            vec_col = vec_col or meta0.get("vectorCol")
+            feat_cols = feat_cols or meta0.get("featureCols")
             # invert the closed form at n=0 so weights(z, 0) == w0
             z = jnp.asarray(-(w0 * (beta / alpha + l2)) - np.sign(w0) * l1)
             n = jnp.zeros_like(z)
 
         batch_no = 0
         for chunk in it:
-            X = get_feature_block(
-                chunk, self, exclude=[label_col],
-                vector_size=self.get(self.VECTOR_SIZE) or None,
-            ).astype(np.float32)
+            if chunk.num_rows == 0:
+                continue
+            if vec_col:
+                X = chunk.to_numeric_block(
+                    [vec_col],
+                    vector_size=self.get(self.VECTOR_SIZE) or None,
+                ).astype(np.float32)
+            else:
+                if feat_cols is None:
+                    feat_cols = resolve_feature_cols(
+                        chunk, self, exclude=[label_col]
+                    )
+                X = chunk.to_numeric_block(feat_cols).astype(np.float32)
             Xb = np.concatenate([X, np.ones((X.shape[0], 1), np.float32)], 1)
-            y_raw = chunk.col(label_col)
+            y_raw = np.asarray(chunk.col(label_col)).tolist()
+            # accumulate distinct labels across chunks; snapshots are held
+            # back until both classes have been observed
             if labels is None:
-                labels = sorted(set(np.asarray(y_raw).tolist()), key=str)
-                if len(labels) < 2:
-                    labels = labels + [None]
+                labels = sorted(set(y_raw), key=str)[:2]
+            elif len(labels) < 2:
+                for v in y_raw:
+                    if v not in labels:
+                        labels = labels + [v]
+                        if len(labels) == 2:
+                            break
             y = np.asarray(
                 [1.0 if v == labels[0] else 0.0 for v in y_raw], np.float32
             )
@@ -140,15 +161,13 @@ class FtrlTrainStreamOp(StreamOperator, HasFtrlParams):
                 )
             z, n, w, _ = step(z, n, jnp.asarray(Xb), jnp.asarray(y))
             batch_no += 1
-            if batch_no % interval == 0:
+            if batch_no % interval == 0 and len(labels) == 2:
                 w_np = np.asarray(w)
                 meta = {
                     "modelName": "LinearModel",
                     "linearModelType": "LR",
-                    "vectorCol": self.get(HasVectorCol.VECTOR_COL),
-                    "featureCols": meta0.get("featureCols")
-                    if self._initial_model is not None
-                    else self.get(HasFeatureCols.FEATURE_COLS),
+                    "vectorCol": vec_col,
+                    "featureCols": feat_cols,
                     "labelCol": label_col,
                     "labelType": meta0.get("labelType", AlinkTypes.STRING)
                     if self._initial_model is not None
@@ -185,21 +204,19 @@ class BinaryClassModelFilterStreamOp(StreamOperator):
 
     LABEL_COL = ParamInfo("labelCol", str, optional=False)
     ACCURACY_THRESHOLD = ParamInfo("accuracyThreshold", float, default=0.5)
+    NUM_EVAL_BATCHES = ParamInfo(
+        "numEvalBatches", int, default=5,
+        desc="evaluate over a sliding window of the last k data micro-batches",
+    )
 
     def _stream_impl(self, model_it, data_it) -> Iterator[MTable]:
         label_col = self.get(self.LABEL_COL)
         thresh = self.get(self.ACCURACY_THRESHOLD)
+        window = max(1, self.get(self.NUM_EVAL_BATCHES))
         data_chunks: List[MTable] = []
-        for model in model_it:
-            # evaluate on the freshest data seen so far
-            try:
-                data_chunks.append(next(data_it))
-            except StopIteration:
-                pass
-            if not data_chunks:
-                yield model
-                continue
-            eval_t = data_chunks[-1]
+
+        def passes(model: MTable) -> bool:
+            eval_t = MTable.concat(data_chunks)
             mapper = LinearModelMapper(
                 model.schema, eval_t.schema,
                 self.get_params().clone().set("predictionCol", "__pred__"),
@@ -211,5 +228,26 @@ class BinaryClassModelFilterStreamOp(StreamOperator):
                     == np.asarray(eval_t.col(label_col)).astype(str)
                 )
             )
-            if acc >= thresh:
+            return acc >= thresh
+
+        pending: Optional[MTable] = None
+        for model in model_it:
+            try:
+                data_chunks.append(next(data_it))
+            except StopIteration:
+                pass
+            del data_chunks[:-window]
+            if not data_chunks:
+                pending = model  # no evidence yet — hold the newest model
+                continue
+            pending = None
+            if passes(model):
                 yield model
+        if pending is not None:
+            # model stream outran the data stream: drain remaining data and
+            # give the newest unevaluated model its quality check
+            for chunk in data_it:
+                data_chunks.append(chunk)
+                del data_chunks[:-window]
+            if data_chunks and passes(pending):
+                yield pending

@@ -54,7 +54,7 @@ def main():
     params = model.init(jax.random.PRNGKey(0), ids[:1], amask[:1])
     params = jax.device_put(params, param_shardings(params, mesh))
     tx = optax.adamw(2e-5, weight_decay=0.01)
-    opt_state = tx.init(params)
+    opt_state = tx.init(params["params"])
 
     def ce(logits, yy):
         return optax.softmax_cross_entropy_with_integer_labels(logits, yy).mean()

@@ -157,3 +157,19 @@ def test_bert_ring_attention_training():
     ).collect()
     acc = np.mean(np.asarray(pred.col("p")) == np.asarray(t.col("label")))
     assert acc > 0.8, acc
+
+
+def test_keras_sequential_batchnorm():
+    """BatchNorm is real flax nn.BatchNorm: batch_stats are created and
+    threaded through training (advisor round-1 finding)."""
+    t = _xor_table(300, seed=7)
+    src = TableSourceBatchOp(t)
+    train = KerasSequentialClassifierTrainBatchOp(
+        layers=["Dense(32)", "BatchNorm()", "Relu()", "Dense(16)", "Relu()"],
+        labelCol="label", numEpochs=150, batchSize=64, learningRate=1e-2,
+    ).link_from(src)
+    pred = KerasSequentialClassifierPredictBatchOp(
+        predictionCol="p"
+    ).link_from(train, src).collect()
+    acc = np.mean(np.asarray(pred.col("p")) == np.asarray(t.col("label")))
+    assert acc > 0.85, acc

@@ -94,3 +94,31 @@ def test_node2vec_walks_and_embedding():
     vecs = {w: np.asarray(v.data) for w, v in
             zip(emb.col("word"), emb.col("vec"))}
     assert all(np.all(np.isfinite(v)) for v in vecs.values())
+
+
+def test_uniform_walk_fast_path_matches_weighted():
+    """Same seed, effectively-equal weights: the vectorized uniform path and
+    the per-node weighted path must produce identical walks."""
+    from alink_tpu.embedding.walks import build_csr, random_walks
+
+    rng = np.random.RandomState(0)
+    src = rng.randint(0, 50, 400)
+    dst = rng.randint(0, 50, 400)
+    indptr, indices, w = build_csr(src, dst)
+    walks_fast = random_walks(indptr, indices, w, num_walks=4, walk_length=10,
+                              seed=3)
+    # flip one weight bit below float32 resolution: disables the uniform
+    # check (weights not all equal) without changing any cumsum, forcing the
+    # per-node weighted path over the same distribution + rng stream
+    w_forced = w.astype(np.float64)
+    w_forced[0] = 1.0 + 1e-13
+    walks_slow = random_walks(indptr, indices, w_forced, num_walks=4,
+                              walk_length=10, seed=3)
+    np.testing.assert_array_equal(walks_fast, walks_slow)
+    assert walks_fast.shape == (200, 10)
+    # every transition is a real edge (or a dead-end repeat)
+    neigh = {v: set(indices[indptr[v]:indptr[v + 1]].tolist())
+             for v in range(50)}
+    for row in walks_fast[:50]:
+        for a, b in zip(row[:-1], row[1:]):
+            assert b in neigh[a] or (a == b and not neigh[a])
