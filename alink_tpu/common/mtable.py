@@ -234,6 +234,18 @@ class MTable:
         cols = {str(c): df[c].to_numpy() for c in df.columns}
         return MTable(cols)
 
+    @staticmethod
+    def empty(schema: "TableSchema | str") -> "MTable":
+        """Zero-row table with correctly-typed columns — the probe input for
+        static schema derivation (ops run on it produce schemas, not data)."""
+        if isinstance(schema, str):
+            schema = TableSchema.parse(schema)
+        cols = {
+            n: np.empty(0, dtype=_NP_OF_TYPE.get(t, object))
+            for n, t in zip(schema.names, schema.types)
+        }
+        return MTable(cols, schema)
+
     # -- basic accessors ---------------------------------------------------
     @property
     def num_rows(self) -> int:

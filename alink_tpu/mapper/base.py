@@ -228,16 +228,18 @@ def get_feature_block(
 
 
 def default_feature_cols(
-    t: MTable,
+    t: "MTable | TableSchema",
     exclude: Optional[Sequence[str]] = None,
     include_vectors: bool = False,
 ) -> List[str]:
     """Every numeric (and optionally vector) column not in ``exclude`` — the
-    shared default-column scan for ops run without explicit featureCols."""
+    shared default-column scan for ops run without explicit featureCols.
+    Works on an MTable or a bare TableSchema (static schema derivation)."""
+    schema = t if isinstance(t, TableSchema) else t.schema
     drop = set(exclude or ())
     cols = [
         n
-        for n, tp in zip(t.names, t.schema.types)
+        for n, tp in zip(schema.names, schema.types)
         if (
             AlinkTypes.is_numeric(tp)
             or (include_vectors and AlinkTypes.is_vector(tp))

@@ -113,10 +113,64 @@ class AlgoOperator(WithParams):
         self._evaluate()
         return len(self._side_tables)
 
-    # schema access (triggers upstream evaluation, see module docstring)
+    # -- static schema derivation ------------------------------------------
+    # The reference computes output schemas at DAG-build time (reference:
+    # Mapper.prepareIoSchema, TableUtil schema derivation). Accessing
+    # ``op.schema`` on an unexecuted chain must therefore never run the job.
+    def _out_schema(self, *in_schemas: TableSchema) -> TableSchema:
+        """Static output schema given the input schemas.
+
+        Default: probe ``_execute_impl`` with zero-row, correctly-typed
+        inputs — row-wise relational ops derive their schema for free this
+        way. Ops whose empty-input execution is expensive, impossible
+        (trainers), or side-effectful (sinks) MUST override."""
+        return self._schema_probe(*in_schemas)[0]
+
+    def _side_schemas(self, *in_schemas: TableSchema) -> List[TableSchema]:
+        """Static schemas of the side outputs (same probe strategy)."""
+        return self._schema_probe(*in_schemas)[1]
+
+    def _schema_probe(self, *in_schemas: TableSchema):
+        key = tuple(s.to_str() for s in in_schemas)
+        cached = getattr(self, "_probe_cache", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        empties = [MTable.empty(s) for s in in_schemas]
+        try:
+            result = self._execute_impl(*empties)
+        except Exception as e:
+            raise AkIllegalOperationException(
+                f"{type(self).__name__} cannot derive a static schema "
+                f"(zero-row probe failed: {e!r}); override _out_schema"
+            ) from e
+        if isinstance(result, tuple):
+            main, sides = result
+            out = (main.schema, [s.schema for s in sides])
+        else:
+            out = (result.schema, [])
+        self._probe_cache = (key, out)
+        return out
+
+    def _static_schema(self) -> TableSchema:
+        if self._executed:
+            return self._output.schema
+        return self._out_schema(*[op._static_schema() for op in self._inputs])
+
+    def _static_model_meta(self) -> "dict | None":
+        """Meta dict of the model table this op will produce, derivable
+        without executing — model-producing ops override with the subset of
+        keys their paired ModelMapper needs for schema decisions (labelType
+        etc.). None = this op does not statically declare model meta."""
+        if self._executed and self._output is not None:
+            from ..common.model import MODEL_SCHEMA, table_to_model
+
+            if self._output.schema == MODEL_SCHEMA:
+                return table_to_model(self._output)[0]
+        return None
+
     @property
     def schema(self) -> TableSchema:
-        return self._evaluate().schema
+        return self._static_schema()
 
     def get_col_names(self) -> List[str]:
         return self.schema.names
@@ -208,10 +262,12 @@ class AlgoOperator(WithParams):
         self,
         fn: Callable[[MTable], MTable],
         name: str = "apply_func",
+        out_schema: "TableSchema | str | None" = None,
     ) -> "AlgoOperator":
         """Escape hatch: arbitrary MTable→MTable host function as a DAG node
-        (reference: udf/udtf ops)."""
-        return _FuncOp(fn, name).link_from(self)
+        (reference: udf/udtf ops). ``out_schema`` declares the result schema
+        for static derivation (like the reference's UDF result types)."""
+        return _FuncOp(fn, name, out_schema).link_from(self)
 
     def __repr__(self):
         state = "executed" if self._executed else "deferred"
@@ -236,17 +292,40 @@ class SideOutputOp(AlgoOperator):
             )
         return sides[self._index]
 
+    def _static_schema(self) -> TableSchema:
+        # bypass the parent's *main* schema: only the side schemas are needed
+        if self._executed:
+            return self._output.schema
+        if self._parent._executed:
+            return self._parent._side_tables[self._index].schema
+        grand = [op._static_schema() for op in self._parent._inputs]
+        sides = self._parent._side_schemas(*grand)
+        if self._index >= len(sides):
+            raise AkIllegalArgumentException(
+                f"side output {self._index} out of range ({len(sides)} declared)"
+            )
+        return sides[self._index]
+
 
 class _FuncOp(AlgoOperator):
     _min_inputs = 1
 
-    def __init__(self, fn, name):
+    def __init__(self, fn, name, out_schema: "TableSchema | str | None" = None):
         super().__init__()
         self._fn = fn
         self._name = name
+        if isinstance(out_schema, str):
+            out_schema = TableSchema.parse(out_schema)
+        self._declared_schema = out_schema
 
     def _execute_impl(self, *inputs: MTable) -> MTable:
         return self._fn(*inputs)
+
+    def _out_schema(self, *in_schemas: TableSchema) -> TableSchema:
+        if self._declared_schema is not None:
+            return self._declared_schema
+        # UDFs without a declared schema fall back to the zero-row probe
+        return super()._out_schema(*in_schemas)
 
 
 class TableSourceOp(AlgoOperator):
@@ -261,3 +340,13 @@ class TableSourceOp(AlgoOperator):
 
     def _execute_impl(self) -> MTable:
         return self._table
+
+    def _out_schema(self) -> TableSchema:
+        return self._table.schema
+
+    def _static_model_meta(self):
+        from ..common.model import MODEL_SCHEMA, table_to_model
+
+        if self._table.schema == MODEL_SCHEMA:
+            return table_to_model(self._table)[0]
+        return None

@@ -59,6 +59,9 @@ class MemSourceBatchOp(BatchOperator):
     def _execute_impl(self) -> MTable:
         return self._table
 
+    def _out_schema(self) -> TableSchema:
+        return self._table.schema
+
 
 class CsvSourceBatchOp(BatchOperator):
     """CSV file source (reference: operator/batch/source/CsvSourceBatchOp.java).
@@ -101,6 +104,9 @@ class CsvSourceBatchOp(BatchOperator):
                 cols[n] = s.to_numpy()
         return MTable(cols, schema)
 
+    def _out_schema(self) -> TableSchema:
+        return TableSchema.parse(self.get(self.SCHEMA_STR))
+
 
 class RandomTableSourceBatchOp(BatchOperator):
     """Random numeric table (reference: operator/batch/source/RandomTableSourceBatchOp.java)."""
@@ -122,6 +128,15 @@ class RandomTableSourceBatchOp(BatchOperator):
             cols = {self.get(self.ID_COL): np.arange(n, dtype=np.int64), **cols}
         return MTable(cols)
 
+    def _out_schema(self) -> TableSchema:
+        d = self.get(self.NUM_COLS)
+        names = self.get(self.OUTPUT_COLS) or [f"col{i}" for i in range(d)]
+        types = [AlinkTypes.DOUBLE] * len(names)
+        if self.get(self.ID_COL):
+            names = [self.get(self.ID_COL)] + list(names)
+            types = [AlinkTypes.LONG] + types
+        return TableSchema(names, types)
+
 
 class NumSeqSourceBatchOp(BatchOperator):
     """Integer sequence source (reference: NumSeqSourceBatchOp.java)."""
@@ -134,6 +149,9 @@ class NumSeqSourceBatchOp(BatchOperator):
 
     def _execute_impl(self) -> MTable:
         return MTable({self._col: np.arange(self._from, self._to + 1, dtype=np.int64)})
+
+    def _out_schema(self) -> TableSchema:
+        return TableSchema([self._col], [AlinkTypes.LONG])
 
 
 class CsvSinkBatchOp(BatchOperator):
@@ -157,6 +175,9 @@ class CsvSinkBatchOp(BatchOperator):
         )
         return t
 
+    def _out_schema(self, in_schema: TableSchema) -> TableSchema:
+        return in_schema  # never probe: a sink must not write on schema access
+
 
 class AkSourceBatchOp(BatchOperator):
     """.ak-file source (reference: AkSourceBatchOp.java; format at
@@ -170,6 +191,26 @@ class AkSourceBatchOp(BatchOperator):
         from ...io.ak import read_ak
 
         return read_ak(self.get(self.FILE_PATH))
+
+    def _out_schema(self) -> TableSchema:
+        from ...io.ak import read_ak_meta
+
+        return TableSchema.parse(read_ak_meta(self.get(self.FILE_PATH))["schema"])
+
+    def _static_model_meta(self):
+        from ...common.model import MODEL_SCHEMA, table_to_model
+        from ...io.ak import read_ak, read_ak_meta
+
+        path = self.get(self.FILE_PATH)
+        cached = getattr(self, "_meta_cache", None)
+        if cached is not None and cached[0] == path:
+            return cached[1]
+        header = read_ak_meta(path)
+        meta = None
+        if TableSchema.parse(header["schema"]) == MODEL_SCHEMA:
+            meta = table_to_model(read_ak(path))[0]
+        self._meta_cache = (path, meta)
+        return meta
 
 
 class AkSinkBatchOp(BatchOperator):
@@ -189,6 +230,9 @@ class AkSinkBatchOp(BatchOperator):
             )
         write_ak(path, t)
         return t
+
+    def _out_schema(self, in_schema: TableSchema) -> TableSchema:
+        return in_schema  # never probe: a sink must not write on schema access
 
 
 class SplitBatchOp(BatchOperator):

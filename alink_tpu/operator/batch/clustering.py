@@ -37,7 +37,7 @@ from ...mapper import (
 from ...parallel.comqueue import shard_rows
 from ...parallel.mesh import AXIS_DATA, default_mesh
 from .base import BatchOperator
-from .utils import ModelMapBatchOp
+from .utils import ModelMapBatchOp, ModelTrainOpMixin
 
 
 class HasKMeansParams(HasVectorCol, HasFeatureCols):
@@ -146,11 +146,14 @@ def _lloyd(mesh, X: np.ndarray, k: int, max_iter: int, tol: float,
     return np.asarray(c), int(iters), float(inertia)
 
 
-class KMeansTrainBatchOp(BatchOperator, HasKMeansParams):
+class KMeansTrainBatchOp(ModelTrainOpMixin, BatchOperator, HasKMeansParams):
     """(reference: operator/batch/clustering/KMeansTrainBatchOp.java)"""
 
     _min_inputs = 1
     _max_inputs = 1
+
+    def _static_meta_keys(self, in_schema):
+        return {"modelName": "KMeansModel"}
 
     def _execute_impl(self, t: MTable) -> MTable:
         k = self.get(self.K)
@@ -254,3 +257,9 @@ class KMeansModelInfoBatchOp(BatchOperator):
                 "center": [" ".join(format(v, "g") for v in row) for row in c],
             }
         )
+
+    def _out_schema(self, in_schema):
+        from ...common.mtable import TableSchema
+
+        return TableSchema(["clusterId", "center"],
+                           [AlinkTypes.LONG, AlinkTypes.STRING])

@@ -43,7 +43,7 @@ from ...mapper import (
     softmax_np,
 )
 from .base import BatchOperator
-from .utils import ModelMapBatchOp
+from .utils import ModelMapBatchOp, ModelTrainOpMixin
 
 
 def _params_to_bytes(params) -> np.ndarray:
@@ -72,7 +72,8 @@ class HasDLTrainParams:
 # ---------------------------------------------------------------------------
 
 
-class BaseKerasSequentialTrainBatchOp(BatchOperator, HasDLTrainParams,
+class BaseKerasSequentialTrainBatchOp(ModelTrainOpMixin, BatchOperator,
+                                      HasDLTrainParams,
                                       HasFeatureCols, HasVectorCol):
     """(reference: common/dl/BaseKerasSequentialTrainBatchOp.java:82)"""
 
@@ -84,6 +85,12 @@ class BaseKerasSequentialTrainBatchOp(BatchOperator, HasDLTrainParams,
     _max_inputs = 1
 
     _regression = False
+
+    def _static_meta_keys(self, in_schema):
+        return {
+            "regression": self._regression,
+            "labelType": in_schema.type_of(self.get(self.LABEL_COL)),
+        }
 
     def _execute_impl(self, t: MTable) -> MTable:
         from ...dl.modules import KerasSequential
@@ -199,7 +206,7 @@ class KerasSequentialRegressorPredictBatchOp(ModelMapBatchOp, HasPredictionCol,
 # ---------------------------------------------------------------------------
 
 
-class BaseBertTextTrainBatchOp(BatchOperator, HasDLTrainParams):
+class BaseBertTextTrainBatchOp(ModelTrainOpMixin, BatchOperator, HasDLTrainParams):
     """(reference: common/dl/BaseEasyTransferTrainBatchOp.java; params
     params/tensorflow/bert/*)"""
 
@@ -223,6 +230,12 @@ class BaseBertTextTrainBatchOp(BatchOperator, HasDLTrainParams):
     _max_inputs = 1
 
     _regression = False
+
+    def _static_meta_keys(self, in_schema):
+        return {
+            "regression": self._regression,
+            "labelType": in_schema.type_of(self.get(self.LABEL_COL)),
+        }
 
     def _bert_config(self, vocab_size: int, num_labels: int):
         from ...dl.modules import BertConfig

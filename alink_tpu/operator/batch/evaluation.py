@@ -19,7 +19,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from ...common.exceptions import AkIllegalDataException
-from ...common.mtable import AlinkTypes, MTable
+from ...common.mtable import AlinkTypes, MTable, TableSchema
 from ...common.params import ParamInfo
 from ...mapper import HasFeatureCols, HasVectorCol
 from .base import BatchOperator
@@ -46,9 +46,18 @@ class BaseEvalBatchOp(BatchOperator):
     _min_inputs = 1
     _max_inputs = 1
 
+    # (name, type) pairs of the scalar metric columns this op emits, in order;
+    # the JSON "Data" column is appended automatically
+    _metric_cols: List = []
+
     def collect_metrics(self) -> Metrics:
         t = self.collect()
         return Metrics(json.loads(t.col("Data")[0]))
+
+    def _out_schema(self, in_schema: TableSchema) -> TableSchema:
+        names = [n for n, _ in self._metric_cols] + ["Data"]
+        types = [t for _, t in self._metric_cols] + [AlinkTypes.STRING]
+        return TableSchema(names, types)
 
 
 class EvalBinaryClassBatchOp(BaseEvalBatchOp):
@@ -59,6 +68,13 @@ class EvalBinaryClassBatchOp(BaseEvalBatchOp):
     LABEL_COL = ParamInfo("labelCol", str, optional=False)
     PREDICTION_DETAIL_COL = ParamInfo("predictionDetailCol", str, optional=False)
     POS_LABEL_VAL_STR = ParamInfo("positiveLabelValueString", str)
+
+    _metric_cols = [
+        ("AUC", AlinkTypes.DOUBLE), ("KS", AlinkTypes.DOUBLE),
+        ("Accuracy", AlinkTypes.DOUBLE), ("Precision", AlinkTypes.DOUBLE),
+        ("Recall", AlinkTypes.DOUBLE), ("F1", AlinkTypes.DOUBLE),
+        ("LogLoss", AlinkTypes.DOUBLE), ("PositiveLabel", AlinkTypes.STRING),
+    ]
 
     def _execute_impl(self, t: MTable) -> MTable:
         y = np.asarray([str(v) for v in t.col(self.get(self.LABEL_COL))])
@@ -123,6 +139,11 @@ class EvalMultiClassBatchOp(BaseEvalBatchOp):
     LABEL_COL = ParamInfo("labelCol", str, optional=False)
     PREDICTION_COL = ParamInfo("predictionCol", str, optional=False)
 
+    _metric_cols = [
+        ("Accuracy", AlinkTypes.DOUBLE), ("MacroPrecision", AlinkTypes.DOUBLE),
+        ("MacroRecall", AlinkTypes.DOUBLE), ("MacroF1", AlinkTypes.DOUBLE),
+    ]
+
     def _execute_impl(self, t: MTable) -> MTable:
         y = np.asarray([str(v) for v in t.col(self.get(self.LABEL_COL))])
         pred = np.asarray([str(v) for v in t.col(self.get(self.PREDICTION_COL))])
@@ -159,6 +180,12 @@ class EvalRegressionBatchOp(BaseEvalBatchOp):
     LABEL_COL = ParamInfo("labelCol", str, optional=False)
     PREDICTION_COL = ParamInfo("predictionCol", str, optional=False)
 
+    _metric_cols = [
+        ("MSE", AlinkTypes.DOUBLE), ("RMSE", AlinkTypes.DOUBLE),
+        ("MAE", AlinkTypes.DOUBLE), ("R2", AlinkTypes.DOUBLE),
+        ("SSE", AlinkTypes.DOUBLE), ("Count", AlinkTypes.LONG),
+    ]
+
     def _execute_impl(self, t: MTable) -> MTable:
         y = np.asarray(t.col(self.get(self.LABEL_COL)), np.float64)
         p = np.asarray(t.col(self.get(self.PREDICTION_COL)), np.float64)
@@ -185,6 +212,15 @@ class EvalClusterBatchOp(BaseEvalBatchOp, HasVectorCol, HasFeatureCols):
 
     PREDICTION_COL = ParamInfo("predictionCol", str, optional=False)
     LABEL_COL = ParamInfo("labelCol", str)
+
+    def _out_schema(self, in_schema: TableSchema) -> TableSchema:
+        names = ["K", "Count", "Compactness", "CalinskiHarabasz"]
+        types = [AlinkTypes.LONG, AlinkTypes.LONG,
+                 AlinkTypes.DOUBLE, AlinkTypes.DOUBLE]
+        if self.get(self.LABEL_COL):
+            names.append("Purity")
+            types.append(AlinkTypes.DOUBLE)
+        return TableSchema(names + ["Data"], types + [AlinkTypes.STRING])
 
     def _execute_impl(self, t: MTable) -> MTable:
         from ...mapper import get_feature_block

@@ -26,7 +26,7 @@ from ...mapper import (
     ModelMapper,
 )
 from .base import BatchOperator
-from .utils import MapBatchOp, ModelMapBatchOp
+from .utils import MapBatchOp, ModelMapBatchOp, ModelTrainOpMixin
 
 
 class VectorAssemblerMapper(Mapper, HasSelectedCols, HasOutputCol, HasReservedCols):
@@ -53,7 +53,7 @@ class VectorAssemblerBatchOp(MapBatchOp, HasSelectedCols, HasOutputCol,
     mapper_cls = VectorAssemblerMapper
 
 
-class StandardScalerTrainBatchOp(BatchOperator, HasSelectedCols):
+class StandardScalerTrainBatchOp(ModelTrainOpMixin, BatchOperator, HasSelectedCols):
     """(reference: StandardScalerTrainBatchOp.java) — one distributed moment
     pass; model = (mean, std) per column."""
 
@@ -77,6 +77,21 @@ class StandardScalerTrainBatchOp(BatchOperator, HasSelectedCols):
         }
         return model_to_table(meta, {"mean": mean, "std": std})
 
+    def _static_meta_keys(self, in_schema):
+        cols = list(self.get(HasSelectedCols.SELECTED_COLS) or
+                    default_feature_cols(in_schema))
+        return {"modelName": "StandardScalerModel", "selectedCols": cols}
+
+
+def _retype_double(schema, cols):
+    from ...common.mtable import TableSchema
+
+    types = [
+        AlinkTypes.DOUBLE if n in cols else t
+        for n, t in zip(schema.names, schema.types)
+    ]
+    return TableSchema(list(schema.names), types)
+
 
 class StandardScalerModelMapper(ModelMapper, HasReservedCols):
     def load_model(self, model: MTable):
@@ -86,7 +101,7 @@ class StandardScalerModelMapper(ModelMapper, HasReservedCols):
         return self
 
     def output_schema(self, input_schema):
-        return input_schema
+        return _retype_double(input_schema, self.meta["selectedCols"])
 
     def map_table(self, t: MTable) -> MTable:
         cols = self.meta["selectedCols"]
@@ -105,7 +120,7 @@ class StandardScalerPredictBatchOp(ModelMapBatchOp, HasReservedCols):
     mapper_cls = StandardScalerModelMapper
 
 
-class MinMaxScalerTrainBatchOp(BatchOperator, HasSelectedCols):
+class MinMaxScalerTrainBatchOp(ModelTrainOpMixin, BatchOperator, HasSelectedCols):
     """(reference: MinMaxScalerTrainBatchOp.java)"""
 
     MIN = ParamInfo("min", float, default=0.0)
@@ -128,6 +143,11 @@ class MinMaxScalerTrainBatchOp(BatchOperator, HasSelectedCols):
             meta, {"dataMin": X.min(axis=0), "dataMax": X.max(axis=0)}
         )
 
+    def _static_meta_keys(self, in_schema):
+        cols = list(self.get(HasSelectedCols.SELECTED_COLS) or
+                    default_feature_cols(in_schema))
+        return {"modelName": "MinMaxScalerModel", "selectedCols": cols}
+
 
 class MinMaxScalerModelMapper(ModelMapper, HasReservedCols):
     def load_model(self, model: MTable):
@@ -138,7 +158,7 @@ class MinMaxScalerModelMapper(ModelMapper, HasReservedCols):
         return self
 
     def output_schema(self, input_schema):
-        return input_schema
+        return _retype_double(input_schema, self.meta["selectedCols"])
 
     def map_table(self, t: MTable) -> MTable:
         lo, hi = self.meta["targetMin"], self.meta["targetMax"]

@@ -62,8 +62,13 @@ def _w2v_model_table(vocab, emb: np.ndarray) -> MTable:
 
 
 class Word2VecTrainBatchOp(BatchOperator, HasWord2VecParams):
+
     _min_inputs = 1
     _max_inputs = 1
+
+    def _out_schema(self, in_schema: TableSchema) -> TableSchema:
+        return TableSchema(["word", "vec"],
+                           [AlinkTypes.STRING, AlinkTypes.DENSE_VECTOR])
 
     def _execute_impl(self, t: MTable) -> MTable:
         delim = self.get(self.WORD_DELIMITER)
@@ -174,6 +179,9 @@ class DeepWalkBatchOp(BatchOperator, HasWalkParams):
     _min_inputs = 1
     _max_inputs = 1
 
+    def _out_schema(self, in_schema: TableSchema) -> TableSchema:
+        return TableSchema(["path"], [AlinkTypes.STRING])
+
     def _execute_impl(self, t: MTable) -> MTable:
         nodes, src, dst, w = _edges_of(self, t)
         indptr, indices, weights = build_csr(
@@ -201,6 +209,9 @@ class Node2VecWalkBatchOp(BatchOperator, HasWalkParams):
     _min_inputs = 1
     _max_inputs = 1
 
+    def _out_schema(self, in_schema: TableSchema) -> TableSchema:
+        return TableSchema(["path"], [AlinkTypes.STRING])
+
     def _execute_impl(self, t: MTable) -> MTable:
         nodes, src, dst, w = _edges_of(self, t)
         indptr, indices, weights = build_csr(
@@ -226,6 +237,10 @@ class _WalkEmbeddingBase(BatchOperator, HasWalkParams, HasWord2VecParams):
     _min_inputs = 1
     _max_inputs = 1
     _walk_op_cls = None
+
+    def _out_schema(self, in_schema: TableSchema) -> TableSchema:
+        return TableSchema(["word", "vec"],
+                           [AlinkTypes.STRING, AlinkTypes.DENSE_VECTOR])
 
     def _execute_impl(self, t: MTable) -> MTable:
         from .base import TableSourceBatchOp

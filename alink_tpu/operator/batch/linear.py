@@ -48,7 +48,7 @@ from ...optim import (
     squared_obj,
 )
 from .base import BatchOperator
-from .utils import ModelMapBatchOp
+from .utils import ModelMapBatchOp, ModelTrainOpMixin
 
 
 class HasLinearTrainParams(HasVectorCol, HasFeatureCols):
@@ -71,7 +71,8 @@ def _labels_of(col: np.ndarray) -> List:
     return vals
 
 
-class BaseLinearModelTrainBatchOp(BatchOperator, HasLinearTrainParams):
+class BaseLinearModelTrainBatchOp(ModelTrainOpMixin, BatchOperator,
+                                  HasLinearTrainParams):
     """Shared train flow: assemble features → standardize → optimize →
     de-standardize weights → model table."""
 
@@ -79,6 +80,13 @@ class BaseLinearModelTrainBatchOp(BatchOperator, HasLinearTrainParams):
     _max_inputs = 1
 
     linear_model_type: str = None  # LR | SVM | LinearReg | Softmax
+
+    def _static_meta_keys(self, in_schema):
+        return {
+            "modelName": "LinearModel",
+            "linearModelType": self.linear_model_type,
+            "labelType": in_schema.type_of(self.get(self.LABEL_COL)),
+        }
 
     # Ridge/Lasso override these to alias their `lambda` param without
     # mutating persistent op state between executions

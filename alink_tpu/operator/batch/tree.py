@@ -35,6 +35,7 @@ from ...mapper import (
 )
 from ...tree import TreeEnsemble, train_forest, train_gbdt
 from .base import BatchOperator
+from .utils import ModelTrainOpMixin
 from .utils import ModelMapBatchOp
 
 
@@ -51,12 +52,19 @@ class HasTreeTrainParams(HasFeatureCols, HasVectorCol):
     RANDOM_SEED = ParamInfo("randomSeed", int, default=0)
 
 
-class _BaseTreeTrainBatchOp(BatchOperator, HasTreeTrainParams):
+class _BaseTreeTrainBatchOp(ModelTrainOpMixin, BatchOperator, HasTreeTrainParams):
     _min_inputs = 1
     _max_inputs = 1
 
     _algo: str = None  # "gbdt" | "forest"
     _regression = False
+
+    def _static_meta_keys(self, in_schema):
+        return {
+            "modelName": "TreeEnsembleModel",
+            "task": "regression" if self._regression else "classification",
+            "labelType": in_schema.type_of(self.get(self.LABEL_COL)),
+        }
     # forced overrides for single-tree variants (DecisionTree)
     _force_num_trees: Optional[int] = None
 

@@ -10,7 +10,9 @@ from __future__ import annotations
 
 from typing import Type
 
-from ...common.mtable import MTable
+from ...common.exceptions import AkIllegalOperationException
+from ...common.model import MODEL_SCHEMA
+from ...common.mtable import MTable, TableSchema
 from ..base import AlgoOperator
 from .base import BatchOperator
 
@@ -32,6 +34,9 @@ class MapBatchOp(BatchOperator):
     def _execute_impl(self, t: MTable) -> MTable:
         return self._make_mapper(t.schema).map_table(t)
 
+    def _out_schema(self, in_schema: TableSchema) -> TableSchema:
+        return self._make_mapper(in_schema).output_schema(in_schema)
+
 
 class ModelMapBatchOp(BatchOperator):
     """Wrap a ModelMapper class; ``link_from(model_op, data_op)``."""
@@ -51,3 +56,42 @@ class ModelMapBatchOp(BatchOperator):
         mapper = self._make_mapper(model.schema, t.schema)
         mapper.load_model(model)
         return mapper.map_table(t)
+
+    def _out_schema(self, model_schema: TableSchema,
+                    data_schema: TableSchema) -> TableSchema:
+        # the mapper's schema decisions (pred type etc.) read model meta;
+        # model-producing ops declare it statically (reference analog:
+        # ModelMapper.prepareIoSchema works off the model *schema* alone)
+        meta = self._inputs[0]._static_model_meta() if self._inputs else None
+        mapper = self._make_mapper(model_schema, data_schema)
+        if meta is not None:
+            mapper.meta = meta
+        try:
+            return mapper.output_schema(data_schema)
+        except (AttributeError, KeyError) as e:
+            raise AkIllegalOperationException(
+                f"{type(self).__name__}: static schema needs model meta that "
+                f"{type(self._inputs[0]).__name__ if self._inputs else '?'} "
+                f"does not declare ({e!r})"
+            ) from e
+
+
+class ModelTrainOpMixin:
+    """Train ops emit the canonical model table; schema is a constant.
+
+    Static model meta: once executed the real meta row wins; before that,
+    ``_static_meta_keys(in_schema)`` supplies the keys the paired
+    ModelMapper's schema decisions need (labelType etc.)."""
+
+    def _out_schema(self, *in_schemas: TableSchema) -> TableSchema:
+        return MODEL_SCHEMA
+
+    def _static_model_meta(self):
+        meta = AlgoOperator._static_model_meta(self)
+        if meta is not None:
+            return meta
+        in_schema = self._inputs[0]._static_schema() if self._inputs else None
+        return self._static_meta_keys(in_schema)
+
+    def _static_meta_keys(self, in_schema: TableSchema) -> dict:
+        return {}

@@ -96,29 +96,45 @@ def test_node2vec_walks_and_embedding():
     assert all(np.all(np.isfinite(v)) for v in vecs.values())
 
 
-def test_uniform_walk_fast_path_matches_weighted():
-    """Same seed, effectively-equal weights: the vectorized uniform path and
-    the per-node weighted path must produce identical walks."""
+def test_uniform_walk_fast_path():
+    """The vectorized uniform path: edges only, and empirically uniform
+    next-hop choice; the weighted path agrees wherever choice is forced."""
     from alink_tpu.embedding.walks import build_csr, random_walks
 
     rng = np.random.RandomState(0)
     src = rng.randint(0, 50, 400)
     dst = rng.randint(0, 50, 400)
     indptr, indices, w = build_csr(src, dst)
-    walks_fast = random_walks(indptr, indices, w, num_walks=4, walk_length=10,
-                              seed=3)
-    # flip one weight bit below float32 resolution: disables the uniform
-    # check (weights not all equal) without changing any cumsum, forcing the
-    # per-node weighted path over the same distribution + rng stream
-    w_forced = w.astype(np.float64)
-    w_forced[0] = 1.0 + 1e-13
-    walks_slow = random_walks(indptr, indices, w_forced, num_walks=4,
-                              walk_length=10, seed=3)
-    np.testing.assert_array_equal(walks_fast, walks_slow)
-    assert walks_fast.shape == (200, 10)
+    walks = random_walks(indptr, indices, w, num_walks=4, walk_length=10,
+                         seed=3)
+    assert walks.shape == (200, 10)
     # every transition is a real edge (or a dead-end repeat)
     neigh = {v: set(indices[indptr[v]:indptr[v + 1]].tolist())
              for v in range(50)}
-    for row in walks_fast[:50]:
+    for row in walks[:50]:
         for a, b in zip(row[:-1], row[1:]):
             assert b in neigh[a] or (a == b and not neigh[a])
+
+    # statistical uniformity on a star graph: center 0 with 4 leaves
+    s2 = np.zeros(4000, np.int64)
+    d2 = np.tile(np.arange(1, 5), 1000)
+    ip, ix, ww = build_csr(s2[:4], d2[:4], directed=True, num_nodes=5)
+    star = random_walks(ip, ix, ww, num_walks=800, walk_length=2, seed=7)
+    hops = star[star[:, 0] == 0][:, 1]
+    counts = np.bincount(hops, minlength=5)[1:]
+    assert counts.sum() == 800
+    # each leaf expected 200 ± 5 sigma (sigma ~ sqrt(800*0.25*0.75) ~ 12.2)
+    assert np.all(np.abs(counts - 200) < 62), counts
+
+    # deterministic agreement where the choice is forced: a weighted chain
+    # with degree-1 nodes must follow the unique edge in both paths
+    cs = np.arange(0, 9)
+    cd = np.arange(1, 10)
+    ip3, ix3, w3 = build_csr(cs, cd, directed=True, num_nodes=10)
+    w_uneq = np.linspace(1.0, 2.0, len(w3)).astype(np.float32)  # weighted path
+    walk_u = random_walks(ip3, ix3, w3, num_walks=1, walk_length=10, seed=1)
+    walk_w = random_walks(ip3, ix3, w_uneq, num_walks=1, walk_length=10, seed=1)
+    start_u = {int(r[0]): r for r in walk_u}
+    start_w = {int(r[0]): r for r in walk_w}
+    np.testing.assert_array_equal(start_u[0], np.arange(10))
+    np.testing.assert_array_equal(start_w[0], np.arange(10))
