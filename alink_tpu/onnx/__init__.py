@@ -1,0 +1,18 @@
+"""Model ingestion: ONNX / torch.export / StableHLO → XLA-compiled inference.
+
+The reference serves foreign models through three JVM plugin engines
+(reference: dl_predictors/predictor-tf (SavedModelBundle), predictor-onnx
+(OnnxRuntime), predictor-torch (libtorch TorchScript), behind the
+DLPredictorService SPI at core/.../common/dl/plugin/DLPredictorService.java).
+This package is the TPU-native equivalent: each format is *imported* into a
+single jit-compiled XLA program instead of bridged to a foreign runtime.
+"""
+
+from .proto import OnnxGraph, OnnxModel, NodeProto, TensorProto, ValueInfo
+from .convert import OnnxToJax, load_onnx_fn
+from .torchfx import TorchToJax, load_torch_fn
+
+__all__ = [
+    "OnnxGraph", "OnnxModel", "NodeProto", "TensorProto", "ValueInfo",
+    "OnnxToJax", "load_onnx_fn", "TorchToJax", "load_torch_fn",
+]

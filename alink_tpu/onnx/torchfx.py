@@ -1,0 +1,575 @@
+"""torch.export ExportedProgram → jittable JAX function.
+
+The reference executes TorchScript through libtorch in the JVM (reference:
+dl_predictors/predictor-torch/.../TorchJavaPredictor.java:29-33 —
+org.pytorch.Module.load + forward). The TPU-native re-design ingests the
+aten-level FX graph produced by ``torch.export`` and lowers each aten op to
+jax.numpy/lax, compiling the whole model into ONE XLA program. Weights are
+materialized to numpy once at load; torch never runs at inference time.
+
+Load path: ``.pt2`` files (torch.export.save) or a live nn.Module.
+TorchScript ``.pt`` files predate torch.export and carry no exportable graph;
+they raise with a pointer to re-export (capability note vs the reference's
+TorchScript path — the artifact format differs, the served models don't).
+"""
+
+from __future__ import annotations
+
+import operator
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..common.exceptions import (
+    AkIllegalArgumentException,
+    AkUnsupportedOperationException,
+)
+
+
+def _t2np(t) -> np.ndarray:
+    return t.detach().cpu().numpy()
+
+
+class TorchToJax:
+    """Compile a torch.export.ExportedProgram into a JAX function."""
+
+    def __init__(self, ep):
+        import torch
+
+        self.ep = ep.run_decompositions({})
+        sig = self.ep.graph_signature
+        self.user_inputs = list(sig.user_inputs)
+        self.user_outputs = list(sig.user_outputs)
+        # placeholder name -> constant numpy value (params, buffers, consts)
+        state: Dict[str, np.ndarray] = {}
+        for name, target in sig.inputs_to_parameters.items():
+            state[name] = _t2np(self.ep.state_dict[target])
+        for name, target in sig.inputs_to_buffers.items():
+            state[name] = _t2np(self.ep.state_dict[target])
+        consts = getattr(self.ep, "constants", {}) or {}
+        for spec in sig.input_specs:
+            target = getattr(spec, "target", None)
+            if target is not None and target in consts:
+                val = consts[target]
+                if hasattr(val, "detach"):
+                    state[spec.arg.name] = _t2np(val)
+        self.state = state
+
+    def function(self) -> Callable[..., List[Any]]:
+        graph = self.ep.graph_module.graph
+        nodes = list(graph.nodes)
+        state = self.state
+        user_inputs = set(self.user_inputs)
+
+        def run(*args):
+            import jax.numpy as jnp
+
+            env: Dict[str, Any] = {}
+            it = iter(args)
+            for node in nodes:
+                if node.op == "placeholder":
+                    if node.name in state:
+                        env[node.name] = state[node.name]
+                    elif node.name in user_inputs or node.target in user_inputs:
+                        env[node.name] = next(it)
+                    else:  # unused input slot
+                        env[node.name] = None
+                elif node.op == "call_function":
+                    env[node.name] = _dispatch(node, env)
+                elif node.op == "output":
+                    outs = node.args[0]
+                    return [_resolve(o, env) for o in outs]
+                elif node.op == "get_attr":
+                    env[node.name] = state.get(node.target)
+                else:
+                    raise AkUnsupportedOperationException(
+                        f"fx node op {node.op!r}"
+                    )
+            return []
+
+        return run
+
+    def jitted(self) -> Callable[..., List[Any]]:
+        import jax
+
+        fn = self.function()
+
+        # pin f32 matmul precision — foreign-model numerics parity on TPU
+        def wrapped(*args):
+            with jax.default_matmul_precision("highest"):
+                return fn(*args)
+
+        return jax.jit(wrapped)
+
+
+def load_torch_fn(path_or_module, example_args: Optional[tuple] = None):
+    """Load a .pt2 exported program (or export a live nn.Module) and return
+    (jitted_fn, converter)."""
+    import torch
+
+    if isinstance(path_or_module, str):
+        if path_or_module.endswith(".pt2"):
+            ep = torch.export.load(path_or_module)
+        else:
+            raise AkIllegalArgumentException(
+                f"{path_or_module!r}: only torch.export .pt2 artifacts are "
+                "ingestable on TPU; re-export TorchScript models with "
+                "torch.export.save(torch.export.export(model, args), 'm.pt2')"
+            )
+    elif isinstance(path_or_module, torch.nn.Module):
+        if example_args is None:
+            raise AkIllegalArgumentException("example_args needed to export")
+        ep = torch.export.export(path_or_module.eval(), example_args)
+    else:
+        ep = path_or_module  # already an ExportedProgram
+    conv = TorchToJax(ep)
+    return conv.jitted(), conv
+
+
+# -- aten dispatch -----------------------------------------------------------
+
+def _resolve(v, env):
+    import torch.fx
+
+    if isinstance(v, torch.fx.Node):
+        return env[v.name]
+    if isinstance(v, (list, tuple)):
+        return type(v)(_resolve(x, env) for x in v)
+    return v
+
+
+def _dispatch(node, env):
+    import torch
+
+    target = node.target
+    args = _resolve(list(node.args), env)
+    kwargs = {k: _resolve(v, env) for k, v in node.kwargs.items()}
+    if target is operator.getitem:
+        return args[0][args[1]]
+    name = getattr(target, "_opname", None) or str(target)
+    # strip overload suffix: aten.add.Tensor -> add
+    key = name.split("::")[-1].split(".")[0] if "::" in name else \
+        str(target).replace("aten.", "").split(".")[0]
+    fn = _ATEN.get(key)
+    if fn is None:
+        raise AkUnsupportedOperationException(
+            f"aten op {target} (key {key!r}) not supported"
+        )
+    return fn(args, kwargs)
+
+
+_ATEN: Dict[str, Callable] = {}
+
+
+def aten(*names):
+    def deco(fn):
+        for n in names:
+            _ATEN[n] = fn
+        return fn
+    return deco
+
+
+def _j(v):
+    import jax.numpy as jnp
+
+    return jnp.asarray(v) if isinstance(v, (np.ndarray, np.generic, int,
+                                            float, bool)) else v
+
+
+def _binop(f):
+    def h(args, kwargs):
+        out = f(_j(args[0]), _j(args[1]))
+        alpha = kwargs.get("alpha")
+        return out if alpha in (None, 1) else f(_j(args[0]),
+                                                _j(args[1]) * alpha)
+    return h
+
+
+def _register_basic():
+    import jax
+    import jax.numpy as jnp
+
+    _ATEN.update({
+        "add": _binop(jnp.add), "sub": _binop(jnp.subtract),
+        "mul": lambda a, k: _j(a[0]) * _j(a[1]),
+        "div": lambda a, k: _j(a[0]) / _j(a[1]),
+        "pow": lambda a, k: _j(a[0]) ** _j(a[1]),
+        "rsqrt": lambda a, k: 1.0 / jnp.sqrt(_j(a[0])),
+        "sqrt": lambda a, k: jnp.sqrt(_j(a[0])),
+        "exp": lambda a, k: jnp.exp(_j(a[0])),
+        "log": lambda a, k: jnp.log(_j(a[0])),
+        "neg": lambda a, k: -_j(a[0]),
+        "abs": lambda a, k: jnp.abs(_j(a[0])),
+        "relu": lambda a, k: jnp.maximum(_j(a[0]), 0),
+        "sigmoid": lambda a, k: jax.nn.sigmoid(_j(a[0])),
+        "silu": lambda a, k: jax.nn.silu(_j(a[0])),
+        "tanh": lambda a, k: jnp.tanh(_j(a[0])),
+        "gelu": lambda a, k: jax.nn.gelu(
+            _j(a[0]),
+            approximate=(k.get("approximate", "none") == "tanh"),
+        ),
+        "hardtanh": lambda a, k: jnp.clip(
+            _j(a[0]), a[1] if len(a) > 1 else -1.0,
+            a[2] if len(a) > 2 else 1.0
+        ),
+        "clamp": lambda a, k: jnp.clip(
+            _j(a[0]), a[1] if len(a) > 1 else None,
+            a[2] if len(a) > 2 else None
+        ),
+        "minimum": lambda a, k: jnp.minimum(_j(a[0]), _j(a[1])),
+        "maximum": lambda a, k: jnp.maximum(_j(a[0]), _j(a[1])),
+        "mm": lambda a, k: _j(a[0]) @ _j(a[1]),
+        "bmm": lambda a, k: jnp.matmul(_j(a[0]), _j(a[1])),
+        "matmul": lambda a, k: jnp.matmul(_j(a[0]), _j(a[1])),
+        "t": lambda a, k: _j(a[0]).T,
+        "addmm": lambda a, k: k.get("beta", 1) * _j(a[0])
+        + k.get("alpha", 1) * (_j(a[1]) @ _j(a[2])),
+        "linear": lambda a, k: _j(a[0]) @ _j(a[1]).T + (
+            _j(a[2]) if len(a) > 2 and a[2] is not None else 0
+        ),
+        "view": lambda a, k: jnp.reshape(_j(a[0]), a[1]),
+        "reshape": lambda a, k: jnp.reshape(_j(a[0]), a[1]),
+        "_unsafe_view": lambda a, k: jnp.reshape(_j(a[0]), a[1]),
+        "expand": lambda a, k: jnp.broadcast_to(
+            _j(a[0]), _expand_shape(_j(a[0]).shape, a[1])
+        ),
+        "permute": lambda a, k: jnp.transpose(_j(a[0]), a[1]),
+        "transpose": lambda a, k: jnp.swapaxes(_j(a[0]), a[1], a[2]),
+        "flatten": lambda a, k: _flatten(_j(a[0]), *a[1:]),
+        "squeeze": lambda a, k: _squeeze(_j(a[0]), *a[1:]),
+        "unsqueeze": lambda a, k: jnp.expand_dims(_j(a[0]), a[1]),
+        "cat": lambda a, k: jnp.concatenate(
+            [_j(x) for x in a[0]], axis=k.get("dim", a[1] if len(a) > 1 else 0)
+        ),
+        "stack": lambda a, k: jnp.stack(
+            [_j(x) for x in a[0]], axis=k.get("dim", a[1] if len(a) > 1 else 0)
+        ),
+        "split": lambda a, k: _split(_j(a[0]), a[1],
+                                     k.get("dim", a[2] if len(a) > 2 else 0)),
+        "chunk": lambda a, k: jnp.array_split(
+            _j(a[0]), a[1], axis=k.get("dim", a[2] if len(a) > 2 else 0)
+        ),
+        "slice": lambda a, k: _slice(_j(a[0]), *a[1:]),
+        "select": lambda a, k: jnp.take(_j(a[0]), a[2], axis=a[1]),
+        "clone": lambda a, k: _j(a[0]),
+        "detach": lambda a, k: _j(a[0]),
+        "alias": lambda a, k: _j(a[0]),
+        "contiguous": lambda a, k: _j(a[0]),
+        "dropout": lambda a, k: _j(a[0]),
+        "_to_copy": lambda a, k: _to_copy(_j(a[0]), k),
+        "to": lambda a, k: _j(a[0]),
+        "softmax": lambda a, k: jax.nn.softmax(_j(a[0]), axis=a[1]),
+        "_softmax": lambda a, k: jax.nn.softmax(_j(a[0]), axis=a[1]),
+        "log_softmax": lambda a, k: jax.nn.log_softmax(_j(a[0]), axis=a[1]),
+        "_log_softmax": lambda a, k: jax.nn.log_softmax(_j(a[0]), axis=a[1]),
+        "mean": lambda a, k: _reduce(jnp.mean, a, k),
+        "sum": lambda a, k: _reduce(jnp.sum, a, k),
+        "amax": lambda a, k: _reduce(jnp.max, a, k),
+        "amin": lambda a, k: _reduce(jnp.min, a, k),
+        "var": lambda a, k: _var(a, k),
+        "argmax": lambda a, k: jnp.argmax(
+            _j(a[0]), axis=a[1] if len(a) > 1 else None
+        ),
+        "embedding": lambda a, k: jnp.take(_j(a[0]),
+                                           _j(a[1]).astype(jnp.int32), axis=0),
+        "arange": _arange,
+        "full": lambda a, k: jnp.full(a[0], a[1]),
+        "zeros": lambda a, k: jnp.zeros(a[0]),
+        "ones": lambda a, k: jnp.ones(a[0]),
+        "where": lambda a, k: jnp.where(_j(a[0]), _j(a[1]), _j(a[2])),
+        "convolution": _convolution,
+        "conv2d": _conv2d,
+        "conv1d": _conv2d,
+        "max_pool2d": _max_pool2d,
+        "max_pool2d_with_indices": lambda a, k: (_max_pool2d(a, k), None),
+        "avg_pool2d": _avg_pool2d,
+        "adaptive_avg_pool2d": _adaptive_avg_pool2d,
+        "_adaptive_avg_pool2d": _adaptive_avg_pool2d,
+        "native_layer_norm": _native_layer_norm,
+        "layer_norm": _layer_norm,
+        "native_batch_norm": _batch_norm,
+        "_native_batch_norm_legit_no_training": _batch_norm,
+        "batch_norm": _batch_norm,
+        "native_group_norm": _group_norm,
+        "scaled_dot_product_attention": _sdpa,
+    })
+
+
+def _expand_shape(cur: Tuple[int, ...], target: Sequence[int]):
+    out = []
+    cur = (1,) * (len(target) - len(cur)) + tuple(cur)
+    for c, t in zip(cur, target):
+        out.append(c if t == -1 else t)
+    return tuple(out)
+
+
+def _flatten(x, start=0, end=-1):
+    import jax.numpy as jnp
+
+    nd = x.ndim
+    start %= nd
+    end %= nd
+    shape = x.shape[:start] + (-1,) + x.shape[end + 1:]
+    return jnp.reshape(x, shape)
+
+
+def _squeeze(x, dims=None):
+    import jax.numpy as jnp
+
+    if dims is None:
+        return jnp.squeeze(x)
+    if isinstance(dims, int):
+        dims = [dims]
+    dims = [d for d in dims if x.shape[d] == 1]
+    return jnp.squeeze(x, axis=tuple(dims)) if dims else x
+
+
+def _split(x, sizes, dim):
+    import jax.numpy as jnp
+
+    if isinstance(sizes, int):
+        n = x.shape[dim] // sizes + (1 if x.shape[dim] % sizes else 0)
+        sizes = [sizes] * n
+        sizes[-1] = x.shape[dim] - sizes[0] * (n - 1)
+    bounds = np.cumsum(sizes)[:-1].tolist()
+    return jnp.split(x, bounds, axis=dim)
+
+
+def _slice(x, dim=0, start=None, end=None, step=1):
+    sl = [slice(None)] * x.ndim
+    if end is not None and end > (1 << 62):
+        end = None
+    sl[dim] = slice(start, end, step)
+    return x[tuple(sl)]
+
+
+def _to_copy(x, kwargs):
+    import torch
+
+    dt = kwargs.get("dtype")
+    if dt is None:
+        return x
+    m = {torch.float32: np.float32, torch.float64: np.float64,
+         torch.int64: np.int64, torch.int32: np.int32, torch.bool: np.bool_,
+         torch.float16: np.float16, torch.bfloat16: "bfloat16"}
+    return x.astype(m.get(dt, np.float32))
+
+
+def _reduce(f, args, kwargs):
+    x = _j(args[0])
+    axis = kwargs.get("dim", args[1] if len(args) > 1 else None)
+    if isinstance(axis, list):
+        axis = tuple(axis)
+    keep = kwargs.get("keepdim", args[2] if len(args) > 2 else False)
+    return f(x, axis=axis, keepdims=keep)
+
+
+def _var(args, kwargs):
+    import jax.numpy as jnp
+
+    x = _j(args[0])
+    axis = kwargs.get("dim", args[1] if len(args) > 1 else None)
+    if isinstance(axis, list):
+        axis = tuple(axis)
+    corr = kwargs.get("correction", 1)
+    keep = kwargs.get("keepdim", False)
+    return jnp.var(x, axis=axis, ddof=int(corr), keepdims=keep)
+
+
+def _arange(args, kwargs):
+    import jax.numpy as jnp
+
+    if len(args) == 1:
+        return jnp.arange(args[0])
+    return jnp.arange(*args[:3])
+
+
+def _convolution(args, kwargs):
+    # aten.convolution(input, weight, bias, stride, padding, dilation,
+    #                  transposed, output_padding, groups)
+    import jax
+
+    x, w, b, stride, padding, dilation, transposed, _outpad, groups = args[:9]
+    x, w = _j(x), _j(w)
+    sp = x.ndim - 2
+    if transposed:
+        raise AkUnsupportedOperationException("transposed convolution")
+    pad = [(int(p), int(p)) for p in padding]
+    lhs = "NC" + "DHW"[-sp:]
+    rhs = "OI" + "DHW"[-sp:]
+    dn = jax.lax.conv_dimension_numbers(x.shape, w.shape, (lhs, rhs, lhs))
+    y = jax.lax.conv_general_dilated(
+        x, w, tuple(int(s) for s in stride), pad,
+        rhs_dilation=tuple(int(d) for d in dilation),
+        dimension_numbers=dn, feature_group_count=int(groups),
+    )
+    if b is not None:
+        y = y + _j(b).reshape((1, -1) + (1,) * sp)
+    return y
+
+
+def _conv2d(args, kwargs):
+    x, w = args[0], args[1]
+    b = args[2] if len(args) > 2 else None
+    stride = args[3] if len(args) > 3 else [1, 1]
+    padding = args[4] if len(args) > 4 else [0, 0]
+    dilation = args[5] if len(args) > 5 else [1, 1]
+    groups = args[6] if len(args) > 6 else 1
+    return _convolution(
+        [x, w, b, stride, padding, dilation, False, [0, 0], groups], kwargs
+    )
+
+
+def _max_pool2d(args, kwargs):
+    import jax
+
+    x = _j(args[0])
+    ks = args[1]
+    stride = args[2] if len(args) > 2 and args[2] else ks
+    padding = args[3] if len(args) > 3 else [0, 0]
+    if isinstance(ks, int):
+        ks = [ks, ks]
+    if isinstance(stride, int):
+        stride = [stride, stride]
+    if isinstance(padding, int):
+        padding = [padding, padding]
+    pad = [(0, 0), (0, 0)] + [(int(p), int(p)) for p in padding]
+    return jax.lax.reduce_window(
+        x, -np.inf, jax.lax.max, (1, 1) + tuple(ks), (1, 1) + tuple(stride),
+        pad,
+    )
+
+
+def _avg_pool2d(args, kwargs):
+    import jax
+    import jax.numpy as jnp
+
+    x = _j(args[0])
+    ks = args[1]
+    stride = args[2] if len(args) > 2 and args[2] else ks
+    padding = args[3] if len(args) > 3 else [0, 0]
+    if isinstance(ks, int):
+        ks = [ks, ks]
+    if isinstance(stride, int):
+        stride = [stride, stride]
+    if isinstance(padding, int):
+        padding = [padding, padding]
+    pad = [(0, 0), (0, 0)] + [(int(p), int(p)) for p in padding]
+    s = jax.lax.reduce_window(
+        x, 0.0, jax.lax.add, (1, 1) + tuple(ks), (1, 1) + tuple(stride), pad
+    )
+    c = jax.lax.reduce_window(
+        jnp.ones_like(x), 0.0, jax.lax.add, (1, 1) + tuple(ks),
+        (1, 1) + tuple(stride), pad,
+    )
+    return s / c
+
+
+def _adaptive_avg_pool2d(args, kwargs):
+    import jax.numpy as jnp
+
+    x = _j(args[0])
+    out = args[1]
+    if isinstance(out, int):
+        out = [out, out]
+    if tuple(out) == (1, 1):
+        return jnp.mean(x, axis=(2, 3), keepdims=True)
+    h, w = x.shape[2], x.shape[3]
+    if h % out[0] or w % out[1]:
+        raise AkUnsupportedOperationException(
+            f"adaptive_avg_pool2d {x.shape} -> {out}"
+        )
+    x = x.reshape(x.shape[0], x.shape[1], out[0], h // out[0],
+                  out[1], w // out[1])
+    return x.mean(axis=(3, 5))
+
+
+def _native_layer_norm(args, kwargs):
+    import jax.numpy as jnp
+
+    x, shape, w, b, eps = args[:5]
+    x = _j(x)
+    axes = tuple(range(x.ndim - len(shape), x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.mean((x - mean) ** 2, axis=axes, keepdims=True)
+    y = (x - mean) / jnp.sqrt(var + eps)
+    if w is not None:
+        y = y * _j(w)
+    if b is not None:
+        y = y + _j(b)
+    return y, mean, var
+
+
+def _layer_norm(args, kwargs):
+    x, shape = args[0], args[1]
+    w = args[2] if len(args) > 2 else kwargs.get("weight")
+    b = args[3] if len(args) > 3 else kwargs.get("bias")
+    eps = args[4] if len(args) > 4 else kwargs.get("eps", 1e-5)
+    return _native_layer_norm([x, shape, w, b, eps], {})[0]
+
+
+def _batch_norm(args, kwargs):
+    import jax.numpy as jnp
+
+    # (input, weight, bias, running_mean, running_var, [training], momentum,
+    #  eps) — legit_no_training drops the `training` slot
+    x = _j(args[0])
+    w, b, rm, rv = args[1:5]
+    eps = args[-1]
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    y = (x - _j(rm).reshape(shape)) / jnp.sqrt(_j(rv).reshape(shape) + eps)
+    if w is not None:
+        y = y * _j(w).reshape(shape)
+    if b is not None:
+        y = y + _j(b).reshape(shape)
+    return y, None, None
+
+
+def _group_norm(args, kwargs):
+    import jax.numpy as jnp
+
+    x, w, b, n, c, hw, groups, eps = args[:8]
+    x = _j(x)
+    orig = x.shape
+    xg = x.reshape(orig[0], groups, -1)
+    mean = xg.mean(axis=2, keepdims=True)
+    var = ((xg - mean) ** 2).mean(axis=2, keepdims=True)
+    y = ((xg - mean) / jnp.sqrt(var + eps)).reshape(orig)
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    if w is not None:
+        y = y * _j(w).reshape(shape)
+    if b is not None:
+        y = y + _j(b).reshape(shape)
+    return y, mean, var
+
+
+def _sdpa(args, kwargs):
+    import jax
+    import jax.numpy as jnp
+
+    q, k, v = [_j(a) for a in args[:3]]
+    mask = _j(args[3]) if len(args) > 3 and args[3] is not None else None
+    scale = kwargs.get("scale") or 1.0 / np.sqrt(q.shape[-1])
+    s = jnp.matmul(q, jnp.swapaxes(k, -1, -2)) * scale
+    if kwargs.get("is_causal"):
+        n, m = s.shape[-2], s.shape[-1]
+        causal = jnp.tril(jnp.ones((n, m), bool))
+        s = jnp.where(causal, s, -jnp.inf)
+    if mask is not None:
+        s = s + mask if mask.dtype != np.bool_ else jnp.where(mask, s, -jnp.inf)
+    return jnp.matmul(jax.nn.softmax(s, axis=-1), v)
+
+
+_basic_registered = False
+_orig_fn = TorchToJax.function
+
+
+def _fn_with_registry(self):
+    global _basic_registered
+    if not _basic_registered:
+        _register_basic()
+        _basic_registered = True
+    return _orig_fn(self)
+
+
+TorchToJax.function = _fn_with_registry

@@ -77,7 +77,14 @@ class SampleBatchOp(SampleOp, BatchOperator):
     pass
 
 
-from .utils import MapBatchOp, ModelMapBatchOp
+from .utils import MapBatchOp, ModelMapBatchOp, ModelTrainOpMixin
+from .modelpredict import (
+    OnnxModelPredictBatchOp,
+    StableHloModelPredictBatchOp,
+    TFSavedModelPredictBatchOp,
+    TorchModelPredictBatchOp,
+    export_stablehlo,
+)
 from .clustering import (
     KMeansModelInfoBatchOp,
     KMeansPredictBatchOp,

@@ -29,7 +29,16 @@ class MapBatchOp(BatchOperator):
         super().__init__(params, **kwargs)
 
     def _make_mapper(self, data_schema):
-        return self.mapper_cls(data_schema, self.get_params())
+        # cached per input schema: foreign-model mappers (modelpredict) load
+        # and convert whole model files, so schema access + execute must
+        # share one instance
+        key = data_schema.to_str()
+        cached = getattr(self, "_mapper_cache", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        mapper = self.mapper_cls(data_schema, self.get_params())
+        self._mapper_cache = (key, mapper)
+        return mapper
 
     def _execute_impl(self, t: MTable) -> MTable:
         return self._make_mapper(t.schema).map_table(t)

@@ -7,6 +7,11 @@ from .base import (
     TableSourceStreamOp,
 )
 from .evaluation import EvalBinaryClassStreamOp
+from .modelpredict import (
+    OnnxModelPredictStreamOp,
+    StableHloModelPredictStreamOp,
+    TorchModelPredictStreamOp,
+)
 from .onlinelearning import (
     BinaryClassModelFilterStreamOp,
     FtrlPredictStreamOp,
@@ -19,6 +24,9 @@ __all__ = [
     "StreamOperator",
     "TableSourceStreamOp",
     "EvalBinaryClassStreamOp",
+    "OnnxModelPredictStreamOp",
+    "StableHloModelPredictStreamOp",
+    "TorchModelPredictStreamOp",
     "BinaryClassModelFilterStreamOp",
     "FtrlPredictStreamOp",
     "FtrlTrainStreamOp",

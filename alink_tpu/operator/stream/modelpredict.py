@@ -1,0 +1,29 @@
+"""Foreign-model predict stream ops (reference:
+operator/stream/onnx/OnnxModelPredictStreamOp.java,
+operator/stream/pytorch/TorchModelPredictStreamOp.java,
+operator/stream/tensorflow/TFSavedModelPredictStreamOp.java).
+
+Each micro-batch runs through the same jit-compiled ingest mapper as the
+batch ops — one device launch per chunk."""
+
+from __future__ import annotations
+
+from ..batch.modelpredict import (
+    HasIngestParams,
+    OnnxModelMapper,
+    StableHloModelMapper,
+    TorchModelMapper,
+)
+from .base import MapStreamOp
+
+
+class OnnxModelPredictStreamOp(MapStreamOp, HasIngestParams):
+    mapper_cls = OnnxModelMapper
+
+
+class TorchModelPredictStreamOp(MapStreamOp, HasIngestParams):
+    mapper_cls = TorchModelMapper
+
+
+class StableHloModelPredictStreamOp(MapStreamOp, HasIngestParams):
+    mapper_cls = StableHloModelMapper
