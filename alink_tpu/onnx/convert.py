@@ -52,6 +52,7 @@ class OnnxToJax:
         }
 
     def function(self) -> Callable[..., Dict[str, Any]]:
+        _ensure_registered()
         graph = self.graph
 
         def run(**inputs):
@@ -264,6 +265,18 @@ def _conv_dims(x_ndim: int):
     return lhs, rhs, lhs
 
 
+def _same_pads(spatial, ks, strides, dils, lower: bool):
+    """Explicit per-dim (lo, hi) pads for SAME_UPPER/SAME_LOWER — ONNX puts
+    the odd pad at the END for UPPER and at the START for LOWER."""
+    out = []
+    for n, k, s, d in zip(spatial, ks, strides, dils):
+        eff_k = (k - 1) * d + 1
+        total = max((int(np.ceil(n / s)) - 1) * s + eff_k - n, 0)
+        half = total // 2
+        out.append((total - half, half) if lower else (half, total - half))
+    return out
+
+
 @op("Conv")
 def _conv(node, args):
     import jax
@@ -276,7 +289,9 @@ def _conv(node, args):
     pads = node.attr("pads")
     auto_pad = node.attr("auto_pad", "NOTSET")
     if auto_pad in ("SAME_UPPER", "SAME_LOWER"):
-        padding = "SAME"
+        ks = [w.shape[2 + i] for i in range(sp)]
+        padding = _same_pads(x.shape[2:], ks, strides, dil,
+                             auto_pad == "SAME_LOWER")
     elif pads is None:
         padding = [(0, 0)] * sp
     else:
@@ -306,7 +321,9 @@ def _pool(node, args, reducer, init, avg: bool):
     window = (1, 1) + tuple(int(k) for k in ks)
     strd = (1, 1) + tuple(int(s) for s in strides)
     if auto_pad in ("SAME_UPPER", "SAME_LOWER"):
-        padding = "SAME"
+        padding = [(0, 0), (0, 0)] + _same_pads(
+            x.shape[2:], ks, strides, [1] * sp, auto_pad == "SAME_LOWER"
+        )
     elif pads is None:
         padding = [(0, 0)] * (sp + 2)
     else:
@@ -637,20 +654,10 @@ _registered = False
 
 
 def _ensure_registered():
+    """Populate the jax-dependent op tables on first use (keeps jax import
+    lazy for pure-codec users)."""
     global _registered
     if not _registered:
         _register_elementwise()
         _register_reduce()
         _registered = True
-
-
-# register lazily on first conversion (jax import deferred)
-_orig_function = OnnxToJax.function
-
-
-def _function_with_registry(self):
-    _ensure_registered()
-    return _orig_function(self)
-
-
-OnnxToJax.function = _function_with_registry

@@ -56,6 +56,7 @@ class TorchToJax:
         self.state = state
 
     def function(self) -> Callable[..., List[Any]]:
+        _ensure_aten_registered()
         graph = self.ep.graph_module.graph
         nodes = list(graph.nodes)
         state = self.state
@@ -287,8 +288,8 @@ def _register_basic():
         "_adaptive_avg_pool2d": _adaptive_avg_pool2d,
         "native_layer_norm": _native_layer_norm,
         "layer_norm": _layer_norm,
-        "native_batch_norm": _batch_norm,
-        "_native_batch_norm_legit_no_training": _batch_norm,
+        "native_batch_norm": _native_batch_norm,
+        "_native_batch_norm_legit_no_training": _batch_norm_no_training,
         "batch_norm": _batch_norm,
         "native_group_norm": _group_norm,
         "scaled_dot_product_attention": _sdpa,
@@ -420,44 +421,67 @@ def _conv2d(args, kwargs):
     )
 
 
+def _pair(v):
+    return [v, v] if isinstance(v, int) else list(v)
+
+
+def _ceil_extra(n, k, s, p, d=1):
+    """Extra right-pad so the output covers ceil((n+2p-eff_k)/s)+1 windows."""
+    eff_k = (k - 1) * d + 1
+    out = int(np.ceil((n + 2 * p - eff_k) / s)) + 1
+    # torch: the last window must start inside input+left padding
+    if (out - 1) * s >= n + p:
+        out -= 1
+    return max((out - 1) * s + eff_k - (n + 2 * p), 0)
+
+
 def _max_pool2d(args, kwargs):
+    # aten.max_pool2d(input, kernel, stride=[], padding=0, dilation=1,
+    #                 ceil_mode=False)
     import jax
 
     x = _j(args[0])
-    ks = args[1]
-    stride = args[2] if len(args) > 2 and args[2] else ks
-    padding = args[3] if len(args) > 3 else [0, 0]
-    if isinstance(ks, int):
-        ks = [ks, ks]
-    if isinstance(stride, int):
-        stride = [stride, stride]
-    if isinstance(padding, int):
-        padding = [padding, padding]
-    pad = [(0, 0), (0, 0)] + [(int(p), int(p)) for p in padding]
+    ks = _pair(args[1])
+    stride = _pair(args[2]) if len(args) > 2 and args[2] else ks
+    padding = _pair(args[3] if len(args) > 3 else 0)
+    dilation = _pair(args[4] if len(args) > 4 else 1)
+    ceil_mode = bool(args[5]) if len(args) > 5 else False
+    pad = [(0, 0), (0, 0)]
+    for i in range(2):
+        hi = padding[i]
+        if ceil_mode:
+            hi += _ceil_extra(x.shape[2 + i], ks[i], stride[i], padding[i],
+                              dilation[i])
+        pad.append((padding[i], hi))
     return jax.lax.reduce_window(
         x, -np.inf, jax.lax.max, (1, 1) + tuple(ks), (1, 1) + tuple(stride),
-        pad,
+        pad, window_dilation=(1, 1) + tuple(dilation),
     )
 
 
 def _avg_pool2d(args, kwargs):
+    # aten.avg_pool2d(input, kernel, stride=[], padding=0, ceil_mode=False,
+    #                 count_include_pad=True, divisor_override=None)
     import jax
     import jax.numpy as jnp
 
     x = _j(args[0])
-    ks = args[1]
-    stride = args[2] if len(args) > 2 and args[2] else ks
-    padding = args[3] if len(args) > 3 else [0, 0]
-    if isinstance(ks, int):
-        ks = [ks, ks]
-    if isinstance(stride, int):
-        stride = [stride, stride]
-    if isinstance(padding, int):
-        padding = [padding, padding]
+    ks = _pair(args[1])
+    stride = _pair(args[2]) if len(args) > 2 and args[2] else ks
+    padding = _pair(args[3] if len(args) > 3 else 0)
+    ceil_mode = bool(args[4]) if len(args) > 4 else False
+    include_pad = bool(args[5]) if len(args) > 5 else True
+    divisor = args[6] if len(args) > 6 else None
+    if ceil_mode:
+        raise AkUnsupportedOperationException("avg_pool2d with ceil_mode")
     pad = [(0, 0), (0, 0)] + [(int(p), int(p)) for p in padding]
     s = jax.lax.reduce_window(
         x, 0.0, jax.lax.add, (1, 1) + tuple(ks), (1, 1) + tuple(stride), pad
     )
+    if divisor:
+        return s / divisor
+    if include_pad:  # torch default: padded zeros count in the denominator
+        return s / float(np.prod(ks))
     c = jax.lax.reduce_window(
         jnp.ones_like(x), 0.0, jax.lax.add, (1, 1) + tuple(ks),
         (1, 1) + tuple(stride), pad,
@@ -508,21 +532,38 @@ def _layer_norm(args, kwargs):
     return _native_layer_norm([x, shape, w, b, eps], {})[0]
 
 
-def _batch_norm(args, kwargs):
+def _batch_norm_impl(x, w, b, rm, rv, eps):
     import jax.numpy as jnp
 
-    # (input, weight, bias, running_mean, running_var, [training], momentum,
-    #  eps) — legit_no_training drops the `training` slot
-    x = _j(args[0])
-    w, b, rm, rv = args[1:5]
-    eps = args[-1]
+    x = _j(x)
     shape = (1, -1) + (1,) * (x.ndim - 2)
     y = (x - _j(rm).reshape(shape)) / jnp.sqrt(_j(rv).reshape(shape) + eps)
     if w is not None:
         y = y * _j(w).reshape(shape)
     if b is not None:
         y = y + _j(b).reshape(shape)
-    return y, None, None
+    return y
+
+
+def _batch_norm(args, kwargs):
+    # aten.batch_norm(input, w, b, rm, rv, training, momentum, eps,
+    #                 cudnn_enabled) -> Tensor
+    return _batch_norm_impl(args[0], args[1], args[2], args[3], args[4],
+                            args[7])
+
+
+def _native_batch_norm(args, kwargs):
+    # aten.native_batch_norm(input, w, b, rm, rv, training, momentum, eps)
+    # -> (out, save_mean, save_invstd)
+    return (_batch_norm_impl(args[0], args[1], args[2], args[3], args[4],
+                             args[7]), None, None)
+
+
+def _batch_norm_no_training(args, kwargs):
+    # aten._native_batch_norm_legit_no_training(input, w, b, rm, rv,
+    #                                           momentum, eps) -> tuple
+    return (_batch_norm_impl(args[0], args[1], args[2], args[3], args[4],
+                             args[6]), None, None)
 
 
 def _group_norm(args, kwargs):
@@ -561,15 +602,11 @@ def _sdpa(args, kwargs):
 
 
 _basic_registered = False
-_orig_fn = TorchToJax.function
 
 
-def _fn_with_registry(self):
+def _ensure_aten_registered():
+    """Populate the jax-dependent aten table on first use."""
     global _basic_registered
     if not _basic_registered:
         _register_basic()
         _basic_registered = True
-    return _orig_fn(self)
-
-
-TorchToJax.function = _fn_with_registry

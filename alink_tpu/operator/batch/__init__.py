@@ -104,6 +104,21 @@ from .linear import (
     SoftmaxPredictBatchOp,
     SoftmaxTrainBatchOp,
 )
+from .recommendation import (
+    AlsItemsPerUserRecommBatchOp,
+    AlsRateRecommBatchOp,
+    AlsSimilarItemsRecommBatchOp,
+    AlsTrainBatchOp,
+    AlsUsersPerItemRecommBatchOp,
+    ItemCfItemsPerUserRecommBatchOp,
+    ItemCfRateRecommBatchOp,
+    ItemCfSimilarItemsRecommBatchOp,
+    ItemCfTrainBatchOp,
+    SwingSimilarItemsRecommBatchOp,
+    SwingTrainBatchOp,
+    UserCfRateRecommBatchOp,
+    UserCfTrainBatchOp,
+)
 from .evaluation import (
     EvalBinaryClassBatchOp,
     EvalClusterBatchOp,

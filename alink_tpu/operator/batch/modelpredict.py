@@ -123,8 +123,10 @@ class _BaseIngestMapper(Mapper):
             for s in range(0, n, bs):
                 chunk = [a[s:s + bs] for a in inputs]
                 m = chunk[0].shape[0]
-                if m < bs and n > bs:
-                    # pad the tail so the compiled program's shape stays fixed
+                if m < bs:
+                    # pad the tail (and short tables) so the compiled
+                    # program's batch shape stays fixed — required for
+                    # fixed-shape StableHLO artifacts, cache-friendly for all
                     chunk = [
                         np.concatenate([c, np.repeat(c[-1:], bs - m, axis=0)])
                         for c in chunk

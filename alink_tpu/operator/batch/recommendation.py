@@ -1,0 +1,508 @@
+"""Recommendation operators: ALS train + serving kernels, ItemCF/UserCF,
+Swing.
+
+Capability parity with the reference (reference:
+operator/batch/recommendation/AlsTrainBatchOp.java (block ALS via
+HugeMfAlsImpl.java:326), AlsRateRecommBatchOp / AlsItemsPerUserRecommBatchOp /
+AlsUsersPerItemRecommBatchOp / AlsSimilarItemsRecommBatchOp, ItemCfTrainBatchOp
+/ UserCfTrainBatchOp / SwingTrainBatchOp and their *RecommBatchOp serving ops —
+all served through the RecommKernel/RecommMapper layer,
+operator/common/recommendation/RecommKernel.java).
+
+Serving re-design: every recommender is a ModelMapper whose scoring is a
+batched device kernel (factor dot products / top_k on the MXU); the
+recommendation column is the reference's JSON format
+{"object":[...],"rate":[...]}.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ...common.exceptions import AkIllegalDataException
+from ...common.model import model_to_table, table_to_model
+from ...common.mtable import AlinkTypes, MTable, TableSchema
+from ...common.params import MinValidator, ParamInfo
+from ...mapper import HasPredictionCol, HasReservedCols, ModelMapper
+from ...recommendation import (
+    interaction_similarity,
+    swing_similarity,
+    train_als,
+)
+from .base import BatchOperator
+from .utils import ModelMapBatchOp, ModelTrainOpMixin
+
+
+class HasRecommTripleCols:
+    USER_COL = ParamInfo("userCol", str, optional=False)
+    ITEM_COL = ParamInfo("itemCol", str, optional=False)
+    RATE_COL = ParamInfo("rateCol", str)
+
+
+# ---------------------------------------------------------------------------
+# ALS
+# ---------------------------------------------------------------------------
+
+class AlsTrainBatchOp(ModelTrainOpMixin, BatchOperator, HasRecommTripleCols):
+    """(reference: AlsTrainBatchOp.java → HugeMfAlsImpl block sweeps)"""
+
+    RANK = ParamInfo("rank", int, default=10, validator=MinValidator(1))
+    NUM_ITER = ParamInfo("numIter", int, default=10, validator=MinValidator(1))
+    LAMBDA = ParamInfo("lambda", float, default=0.1, aliases=("lambda_",))
+    IMPLICIT_PREFS = ParamInfo("implicitPrefs", bool, default=False)
+    ALPHA = ParamInfo("alpha", float, default=40.0)
+    RANDOM_SEED = ParamInfo("randomSeed", int, default=0, aliases=("seed",))
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    def _static_meta_keys(self, in_schema):
+        return {
+            "modelName": "AlsModel",
+            "userCol": self.get(self.USER_COL),
+            "itemCol": self.get(self.ITEM_COL),
+        }
+
+    def _execute_impl(self, t: MTable) -> MTable:
+        user_col = self.get(self.USER_COL)
+        item_col = self.get(self.ITEM_COL)
+        rate_col = self.get(self.RATE_COL)
+        rates = (np.asarray(t.col(rate_col), np.float32) if rate_col
+                 else np.ones(t.num_rows, np.float32))
+        model = train_als(
+            np.asarray(t.col(user_col)), np.asarray(t.col(item_col)), rates,
+            rank=self.get(self.RANK), num_iter=self.get(self.NUM_ITER),
+            lam=self.get(self.LAMBDA),
+            implicit=self.get(self.IMPLICIT_PREFS),
+            alpha=self.get(self.ALPHA), seed=self.get(self.RANDOM_SEED),
+            mesh=self.env.mesh,
+        )
+        meta = {
+            "modelName": "AlsModel",
+            "userCol": user_col,
+            "itemCol": item_col,
+            "rateCol": rate_col,
+            "rank": self.get(self.RANK),
+            "implicitPrefs": self.get(self.IMPLICIT_PREFS),
+        }
+        return model_to_table(meta, {
+            "userIds": model.user_ids,
+            "itemIds": model.item_ids,
+            "userFactors": model.user_factors,
+            "itemFactors": model.item_factors,
+        })
+
+
+class _AlsRecommMapper(ModelMapper, HasPredictionCol, HasReservedCols):
+    """Shared ALS serving state (RecommKernel analog)."""
+
+    USER_COL = ParamInfo("userCol", str)
+    ITEM_COL = ParamInfo("itemCol", str)
+    K = ParamInfo("k", int, default=10)
+
+    def load_model(self, model: MTable):
+        import jax
+
+        self.meta, arrays = table_to_model(model)
+        self.user_ids = arrays["userIds"]
+        self.item_ids = arrays["itemIds"]
+        self.U = arrays["userFactors"].astype(np.float32)
+        self.V = arrays["itemFactors"].astype(np.float32)
+        self.u_index = {v: i for i, v in enumerate(self.user_ids.tolist())}
+        self.i_index = {v: i for i, v in enumerate(self.item_ids.tolist())}
+        self._topk_jit = jax.jit(
+            lambda F, Q, k: jax.lax.top_k(Q @ F.T, k), static_argnums=2
+        )
+        return self
+
+    def _lookup(self, col_vals, index) -> np.ndarray:
+        return np.asarray([index.get(v, -1) for v in col_vals], np.int64)
+
+    def _out_col(self) -> str:
+        return self.get(HasPredictionCol.PREDICTION_COL) or "recomm"
+
+
+def _recomm_json(ids: np.ndarray, scores: np.ndarray, valid: bool) -> str:
+    if not valid:
+        return json.dumps({"object": [], "rate": []})
+    return json.dumps({
+        "object": [v.item() if hasattr(v, "item") else v for v in ids],
+        "rate": [round(float(s), 6) for s in scores],
+    })
+
+
+class AlsRateRecommMapper(_AlsRecommMapper):
+    def output_schema(self, input_schema: TableSchema) -> TableSchema:
+        return self._append_result_schema(
+            input_schema, [self._out_col()], [AlinkTypes.DOUBLE]
+        )
+
+    def map_table(self, t: MTable) -> MTable:
+        u = self._lookup(t.col(self.get(self.USER_COL) or
+                               self.meta["userCol"]), self.u_index)
+        i = self._lookup(t.col(self.get(self.ITEM_COL) or
+                               self.meta["itemCol"]), self.i_index)
+        known = (u >= 0) & (i >= 0)
+        scores = np.einsum(
+            "nk,nk->n", self.U[np.maximum(u, 0)], self.V[np.maximum(i, 0)]
+        ).astype(np.float64)
+        scores[~known] = np.nan
+        out = self._out_col()
+        return self._append_result(t, {out: scores}, {out: AlinkTypes.DOUBLE})
+
+
+class _AlsTopKMapper(_AlsRecommMapper):
+    _query_side = "user"  # user -> items | item -> users | item -> items
+
+    def output_schema(self, input_schema: TableSchema) -> TableSchema:
+        return self._append_result_schema(
+            input_schema, [self._out_col()], [AlinkTypes.STRING]
+        )
+
+    def map_table(self, t: MTable) -> MTable:
+        import jax
+
+        k = self.get(self.K)
+        if self._query_side == "user":
+            col = self.get(self.USER_COL) or self.meta["userCol"]
+            q_idx = self._lookup(t.col(col), self.u_index)
+            Q, F, obj_ids = self.U, self.V, self.item_ids
+        elif self._query_side == "item":
+            col = self.get(self.ITEM_COL) or self.meta["itemCol"]
+            q_idx = self._lookup(t.col(col), self.i_index)
+            Q, F, obj_ids = self.V, self.U, self.user_ids
+        else:  # similar items: cosine over item factors
+            col = self.get(self.ITEM_COL) or self.meta["itemCol"]
+            q_idx = self._lookup(t.col(col), self.i_index)
+            Vn = self.V / np.maximum(
+                np.linalg.norm(self.V, axis=1, keepdims=True), 1e-12
+            )
+            Q, F, obj_ids = Vn, Vn, self.item_ids
+
+        kk = min(k + (1 if self._query_side == "similar" else 0), F.shape[0])
+        queries = Q[np.maximum(q_idx, 0)]
+        scores, idx = jax.device_get(
+            self._topk_jit(F, queries.astype(np.float32), kk)
+        )
+        rows = []
+        for r, (si, sc) in enumerate(zip(idx, scores)):
+            if q_idx[r] < 0:
+                rows.append(_recomm_json(np.empty(0), np.empty(0), False))
+                continue
+            if self._query_side == "similar":
+                keep = si != q_idx[r]
+                si, sc = si[keep][:k], sc[keep][:k]
+            rows.append(_recomm_json(obj_ids[si], sc, True))
+        out = self._out_col()
+        return self._append_result(
+            t, {out: np.asarray(rows, object)}, {out: AlinkTypes.STRING}
+        )
+
+
+class AlsItemsPerUserRecommMapper(_AlsTopKMapper):
+    _query_side = "user"
+
+
+class AlsUsersPerItemRecommMapper(_AlsTopKMapper):
+    _query_side = "item"
+
+
+class AlsSimilarItemsRecommMapper(_AlsTopKMapper):
+    _query_side = "similar"
+
+
+class _RecommOpBase(ModelMapBatchOp, HasPredictionCol, HasReservedCols):
+    USER_COL = _AlsRecommMapper.USER_COL
+    ITEM_COL = _AlsRecommMapper.ITEM_COL
+    K = _AlsRecommMapper.K
+
+
+class AlsRateRecommBatchOp(_RecommOpBase):
+    mapper_cls = AlsRateRecommMapper
+
+
+class AlsItemsPerUserRecommBatchOp(_RecommOpBase):
+    mapper_cls = AlsItemsPerUserRecommMapper
+
+
+class AlsUsersPerItemRecommBatchOp(_RecommOpBase):
+    mapper_cls = AlsUsersPerItemRecommMapper
+
+
+class AlsSimilarItemsRecommBatchOp(_RecommOpBase):
+    mapper_cls = AlsSimilarItemsRecommMapper
+
+
+# ---------------------------------------------------------------------------
+# ItemCF / UserCF / Swing
+# ---------------------------------------------------------------------------
+
+class _CfTrainBase(ModelTrainOpMixin, BatchOperator, HasRecommTripleCols):
+    SIMILARITY_TYPE = ParamInfo("similarityType", str, default="cosine")
+    MAX_NEIGHBOR = ParamInfo("maxNeighborNumber", int, default=64,
+                             aliases=("topK",))
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    _kind = "item"
+    _model_name = "ItemCfModel"
+
+    def _static_meta_keys(self, in_schema):
+        return {
+            "modelName": self._model_name,
+            "userCol": self.get(self.USER_COL),
+            "itemCol": self.get(self.ITEM_COL),
+        }
+
+    def _execute_impl(self, t: MTable) -> MTable:
+        user_col = self.get(self.USER_COL)
+        item_col = self.get(self.ITEM_COL)
+        rate_col = self.get(self.RATE_COL)
+        users = np.asarray(t.col(user_col))
+        items = np.asarray(t.col(item_col))
+        rates = (np.asarray(t.col(rate_col), np.float32) if rate_col
+                 else np.ones(t.num_rows, np.float32))
+        ids, nbrs, sims, _counts = interaction_similarity(
+            users, items, rates, kind=self._kind,
+            metric=self.get(self.SIMILARITY_TYPE),
+            top_k=self.get(self.MAX_NEIGHBOR),
+        )
+        # interactions are part of the model: serving scores new queries
+        # against each user's history (reference: ItemCfRecommKernel)
+        u_ids, u_inv = np.unique(users, return_inverse=True)
+        i_ids, i_inv = np.unique(items, return_inverse=True)
+        meta = {
+            "modelName": self._model_name,
+            "kind": self._kind,
+            "userCol": user_col,
+            "itemCol": item_col,
+            "rateCol": rate_col,
+            "similarityType": self.get(self.SIMILARITY_TYPE),
+        }
+        return model_to_table(meta, {
+            "entityIds": ids,
+            "neighbors": nbrs,
+            "sims": sims,
+            "userIds": u_ids,
+            "itemIds": i_ids,
+            "interU": u_inv.astype(np.int64),
+            "interI": i_inv.astype(np.int64),
+            "interR": rates,
+        })
+
+
+class ItemCfTrainBatchOp(_CfTrainBase):
+    """(reference: ItemCfTrainBatchOp.java)"""
+
+    _kind = "item"
+    _model_name = "ItemCfModel"
+
+
+class UserCfTrainBatchOp(_CfTrainBase):
+    """(reference: UserCfTrainBatchOp.java)"""
+
+    _kind = "user"
+    _model_name = "UserCfModel"
+
+
+class SwingTrainBatchOp(ModelTrainOpMixin, BatchOperator, HasRecommTripleCols):
+    """(reference: SwingTrainBatchOp.java)"""
+
+    ALPHA = ParamInfo("alpha", float, default=1.0)
+    MAX_NEIGHBOR = ParamInfo("maxNeighborNumber", int, default=64,
+                             aliases=("topK",))
+    RATE_COL = ParamInfo("rateCol", str)  # unused; API parity
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    def _static_meta_keys(self, in_schema):
+        return {"modelName": "SwingModel",
+                "itemCol": self.get(self.ITEM_COL)}
+
+    def _execute_impl(self, t: MTable) -> MTable:
+        users = np.asarray(t.col(self.get(self.USER_COL)))
+        items = np.asarray(t.col(self.get(self.ITEM_COL)))
+        ids, nbrs, sims = swing_similarity(
+            users, items, alpha=self.get(self.ALPHA),
+            top_k=self.get(self.MAX_NEIGHBOR),
+        )
+        meta = {
+            "modelName": "SwingModel",
+            "itemCol": self.get(self.ITEM_COL),
+            "userCol": self.get(self.USER_COL),
+        }
+        return model_to_table(
+            meta, {"entityIds": ids, "neighbors": nbrs, "sims": sims}
+        )
+
+
+class _CfRecommMapper(ModelMapper, HasPredictionCol, HasReservedCols):
+    USER_COL = ParamInfo("userCol", str)
+    ITEM_COL = ParamInfo("itemCol", str)
+    K = ParamInfo("k", int, default=10)
+
+    def load_model(self, model: MTable):
+        self.meta, a = table_to_model(model)
+        self.entity_ids = a["entityIds"]
+        self.nbrs = a["neighbors"]
+        self.sims = a["sims"]
+        self.e_index = {v: i for i, v in enumerate(self.entity_ids.tolist())}
+        if "userIds" in a:
+            self.user_ids = a["userIds"]
+            self.item_ids = a["itemIds"]
+            self.u_index = {v: i
+                            for i, v in enumerate(self.user_ids.tolist())}
+            self.i_index = {v: i
+                            for i, v in enumerate(self.item_ids.tolist())}
+            # per-user and per-item histories
+            self.hist: Dict[int, list] = {}
+            self.hist_by_item: Dict[int, list] = {}
+            for u, i, r in zip(a["interU"], a["interI"], a["interR"]):
+                self.hist.setdefault(int(u), []).append((int(i), float(r)))
+                self.hist_by_item.setdefault(int(i), []).append(
+                    (int(u), float(r))
+                )
+            # sparse views of the stored top-K lists — O(n·K) memory, never a
+            # dense n×n matrix: sim_of[i][j] = sim(i,j); rev[j] = [(i, s)]
+            # inverts the lists for column scans
+            self.sim_of: List[Dict[int, float]] = []
+            self.rev: Dict[int, List] = {}
+            for i, (nb, sm) in enumerate(zip(self.nbrs, self.sims)):
+                row = {int(j): float(s) for j, s in zip(nb, sm) if s > 0}
+                self.sim_of.append(row)
+                for j, s in row.items():
+                    self.rev.setdefault(j, []).append((i, s))
+        return self
+
+    def _sim(self, i: int, j: int) -> float:
+        # top-K lists are not symmetric: fall back to the other direction
+        return self.sim_of[i].get(j) or self.sim_of[j].get(i, 0.0)
+
+    def _out_col(self) -> str:
+        return self.get(HasPredictionCol.PREDICTION_COL) or "recomm"
+
+
+class CfRateRecommMapper(_CfRecommMapper):
+    """ItemCf: rate(u,i) = Σ_{j∈I_u} sim(i,j)·r_uj / Σ|sim|;
+    UserCf: rate(u,i) = Σ_{v∈U_i} sim(u,v)·r_vi / Σ|sim| (reference:
+    ItemCfRecommKernel.rate / UserCfRecommKernel.rate)."""
+
+    def output_schema(self, input_schema: TableSchema) -> TableSchema:
+        return self._append_result_schema(
+            input_schema, [self._out_col()], [AlinkTypes.DOUBLE]
+        )
+
+    def map_table(self, t: MTable) -> MTable:
+        ucol = self.get(self.USER_COL) or self.meta["userCol"]
+        icol = self.get(self.ITEM_COL) or self.meta["itemCol"]
+        user_kind = self.meta.get("kind") == "user"
+        out = np.full(t.num_rows, np.nan)
+        for r, (uv, iv) in enumerate(zip(t.col(ucol), t.col(icol))):
+            u = self.u_index.get(uv, -1)
+            i = self.i_index.get(iv, -1)
+            if u < 0 or i < 0:
+                continue
+            if user_kind:
+                pairs = self.hist_by_item.get(i, [])
+                query = u
+            else:
+                pairs = self.hist.get(u, [])
+                query = i
+            num = den = 0.0
+            for e, rate in pairs:
+                s = self._sim(query, e)
+                num += s * rate
+                den += abs(s)
+            out[r] = num / den if den > 0 else np.nan
+        oc = self._out_col()
+        return self._append_result(t, {oc: out}, {oc: AlinkTypes.DOUBLE})
+
+
+class ItemCfItemsPerUserRecommMapper(_CfRecommMapper):
+    """Top-K unseen items scored by similarity-weighted history."""
+
+    def output_schema(self, input_schema: TableSchema) -> TableSchema:
+        return self._append_result_schema(
+            input_schema, [self._out_col()], [AlinkTypes.STRING]
+        )
+
+    def map_table(self, t: MTable) -> MTable:
+        ucol = self.get(self.USER_COL) or self.meta["userCol"]
+        k = self.get(self.K)
+        rows = []
+        for uv in t.col(ucol):
+            u = self.u_index.get(uv, -1)
+            if u < 0 or u not in self.hist:
+                rows.append(_recomm_json(np.empty(0), np.empty(0), False))
+                continue
+            scores = np.zeros(len(self.item_ids), np.float32)
+            seen = []
+            for j, rate in self.hist[u]:
+                # column scan over the inverted top-K lists (plus the row of
+                # j itself, since the lists are not symmetric)
+                for i2, s in self.rev.get(j, []):
+                    scores[i2] += s * rate
+                for i2, s in self.sim_of[j].items():
+                    if j not in self.sim_of[i2]:
+                        scores[i2] += s * rate
+                seen.append(j)
+            scores[seen] = -np.inf
+            top = np.argsort(-scores)[:k]
+            top = top[np.isfinite(scores[top]) & (scores[top] > 0)]
+            rows.append(_recomm_json(self.item_ids[top], scores[top], True))
+        oc = self._out_col()
+        return self._append_result(
+            t, {oc: np.asarray(rows, object)}, {oc: AlinkTypes.STRING}
+        )
+
+
+class _SimilarItemsMapper(_CfRecommMapper):
+    """Top-K neighbors straight from the model's similarity lists (serves
+    ItemCf/UserCf/Swing models alike)."""
+
+    def output_schema(self, input_schema: TableSchema) -> TableSchema:
+        return self._append_result_schema(
+            input_schema, [self._out_col()], [AlinkTypes.STRING]
+        )
+
+    def map_table(self, t: MTable) -> MTable:
+        col = self.get(self.ITEM_COL) or self.meta["itemCol"]
+        k = self.get(self.K)
+        rows = []
+        for v in t.col(col):
+            e = self.e_index.get(v, -1)
+            if e < 0:
+                rows.append(_recomm_json(np.empty(0), np.empty(0), False))
+                continue
+            nb, sm = self.nbrs[e][:k], self.sims[e][:k]
+            keep = sm > 0
+            rows.append(_recomm_json(self.entity_ids[nb[keep]], sm[keep], True))
+        oc = self._out_col()
+        return self._append_result(
+            t, {oc: np.asarray(rows, object)}, {oc: AlinkTypes.STRING}
+        )
+
+
+class ItemCfRateRecommBatchOp(_RecommOpBase):
+    mapper_cls = CfRateRecommMapper
+
+
+class ItemCfItemsPerUserRecommBatchOp(_RecommOpBase):
+    mapper_cls = ItemCfItemsPerUserRecommMapper
+
+
+class ItemCfSimilarItemsRecommBatchOp(_RecommOpBase):
+    mapper_cls = _SimilarItemsMapper
+
+
+class UserCfRateRecommBatchOp(_RecommOpBase):
+    mapper_cls = CfRateRecommMapper
+
+
+class SwingSimilarItemsRecommBatchOp(_RecommOpBase):
+    mapper_cls = _SimilarItemsMapper

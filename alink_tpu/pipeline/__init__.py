@@ -1,5 +1,7 @@
 from .base import EstimatorBase, ModelBase, PipelineStageBase, TransformerBase
 from .estimators import (
+    ALS,
+    ALSModel,
     KMeans,
     KMeansModel,
     Lasso,

@@ -95,3 +95,26 @@ class VectorAssembler(TransformerBase, _feat.HasSelectedCols):
     _map_op_cls = _feat.VectorAssemblerBatchOp
     OUTPUT_COL = _feat.HasOutputCol.OUTPUT_COL
     RESERVED_COLS = _feat.HasReservedCols.RESERVED_COLS
+
+
+# -- recommendation ----------------------------------------------------------
+from ..operator.batch import recommendation as _rec
+
+
+class ALSModel(ModelBase):
+    """transform() scores (user, item) pairs with the factor model."""
+
+    _predict_op_cls = _rec.AlsRateRecommBatchOp
+
+
+class ALS(EstimatorBase, _rec.HasRecommTripleCols):
+    """(reference: pipeline/recommendation/ALS.java / AlsRateRecommender)"""
+
+    _train_op_cls = _rec.AlsTrainBatchOp
+    _model_cls = ALSModel
+    RANK = _rec.AlsTrainBatchOp.RANK
+    NUM_ITER = _rec.AlsTrainBatchOp.NUM_ITER
+    LAMBDA = _rec.AlsTrainBatchOp.LAMBDA
+    IMPLICIT_PREFS = _rec.AlsTrainBatchOp.IMPLICIT_PREFS
+    ALPHA = _rec.AlsTrainBatchOp.ALPHA
+    PREDICTION_COL = _rec._AlsRecommMapper.PREDICTION_COL

@@ -1,0 +1,13 @@
+"""Recommendation core: ALS factorization + neighborhood CF + Swing.
+
+(reference: core/.../operator/common/recommendation/ — HugeMfAlsImpl,
+ItemCf/UserCf kernels, Swing, and the RecommKernel serving layer.)
+"""
+
+from .als import AlsModelData, train_als
+from .cf import interaction_similarity, swing_similarity
+
+__all__ = [
+    "AlsModelData", "train_als",
+    "interaction_similarity", "swing_similarity",
+]

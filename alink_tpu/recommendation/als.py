@@ -1,0 +1,145 @@
+"""Alternating Least Squares matrix factorization — TPU-first.
+
+Capability parity with the reference's block ALS (reference:
+core/src/main/java/com/alibaba/alink/operator/common/recommendation/
+HugeMfAlsImpl.java:326 — block-partitioned alternating sweeps; normal
+equations per user/item block at :409-438; implicit-preference variant per
+Hu/Koren/Volinsky).
+
+TPU re-design: instead of Flink block shuffles, each half-sweep is ONE
+compiled shard_map program. Ratings are laid out as padded per-entity
+neighbor lists (ragged → rectangular, the XLA-friendly shape): for every
+user a fixed-width row of rated item ids + ratings + mask. A sweep gathers
+the (replicated) opposite-side factors, builds every k×k Gramian with one
+einsum (MXU), adds λI, and solves all systems batched; the updated factors
+are re-replicated with an all_gather. The implicit variant adds the shared
+Y^T Y Gramian (computed once per sweep) and confidence weights c = 1 + α r.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ..parallel.mesh import AXIS_DATA, default_mesh, pad_to_multiple
+
+
+@dataclass
+class AlsModelData:
+    user_ids: np.ndarray     # original user id values (n_users,)
+    item_ids: np.ndarray     # original item id values (n_items,)
+    user_factors: np.ndarray  # (n_users, k) float32
+    item_factors: np.ndarray  # (n_items, k) float32
+
+
+def _pad_lists(idx_of: Dict[int, list], count: int) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Ragged neighbor lists → (ids, ratings, mask) rectangles."""
+    max_deg = max((len(v) for v in idx_of.values()), default=1)
+    max_deg = max(max_deg, 1)
+    ids = np.zeros((count, max_deg), np.int32)
+    rts = np.zeros((count, max_deg), np.float32)
+    mask = np.zeros((count, max_deg), np.float32)
+    for e, pairs in idx_of.items():
+        d = len(pairs)
+        if d:
+            ids[e, :d] = [p[0] for p in pairs]
+            rts[e, :d] = [p[1] for p in pairs]
+            mask[e, :d] = 1.0
+    return ids, rts, mask
+
+
+def _half_sweep_fn(mesh, k: int, lam: float, implicit: bool, alpha: float):
+    """Compiled half-sweep: solve all 'left' factors given 'right' factors."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    axis = AXIS_DATA
+
+    def body(ids, rts, mask, cnt, right):
+        # ids/rts/mask: (n_local, D); right: (m, k) replicated
+        V = right[ids]                                  # (n_local, D, k)
+        Vm = V * mask[..., None]
+        if implicit:
+            # A_u = Y^T Y + α Σ r_ui v v^T + λI ; b_u = Σ (1+α r) p v, p=1
+            yty = right.T @ right                       # (k, k), replicated
+            conf = alpha * rts * mask                   # c-1
+            A = jnp.einsum("udk,udl->ukl", Vm * conf[..., None], V)
+            A = A + yty[None] + lam * cnt[:, None, None] * jnp.eye(k)
+            b = jnp.einsum("udk,ud->uk", Vm, (1.0 + conf) * mask)
+        else:
+            A = jnp.einsum("udk,udl->ukl", Vm, V)
+            A = A + lam * jnp.maximum(cnt, 1.0)[:, None, None] * jnp.eye(k)
+            b = jnp.einsum("udk,ud->uk", Vm, rts * mask)
+        sol = jnp.linalg.solve(A, b[..., None])[..., 0]  # batched k×k solves
+        return jnp.where(cnt[:, None] > 0, sol, 0.0)
+
+    return jax.jit(
+        jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(P(axis), P(axis), P(axis), P(axis), P()),
+            out_specs=P(axis), check_vma=False,
+        )
+    )
+
+
+def train_als(
+    users: np.ndarray,
+    items: np.ndarray,
+    ratings: np.ndarray,
+    *,
+    rank: int = 10,
+    num_iter: int = 10,
+    lam: float = 0.1,
+    implicit: bool = False,
+    alpha: float = 40.0,
+    seed: int = 0,
+    mesh=None,
+) -> AlsModelData:
+    """Factorize sparse (user, item, rating) triples. λ is scaled by each
+    entity's rating count (ALS-WR weighting, matching the reference)."""
+    mesh = mesh or default_mesh()
+    dp = mesh.shape[AXIS_DATA]
+
+    u_ids, u_inv = np.unique(users, return_inverse=True)
+    i_ids, i_inv = np.unique(items, return_inverse=True)
+    n_u, n_i = len(u_ids), len(i_ids)
+    r = np.asarray(ratings, np.float32)
+
+    by_user: Dict[int, list] = {u: [] for u in range(n_u)}
+    by_item: Dict[int, list] = {i: [] for i in range(n_i)}
+    for u, i, v in zip(u_inv, i_inv, r):
+        by_user[u].append((i, v))
+        by_item[i].append((u, v))
+
+    uids, urts, umask = _pad_lists(by_user, n_u)
+    iids, irts, imask = _pad_lists(by_item, n_i)
+    ucnt = umask.sum(1)
+    icnt = imask.sum(1)
+
+    rng = np.random.default_rng(seed)
+    scale = 1.0 / np.sqrt(rank)
+    U = (rng.standard_normal((n_u, rank)) * scale).astype(np.float32)
+    V = (rng.standard_normal((n_i, rank)) * scale).astype(np.float32)
+
+    sweep = _half_sweep_fn(mesh, rank, lam, implicit, alpha)
+
+    def pad(arr):
+        n = arr.shape[0]
+        np_ = pad_to_multiple(max(n, dp), dp)
+        if np_ != n:
+            arr = np.pad(arr, [(0, np_ - n)] + [(0, 0)] * (arr.ndim - 1))
+        return arr
+
+    u_in = [pad(x) for x in (uids, urts, umask, ucnt)]
+    i_in = [pad(x) for x in (iids, irts, imask, icnt)]
+
+    import jax
+
+    for _ in range(num_iter):
+        U = np.asarray(jax.device_get(sweep(*u_in, V)))[:n_u]
+        V = np.asarray(jax.device_get(sweep(*i_in, U)))[:n_i]
+
+    return AlsModelData(u_ids, i_ids, U, V)

@@ -249,3 +249,46 @@ def test_tf_savedmodel_shim_raises():
     ).link_from(TableSourceBatchOp(t))
     with pytest.raises(AkUnsupportedOperationException):
         op.collect()
+
+
+def test_torch_pooling_semantics():
+    """count_include_pad (avg) and ceil_mode/dilation (max) match torch."""
+    import torch
+    import torch.nn as nn
+
+    from alink_tpu.onnx import load_torch_fn
+
+    torch.manual_seed(2)
+    x = torch.randn(1, 2, 6, 6)
+    for mod in [
+        nn.AvgPool2d(2, stride=2, padding=1),
+        nn.AvgPool2d(3, stride=2, padding=1, count_include_pad=False),
+        nn.MaxPool2d(3, stride=2, ceil_mode=True),
+        nn.MaxPool2d(3, stride=1, dilation=2),
+    ]:
+        fn, _ = load_torch_fn(mod.eval(), (x,))
+        out = np.asarray(fn(x.numpy())[0])
+        with torch.no_grad():
+            ref = mod(x).numpy()
+        assert out.shape == ref.shape, (mod, out.shape, ref.shape)
+        np.testing.assert_allclose(out, ref, atol=1e-5, err_msg=str(mod))
+
+
+def test_stablehlo_short_table(tmp_path):
+    """Tables smaller than predictBatchSize pad up to the fixed batch."""
+    import jax
+
+    def forward(x):
+        return x @ np.ones((3, 2), np.float32)
+
+    path = str(tmp_path / "f.hlo")
+    export_stablehlo(forward, (np.zeros((4, 3), np.float32),), path)
+    X = np.random.RandomState(0).rand(2, 3)  # 2 rows < batch 4
+    t = MTable({"a": X[:, 0], "b": X[:, 1], "c": X[:, 2]})
+    out = StableHloModelPredictBatchOp(
+        modelPath=path, selectedCols=["a", "b", "c"], outputCols=["y"],
+        predictBatchSize=4,
+    ).link_from(TableSourceBatchOp(t)).collect()
+    got = np.stack(list(out.col("y")))
+    np.testing.assert_allclose(got, X.astype(np.float32).sum(1)[:, None]
+                               @ np.ones((1, 2)), atol=1e-5)

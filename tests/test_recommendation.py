@@ -1,0 +1,236 @@
+"""Recommendation family tests (reference model: AlsTrainBatchOpTest,
+ItemCfTrainBatchOpTest, SwingTrainBatchOpTest + RecommKernel serving tests)."""
+
+import json
+
+import numpy as np
+
+from alink_tpu.common.mtable import AlinkTypes, MTable
+from alink_tpu.operator.batch import (
+    AlsItemsPerUserRecommBatchOp,
+    AlsRateRecommBatchOp,
+    AlsSimilarItemsRecommBatchOp,
+    AlsTrainBatchOp,
+    AlsUsersPerItemRecommBatchOp,
+    ItemCfItemsPerUserRecommBatchOp,
+    ItemCfRateRecommBatchOp,
+    ItemCfSimilarItemsRecommBatchOp,
+    ItemCfTrainBatchOp,
+    SwingSimilarItemsRecommBatchOp,
+    SwingTrainBatchOp,
+    TableSourceBatchOp,
+    UserCfRateRecommBatchOp,
+    UserCfTrainBatchOp,
+)
+
+
+def _low_rank_ratings(n_u=40, n_i=30, k=4, seed=0, keep=0.6):
+    """Observed entries of a rank-k matrix, plus the full ground truth."""
+    rng = np.random.RandomState(seed)
+    U = rng.randn(n_u, k) / np.sqrt(k)
+    V = rng.randn(n_i, k) / np.sqrt(k)
+    M = U @ V.T
+    mask = rng.rand(n_u, n_i) < keep
+    us, is_ = np.nonzero(mask)
+    return us, is_, M[us, is_], M
+
+
+def test_als_recovers_low_rank():
+    us, is_, r, M = _low_rank_ratings()
+    t = MTable({"user": us.astype(np.int64), "item": is_.astype(np.int64),
+                "rating": r})
+    src = TableSourceBatchOp(t)
+    train = AlsTrainBatchOp(
+        userCol="user", itemCol="item", rateCol="rating",
+        rank=4, numIter=15, **{"lambda": 0.01},
+    ).link_from(src)
+    pred = AlsRateRecommBatchOp(predictionCol="p").link_from(train, src)
+    out = pred.collect()
+    rmse = float(np.sqrt(np.mean(
+        (np.asarray(out.col("p")) - r) ** 2
+    )))
+    assert rmse < 0.08, rmse
+    # held-out entries reconstruct too (generalization, not memorization)
+    held_u, held_i = np.nonzero(np.ones_like(M, dtype=bool))
+    ht = MTable({"user": held_u.astype(np.int64),
+                 "item": held_i.astype(np.int64)})
+    hp = AlsRateRecommBatchOp(predictionCol="p").link_from(
+        train, TableSourceBatchOp(ht)
+    ).collect()
+    rmse_all = float(np.sqrt(np.nanmean(
+        (np.asarray(hp.col("p")) - M[held_u, held_i]) ** 2
+    )))
+    assert rmse_all < 0.25, rmse_all
+
+
+def test_als_implicit_ranks_positives():
+    rng = np.random.RandomState(1)
+    # two user groups, two item groups; users interact within their group
+    us, is_ = [], []
+    for u in range(20):
+        grp = u % 2
+        for i in range(15):
+            if i % 2 == grp and rng.rand() < 0.8:
+                us.append(u)
+                is_.append(i)
+    t = MTable({"user": np.asarray(us, np.int64),
+                "item": np.asarray(is_, np.int64)})
+    src = TableSourceBatchOp(t)
+    train = AlsTrainBatchOp(
+        userCol="user", itemCol="item", rank=4, numIter=10,
+        implicitPrefs=True, alpha=20.0, **{"lambda": 0.05},
+    ).link_from(src)
+    rec = AlsItemsPerUserRecommBatchOp(predictionCol="rec", k=5).link_from(
+        train, TableSourceBatchOp(MTable({"user": np.arange(4, dtype=np.int64)}))
+    ).collect()
+    for row, user in zip(rec.col("rec"), range(4)):
+        items = json.loads(row)["object"]
+        assert items, "no recommendations"
+        grp_match = sum(1 for i in items if i % 2 == user % 2)
+        assert grp_match >= len(items) * 0.6, (user, items)
+
+
+def test_als_topk_and_similar_ops():
+    us, is_, r, _ = _low_rank_ratings(20, 12, 3, seed=2)
+    t = MTable({"user": us.astype(np.int64), "item": is_.astype(np.int64),
+                "rating": r})
+    train = AlsTrainBatchOp(
+        userCol="user", itemCol="item", rateCol="rating", rank=3, numIter=5,
+    ).link_from(TableSourceBatchOp(t))
+    users = MTable({"user": np.asarray([0, 1, 999], np.int64)})
+    rec = AlsItemsPerUserRecommBatchOp(predictionCol="rec", k=4).link_from(
+        train, TableSourceBatchOp(users)
+    ).collect()
+    assert rec.schema.type_of("rec") == AlinkTypes.STRING
+    d0 = json.loads(rec.col("rec")[0])
+    assert len(d0["object"]) == 4 and len(d0["rate"]) == 4
+    assert json.loads(rec.col("rec")[2])["object"] == []  # unknown user
+
+    items = MTable({"item": np.asarray([0, 5], np.int64)})
+    upi = AlsUsersPerItemRecommBatchOp(predictionCol="rec", k=3).link_from(
+        train, TableSourceBatchOp(items)
+    ).collect()
+    assert len(json.loads(upi.col("rec")[0])["object"]) == 3
+
+    sim = AlsSimilarItemsRecommBatchOp(predictionCol="rec", k=3).link_from(
+        train, TableSourceBatchOp(items)
+    ).collect()
+    d = json.loads(sim.col("rec")[0])
+    assert 0 not in d["object"] and len(d["object"]) == 3
+
+
+def test_item_cf_rate_and_topk():
+    # item 0 and 1 co-rated by everyone, item 2 by nobody who rated 0
+    users = np.repeat(np.arange(8), 2)
+    items = np.tile([0, 1], 8)
+    users = np.concatenate([users, [8, 8]])
+    items = np.concatenate([items, [2, 3]])
+    rates = np.ones(len(users))
+    t = MTable({"u": users.astype(np.int64), "i": items.astype(np.int64),
+                "r": rates})
+    train = ItemCfTrainBatchOp(userCol="u", itemCol="i", rateCol="r"
+                               ).link_from(TableSourceBatchOp(t))
+    sim = ItemCfSimilarItemsRecommBatchOp(
+        predictionCol="rec", k=2, itemCol="i"
+    ).link_from(train, TableSourceBatchOp(
+        MTable({"i": np.asarray([0], np.int64)})
+    )).collect()
+    d = json.loads(sim.col("rec")[0])
+    assert d["object"][0] == 1  # strongest co-occurrence
+
+    pairs = MTable({"u": np.asarray([0, 0], np.int64),
+                    "i": np.asarray([1, 2], np.int64)})
+    rate = ItemCfRateRecommBatchOp(predictionCol="p").link_from(
+        train, TableSourceBatchOp(pairs)
+    ).collect()
+    p = np.asarray(rate.col("p"))
+    assert p[0] > 0  # item 1 similar to user 0's history
+    assert np.isnan(p[1]) or p[1] == 0  # item 2 unrelated
+
+    topk = ItemCfItemsPerUserRecommBatchOp(
+        predictionCol="rec", k=3, userCol="u"
+    ).link_from(train, TableSourceBatchOp(
+        MTable({"u": np.asarray([0], np.int64)})
+    )).collect()
+    d = json.loads(topk.col("rec")[0])
+    assert 0 not in d["object"] and 1 not in d["object"]  # seen items excluded
+
+
+def test_user_cf_rate():
+    users = np.repeat(np.arange(6), 3)
+    items = np.tile([0, 1, 2], 6)
+    rng = np.random.RandomState(3)
+    rates = np.where(users % 2 == 0, 5.0, 1.0) + rng.rand(len(users)) * 0.1
+    t = MTable({"u": users.astype(np.int64), "i": items.astype(np.int64),
+                "r": rates})
+    train = UserCfTrainBatchOp(userCol="u", itemCol="i", rateCol="r"
+                               ).link_from(TableSourceBatchOp(t))
+    pairs = MTable({"u": np.asarray([0], np.int64),
+                    "i": np.asarray([0], np.int64)})
+    out = UserCfRateRecommBatchOp(predictionCol="p").link_from(
+        train, TableSourceBatchOp(pairs)
+    ).collect()
+    assert np.isfinite(out.col("p")[0])
+
+
+def test_swing_similarity():
+    # items 0,1 share many user pairs; item 2 isolated
+    users, items = [], []
+    for u in range(6):
+        users += [u, u]
+        items += [0, 1]
+    users += [6]
+    items += [2]
+    t = MTable({"u": np.asarray(users, np.int64),
+                "i": np.asarray(items, np.int64)})
+    train = SwingTrainBatchOp(userCol="u", itemCol="i").link_from(
+        TableSourceBatchOp(t)
+    )
+    sim = SwingSimilarItemsRecommBatchOp(
+        predictionCol="rec", k=2, itemCol="i"
+    ).link_from(train, TableSourceBatchOp(
+        MTable({"i": np.asarray([0, 2], np.int64)})
+    )).collect()
+    d0 = json.loads(sim.col("rec")[0])
+    assert d0["object"] == [1]
+    assert json.loads(sim.col("rec")[1])["object"] == []  # isolated item
+
+
+def test_als_pipeline_and_persistence(tmp_path):
+    from alink_tpu.pipeline import ALS, Pipeline
+
+    us, is_, r, _ = _low_rank_ratings(15, 10, 3, seed=4)
+    t = MTable({"user": us.astype(np.int64), "item": is_.astype(np.int64),
+                "rating": r})
+    est = ALS(userCol="user", itemCol="item", rateCol="rating",
+              rank=3, numIter=20, predictionCol="p", **{"lambda": 0.01})
+    model = Pipeline(est).fit(t)
+    out = model.transform(t).collect()
+    rmse = float(np.sqrt(np.mean((np.asarray(out.col("p")) - r) ** 2)))
+    assert rmse < 0.15, rmse
+    path = str(tmp_path / "als_pipe.ak")
+    model.save(path)
+    from alink_tpu.pipeline import PipelineModel
+
+    loaded = PipelineModel.load(path)
+    out2 = loaded.transform(t).collect()
+    np.testing.assert_allclose(
+        np.asarray(out2.col("p")), np.asarray(out.col("p")), rtol=1e-5
+    )
+
+
+def test_item_cf_jaccard():
+    users = np.repeat(np.arange(8), 2)
+    items = np.tile([0, 1], 8)
+    t = MTable({"u": users.astype(np.int64), "i": items.astype(np.int64)})
+    train = ItemCfTrainBatchOp(
+        userCol="u", itemCol="i", similarityType="jaccard"
+    ).link_from(TableSourceBatchOp(t))
+    sim = ItemCfSimilarItemsRecommBatchOp(
+        predictionCol="rec", k=1, itemCol="i"
+    ).link_from(train, TableSourceBatchOp(
+        MTable({"i": np.asarray([0], np.int64)})
+    )).collect()
+    d = json.loads(sim.col("rec")[0])
+    assert d["object"] == [1]
+    assert abs(d["rate"][0] - 1.0) < 1e-6  # identical user sets -> jaccard 1
