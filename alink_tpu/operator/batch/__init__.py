@@ -104,6 +104,26 @@ from .linear import (
     SoftmaxPredictBatchOp,
     SoftmaxTrainBatchOp,
 )
+from .outlier import (
+    BoxPlotOutlier4GroupedDataBatchOp,
+    BoxPlotOutlierBatchOp,
+    CopodOutlierBatchOp,
+    EcodOutlierBatchOp,
+    EsdOutlier4GroupedDataBatchOp,
+    EsdOutlierBatchOp,
+    EvalOutlierBatchOp,
+    HbosOutlierBatchOp,
+    IForestOutlier4GroupedDataBatchOp,
+    IForestOutlierBatchOp,
+    KdeOutlierBatchOp,
+    KSigmaOutlier4GroupedDataBatchOp,
+    KSigmaOutlierBatchOp,
+    LofOutlierBatchOp,
+    MadOutlier4GroupedDataBatchOp,
+    MadOutlierBatchOp,
+    ShEsdOutlier4GroupedDataBatchOp,
+    ShEsdOutlierBatchOp,
+)
 from .recommendation import (
     AlsItemsPerUserRecommBatchOp,
     AlsRateRecommBatchOp,

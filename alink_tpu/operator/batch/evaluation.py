@@ -35,6 +35,21 @@ class Metrics(dict):
             raise AttributeError(k)
 
 
+def rank_auc(scores: np.ndarray, y: np.ndarray) -> float:
+    """AUC by the rank statistic with tie-averaged ranks; y is boolean."""
+    order = np.argsort(scores, kind="stable")
+    ranks = np.empty(len(scores), np.float64)
+    sp = scores[order]
+    uniq, inv, counts = np.unique(sp, return_inverse=True, return_counts=True)
+    cum = np.cumsum(counts)
+    avg_rank = cum - (counts - 1) / 2.0
+    ranks[order] = avg_rank[inv]
+    n_pos, n_neg = int(y.sum()), int((~y).sum())
+    if n_pos == 0 or n_neg == 0:
+        return float("nan")
+    return float((ranks[y].sum() - n_pos * (n_pos + 1) / 2) / (n_pos * n_neg))
+
+
 def _metrics_table(metrics: Dict) -> MTable:
     flat = {k: v for k, v in metrics.items() if isinstance(v, (int, float, str))}
     cols = {k: [v] for k, v in flat.items()}
@@ -86,20 +101,8 @@ class EvalBinaryClassBatchOp(BaseEvalBatchOp):
         p = np.asarray([d.get(pos, 0.0) for d in details], np.float64)
         yb = (y == pos).astype(np.int64)
 
-        # AUC by rank statistic (ties get average rank)
-        order = np.argsort(p, kind="stable")
-        ranks = np.empty_like(p)
-        sp = p[order]
-        # average ranks over ties
-        uniq, inv, counts = np.unique(sp, return_inverse=True, return_counts=True)
-        cum = np.cumsum(counts)
-        avg_rank = (cum - (counts - 1) / 2.0)
-        ranks[order] = avg_rank[inv]
         n_pos, n_neg = yb.sum(), (1 - yb).sum()
-        if n_pos == 0 or n_neg == 0:
-            auc = float("nan")
-        else:
-            auc = (ranks[yb == 1].sum() - n_pos * (n_pos + 1) / 2) / (n_pos * n_neg)
+        auc = rank_auc(p, yb.astype(bool))
 
         pred = (p >= 0.5).astype(np.int64)
         tp = int(((pred == 1) & (yb == 1)).sum())

@@ -1,0 +1,348 @@
+"""Outlier batch operators + grouped-series variants + evaluation.
+
+Capability parity with the reference (reference: operator/batch/outlier/ —
+KSigmaOutlierBatchOp.java, BoxPlotOutlierBatchOp.java, MadOutlierBatchOp,
+EsdOutlierBatchOp, ShEsdOutlierBatchOp, HbosOutlierBatchOp, KdeOutlierBatchOp,
+LofOutlierBatchOp, IForestOutlierBatchOp, EcodOutlierBatchOp,
+CopodOutlierBatchOp and the *Outlier4GroupedDataBatchOp series variants;
+base harness common/outlier/BaseOutlierBatchOp.java + OutlierDetector.java;
+evaluation/EvalOutlierBatchOp.java).
+
+One shared harness: detectors are pure scoring functions (alink_tpu.outlier);
+ops bind columns, run the scorer (device matmuls for the O(n²) ones), and
+append predictionCol (bool) + predictionDetailCol (JSON {outlier_score}).
+Grouped variants partition by groupCols and score each group's series
+independently — the reference's per-group task parallelism becomes a host
+loop over columnar slices feeding the same vectorized kernels.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from ...common.exceptions import AkIllegalArgumentException
+from ...common.mtable import AlinkTypes, MTable, TableSchema
+from ...common.params import ParamInfo
+from ...mapper import (
+    HasFeatureCols,
+    HasPredictionCol,
+    HasPredictionDetailCol,
+    HasVectorCol,
+    get_feature_block,
+)
+from .base import BatchOperator
+
+
+class _BaseOutlierBatchOp(BatchOperator, HasPredictionCol,
+                          HasPredictionDetailCol):
+    """Shared outlier harness (reference: BaseOutlierBatchOp.java)."""
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    _univariate = False  # univariate ops read SELECTED_COL series
+
+    SELECTED_COL = ParamInfo("selectedCol", str,
+                             desc="value column (univariate detectors)")
+
+    def _score(self, X: np.ndarray):
+        """Return (scores, is_outlier). Implemented per op."""
+        raise NotImplementedError
+
+    def _matrix(self, t: MTable) -> np.ndarray:
+        if self._univariate:
+            col = self.get(self.SELECTED_COL)
+            if not col:
+                raise AkIllegalArgumentException(
+                    f"{type(self).__name__} needs selectedCol"
+                )
+            return np.asarray(t.col(col), np.float64)
+        return get_feature_block(t, self, dtype=np.float64)
+
+    def _execute_impl(self, t: MTable) -> MTable:
+        X = self._matrix(t)
+        scores, flags = self._score(X)
+        return _append_outlier(t, self, scores, flags)
+
+    def _out_schema(self, in_schema: TableSchema) -> TableSchema:
+        names = list(in_schema.names) + [self.get(self.PREDICTION_COL)]
+        types = list(in_schema.types) + [AlinkTypes.BOOLEAN]
+        if self.get(self.PREDICTION_DETAIL_COL):
+            names.append(self.get(self.PREDICTION_DETAIL_COL))
+            types.append(AlinkTypes.STRING)
+        return TableSchema(names, types)
+
+
+def _append_outlier(t: MTable, op, scores, flags) -> MTable:
+    out = t.with_column(op.get(op.PREDICTION_COL), np.asarray(flags, bool),
+                        AlinkTypes.BOOLEAN)
+    detail_col = op.get(op.PREDICTION_DETAIL_COL)
+    if detail_col:
+        details = np.asarray(
+            [json.dumps({
+                "outlier_score": round(float(s), 6)
+                if np.isfinite(s) else None  # strict-JSON safe
+            }) for s in scores], object,
+        )
+        out = out.with_column(detail_col, details, AlinkTypes.STRING)
+    return out
+
+
+class _MultivariateOutlierOp(_BaseOutlierBatchOp, HasFeatureCols, HasVectorCol):
+    _univariate = False
+
+
+# -- univariate ops ----------------------------------------------------------
+
+class KSigmaOutlierBatchOp(_BaseOutlierBatchOp):
+    """(reference: KSigmaOutlierBatchOp.java)"""
+
+    _univariate = True
+    K = ParamInfo("k", float, default=3.0)
+
+    def _score(self, x):
+        from ...outlier import ksigma
+
+        return ksigma(x, self.get(self.K))
+
+
+class BoxPlotOutlierBatchOp(_BaseOutlierBatchOp):
+    """(reference: BoxPlotOutlierBatchOp.java)"""
+
+    _univariate = True
+    K = ParamInfo("k", float, default=1.5)
+
+    def _score(self, x):
+        from ...outlier import boxplot
+
+        return boxplot(x, self.get(self.K))
+
+
+class MadOutlierBatchOp(_BaseOutlierBatchOp):
+    """(reference: MadOutlierBatchOp.java)"""
+
+    _univariate = True
+    K = ParamInfo("k", float, default=3.5)
+
+    def _score(self, x):
+        from ...outlier import mad
+
+        return mad(x, self.get(self.K))
+
+
+class EsdOutlierBatchOp(_BaseOutlierBatchOp):
+    """(reference: EsdOutlierBatchOp.java)"""
+
+    _univariate = True
+    ALPHA = ParamInfo("alpha", float, default=0.05)
+    MAX_OUTLIER_NUM = ParamInfo("maxOutlierNum", int)
+
+    def _score(self, x):
+        from ...outlier import esd
+
+        return esd(x, self.get(self.ALPHA), self.get(self.MAX_OUTLIER_NUM))
+
+
+class ShEsdOutlierBatchOp(_BaseOutlierBatchOp):
+    """(reference: ShEsdOutlierBatchOp.java)"""
+
+    _univariate = True
+    FREQUENCY = ParamInfo("frequency", int, optional=False,
+                          desc="seasonal period")
+    ALPHA = ParamInfo("alpha", float, default=0.05)
+    MAX_OUTLIER_NUM = ParamInfo("maxOutlierNum", int)
+
+    def _score(self, x):
+        from ...outlier import shesd
+
+        return shesd(x, self.get(self.FREQUENCY), self.get(self.ALPHA),
+                     self.get(self.MAX_OUTLIER_NUM))
+
+
+# -- multivariate ops --------------------------------------------------------
+
+class HbosOutlierBatchOp(_MultivariateOutlierOp):
+    """(reference: HbosOutlierBatchOp.java)"""
+
+    NUM_BINS = ParamInfo("numBins", int, default=10)
+
+    def _score(self, X):
+        from ...outlier import hbos
+
+        return hbos(X, self.get(self.NUM_BINS))
+
+
+class KdeOutlierBatchOp(_MultivariateOutlierOp):
+    """(reference: KdeOutlierBatchOp.java)"""
+
+    BANDWIDTH = ParamInfo("bandwidth", float)
+
+    def _score(self, X):
+        from ...outlier import kde
+
+        return kde(X, self.get(self.BANDWIDTH))
+
+
+class LofOutlierBatchOp(_MultivariateOutlierOp):
+    """(reference: LofOutlierBatchOp.java)"""
+
+    NUM_NEIGHBORS = ParamInfo("numNeighbors", int, default=10, aliases=("k",))
+
+    def _score(self, X):
+        from ...outlier import lof
+
+        return lof(X, self.get(self.NUM_NEIGHBORS))
+
+
+class IForestOutlierBatchOp(_MultivariateOutlierOp):
+    """(reference: IForestOutlierBatchOp.java)"""
+
+    NUM_TREES = ParamInfo("numTrees", int, default=100)
+    SUBSAMPLING_SIZE = ParamInfo("subsamplingSize", int, default=256)
+    RANDOM_SEED = ParamInfo("randomSeed", int, default=0, aliases=("seed",))
+
+    def _score(self, X):
+        from ...outlier import iforest
+
+        return iforest(X, self.get(self.NUM_TREES),
+                       self.get(self.SUBSAMPLING_SIZE),
+                       self.get(self.RANDOM_SEED))
+
+
+class EcodOutlierBatchOp(_MultivariateOutlierOp):
+    """(reference: EcodOutlierBatchOp.java)"""
+
+    def _score(self, X):
+        from ...outlier import ecod
+
+        return ecod(X)
+
+
+class CopodOutlierBatchOp(_MultivariateOutlierOp):
+    """(reference: CopodOutlierBatchOp.java)"""
+
+    def _score(self, X):
+        from ...outlier import copod
+
+        return copod(X)
+
+
+# -- grouped-series variants -------------------------------------------------
+
+class _Grouped4DataMixin:
+    """Per-group scoring (reference: *Outlier4GroupedDataBatchOp — the
+    per-group task-parallel pattern, SURVEY §2.2 parallelism #4)."""
+
+    GROUP_COLS = ParamInfo("groupCols", list, optional=False)
+
+    def _execute_impl(self, t: MTable):
+        group_cols = [c.strip() for c in (
+            self.get(self.GROUP_COLS) if isinstance(
+                self.get(self.GROUP_COLS), (list, tuple))
+            else str(self.get(self.GROUP_COLS)).split(",")
+        )]
+        keys = list(zip(*[t.col(c) for c in group_cols]))
+        index: Dict = {}
+        for r, k in enumerate(keys):
+            index.setdefault(k, []).append(r)
+        n = t.num_rows
+        scores = np.zeros(n)
+        flags = np.zeros(n, bool)
+        for rows in index.values():
+            rows = np.asarray(rows)
+            sub = t.take(rows)
+            s, f = self._score(self._matrix(sub))
+            scores[rows] = s
+            flags[rows] = f
+        return _append_outlier(t, self, scores, flags)
+
+
+def _grouped(name: str, base):
+    cls = type(name, (_Grouped4DataMixin, base), {
+        "__doc__": f"Grouped variant of {base.__name__} "
+        f"(reference: {name}.java)",
+    })
+    return cls
+
+
+KSigmaOutlier4GroupedDataBatchOp = _grouped(
+    "KSigmaOutlier4GroupedDataBatchOp", KSigmaOutlierBatchOp)
+BoxPlotOutlier4GroupedDataBatchOp = _grouped(
+    "BoxPlotOutlier4GroupedDataBatchOp", BoxPlotOutlierBatchOp)
+MadOutlier4GroupedDataBatchOp = _grouped(
+    "MadOutlier4GroupedDataBatchOp", MadOutlierBatchOp)
+EsdOutlier4GroupedDataBatchOp = _grouped(
+    "EsdOutlier4GroupedDataBatchOp", EsdOutlierBatchOp)
+ShEsdOutlier4GroupedDataBatchOp = _grouped(
+    "ShEsdOutlier4GroupedDataBatchOp", ShEsdOutlierBatchOp)
+IForestOutlier4GroupedDataBatchOp = _grouped(
+    "IForestOutlier4GroupedDataBatchOp", IForestOutlierBatchOp)
+
+
+# -- evaluation --------------------------------------------------------------
+
+class EvalOutlierBatchOp(BatchOperator):
+    """Outlier metrics (reference: operator/batch/evaluation/
+    EvalOutlierBatchOp.java): precision/recall/F1 on the boolean prediction
+    plus AUC over the detail score."""
+
+    LABEL_COL = ParamInfo("labelCol", str, optional=False)
+    PREDICTION_COL = ParamInfo("predictionCol", str, optional=False)
+    PREDICTION_DETAIL_COL = ParamInfo("predictionDetailCol", str)
+    OUTLIER_VALUE_STRINGS = ParamInfo(
+        "outlierValueStrings", list,
+        desc="label values regarded as outliers; default: true/1",
+    )
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    def _out_schema(self, in_schema: TableSchema) -> TableSchema:
+        return TableSchema(
+            ["Precision", "Recall", "F1", "AUC", "Data"],
+            [AlinkTypes.DOUBLE] * 4 + [AlinkTypes.STRING],
+        )
+
+    def _execute_impl(self, t: MTable) -> MTable:
+        pos_vals = set(
+            str(v) for v in (self.get(self.OUTLIER_VALUE_STRINGS) or
+                             ["true", "True", "1", "1.0"])
+        )
+        y = np.asarray(
+            [str(v) in pos_vals for v in t.col(self.get(self.LABEL_COL))]
+        )
+        pred = np.asarray(t.col(self.get(self.PREDICTION_COL))).astype(bool)
+        tp = int((pred & y).sum())
+        fp = int((pred & ~y).sum())
+        fn = int((~pred & y).sum())
+        precision = tp / (tp + fp) if tp + fp else 0.0
+        recall = tp / (tp + fn) if tp + fn else 0.0
+        f1 = (2 * precision * recall / (precision + recall)
+              if precision + recall else 0.0)
+        auc = float("nan")
+        detail_col = self.get(self.PREDICTION_DETAIL_COL)
+        if detail_col:
+            from .evaluation import rank_auc
+
+            s = np.asarray([
+                v if (v := json.loads(d)["outlier_score"]) is not None
+                else np.nan
+                for d in t.col(detail_col)
+            ], np.float64)
+            auc = rank_auc(np.nan_to_num(s), y)
+        metrics = {"Precision": precision, "Recall": recall, "F1": f1,
+                   "AUC": auc}
+        return MTable(
+            {**{k: [v] for k, v in metrics.items()},
+             "Data": [json.dumps(metrics)]},
+            self._out_schema(t.schema),
+        )
+
+    def collect_metrics(self):
+        from .evaluation import Metrics
+
+        t = self.collect()
+        return Metrics(json.loads(t.col("Data")[0]))

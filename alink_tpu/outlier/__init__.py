@@ -1,0 +1,20 @@
+"""Outlier detection core (reference: operator/common/outlier/)."""
+
+from .detectors import (
+    boxplot,
+    copod,
+    ecod,
+    esd,
+    hbos,
+    iforest,
+    kde,
+    ksigma,
+    lof,
+    mad,
+    shesd,
+)
+
+__all__ = [
+    "boxplot", "copod", "ecod", "esd", "hbos", "iforest", "kde",
+    "ksigma", "lof", "mad", "shesd",
+]

@@ -1,0 +1,307 @@
+"""Outlier detector scoring kernels.
+
+Capability parity with the reference's outlier calculators (reference:
+core/src/main/java/com/alibaba/alink/operator/common/outlier/ —
+KSigmaDetectorCalc, BoxPlotDetectorCalc, MadDetectorCalc, EsdDetectorCalc,
+SHEsdDetectorCalc, HbosDetector, KdeDetector, LofDetector,
+IForestDetector, EcodDetector, CopodDetector; 7.6k LoC).
+
+TPU re-design: every detector is a vectorized scoring function — univariate
+detectors are closed-form columnar reductions; the O(n²) neighborhood
+detectors (KDE, LOF) compute their pairwise-distance blocks as matmuls on the
+MXU via jit; isolation forest grows tiny random trees host-side (cheap) and
+evaluates all rows' path lengths with a vectorized heap descent.
+
+Each scorer returns (scores, is_outlier) with scores oriented so larger =
+more anomalous.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import numpy as np
+
+Arr = np.ndarray
+
+
+# -- univariate (series) detectors ------------------------------------------
+
+def ksigma(x: Arr, k: float = 3.0) -> Tuple[Arr, Arr]:
+    """(reference: KSigmaDetectorCalc) score = |z|; outlier if > k."""
+    mu = np.nanmean(x)
+    sd = np.nanstd(x)
+    z = np.abs(x - mu) / max(sd, 1e-12)
+    return z, z > k
+
+
+def boxplot(x: Arr, k: float = 1.5) -> Tuple[Arr, Arr]:
+    """(reference: BoxPlotDetectorCalc) distance beyond the IQR fences in
+    IQR units; outlier if > 0 with fence factor k."""
+    q1, q3 = np.nanpercentile(x, [25, 75])
+    iqr = max(q3 - q1, 1e-12)
+    lo, hi = q1 - k * iqr, q3 + k * iqr
+    score = np.maximum(lo - x, x - hi) / iqr
+    return np.maximum(score, 0.0), (x < lo) | (x > hi)
+
+
+def mad(x: Arr, k: float = 3.5) -> Tuple[Arr, Arr]:
+    """(reference: MadDetectorCalc) modified z-score via median absolute
+    deviation (0.6745 consistency constant)."""
+    med = np.nanmedian(x)
+    m = np.nanmedian(np.abs(x - med))
+    z = 0.6745 * np.abs(x - med) / max(m, 1e-12)
+    return z, z > k
+
+
+def esd(x: Arr, alpha: float = 0.05,
+        max_outliers: Optional[int] = None) -> Tuple[Arr, Arr]:
+    """Generalized ESD test (reference: EsdDetectorCalc). Iteratively removes
+    the most extreme point and compares the test statistic to the critical
+    value; scores are |z| at removal time."""
+    from scipy import stats
+
+    n = len(x)
+    k = max_outliers or max(1, int(n * 0.1))
+    work = x.astype(np.float64).copy()
+    active = ~np.isnan(work)  # NaNs never participate (nan-aware like ksigma)
+    out = np.zeros(n, bool)
+    scores = np.zeros(n)
+    order = []
+    for i in range(1, k + 1):
+        vals = work[active]
+        m = len(vals)
+        if m < 3:
+            break
+        mu, sd = vals.mean(), vals.std(ddof=1)
+        if sd < 1e-12:
+            break
+        z = np.abs(work - mu) / sd
+        z[~active] = -1
+        j = int(np.argmax(z))
+        R = z[j]
+        p = 1 - alpha / (2 * (n - i + 1))
+        t = stats.t.ppf(p, n - i - 1)
+        lam = (n - i) * t / math.sqrt((n - i - 1 + t * t) * (n - i + 1))
+        scores[j] = R
+        order.append((j, R > lam))
+        active[j] = False
+    # ESD semantics: if the i-th test rejects, ALL i most extreme are outliers
+    last_reject = -1
+    for idx, (j, rej) in enumerate(order):
+        if rej:
+            last_reject = idx
+    for idx, (j, _) in enumerate(order):
+        if idx <= last_reject:
+            out[j] = True
+    return scores, out
+
+
+def shesd(x: Arr, period: int, alpha: float = 0.05,
+          max_outliers: Optional[int] = None) -> Tuple[Arr, Arr]:
+    """Seasonal-hybrid ESD (reference: SHEsdDetectorCalc): remove the
+    per-phase seasonal median and the global median, then run ESD on the
+    residual."""
+    n = len(x)
+    phases = np.arange(n) % max(period, 1)
+    seasonal = np.zeros(n)
+    for p in range(max(period, 1)):
+        m = phases == p
+        if m.any():
+            seasonal[m] = np.nanmedian(x[m])
+    resid = x - seasonal - np.nanmedian(x - seasonal)
+    return esd(resid, alpha=alpha, max_outliers=max_outliers)
+
+
+# -- multivariate detectors --------------------------------------------------
+
+def hbos(X: Arr, num_bins: int = 10) -> Tuple[Arr, Arr]:
+    """Histogram-based outlier score (reference: HbosDetector):
+    Σ_d -log(density_d(x)); outlier above the 95th percentile score."""
+    n, d = X.shape
+    score = np.zeros(n)
+    for j in range(d):
+        col = X[:, j]
+        hist, edges = np.histogram(col, bins=num_bins)
+        dens = hist / max(hist.max(), 1)
+        idx = np.clip(np.searchsorted(edges, col, side="right") - 1,
+                      0, num_bins - 1)
+        score += -np.log(np.maximum(dens[idx], 1e-12))
+    return score, score > np.percentile(score, 95)
+
+
+def _pairwise_sq_dists(X: Arr, chunk: int = 4096) -> Arr:
+    """(n, n) squared distances, chunked matmuls on the device."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def block(A, B):
+        return (
+            (A * A).sum(1)[:, None] - 2.0 * (A @ B.T) + (B * B).sum(1)[None, :]
+        )
+
+    n = X.shape[0]
+    X32 = jnp.asarray(X, jnp.float32)
+    out = np.empty((n, n), np.float32)
+    for s in range(0, n, chunk):
+        out[s:s + chunk] = np.asarray(
+            jax.device_get(block(X32[s:s + chunk], X32))
+        )
+    return np.maximum(out, 0.0)
+
+
+def kde(X: Arr, bandwidth: Optional[float] = None) -> Tuple[Arr, Arr]:
+    """Gaussian KDE negative log density (reference: KdeDetector)."""
+    n, d = X.shape
+    if bandwidth is None:
+        bandwidth = float(np.mean(np.std(X, axis=0)) *
+                          (4 / (d + 2)) ** (1 / (d + 4)) *
+                          n ** (-1 / (d + 4)) + 1e-12)
+    d2 = _pairwise_sq_dists(X)
+    K = np.exp(-d2 / (2 * bandwidth ** 2))
+    np.fill_diagonal(K, 0.0)
+    dens = K.sum(1) / max(n - 1, 1)
+    score = -np.log(np.maximum(dens, 1e-300))
+    return score, score > np.percentile(score, 95)
+
+
+def lof(X: Arr, k: int = 10) -> Tuple[Arr, Arr]:
+    """Local outlier factor (reference: LofDetector); outlier if LOF > 1.5."""
+    n = X.shape[0]
+    if n <= 1:
+        return np.zeros(n), np.zeros(n, bool)
+    k = min(k, n - 1)
+    d2 = _pairwise_sq_dists(X)
+    np.fill_diagonal(d2, np.inf)
+    dist = np.sqrt(d2)
+    nn_idx = np.argpartition(dist, k - 1, axis=1)[:, :k]
+    nn_dist = np.take_along_axis(dist, nn_idx, axis=1)
+    k_dist = nn_dist.max(axis=1)                       # k-distance per point
+    reach = np.maximum(nn_dist, k_dist[nn_idx])        # reach-dist(a, b)
+    lrd = 1.0 / np.maximum(reach.mean(axis=1), 1e-12)
+    lof_score = (lrd[nn_idx].mean(axis=1)) / lrd
+    return lof_score, lof_score > 1.5
+
+
+def _tail_log_probs(col: Arr) -> Tuple[Arr, Arr, Arr]:
+    """Per-column ECDF tail scores: (-log F, -log(1-F), skew-selected tail)
+    — the shared core of ECOD and COPOD."""
+    n = len(col)
+    order = np.argsort(col, kind="stable")
+    ranks = np.empty(n)
+    ranks[order] = np.arange(1, n + 1)
+    F = ranks / (n + 1)
+    left = -np.log(F)
+    right = -np.log(1 - F)
+    skew = float(((col - col.mean()) ** 3).mean() /
+                 max(col.std() ** 3, 1e-12))
+    return left, right, (right if skew > 0 else left)
+
+
+def _ecdf_tail_score(X: Arr) -> Arr:
+    """max over the left / right / skew-corrected tail-probability sums —
+    the ECOD/COPOD aggregation (both tails count, so a low outlier in a
+    right-skewed dimension still scores)."""
+    n, d = X.shape
+    left = np.zeros(n)
+    right = np.zeros(n)
+    skewed = np.zeros(n)
+    for j in range(d):
+        l_, r_, a_ = _tail_log_probs(X[:, j])
+        left += l_
+        right += r_
+        skewed += a_
+    return np.maximum.reduce([left, right, skewed])
+
+
+def ecod(X: Arr) -> Tuple[Arr, Arr]:
+    """Empirical-CDF outlier detection (reference: EcodDetector): score =
+    max(Σ-log F, Σ-log(1-F), Σ skew-selected tail)."""
+    score = _ecdf_tail_score(X)
+    return score, score > np.percentile(score, 95)
+
+
+def copod(X: Arr) -> Tuple[Arr, Arr]:
+    """Copula-based outlier detection (reference: CopodDetector): the
+    empirical-copula formulation reduces to the same max-of-tail-sums
+    aggregation as ECOD on per-dimension ECDFs."""
+    score = _ecdf_tail_score(X)
+    return score, score > np.percentile(score, 95)
+
+
+# -- isolation forest --------------------------------------------------------
+
+def _avg_path(n: float) -> float:
+    if n <= 1:
+        return 0.0
+    return 2.0 * (math.log(n - 1) + 0.5772156649) - 2.0 * (n - 1) / n
+
+
+def _avg_path_vec(ns: Arr) -> Arr:
+    """Vectorized c(n) — the per-row hot path of iforest scoring."""
+    ns = np.asarray(ns, np.float64)
+    safe = np.maximum(ns, 2.0)
+    val = 2.0 * (np.log(safe - 1.0) + 0.5772156649) - 2.0 * (safe - 1.0) / safe
+    return np.where(ns <= 1, 0.0, val)
+
+
+def iforest(X: Arr, num_trees: int = 100, subsample: int = 256,
+            seed: int = 0) -> Tuple[Arr, Arr]:
+    """Isolation forest (reference: IForestDetector). Trees are grown on
+    subsamples host-side in heap layout; scoring descends all rows through
+    each tree fully vectorized."""
+    rng = np.random.default_rng(seed)
+    n, d = X.shape
+    psi = min(subsample, n)
+    depth = max(1, int(np.ceil(np.log2(max(psi, 2)))))
+    n_nodes = 2 ** (depth + 1) - 1
+    path = np.zeros(n)
+
+    for _ in range(num_trees):
+        idx = rng.choice(n, psi, replace=False)
+        feat = np.zeros(n_nodes, np.int64)
+        thr = np.zeros(n_nodes, np.float32)
+        is_leaf = np.ones(n_nodes, bool)
+        leaf_size = np.zeros(n_nodes, np.float64)
+        # grow: queue of (node, row indices)
+        queue = [(0, idx)]
+        while queue:
+            node, rows = queue.pop()
+            node_depth = int(np.floor(np.log2(node + 1)))
+            if len(rows) <= 1 or node_depth >= depth:
+                leaf_size[node] = len(rows)
+                continue
+            j = rng.integers(d)
+            lo, hi = X[rows, j].min(), X[rows, j].max()
+            if hi <= lo:
+                leaf_size[node] = len(rows)
+                continue
+            t = rng.uniform(lo, hi)
+            feat[node] = j
+            thr[node] = t
+            is_leaf[node] = False
+            mask = X[rows, j] < t
+            queue.append((2 * node + 1, rows[mask]))
+            queue.append((2 * node + 2, rows[~mask]))
+
+        # vectorized descent of ALL rows
+        cur = np.zeros(n, np.int64)
+        depth_at = np.zeros(n, np.float64)
+        done = is_leaf[cur]
+        for _level in range(depth):
+            go = ~done
+            if not go.any():
+                break
+            f = feat[cur[go]]
+            t = thr[cur[go]]
+            left = X[go, f] < t
+            cur[go] = np.where(left, 2 * cur[go] + 1, 2 * cur[go] + 2)
+            depth_at[go] += 1
+            done = is_leaf[cur]
+        path += depth_at + _avg_path_vec(leaf_size[cur])
+
+    e_path = path / num_trees
+    score = 2.0 ** (-e_path / max(_avg_path(psi), 1e-12))
+    return score, score > 0.6

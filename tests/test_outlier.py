@@ -1,0 +1,172 @@
+"""Outlier family tests (reference model: operator/batch/outlier tests,
+e.g. BoxPlotOutlierBatchOpTest, IForestOutlierBatchOpTest)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from alink_tpu.common.mtable import AlinkTypes, MTable
+from alink_tpu.operator.batch import (
+    BoxPlotOutlierBatchOp,
+    CopodOutlierBatchOp,
+    EcodOutlierBatchOp,
+    EsdOutlierBatchOp,
+    EvalOutlierBatchOp,
+    HbosOutlierBatchOp,
+    IForestOutlierBatchOp,
+    KdeOutlierBatchOp,
+    KSigmaOutlier4GroupedDataBatchOp,
+    KSigmaOutlierBatchOp,
+    LofOutlierBatchOp,
+    MadOutlierBatchOp,
+    ShEsdOutlierBatchOp,
+    TableSourceBatchOp,
+)
+
+
+def _series_with_spikes(n=200, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n)
+    spike_idx = [20, 90, 150]
+    x[spike_idx] = [12.0, -11.0, 14.0]
+    return x, set(spike_idx)
+
+
+def _blob_with_outliers(n=150, seed=1):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, 3)
+    out_idx = [5, 60, 120]
+    X[out_idx] = X[out_idx] + 10.0
+    return X, set(out_idx)
+
+
+@pytest.mark.parametrize("op_cls,kwargs", [
+    (KSigmaOutlierBatchOp, {}),
+    (BoxPlotOutlierBatchOp, {}),
+    (MadOutlierBatchOp, {}),
+    (EsdOutlierBatchOp, {}),
+])
+def test_univariate_detectors(op_cls, kwargs):
+    x, spikes = _series_with_spikes()
+    t = MTable({"v": x})
+    out = op_cls(selectedCol="v", predictionCol="o",
+                 predictionDetailCol="d", **kwargs).link_from(
+        TableSourceBatchOp(t)
+    ).collect()
+    flags = np.asarray(out.col("o"))
+    found = set(np.nonzero(flags)[0].tolist())
+    assert spikes <= found, (spikes, found)
+    assert len(found) <= 12  # no mass false positives
+    s = json.loads(out.col("d")[20])["outlier_score"]
+    assert s > json.loads(out.col("d")[0])["outlier_score"]
+
+
+def test_shesd_seasonal():
+    rng = np.random.RandomState(2)
+    n, period = 240, 24
+    seasonal = 5 * np.sin(2 * np.pi * np.arange(n) / period)
+    x = seasonal + rng.randn(n) * 0.3
+    x[100] += 4.0  # large vs the 0.3 residual noise, small vs the ±5 seasonal
+    t = MTable({"v": x})
+    out = ShEsdOutlierBatchOp(
+        selectedCol="v", frequency=period, predictionCol="o"
+    ).link_from(TableSourceBatchOp(t)).collect()
+    flags = np.asarray(out.col("o"))
+    assert flags[100]
+    assert flags.sum() <= 8
+    # plain ksigma on the raw series misses it (seasonal variance dominates)
+    k_out = KSigmaOutlierBatchOp(selectedCol="v", predictionCol="o").link_from(
+        TableSourceBatchOp(t)
+    ).collect()
+    assert not np.asarray(k_out.col("o"))[100]
+
+
+@pytest.mark.parametrize("op_cls,kwargs", [
+    (HbosOutlierBatchOp, {}),
+    (KdeOutlierBatchOp, {}),
+    (LofOutlierBatchOp, {"numNeighbors": 15}),
+    (IForestOutlierBatchOp, {"numTrees": 50}),
+    (EcodOutlierBatchOp, {}),
+    (CopodOutlierBatchOp, {}),
+])
+def test_multivariate_detectors(op_cls, kwargs):
+    X, outs = _blob_with_outliers()
+    t = MTable({f"f{i}": X[:, i] for i in range(3)})
+    op = op_cls(featureCols=[f"f{i}" for i in range(3)], predictionCol="o",
+                predictionDetailCol="d", **kwargs).link_from(
+        TableSourceBatchOp(t)
+    )
+    assert op.schema.type_of("o") == AlinkTypes.BOOLEAN  # static schema
+    out = op.collect()
+    scores = np.asarray(
+        [json.loads(d)["outlier_score"] for d in out.col("d")]
+    )
+    # planted outliers are the top-scored rows
+    top3 = set(np.argsort(-scores)[:3].tolist())
+    assert top3 == outs, (op_cls.__name__, top3)
+
+
+def test_grouped_ksigma():
+    x1, s1 = _series_with_spikes(seed=3)
+    x2 = np.random.RandomState(4).randn(200) * 100  # different scale group
+    x2[7] = 5000.0
+    t = MTable({
+        "g": np.asarray(["a"] * 200 + ["b"] * 200, object),
+        "v": np.concatenate([x1, x2]),
+    })
+    out = KSigmaOutlier4GroupedDataBatchOp(
+        groupCols=["g"], selectedCol="v", predictionCol="o",
+    ).link_from(TableSourceBatchOp(t)).collect()
+    flags = np.asarray(out.col("o"))
+    assert s1 <= set(np.nonzero(flags[:200])[0].tolist())
+    assert flags[207]  # the group-b spike found at its own scale
+    # group-a detection unaffected by group-b's 100x scale
+    assert flags[:200].sum() <= 12
+
+
+def test_eval_outlier():
+    X, outs = _blob_with_outliers()
+    y = np.zeros(len(X), np.int64)
+    y[list(outs)] = 1
+    t = MTable({**{f"f{i}": X[:, i] for i in range(3)}, "label": y})
+    pred = IForestOutlierBatchOp(
+        featureCols=[f"f{i}" for i in range(3)], predictionCol="o",
+        predictionDetailCol="d", numTrees=50,
+    ).link_from(TableSourceBatchOp(t))
+    ev = EvalOutlierBatchOp(
+        labelCol="label", predictionCol="o", predictionDetailCol="d",
+    ).link_from(pred)
+    m = ev.collect_metrics()
+    assert m["Recall"] == 1.0
+    assert m["AUC"] > 0.99
+    assert m["Precision"] > 0.2
+
+
+def test_esd_nan_aware_and_ecod_left_tail():
+    from alink_tpu.outlier import ecod, esd
+
+    x, spikes = _series_with_spikes()
+    x[10] = np.nan
+    scores, flags = esd(x)
+    assert spikes <= set(np.nonzero(flags)[0].tolist())
+    assert not flags[10]
+
+    # right-skewed column with a LOW outlier must still score highest
+    rng = np.random.RandomState(5)
+    col = np.exp(rng.randn(200))  # right-skewed
+    col[17] = -50.0
+    s, f = ecod(col[:, None])
+    # the ECDF extremes tie (min's left tail == max's right tail), so the
+    # planted low outlier is among the top-2 scores — before the fix its
+    # score was ~0 (skew-selected right tail only)
+    assert 17 in np.argsort(-s)[:2].tolist()
+    assert f[17]
+
+
+def test_lof_single_row():
+    t = MTable({"a": np.asarray([1.0]), "b": np.asarray([2.0])})
+    out = LofOutlierBatchOp(
+        featureCols=["a", "b"], predictionCol="o"
+    ).link_from(TableSourceBatchOp(t)).collect()
+    assert not out.col("o")[0]
