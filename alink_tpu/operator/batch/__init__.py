@@ -104,6 +104,21 @@ from .linear import (
     SoftmaxPredictBatchOp,
     SoftmaxTrainBatchOp,
 )
+from .classification import (
+    FmClassifierPredictBatchOp,
+    FmClassifierTrainBatchOp,
+    FmPredictBatchOp,
+    FmRegressorPredictBatchOp,
+    FmRegressorTrainBatchOp,
+    KnnPredictBatchOp,
+    KnnTrainBatchOp,
+    MultilayerPerceptronPredictBatchOp,
+    MultilayerPerceptronTrainBatchOp,
+    NaiveBayesPredictBatchOp,
+    NaiveBayesTrainBatchOp,
+    OneVsRestPredictBatchOp,
+    OneVsRestTrainBatchOp,
+)
 from .outlier import (
     BoxPlotOutlier4GroupedDataBatchOp,
     BoxPlotOutlierBatchOp,

@@ -80,6 +80,7 @@ class BaseLinearModelTrainBatchOp(ModelTrainOpMixin, BatchOperator,
     _max_inputs = 1
 
     linear_model_type: str = None  # LR | SVM | LinearReg | Softmax
+    paired_mapper_cls_name = "LinearModelMapper"  # OneVsRest serving hook
 
     def _static_meta_keys(self, in_schema):
         return {

@@ -1,4 +1,7 @@
 from .objfunc import (
+    fm_obj,
+    mlp_forward,
+    mlp_obj,
     ObjFunc,
     hinge_obj,
     huber_obj,

@@ -116,3 +116,80 @@ def huber_obj(dim: int, delta: float = 1.0) -> ObjFunc:
         return _weighted_sum(per_row, wt)
 
     return ObjFunc(local_loss, dim)
+
+
+def fm_obj(dim: int, num_factors: int, task: str = "binary") -> ObjFunc:
+    """Factorization machine objective (reference:
+    operator/common/optim/FmOptimizer.java:39 + common/fm/FmLossUtils.java).
+
+    Flat weights = [w0 (1), w (dim), V (dim*num_factors)]. The pairwise term is
+    the O(n·d·k) identity 0.5·Σ_f((XV)² − X²V²) — two matmuls on the MXU rather
+    than the reference's per-sample loops. ``task`` is "binary" (logistic,
+    y∈{−1,+1}) or "regression" (squared)."""
+    import jax.numpy as jnp
+
+    def score(w, X):
+        w0 = w[0]
+        lin = w[1:1 + dim]
+        V = w[1 + dim:].reshape(dim, num_factors)
+        xv = X @ V
+        pair = 0.5 * ((xv * xv) - (X * X) @ (V * V)).sum(axis=1)
+        return w0 + X @ lin + pair
+
+    def local_loss(w, X, y, wt):
+        s = score(w, X)
+        if task == "binary":
+            per_row = jnp.logaddexp(0.0, -y * s)
+        else:
+            per_row = 0.5 * (s - y) ** 2
+        return _weighted_sum(per_row, wt)
+
+    return ObjFunc(local_loss, 1 + dim + dim * num_factors)
+
+
+def mlp_obj(layer_sizes) -> ObjFunc:
+    """Feed-forward network objective (reference:
+    operator/common/classification/ann/FeedForwardTopology.java +
+    FeedForwardTrainer.java — affine+sigmoid hidden layers, softmax output,
+    trained through the same optimizer framework as linear models).
+
+    Flat weights pack (W_i, b_i) per layer; hidden activation is sigmoid for
+    parity with the reference topology; final layer is softmax cross-entropy."""
+    import jax
+    import jax.numpy as jnp
+
+    sizes = list(layer_sizes)
+    num_params = sum(
+        sizes[i] * sizes[i + 1] + sizes[i + 1] for i in range(len(sizes) - 1)
+    )
+
+    def local_loss(w, X, y, wt):
+        logits = mlp_forward(sizes, w, X)
+        logz = jax.scipy.special.logsumexp(logits, axis=1)
+        true_logit = jnp.take_along_axis(
+            logits, y.astype(jnp.int32)[:, None], axis=1
+        )[:, 0]
+        return _weighted_sum(logz - true_logit, wt)
+
+    return ObjFunc(local_loss, num_params)
+
+
+def mlp_forward(layer_sizes, w, X):
+    """Shared forward pass for mlp_obj's flat weight layout — used by both the
+    training objective and the predict mapper so layouts cannot drift."""
+    import jax
+    import jax.numpy as jnp
+
+    sizes = list(layer_sizes)
+    h = X
+    off = 0
+    for i in range(len(sizes) - 1):
+        fan_in, fan_out = sizes[i], sizes[i + 1]
+        W = w[off:off + fan_in * fan_out].reshape(fan_in, fan_out)
+        off += fan_in * fan_out
+        b = w[off:off + fan_out]
+        off += fan_out
+        h = h @ W + b
+        if i < len(sizes) - 2:
+            h = jax.nn.sigmoid(h)
+    return h

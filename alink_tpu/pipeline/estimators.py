@@ -118,3 +118,83 @@ class ALS(EstimatorBase, _rec.HasRecommTripleCols):
     IMPLICIT_PREFS = _rec.AlsTrainBatchOp.IMPLICIT_PREFS
     ALPHA = _rec.AlsTrainBatchOp.ALPHA
     PREDICTION_COL = _rec._AlsRecommMapper.PREDICTION_COL
+
+
+# -- classical classification breadth ---------------------------------------
+from ..operator.batch import classification as _cls
+
+
+class _RichPredictParams:
+    PREDICTION_COL = _lin.HasPredictionCol.PREDICTION_COL
+    PREDICTION_DETAIL_COL = _lin.HasPredictionDetailCol.PREDICTION_DETAIL_COL
+    RESERVED_COLS = _lin.HasReservedCols.RESERVED_COLS
+
+
+class NaiveBayesModel(ModelBase):
+    _predict_op_cls = _cls.NaiveBayesPredictBatchOp
+
+
+class NaiveBayes(EstimatorBase, _RichPredictParams):
+    """(reference: pipeline/classification/NaiveBayes.java)"""
+
+    _train_op_cls = _cls.NaiveBayesTrainBatchOp
+    _model_cls = NaiveBayesModel
+    LABEL_COL = _cls.NaiveBayesTrainBatchOp.LABEL_COL
+    MODEL_TYPE = _cls.NaiveBayesTrainBatchOp.MODEL_TYPE
+    SMOOTHING = _cls.NaiveBayesTrainBatchOp.SMOOTHING
+    FEATURE_COLS = _cls.HasFeatureCols.FEATURE_COLS
+    VECTOR_COL = _cls.HasVectorCol.VECTOR_COL
+
+
+class KnnClassifierModel(ModelBase):
+    _predict_op_cls = _cls.KnnPredictBatchOp
+
+
+class KnnClassifier(EstimatorBase, _RichPredictParams):
+    """(reference: pipeline/classification/KnnClassifier.java)"""
+
+    _train_op_cls = _cls.KnnTrainBatchOp
+    _model_cls = KnnClassifierModel
+    LABEL_COL = _cls.KnnTrainBatchOp.LABEL_COL
+    DISTANCE_TYPE = _cls.KnnTrainBatchOp.DISTANCE_TYPE
+    K = _cls.KnnModelMapper.K
+    FEATURE_COLS = _cls.HasFeatureCols.FEATURE_COLS
+    VECTOR_COL = _cls.HasVectorCol.VECTOR_COL
+
+
+class FmModel(ModelBase):
+    _predict_op_cls = _cls.FmPredictBatchOp
+
+
+class FmClassifier(EstimatorBase, _RichPredictParams):
+    """(reference: pipeline/classification/FmClassifier.java)"""
+
+    _train_op_cls = _cls.FmClassifierTrainBatchOp
+    _model_cls = FmModel
+    LABEL_COL = _cls.BaseFmTrainBatchOp.LABEL_COL
+    NUM_FACTOR = _cls.BaseFmTrainBatchOp.NUM_FACTOR
+    MAX_ITER = _cls.BaseFmTrainBatchOp.MAX_ITER
+    FEATURE_COLS = _cls.HasFeatureCols.FEATURE_COLS
+    VECTOR_COL = _cls.HasVectorCol.VECTOR_COL
+
+
+class FmRegressor(FmClassifier):
+    """(reference: pipeline/regression/FmRegressor.java)"""
+
+    _train_op_cls = _cls.FmRegressorTrainBatchOp
+
+
+class MultilayerPerceptronModel(ModelBase):
+    _predict_op_cls = _cls.MultilayerPerceptronPredictBatchOp
+
+
+class MultilayerPerceptronClassifier(EstimatorBase, _RichPredictParams):
+    """(reference: pipeline/classification/MultilayerPerceptronClassifier.java)"""
+
+    _train_op_cls = _cls.MultilayerPerceptronTrainBatchOp
+    _model_cls = MultilayerPerceptronModel
+    LABEL_COL = _cls.MultilayerPerceptronTrainBatchOp.LABEL_COL
+    LAYERS = _cls.MultilayerPerceptronTrainBatchOp.LAYERS
+    MAX_ITER = _cls.MultilayerPerceptronTrainBatchOp.MAX_ITER
+    FEATURE_COLS = _cls.HasFeatureCols.FEATURE_COLS
+    VECTOR_COL = _cls.HasVectorCol.VECTOR_COL

@@ -1,0 +1,201 @@
+"""Classical classification breadth tests: NaiveBayes, KNN, FM, MLP, OneVsRest.
+
+Mirrors the reference's operator-level integration tests (reference:
+core/src/test/java/com/alibaba/alink/operator/batch/classification/
+NaiveBayesTrainBatchOpTest.java, KnnTrainBatchOpTest.java,
+FmClassifierTrainBatchOpTest.java, MultilayerPerceptronTrainBatchOpTest.java,
+OneVsRestTrainBatchOpTest.java).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from alink_tpu.common import MTable
+from alink_tpu.operator.base import TableSourceOp
+from alink_tpu.operator.batch import (
+    FmClassifierPredictBatchOp,
+    FmClassifierTrainBatchOp,
+    FmRegressorPredictBatchOp,
+    FmRegressorTrainBatchOp,
+    KnnPredictBatchOp,
+    KnnTrainBatchOp,
+    LogisticRegressionTrainBatchOp,
+    MultilayerPerceptronPredictBatchOp,
+    MultilayerPerceptronTrainBatchOp,
+    NaiveBayesPredictBatchOp,
+    NaiveBayesTrainBatchOp,
+    OneVsRestPredictBatchOp,
+    OneVsRestTrainBatchOp,
+)
+
+
+def _blobs(n_per=60, centers=((0, 0), (6, 6), (0, 6)), seed=0, spread=0.5):
+    rng = np.random.default_rng(seed)
+    X = np.concatenate(
+        [rng.normal(c, spread, size=(n_per, 2)) for c in centers]
+    ).astype(np.float64)
+    y = np.repeat(np.arange(len(centers)), n_per)
+    return X, y
+
+
+def _table(X, y, label_as=str):
+    return MTable({
+        "f0": X[:, 0], "f1": X[:, 1],
+        "label": np.asarray([label_as(v) for v in y], dtype=object),
+    })
+
+
+def _accuracy(out, y, pred_col="pred", label_as=str):
+    pred = np.asarray(out.col(pred_col))
+    truth = np.asarray([label_as(v) for v in y])
+    return (pred.astype(str) == truth.astype(str)).mean()
+
+
+def test_naive_bayes_gaussian():
+    X, y = _blobs(centers=((1, 1), (6, 6), (1, 6)))
+    src = TableSourceOp(_table(X, y))
+    train = NaiveBayesTrainBatchOp(
+        labelCol="label", featureCols=["f0", "f1"], modelType="GAUSSIAN"
+    ).link_from(src)
+    out = NaiveBayesPredictBatchOp(
+        predictionCol="pred", predictionDetailCol="detail"
+    ).link_from(train, src).collect()
+    assert _accuracy(out, y) > 0.95
+    detail = json.loads(out.col("detail")[0])
+    assert set(detail) == {"0", "1", "2"}
+    assert abs(sum(detail.values()) - 1.0) < 1e-6
+
+
+@pytest.mark.parametrize("model_type", ["MULTINOMIAL", "BERNOULLI"])
+def test_naive_bayes_count_data(model_type):
+    # bag-of-words style counts: each class concentrates on 2 of 6 features
+    rng = np.random.default_rng(1)
+    rows, y = [], []
+    for cls in range(3):
+        p = np.full(6, 0.02)
+        p[2 * cls:2 * cls + 2] = 0.45
+        p /= p.sum()
+        rows.append(rng.multinomial(20, p, size=60))
+        y.extend([cls] * 60)
+    X = np.concatenate(rows).astype(np.float64)
+    y = np.asarray(y)
+    t = MTable({f"w{j}": X[:, j] for j in range(6)}
+               | {"label": np.asarray([str(v) for v in y], dtype=object)})
+    src = TableSourceOp(t)
+    train = NaiveBayesTrainBatchOp(
+        labelCol="label", featureCols=[f"w{j}" for j in range(6)],
+        modelType=model_type,
+    ).link_from(src)
+    out = NaiveBayesPredictBatchOp(predictionCol="pred").link_from(
+        train, src
+    ).collect()
+    # binarizing the counts (BERNOULLI) is inherently lossier than the counts
+    assert _accuracy(out, y) > (0.95 if model_type == "MULTINOMIAL" else 0.85)
+
+
+def test_knn_classifier():
+    X, y = _blobs()
+    src = TableSourceOp(_table(X, y))
+    train = KnnTrainBatchOp(labelCol="label", featureCols=["f0", "f1"]).link_from(src)
+    out = KnnPredictBatchOp(k=5, predictionCol="pred").link_from(train, src).collect()
+    assert _accuracy(out, y) > 0.97
+
+
+def test_knn_integer_labels_and_cosine():
+    X, y = _blobs(centers=((2, 0.5), (0.5, 2)))
+    t = MTable({"f0": X[:, 0], "f1": X[:, 1],
+                "label": np.asarray(y, dtype=np.int64)})
+    src = TableSourceOp(t)
+    train = KnnTrainBatchOp(
+        labelCol="label", featureCols=["f0", "f1"], distanceType="COSINE"
+    ).link_from(src)
+    out = KnnPredictBatchOp(k=3, predictionCol="pred").link_from(train, src).collect()
+    pred = np.asarray(out.col("pred"))
+    assert pred.dtype.kind == "i"
+    assert (pred == y).mean() > 0.9
+
+
+def test_fm_classifier_nonlinear():
+    # XOR-ish: linear models fail, the pairwise FM term separates it
+    rng = np.random.default_rng(7)
+    X = rng.uniform(-1, 1, size=(400, 2))
+    y = ((X[:, 0] * X[:, 1]) > 0).astype(int)
+    src = TableSourceOp(_table(X, y))
+    train = FmClassifierTrainBatchOp(
+        labelCol="label", featureCols=["f0", "f1"], numFactor=4, maxIter=200
+    ).link_from(src)
+    out = FmClassifierPredictBatchOp(
+        predictionCol="pred", predictionDetailCol="detail"
+    ).link_from(train, src).collect()
+    assert _accuracy(out, y) > 0.9
+
+
+def test_fm_regressor():
+    rng = np.random.default_rng(3)
+    X = rng.uniform(-1, 1, size=(300, 2))
+    y = 2.0 * X[:, 0] + 3.0 * X[:, 0] * X[:, 1]
+    t = MTable({"f0": X[:, 0], "f1": X[:, 1], "label": y})
+    src = TableSourceOp(t)
+    train = FmRegressorTrainBatchOp(
+        labelCol="label", featureCols=["f0", "f1"], numFactor=4, maxIter=300
+    ).link_from(src)
+    out = FmRegressorPredictBatchOp(predictionCol="pred").link_from(train, src).collect()
+    pred = np.asarray(out.col("pred"), np.float64)
+    rmse = np.sqrt(((pred - y) ** 2).mean())
+    assert rmse < 0.35
+
+
+def test_mlp_classifier():
+    X, y = _blobs(centers=((0, 0), (4, 4), (0, 4), (4, 0)))
+    src = TableSourceOp(_table(X, y))
+    train = MultilayerPerceptronTrainBatchOp(
+        labelCol="label", featureCols=["f0", "f1"], layers=[16], maxIter=200
+    ).link_from(src)
+    out = MultilayerPerceptronPredictBatchOp(
+        predictionCol="pred", predictionDetailCol="detail"
+    ).link_from(train, src).collect()
+    assert _accuracy(out, y) > 0.95
+
+
+def test_one_vs_rest():
+    X, y = _blobs()
+    src = TableSourceOp(_table(X, y))
+    proto = LogisticRegressionTrainBatchOp(
+        labelCol="label", featureCols=["f0", "f1"], maxIter=50
+    )
+    train = OneVsRestTrainBatchOp(proto).link_from(src)
+    out = OneVsRestPredictBatchOp(
+        predictionCol="pred", predictionDetailCol="detail"
+    ).link_from(train, src).collect()
+    assert _accuracy(out, y) > 0.97
+    detail = json.loads(out.col("detail")[0])
+    assert set(detail) == {"0", "1", "2"}
+
+
+def test_one_vs_rest_model_roundtrip(tmp_path):
+    from alink_tpu.operator.batch import AkSinkBatchOp, AkSourceBatchOp
+
+    X, y = _blobs(n_per=30)
+    src = TableSourceOp(_table(X, y))
+    proto = LogisticRegressionTrainBatchOp(
+        labelCol="label", featureCols=["f0", "f1"], maxIter=30
+    )
+    train = OneVsRestTrainBatchOp(proto).link_from(src)
+    path = str(tmp_path / "ovr.ak")
+    AkSinkBatchOp(filePath=path).link_from(train).collect()
+    model = AkSourceBatchOp(filePath=path)
+    out = OneVsRestPredictBatchOp(predictionCol="pred").link_from(model, src).collect()
+    assert _accuracy(out, y) > 0.97
+
+
+def test_static_schema_no_execution():
+    X, y = _blobs(n_per=10)
+    src = TableSourceOp(_table(X, y))
+    train = NaiveBayesTrainBatchOp(
+        labelCol="label", featureCols=["f0", "f1"]
+    ).link_from(src)
+    pred = NaiveBayesPredictBatchOp(predictionCol="p").link_from(train, src)
+    assert "p" in pred.schema.names
+    assert not train._executed
