@@ -195,6 +195,16 @@ from .tree import (
     RandomForestRegTrainBatchOp,
     RandomForestTrainBatchOp,
 )
+from .statistics import (
+    ChiSquareTestBatchOp,
+    CorrelationBatchOp,
+    CovarianceBatchOp,
+    QuantileBatchOp,
+    SummarizerBatchOp,
+    VectorChiSquareTestBatchOp,
+    VectorCorrelationBatchOp,
+    VectorSummarizerBatchOp,
+)
 from .huge import (
     DeepWalkBatchOp,
     DeepWalkEmbeddingBatchOp,

@@ -24,13 +24,12 @@ TPU-first re-design notes:
 
 from __future__ import annotations
 
-import json
 from typing import List, Optional
 
 import numpy as np
 
 from ...common.exceptions import AkIllegalArgumentException, AkIllegalDataException
-from ...common.model import MODEL_SCHEMA, model_to_table, table_to_model
+from ...common.model import model_to_table, table_to_model
 from ...common.mtable import AlinkTypes, MTable
 from ...common.params import InValidator, MinValidator, ParamInfo
 from ...mapper import (
@@ -40,14 +39,13 @@ from ...mapper import (
     HasReservedCols,
     HasVectorCol,
     RichModelMapper,
-    detail_json,
     get_feature_block,
     merge_feature_params,
-    np_labels,
     resolve_feature_cols,
+    sigmoid_np,
     softmax_np,
 )
-from ...optim import fm_obj, mlp_forward, mlp_obj, optimize
+from ...optim import fm_obj, fm_pairwise, mlp_forward, mlp_obj, optimize
 from .base import BatchOperator
 from .utils import ModelMapBatchOp, ModelTrainOpMixin
 
@@ -188,7 +186,7 @@ class NaiveBayesModelMapper(RichModelMapper):
     def _pred_type(self) -> str:
         return self.meta.get("labelType", AlinkTypes.STRING)
 
-    def predict_block(self, t: MTable):
+    def predict_proba_block(self, t: MTable):
         import jax
 
         X = get_feature_block(
@@ -196,13 +194,10 @@ class NaiveBayesModelMapper(RichModelMapper):
             vector_size=self.meta["dim"],
         ).astype(np.float32)
         s = np.asarray(jax.device_get(self._score_jit(X)))
-        labels = self.meta["labels"]
-        label_type = self.meta.get("labelType", AlinkTypes.STRING)
-        pred = np_labels(labels, label_type, s.argmax(axis=1))
-        detail = None
-        if self.get(HasPredictionDetailCol.PREDICTION_DETAIL_COL):
-            detail = detail_json(labels, softmax_np(s))
-        return pred, label_type, detail
+        return softmax_np(s)
+
+    def predict_block(self, t: MTable):
+        return self._classification_result(self.predict_proba_block(t))
 
 
 class NaiveBayesPredictBatchOp(ModelMapBatchOp, HasPredictionCol,
@@ -291,7 +286,7 @@ class KnnModelMapper(RichModelMapper):
     def _pred_type(self) -> str:
         return self.meta.get("labelType", AlinkTypes.STRING)
 
-    def predict_block(self, t: MTable):
+    def predict_proba_block(self, t: MTable):
         import jax
 
         Q = get_feature_block(
@@ -300,13 +295,10 @@ class KnnModelMapper(RichModelMapper):
         ).astype(np.float32)
         votes, _ = jax.device_get(self._knn_jit(Q, self.X_train, self.y_train))
         votes = np.asarray(votes)
-        labels = self.meta["labels"]
-        label_type = self.meta.get("labelType", AlinkTypes.STRING)
-        pred = np_labels(labels, label_type, votes.argmax(axis=1))
-        detail = None
-        if self.get(HasPredictionDetailCol.PREDICTION_DETAIL_COL):
-            detail = detail_json(labels, votes / votes.sum(axis=1, keepdims=True))
-        return pred, label_type, detail
+        return votes / votes.sum(axis=1, keepdims=True)
+
+    def predict_block(self, t: MTable):
+        return self._classification_result(self.predict_proba_block(t))
 
 
 class KnnPredictBatchOp(ModelMapBatchOp, HasPredictionCol,
@@ -371,13 +363,19 @@ class BaseFmTrainBatchOp(ModelTrainOpMixin, BatchOperator, HasVectorCol,
         w0 = np.zeros(obj.num_params, np.float32)
         # V must start non-zero: the pairwise term's gradient vanishes at V=0
         w0[1 + d:] = rng.normal(0.0, self.get(self.INIT_STDEV), d * kf)
+        # per-block L2 as in the reference FmOptimizer: lambda0 on the
+        # intercept, lambda1 on the linear weights, lambda2 on the factors
+        l2_vec = np.concatenate([
+            [self.get(self.LAMBDA_0)],
+            np.full(d, self.get(self.LAMBDA_1)),
+            np.full(d * kf, self.get(self.LAMBDA_2)),
+        ]).astype(np.float32)
         res = optimize(
             obj, X, y, w0=w0,
             mesh=self.env.mesh,
             method="lbfgs",
             max_iter=self.get(self.MAX_ITER),
-            l2=self.get(self.LAMBDA_2),
-            l1=self.get(self.LAMBDA_1),
+            l2=l2_vec,
             tol=self.get(self.EPSILON),
             learning_rate=self.get(self.LEARN_RATE),
         )
@@ -419,13 +417,7 @@ class FmModelMapper(RichModelMapper):
 
         self.meta, arrays = table_to_model(model)
         w0, w, V = arrays["w0"], arrays["w"], arrays["V"]
-
-        def score(X):
-            xv = X @ V
-            pair = 0.5 * ((xv * xv) - (X * X) @ (V * V)).sum(axis=1)
-            return w0[0] + X @ w + pair
-
-        self._score_jit = jax.jit(score)
+        self._score_jit = jax.jit(lambda X: w0[0] + X @ w + fm_pairwise(X, V))
         return self
 
     def _pred_type(self) -> str:
@@ -433,28 +425,25 @@ class FmModelMapper(RichModelMapper):
             return AlinkTypes.DOUBLE
         return self.meta.get("labelType", AlinkTypes.STRING)
 
-    def predict_block(self, t: MTable):
+    def _scores(self, t: MTable) -> np.ndarray:
         import jax
 
         X = get_feature_block(
             t, merge_feature_params(self.get_params(), self.meta),
             vector_size=self.meta["dim"],
         ).astype(np.float32)
-        s = np.asarray(jax.device_get(self._score_jit(X)))
+        return np.asarray(jax.device_get(self._score_jit(X)))
+
+    def predict_proba_block(self, t: MTable):
         if self.meta["fmTask"] == "regression":
-            return s.astype(np.float64), AlinkTypes.DOUBLE, None
-        labels = self.meta["labels"]
-        label_type = self.meta.get("labelType", AlinkTypes.STRING)
-        prob_pos = np.where(
-            s >= 0,
-            1.0 / (1.0 + np.exp(-np.abs(s))),
-            np.exp(-np.abs(s)) / (1.0 + np.exp(-np.abs(s))),
-        )
-        pred = np_labels(labels, label_type, np.where(prob_pos >= 0.5, 0, 1))
-        detail = None
-        if self.get(HasPredictionDetailCol.PREDICTION_DETAIL_COL):
-            detail = detail_json(labels, np.stack([prob_pos, 1 - prob_pos], 1))
-        return pred, label_type, detail
+            return None
+        prob_pos = sigmoid_np(self._scores(t))
+        return np.stack([prob_pos, 1 - prob_pos], 1)
+
+    def predict_block(self, t: MTable):
+        if self.meta["fmTask"] == "regression":
+            return self._scores(t).astype(np.float64), AlinkTypes.DOUBLE, None
+        return self._classification_result(self.predict_proba_block(t))
 
 
 class FmPredictBatchOp(ModelMapBatchOp, HasPredictionCol,
@@ -550,7 +539,7 @@ class MlpModelMapper(RichModelMapper):
     def _pred_type(self) -> str:
         return self.meta.get("labelType", AlinkTypes.STRING)
 
-    def predict_block(self, t: MTable):
+    def predict_proba_block(self, t: MTable):
         import jax
 
         X = get_feature_block(
@@ -558,13 +547,10 @@ class MlpModelMapper(RichModelMapper):
             vector_size=self.meta["dim"],
         ).astype(np.float32)
         logits = np.asarray(jax.device_get(self._score_jit(X)))
-        labels = self.meta["labels"]
-        label_type = self.meta.get("labelType", AlinkTypes.STRING)
-        pred = np_labels(labels, label_type, logits.argmax(axis=1))
-        detail = None
-        if self.get(HasPredictionDetailCol.PREDICTION_DETAIL_COL):
-            detail = detail_json(labels, softmax_np(logits))
-        return pred, label_type, detail
+        return softmax_np(logits)
+
+    def predict_block(self, t: MTable):
+        return self._classification_result(self.predict_proba_block(t))
 
 
 class MultilayerPerceptronPredictBatchOp(ModelMapBatchOp, HasPredictionCol,
@@ -626,7 +612,7 @@ class OneVsRestTrainBatchOp(ModelTrainOpMixin, BatchOperator):
             [AlinkTypes.STRING if n == label_col else t.schema.type_of(n)
              for n in t.schema.names],
         )
-        sub_metas, all_keys, all_jsons, all_tensors = [], [], [], []
+        sub_metas, all_arrays = [], {}
         for ci in range(len(labels)):
             relabel = np.where(idx == ci, _OVR_POS, _OVR_NEG).astype(object)
             cols = {n: t.col(n) for n in t.names}
@@ -638,9 +624,7 @@ class OneVsRestTrainBatchOp(ModelTrainOpMixin, BatchOperator):
             sub_meta, sub_arrays = table_to_model(model)
             sub_metas.append(sub_meta)
             for key, arr in sub_arrays.items():
-                all_keys.append(f"m{ci}:{key}")
-                all_jsons.append("")
-                all_tensors.append(np.asarray(arr))
+                all_arrays[f"m{ci}:{key}"] = np.asarray(arr)
         meta = {
             "modelName": "OneVsRestModel",
             "labelCol": label_col,
@@ -652,11 +636,7 @@ class OneVsRestTrainBatchOp(ModelTrainOpMixin, BatchOperator):
                 type(self.classifier), "paired_mapper_cls_name", None
             ) or _fail_no_mapper(type(self.classifier).__name__),
         }
-        keys = ["__meta__"] + all_keys
-        jsons = [json.dumps(meta)] + all_jsons
-        tensors = [np.zeros(0)] + all_tensors
-        return MTable({"key": keys, "json": jsons, "tensor": tensors},
-                      MODEL_SCHEMA)
+        return model_to_table(meta, all_arrays)
 
 
 class OneVsRestModelMapper(RichModelMapper):
@@ -665,19 +645,17 @@ class OneVsRestModelMapper(RichModelMapper):
 
     def load_model(self, model: MTable):
         self.meta, arrays = table_to_model(model)
-        n_cls = self.meta["numClasses"]
+        mapper_cls = _resolve_mapper(self.meta["mapperClass"])
         self.sub_mappers = []
-        for ci in range(n_cls):
+        for ci in range(self.meta["numClasses"]):
             prefix = f"m{ci}:"
             sub_arrays = {
                 k[len(prefix):]: v for k, v in arrays.items()
                 if k.startswith(prefix)
             }
             sub_model = model_to_table(self.meta["subMetas"][ci], sub_arrays)
-            mapper_cls = _resolve_mapper(self.meta["mapperClass"])
-            params = self.get_params().clone()
-            params.set("predictionDetailCol", "__detail__")
-            sub = mapper_cls(self.model_schema, self.data_schema, params)
+            sub = mapper_cls(self.model_schema, self.data_schema,
+                             self.get_params().clone())
             sub.load_model(sub_model)
             self.sub_mappers.append(sub)
         return self
@@ -685,22 +663,17 @@ class OneVsRestModelMapper(RichModelMapper):
     def _pred_type(self) -> str:
         return self.meta.get("labelType", AlinkTypes.STRING)
 
-    def predict_block(self, t: MTable):
+    def predict_proba_block(self, t: MTable):
         probs = []
         for sub in self.sub_mappers:
-            _, _, detail = sub.predict_block(t)
-            probs.append(
-                np.asarray([json.loads(s)[_OVR_POS] for s in detail], np.float64)
-            )
+            sub_p = sub.predict_proba_block(t)
+            pos = sub.meta["labels"].index(_OVR_POS)
+            probs.append(np.asarray(sub_p[:, pos], np.float64))
         P = np.stack(probs, axis=1)  # (n, k) one-vs-rest positive probs
-        P = P / np.maximum(P.sum(axis=1, keepdims=True), 1e-12)
-        labels = self.meta["labels"]
-        label_type = self.meta.get("labelType", AlinkTypes.STRING)
-        pred = np_labels(labels, label_type, P.argmax(axis=1))
-        detail = None
-        if self.get(HasPredictionDetailCol.PREDICTION_DETAIL_COL):
-            detail = detail_json(labels, P)
-        return pred, label_type, detail
+        return P / np.maximum(P.sum(axis=1, keepdims=True), 1e-12)
+
+    def predict_block(self, t: MTable):
+        return self._classification_result(self.predict_proba_block(t))
 
 
 class OneVsRestPredictBatchOp(ModelMapBatchOp, HasPredictionCol,
@@ -709,12 +682,7 @@ class OneVsRestPredictBatchOp(ModelMapBatchOp, HasPredictionCol,
     mapper_cls = OneVsRestModelMapper
 
 
-_MAPPER_REGISTRY = {}
-
-
 def _resolve_mapper(name: str):
-    if name in _MAPPER_REGISTRY:
-        return _MAPPER_REGISTRY[name]
     from .linear import LinearModelMapper
 
     base = {

@@ -33,11 +33,10 @@ from ...mapper import (
     HasReservedCols,
     HasVectorCol,
     RichModelMapper,
-    detail_json,
     get_feature_block,
     merge_feature_params,
-    np_labels,
     resolve_feature_cols,
+    sigmoid_np,
     softmax_np,
 )
 from ...optim import (
@@ -280,39 +279,23 @@ class LinearModelMapper(RichModelMapper):
             jax.device_get(self._score_jit(X, self.weights, self.intercept))
         )
 
-    def predict_block(self, t: MTable):
+    def predict_proba_block(self, t: MTable):
         mtype = self.meta["linearModelType"]
-        labels = self.meta.get("labels")
-        label_type = self.meta.get("labelType", AlinkTypes.STRING)
-        detail_wanted = bool(self.get(HasPredictionDetailCol.PREDICTION_DETAIL_COL))
-        detail = None
-
         if mtype == "LinearReg":
-            s = self._scores(t)[:, 0] if self.weights.ndim > 1 else self._scores(t)
-            return np.asarray(s, np.float64), AlinkTypes.DOUBLE, None
-
+            return None
         if mtype == "Softmax":
-            probs = softmax_np(self._scores(t))
-            idx = probs.argmax(axis=1)
-            pred = np_labels(labels, label_type, idx)
-            if detail_wanted:
-                detail = detail_json(labels, probs)
-            return pred, label_type, detail
-
+            return softmax_np(self._scores(t))
         # binary LR / SVM: labels[0] is positive
         s = self._scores(t)
         s = s[:, 0] if s.ndim > 1 else s
-        # numerically stable sigmoid (no overflow for large |s|)
-        prob_pos = np.where(
-            s >= 0,
-            1.0 / (1.0 + np.exp(-np.abs(s))),
-            np.exp(-np.abs(s)) / (1.0 + np.exp(-np.abs(s))),
-        )
-        idx = np.where(prob_pos >= 0.5, 0, 1)
-        pred = np_labels(labels, label_type, idx)
-        if detail_wanted:
-            detail = detail_json(labels, np.stack([prob_pos, 1 - prob_pos], 1))
-        return pred, label_type, detail
+        prob_pos = sigmoid_np(s)
+        return np.stack([prob_pos, 1 - prob_pos], 1)
+
+    def predict_block(self, t: MTable):
+        if self.meta["linearModelType"] == "LinearReg":
+            s = self._scores(t)[:, 0] if self.weights.ndim > 1 else self._scores(t)
+            return np.asarray(s, np.float64), AlinkTypes.DOUBLE, None
+        return self._classification_result(self.predict_proba_block(t))
 
 
 class LinearModelPredictOp(ModelMapBatchOp, HasPredictionCol,

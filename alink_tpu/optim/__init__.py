@@ -1,5 +1,6 @@
 from .objfunc import (
     fm_obj,
+    fm_pairwise,
     mlp_forward,
     mlp_obj,
     ObjFunc,

@@ -118,6 +118,14 @@ def huber_obj(dim: int, delta: float = 1.0) -> ObjFunc:
     return ObjFunc(local_loss, dim)
 
 
+def fm_pairwise(X, V):
+    """FM second-order term via the O(n·d·k) identity 0.5·Σ_f((XV)² − X²V²) —
+    two matmuls on the MXU. Generic over numpy/jax arrays; the single home of
+    this formula for both training and serving."""
+    xv = X @ V
+    return 0.5 * ((xv * xv) - (X * X) @ (V * V)).sum(axis=1)
+
+
 def fm_obj(dim: int, num_factors: int, task: str = "binary") -> ObjFunc:
     """Factorization machine objective (reference:
     operator/common/optim/FmOptimizer.java:39 + common/fm/FmLossUtils.java).
@@ -132,9 +140,7 @@ def fm_obj(dim: int, num_factors: int, task: str = "binary") -> ObjFunc:
         w0 = w[0]
         lin = w[1:1 + dim]
         V = w[1 + dim:].reshape(dim, num_factors)
-        xv = X @ V
-        pair = 0.5 * ((xv * xv) - (X * X) @ (V * V)).sum(axis=1)
-        return w0 + X @ lin + pair
+        return w0 + X @ lin + fm_pairwise(X, V)
 
     def local_loss(w, X, y, wt):
         s = score(w, X)

@@ -56,7 +56,11 @@ def optimize(
     num_search_step: int = 40,
     batch_size: int = 0,
 ) -> OptimResult:
-    """Minimize ``psum(obj.local_loss)/N + l1·|w| + l2/2·|w|²`` over the mesh."""
+    """Minimize ``psum(obj.local_loss)/N + l1·|w| + l2/2·|w|²`` over the mesh.
+
+    ``l2`` may be a scalar or a per-parameter vector of length
+    ``obj.num_params`` (e.g. FM's separate lambda0/1/2 on intercept, linear
+    weights, and factors — reference: optim/FmOptimizer.java)."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
@@ -91,7 +95,7 @@ def optimize(
             l, g = jax.value_and_grad(obj.local_loss)(w, Xl, yl, wt_eff)
             L = jax.lax.psum(l, axis) / total_w
             G = jax.lax.psum(g, axis) / total_w
-            L = L + 0.5 * l2 * (w @ w)
+            L = L + 0.5 * jnp.sum(l2 * w * w)
             G = G + l2 * w
             return L, G
 
@@ -99,7 +103,7 @@ def optimize(
             # batched local losses for all candidate weight vectors: one psum
             local = jax.vmap(lambda w: obj.local_loss(w, Xl, yl, wt_eff))(cands)
             L = jax.lax.psum(local, axis) / total_w
-            return L + 0.5 * l2 * jnp.sum(cands * cands, axis=1)
+            return L + 0.5 * jnp.sum(l2 * cands * cands, axis=1)
 
         def l1_term(w):
             return l1 * jnp.abs(w).sum() if l1 > 0 else 0.0
@@ -250,7 +254,7 @@ def optimize(
         def hess(w):
             Hl = jax.hessian(obj.local_loss)(w, Xl, yl, wt_eff)
             H = jax.lax.psum(Hl, axis) / total_w
-            return H + l2 * jnp.eye(obj.num_params)
+            return H + l2 * jnp.eye(obj.num_params)  # eye*vec == diag(vec)
 
         loss0, g0 = value_and_grad(w_init)
 

@@ -1,1 +1,2 @@
+from .prob import CDF, IDF, PDF, XRandom
 from .summarizer import TableSummary, summarize

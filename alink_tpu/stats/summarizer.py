@@ -18,6 +18,16 @@ import numpy as np
 from ..common.mtable import AlinkTypes, MTable, TableSchema
 
 
+SUMMARY_KEYS = ["count", "numMissing", "sum", "mean", "variance",
+                "standardDeviation", "min", "max"]
+
+
+def summary_schema() -> TableSchema:
+    """Schema of a summary table — the single source for the statistic list."""
+    return TableSchema(["colName"] + SUMMARY_KEYS,
+                       [AlinkTypes.STRING] + [AlinkTypes.DOUBLE] * len(SUMMARY_KEYS))
+
+
 class TableSummary:
     """Per-column count/numMissing/sum/mean/variance/std/min/max
     (reference: common/statistics/basicstatistic/TableSummary.java)."""
@@ -67,8 +77,7 @@ class TableSummary:
         return self.stats[col]["numMissing"]
 
     def to_mtable(self) -> MTable:
-        keys = ["count", "numMissing", "sum", "mean", "variance",
-                "standardDeviation", "min", "max"]
+        keys = SUMMARY_KEYS
         cols: Dict[str, list] = {"colName": []}
         for k in keys:
             cols[k] = []

@@ -1,0 +1,151 @@
+"""Statistics ops + probabilistic distributions.
+
+Mirrors the reference's statistics test style (reference:
+core/src/test/java/com/alibaba/alink/operator/batch/statistics/
+CorrelationBatchOpTest.java, ChiSquareTestBatchOpTest.java): tiny in-memory
+datasets, assert numeric outputs.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+from alink_tpu.operator.batch import (
+    ChiSquareTestBatchOp,
+    CorrelationBatchOp,
+    CovarianceBatchOp,
+    MemSourceBatchOp,
+    QuantileBatchOp,
+    SummarizerBatchOp,
+    VectorChiSquareTestBatchOp,
+    VectorCorrelationBatchOp,
+    VectorSummarizerBatchOp,
+)
+from alink_tpu.stats.prob import CDF, IDF, PDF, XRandom
+
+
+def _xy_source(n=200, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=n)
+    y = 2.0 * x + rng.normal(scale=0.1, size=n)
+    z = rng.normal(size=n)
+    rows = [(float(a), float(b), float(c)) for a, b, c in zip(x, y, z)]
+    return MemSourceBatchOp(rows, "x double, y double, z double")
+
+
+def test_pearson_correlation():
+    corr = CorrelationBatchOp().link_from(_xy_source()).collect_correlation()
+    m = corr.correlation_matrix
+    assert corr.col_names == ["x", "y", "z"]
+    assert m[0, 0] == pytest.approx(1.0)
+    assert m[0, 1] == pytest.approx(1.0, abs=0.01)
+    assert abs(m[0, 2]) < 0.25
+
+
+def test_spearman_correlation_monotone_invariance():
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=100)
+    rows = [(float(a), float(math.exp(a))) for a in x]
+    src = MemSourceBatchOp(rows, "x double, ex double")
+    m = (CorrelationBatchOp(method="SPEARMAN").link_from(src)
+         .collect_correlation().correlation_matrix)
+    assert m[0, 1] == pytest.approx(1.0)
+
+
+def test_vector_correlation():
+    rng = np.random.default_rng(2)
+    rows = [(f"{a} {-a}",) for a in rng.normal(size=50)]
+    src = MemSourceBatchOp(rows, "vec string")
+    m = (VectorCorrelationBatchOp(selectedCol="vec").link_from(src)
+         .collect_correlation().correlation_matrix)
+    assert m[0, 1] == pytest.approx(-1.0)
+
+
+def test_chi_square_dependence():
+    # col 'dep' is a deterministic function of the label; 'ind' is independent
+    rng = np.random.default_rng(3)
+    rows = []
+    for _ in range(300):
+        label = int(rng.integers(2))
+        rows.append((("a" if label else "b"), str(rng.integers(2)), label))
+    src = MemSourceBatchOp(rows, "dep string, ind string, label int")
+    out = (ChiSquareTestBatchOp(selectedCols=["dep", "ind"], labelCol="label")
+           .link_from(src).collect())
+    by_col = {r[0]: r for r in out.rows()}
+    assert by_col["dep"][2] < 1e-6       # p-value ~ 0
+    assert by_col["ind"][2] > 0.01
+
+
+def test_vector_chi_square():
+    rows = [(f"{i % 2} {1 - i % 2}", i % 2) for i in range(100)]
+    src = MemSourceBatchOp(rows, "vec string, label int")
+    out = (VectorChiSquareTestBatchOp(selectedCol="vec", labelCol="label")
+           .link_from(src).collect())
+    assert all(r[2] < 1e-6 for r in out.rows())
+
+
+def test_quantile_op():
+    rows = [(float(i),) for i in range(101)]
+    out = (QuantileBatchOp(selectedCols=["v"], quantileNum=4)
+           .link_from(MemSourceBatchOp(rows, "v double")).collect())
+    assert list(out.col("v")) == [0.0, 25.0, 50.0, 75.0, 100.0]
+
+
+def test_summarizer_and_covariance():
+    src = _xy_source()
+    s = SummarizerBatchOp().link_from(src).collect_summary()
+    assert s.count("x") == 200
+    assert s.mean("x") == pytest.approx(0.0, abs=0.2)
+    cov = CovarianceBatchOp().link_from(src).collect()
+    # var(y) ≈ 4*var(x)
+    names = list(cov.col("colName"))
+    vx = cov.col("x")[names.index("x")]
+    vy = cov.col("y")[names.index("y")]
+    assert vy / vx == pytest.approx(4.0, rel=0.15)
+
+
+def test_vector_summarizer():
+    rows = [(f"{i} {2 * i}",) for i in range(10)]
+    src = MemSourceBatchOp(rows, "vec string")
+    s = (VectorSummarizerBatchOp(selectedCol="vec").link_from(src)
+         .collect_vector_summary())
+    assert s.mean("v0") == pytest.approx(4.5)
+    assert s.mean("v1") == pytest.approx(9.0)
+
+
+# -- probabilistic module (reference: common/probabilistic/CDF.java etc.) ---
+
+def test_normal_cdf_idf_roundtrip():
+    p = CDF.normal(1.96)
+    assert p == pytest.approx(0.975, abs=1e-4)
+    assert IDF.normal(p) == pytest.approx(1.96, abs=1e-6)
+
+
+def test_chi2_known_values():
+    # chi2 cdf with df=2 is 1 - exp(-x/2)
+    for x in (0.5, 1.0, 3.0, 10.0):
+        assert CDF.chi2(x, 2) == pytest.approx(1 - math.exp(-x / 2), abs=1e-10)
+    assert IDF.chi2(0.95, 2) == pytest.approx(-2 * math.log(0.05), abs=1e-6)
+
+
+def test_student_t_f_symmetry():
+    assert CDF.student_t(0.0, 7) == pytest.approx(0.5)
+    assert CDF.student_t(-2.0, 7) == pytest.approx(1 - CDF.student_t(2.0, 7))
+    # F(1, d2->inf) ~ chi2(1)
+    assert CDF.f(3.84, 1, 100000) == pytest.approx(CDF.chi2(3.84, 1), abs=1e-3)
+
+
+def test_pdf_integrates():
+    xs = np.linspace(-8, 8, 4001)
+    for pdf in (lambda x: PDF.normal(x),
+                lambda x: PDF.student_t(x, 5)):
+        total = np.trapezoid(pdf(xs), xs)
+        assert total == pytest.approx(1.0, abs=1e-3)
+
+
+def test_xrandom_matches_cdf():
+    r = XRandom(seed=42)
+    draws = r.normal(size=20000)
+    emp = (draws < 1.0).mean()
+    assert emp == pytest.approx(CDF.normal(1.0), abs=0.01)
