@@ -167,6 +167,32 @@ from .feature import (
     StandardScalerTrainBatchOp,
     VectorAssemblerBatchOp,
 )
+from .feature2 import (
+    BinningPredictBatchOp,
+    BinningTrainBatchOp,
+    ChiSqSelectorBatchOp,
+    ChiSqSelectorPredictBatchOp,
+    EqualWidthDiscretizerPredictBatchOp,
+    EqualWidthDiscretizerTrainBatchOp,
+    FeatureHasherBatchOp,
+    MaxAbsScalerPredictBatchOp,
+    MaxAbsScalerTrainBatchOp,
+    OneHotPredictBatchOp,
+    OneHotTrainBatchOp,
+    PcaPredictBatchOp,
+    PcaTrainBatchOp,
+    QuantileDiscretizerPredictBatchOp,
+    QuantileDiscretizerTrainBatchOp,
+)
+from .dataproc import (
+    ImputerPredictBatchOp,
+    ImputerTrainBatchOp,
+    JsonValueBatchOp,
+    LookupBatchOp,
+    StringIndexerPredictBatchOp,
+    StringIndexerTrainBatchOp,
+    TypeConvertBatchOp,
+)
 from .dl import (
     BertTextClassifierPredictBatchOp,
     BertTextClassifierTrainBatchOp,

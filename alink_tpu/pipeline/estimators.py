@@ -97,6 +97,101 @@ class VectorAssembler(TransformerBase, _feat.HasSelectedCols):
     RESERVED_COLS = _feat.HasReservedCols.RESERVED_COLS
 
 
+# -- feature engineering breadth ---------------------------------------------
+from ..operator.batch import dataproc as _dp
+from ..operator.batch import feature2 as _feat2
+
+
+class OneHotEncoderModel(ModelBase):
+    _predict_op_cls = _feat2.OneHotPredictBatchOp
+
+
+class OneHotEncoder(EstimatorBase, _feat2.HasSelectedCols):
+    """(reference: pipeline/feature/OneHotEncoder.java)"""
+
+    _train_op_cls = _feat2.OneHotTrainBatchOp
+    _model_cls = OneHotEncoderModel
+    DROP_LAST = _feat2.OneHotTrainBatchOp.DROP_LAST
+    OUTPUT_COL = _feat2.HasOutputCol.OUTPUT_COL
+
+
+class PCAModel(ModelBase):
+    _predict_op_cls = _feat2.PcaPredictBatchOp
+
+
+class PCA(EstimatorBase, _feat2.HasSelectedCols):
+    """(reference: pipeline/feature/PCA.java)"""
+
+    _train_op_cls = _feat2.PcaTrainBatchOp
+    _model_cls = PCAModel
+    K = _feat2.PcaTrainBatchOp.K
+    CALCULATION_TYPE = _feat2.PcaTrainBatchOp.CALCULATION_TYPE
+    VECTOR_COL = _feat2.PcaTrainBatchOp.VECTOR_COL
+    OUTPUT_COL = _feat2.HasOutputCol.OUTPUT_COL
+
+
+class QuantileDiscretizerModel(ModelBase):
+    _predict_op_cls = _feat2.QuantileDiscretizerPredictBatchOp
+
+
+class QuantileDiscretizer(EstimatorBase, _feat2.HasSelectedCols):
+    """(reference: pipeline/feature/QuantileDiscretizer.java)"""
+
+    _train_op_cls = _feat2.QuantileDiscretizerTrainBatchOp
+    _model_cls = QuantileDiscretizerModel
+    NUM_BUCKETS = _feat2.QuantileDiscretizerTrainBatchOp.NUM_BUCKETS
+
+
+class BinningModel(ModelBase):
+    _predict_op_cls = _feat2.BinningPredictBatchOp
+
+
+class Binning(EstimatorBase, _feat2.HasSelectedCols):
+    """(reference: pipeline/feature/Binning.java — WOE/INDEX encode)"""
+
+    _train_op_cls = _feat2.BinningTrainBatchOp
+    _model_cls = BinningModel
+    LABEL_COL = _feat2.BinningTrainBatchOp.LABEL_COL
+    NUM_BUCKETS = _feat2.BinningTrainBatchOp.NUM_BUCKETS
+    ENCODE = _feat2.BinningModelMapper.ENCODE
+
+
+class StringIndexerModel(ModelBase):
+    _predict_op_cls = _dp.StringIndexerPredictBatchOp
+
+
+class StringIndexer(EstimatorBase, _dp.HasSelectedCols):
+    """(reference: pipeline/dataproc/StringIndexer.java)"""
+
+    _train_op_cls = _dp.StringIndexerTrainBatchOp
+    _model_cls = StringIndexerModel
+    STRING_ORDER_TYPE = _dp.StringIndexerTrainBatchOp.STRING_ORDER_TYPE
+    HANDLE_INVALID = _dp.StringIndexerModelMapper.HANDLE_INVALID
+    OUTPUT_COLS = _dp.HasOutputCols.OUTPUT_COLS
+
+
+class ImputerModel(ModelBase):
+    _predict_op_cls = _dp.ImputerPredictBatchOp
+
+
+class Imputer(EstimatorBase, _dp.HasSelectedCols):
+    """(reference: pipeline/dataproc/Imputer.java)"""
+
+    _train_op_cls = _dp.ImputerTrainBatchOp
+    _model_cls = ImputerModel
+    STRATEGY = _dp.ImputerTrainBatchOp.STRATEGY
+    FILL_VALUE = _dp.ImputerTrainBatchOp.FILL_VALUE
+
+
+class FeatureHasher(TransformerBase, _feat2.HasSelectedCols):
+    """(reference: pipeline/feature/FeatureHasher.java)"""
+
+    _map_op_cls = _feat2.FeatureHasherBatchOp
+    NUM_FEATURES = _feat2.FeatureHasherBatchOp.NUM_FEATURES
+    CATEGORICAL_COLS = _feat2.FeatureHasherBatchOp.CATEGORICAL_COLS
+    OUTPUT_COL = _feat2.HasOutputCol.OUTPUT_COL
+
+
 # -- recommendation ----------------------------------------------------------
 from ..operator.batch import recommendation as _rec
 
