@@ -90,6 +90,18 @@ from .clustering import (
     KMeansPredictBatchOp,
     KMeansTrainBatchOp,
 )
+from .clustering2 import (
+    AgnesBatchOp,
+    BisectingKMeansPredictBatchOp,
+    BisectingKMeansTrainBatchOp,
+    DbscanBatchOp,
+    GmmPredictBatchOp,
+    GmmTrainBatchOp,
+    KModesPredictBatchOp,
+    KModesTrainBatchOp,
+    LdaPredictBatchOp,
+    LdaTrainBatchOp,
+)
 from .linear import (
     LassoRegPredictBatchOp,
     LassoRegTrainBatchOp,

@@ -30,6 +30,57 @@ class KMeans(EstimatorBase, _clu.HasKMeansParams):
     RESERVED_COLS = _clu.HasReservedCols.RESERVED_COLS
 
 
+from ..operator.batch import clustering2 as _clu2
+
+
+class GaussianMixtureModel(ModelBase):
+    _predict_op_cls = _clu2.GmmPredictBatchOp
+
+
+class GaussianMixture(EstimatorBase):
+    """(reference: pipeline/clustering/GaussianMixture.java)"""
+
+    _train_op_cls = _clu2.GmmTrainBatchOp
+    _model_cls = GaussianMixtureModel
+    K = _clu2.GmmTrainBatchOp.K
+    MAX_ITER = _clu2.GmmTrainBatchOp.MAX_ITER
+    FEATURE_COLS = _clu2.HasFeatureCols.FEATURE_COLS
+    VECTOR_COL = _clu2.HasVectorCol.VECTOR_COL
+    PREDICTION_COL = _clu2.HasPredictionCol.PREDICTION_COL
+    PREDICTION_DETAIL_COL = _clu2.HasPredictionDetailCol.PREDICTION_DETAIL_COL
+
+
+class BisectingKMeansModel(ModelBase):
+    _predict_op_cls = _clu2.BisectingKMeansPredictBatchOp
+
+
+class BisectingKMeans(EstimatorBase):
+    """(reference: pipeline/clustering/BisectingKMeans.java)"""
+
+    _train_op_cls = _clu2.BisectingKMeansTrainBatchOp
+    _model_cls = BisectingKMeansModel
+    K = _clu2.BisectingKMeansTrainBatchOp.K
+    FEATURE_COLS = _clu2.HasFeatureCols.FEATURE_COLS
+    VECTOR_COL = _clu2.HasVectorCol.VECTOR_COL
+    PREDICTION_COL = _clu2.HasPredictionCol.PREDICTION_COL
+
+
+class LdaModel(ModelBase):
+    _predict_op_cls = _clu2.LdaPredictBatchOp
+
+
+class Lda(EstimatorBase):
+    """(reference: pipeline/clustering/Lda.java)"""
+
+    _train_op_cls = _clu2.LdaTrainBatchOp
+    _model_cls = LdaModel
+    SELECTED_COL = _clu2.HasSelectedCol.SELECTED_COL
+    TOPIC_NUM = _clu2.LdaTrainBatchOp.TOPIC_NUM
+    NUM_ITER = _clu2.LdaTrainBatchOp.NUM_ITER
+    PREDICTION_COL = _clu2.HasPredictionCol.PREDICTION_COL
+    PREDICTION_DETAIL_COL = _clu2.HasPredictionDetailCol.PREDICTION_DETAIL_COL
+
+
 # -- linear models -----------------------------------------------------------
 class LinearModel(ModelBase):
     _predict_op_cls = _lin.LinearModelPredictOp

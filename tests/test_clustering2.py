@@ -1,0 +1,119 @@
+"""Clustering breadth tests: GMM, BisectingKMeans, DBSCAN, LDA, KModes, Agnes.
+
+Mirrors the reference tests (reference: core/src/test/java/com/alibaba/alink/
+operator/batch/clustering/GmmTrainBatchOpTest.java, DbscanBatchOpTest.java,
+LdaTrainBatchOpTest.java, ...): tiny synthetic datasets, assert cluster
+recovery.
+"""
+
+import numpy as np
+import pytest
+
+from alink_tpu.operator.batch import (
+    AgnesBatchOp,
+    BisectingKMeansPredictBatchOp,
+    BisectingKMeansTrainBatchOp,
+    DbscanBatchOp,
+    GmmPredictBatchOp,
+    GmmTrainBatchOp,
+    KModesPredictBatchOp,
+    KModesTrainBatchOp,
+    LdaPredictBatchOp,
+    LdaTrainBatchOp,
+    MemSourceBatchOp,
+)
+
+
+def _blobs(centers, n_per=50, scale=0.15, seed=0):
+    rng = np.random.default_rng(seed)
+    rows = []
+    for c in centers:
+        pts = rng.normal(scale=scale, size=(n_per, len(c))) + np.asarray(c)
+        rows.extend(tuple(float(v) for v in p) for p in pts)
+    return rows
+
+
+def _cluster_purity(labels, n_per, n_clusters):
+    """Each true blob should map to one predicted cluster."""
+    labels = np.asarray(labels)
+    ok = 0
+    for ci in range(n_clusters):
+        chunk = labels[ci * n_per:(ci + 1) * n_per]
+        vals, counts = np.unique(chunk, return_counts=True)
+        ok += counts.max()
+    return ok / labels.size
+
+
+def test_gmm_recovers_blobs():
+    rows = _blobs([(0, 0), (4, 4), (-4, 4)])
+    src = MemSourceBatchOp(rows, "x double, y double")
+    model = GmmTrainBatchOp(k=3, maxIter=60).link_from(src)
+    out = GmmPredictBatchOp(predictionDetailCol="d").link_from(model, src).collect()
+    assert _cluster_purity(out.col("pred"), 50, 3) > 0.95
+    import json
+    probs = json.loads(out.col("d")[0])
+    assert sum(probs.values()) == pytest.approx(1.0, abs=1e-3)
+
+
+def test_gmm_anisotropic():
+    rng = np.random.default_rng(1)
+    a = rng.normal(size=(80, 2)) @ np.array([[2.0, 0.0], [0.0, 0.1]])
+    b = rng.normal(size=(80, 2)) @ np.array([[0.1, 0.0], [0.0, 2.0]]) + [6, 0]
+    rows = [tuple(map(float, p)) for p in np.vstack([a, b])]
+    src = MemSourceBatchOp(rows, "x double, y double")
+    model = GmmTrainBatchOp(k=2, maxIter=80).link_from(src)
+    out = GmmPredictBatchOp().link_from(model, src).collect()
+    assert _cluster_purity(out.col("pred"), 80, 2) > 0.95
+
+
+def test_bisecting_kmeans():
+    rows = _blobs([(0, 0), (5, 0), (0, 5), (5, 5)])
+    src = MemSourceBatchOp(rows, "x double, y double")
+    model = BisectingKMeansTrainBatchOp(k=4).link_from(src)
+    out = BisectingKMeansPredictBatchOp().link_from(model, src).collect()
+    assert _cluster_purity(out.col("pred"), 50, 4) > 0.95
+
+
+def test_dbscan_noise_and_clusters():
+    rows = _blobs([(0, 0), (10, 10)], n_per=40, scale=0.3)
+    rows.append((5.0, 5.0))  # isolated noise point
+    src = MemSourceBatchOp(rows, "x double, y double")
+    out = DbscanBatchOp(epsilon=1.5, minPoints=4).link_from(src).collect()
+    labels = np.asarray(out.col("pred"))
+    assert labels[-1] == -1
+    assert len(set(labels[:40].tolist())) == 1
+    assert len(set(labels[40:80].tolist())) == 1
+    assert labels[0] != labels[40]
+
+
+def test_lda_separates_topics():
+    docs_a = ["apple banana fruit juice sweet"] * 20
+    docs_b = ["engine wheel car road drive"] * 20
+    rows = [(d,) for d in docs_a + docs_b]
+    src = MemSourceBatchOp(rows, "doc string")
+    model = LdaTrainBatchOp(selectedCol="doc", topicNum=2, numIter=30) \
+        .link_from(src)
+    out = LdaPredictBatchOp().link_from(model, src).collect()
+    labels = np.asarray(out.col("pred"))
+    assert len(set(labels[:20].tolist())) == 1
+    assert len(set(labels[20:].tolist())) == 1
+    assert labels[0] != labels[20]
+
+
+def test_kmodes():
+    rows = ([("a", "x", "p")] * 20 + [("b", "y", "q")] * 20)
+    src = MemSourceBatchOp(rows, "c1 string, c2 string, c3 string")
+    model = KModesTrainBatchOp(selectedCols=["c1", "c2", "c3"], k=2,
+                               randomSeed=3).link_from(src)
+    out = KModesPredictBatchOp().link_from(model, src).collect()
+    labels = np.asarray(out.col("pred"))
+    assert len(set(labels[:20].tolist())) == 1
+    assert labels[0] != labels[20]
+
+
+def test_agnes_linkages():
+    rows = _blobs([(0, 0), (8, 8)], n_per=15, scale=0.2)
+    src = MemSourceBatchOp(rows, "x double, y double")
+    for linkage in ("MIN", "MAX", "AVERAGE"):
+        out = AgnesBatchOp(k=2, linkage=linkage).link_from(src).collect()
+        assert _cluster_purity(out.col("pred"), 15, 2) == 1.0
