@@ -113,8 +113,18 @@ from .linear import (
     LogisticRegressionTrainBatchOp,
     RidgeRegPredictBatchOp,
     RidgeRegTrainBatchOp,
+    LinearSvrPredictBatchOp,
+    LinearSvrTrainBatchOp,
     SoftmaxPredictBatchOp,
     SoftmaxTrainBatchOp,
+)
+from .regression import (
+    AftSurvivalRegPredictBatchOp,
+    AftSurvivalRegTrainBatchOp,
+    GlmPredictBatchOp,
+    GlmTrainBatchOp,
+    IsotonicRegPredictBatchOp,
+    IsotonicRegTrainBatchOp,
 )
 from .classification import (
     FmClassifierPredictBatchOp,

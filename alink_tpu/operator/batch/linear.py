@@ -45,6 +45,7 @@ from ...optim import (
     optimize,
     softmax_obj,
     squared_obj,
+    svr_obj,
 )
 from .base import BatchOperator
 from .utils import ModelMapBatchOp, ModelTrainOpMixin
@@ -104,6 +105,8 @@ class BaseLinearModelTrainBatchOp(ModelTrainOpMixin, BatchOperator,
             return hinge_obj(dim)
         if t == "LinearReg":
             return squared_obj(dim)
+        if t == "SVR":
+            return svr_obj(dim, float(self.get(LinearSvrTrainBatchOp.SVR_EPSILON)))
         if t == "Softmax":
             return softmax_obj(dim, num_classes)
         raise AkIllegalDataException(f"unknown linear model type {t}")
@@ -122,7 +125,7 @@ class BaseLinearModelTrainBatchOp(ModelTrainOpMixin, BatchOperator,
             X = t.to_numeric_block(feature_cols, dtype=np.float32)
         n, d_raw = X.shape
         y_raw = t.col(label_col)
-        is_classif = self.linear_model_type in ("LR", "SVM", "Softmax")
+        is_classif = self.linear_model_type in ("LR", "SVM", "Softmax")  # SVR/LinearReg: numeric y
         labels: Optional[List] = None
         if is_classif:
             labels = _labels_of(y_raw)
@@ -244,6 +247,15 @@ class LassoRegTrainBatchOp(BaseLinearModelTrainBatchOp):
         return self.get(self.LAMBDA)
 
 
+class LinearSvrTrainBatchOp(BaseLinearModelTrainBatchOp):
+    """Linear support-vector regression with a smoothed epsilon-insensitive
+    loss (reference: operator/batch/regression/LinearSvrTrainBatchOp.java)."""
+
+    linear_model_type = "SVR"
+    SVR_EPSILON = ParamInfo("svrEpsilon", float, default=0.1,
+                            aliases=("tau", "epsilonSvr"))
+
+
 class SoftmaxTrainBatchOp(BaseLinearModelTrainBatchOp):
     linear_model_type = "Softmax"
 
@@ -281,7 +293,7 @@ class LinearModelMapper(RichModelMapper):
 
     def predict_proba_block(self, t: MTable):
         mtype = self.meta["linearModelType"]
-        if mtype == "LinearReg":
+        if mtype in ("LinearReg", "SVR"):
             return None
         if mtype == "Softmax":
             return softmax_np(self._scores(t))
@@ -292,7 +304,7 @@ class LinearModelMapper(RichModelMapper):
         return np.stack([prob_pos, 1 - prob_pos], 1)
 
     def predict_block(self, t: MTable):
-        if self.meta["linearModelType"] == "LinearReg":
+        if self.meta["linearModelType"] in ("LinearReg", "SVR"):
             s = self._scores(t)[:, 0] if self.weights.ndim > 1 else self._scores(t)
             return np.asarray(s, np.float64), AlinkTypes.DOUBLE, None
         return self._classification_result(self.predict_proba_block(t))
@@ -321,6 +333,10 @@ class RidgeRegPredictBatchOp(LinearModelPredictOp):
 
 
 class LassoRegPredictBatchOp(LinearModelPredictOp):
+    pass
+
+
+class LinearSvrPredictBatchOp(LinearModelPredictOp):
     pass
 
 

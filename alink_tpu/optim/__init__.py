@@ -1,4 +1,5 @@
 from .objfunc import (
+    aft_obj,
     fm_obj,
     fm_pairwise,
     mlp_forward,
@@ -10,5 +11,6 @@ from .objfunc import (
     perceptron_obj,
     softmax_obj,
     squared_obj,
+    svr_obj,
 )
 from .optimizers import OptimResult, optimize

@@ -105,6 +105,44 @@ def perceptron_obj(dim: int) -> ObjFunc:
     return ObjFunc(local_loss, dim)
 
 
+def svr_obj(dim: int, epsilon: float = 0.1) -> ObjFunc:
+    """Quadratically smoothed ε-insensitive loss for linear SVR (reference:
+    unarylossfunc/SvrLossFunc.java). 0 inside the ε-tube, 0.5·(|r|−ε)²
+    outside — differentiable everywhere for L-BFGS."""
+    import jax.numpy as jnp
+
+    def local_loss(w, X, y, wt):
+        r = X @ w - y
+        excess = jnp.maximum(jnp.abs(r) - epsilon, 0.0)
+        return _weighted_sum(0.5 * excess * excess, wt)
+
+    return ObjFunc(local_loss, dim)
+
+
+def aft_obj(dim: int):
+    """Weibull AFT survival objective (reference:
+    operator/common/regression/AftRegObjFunc.java). The censor indicator rides
+    as the LAST column of the feature block (1 = event observed, 0 =
+    right-censored); ``y`` is log(survival time). Flat weights =
+    [beta (dim), log_sigma]."""
+    import jax.numpy as jnp
+
+    def local_loss(w, X, y, wt):
+        beta = w[:dim]
+        log_sigma = w[dim]
+        sigma = jnp.exp(log_sigma)
+        censor = X[:, dim]          # appended indicator column
+        feats = X[:, :dim]
+        z = (y - feats @ beta) / sigma
+        # observed: log-pdf of the extreme-value dist; censored: log-survival
+        log_pdf = z - jnp.exp(z) - log_sigma
+        log_surv = -jnp.exp(z)
+        per_row = -(censor * log_pdf + (1.0 - censor) * log_surv)
+        return _weighted_sum(per_row, wt)
+
+    return ObjFunc(local_loss, dim + 1)
+
+
 def huber_obj(dim: int, delta: float = 1.0) -> ObjFunc:
     """Huber regression loss (reference: unarylossfunc/HuberLossFunc.java)."""
     import jax.numpy as jnp

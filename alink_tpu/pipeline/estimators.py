@@ -119,6 +119,64 @@ class Softmax(_LinearEstimator):
     _train_op_cls = _lin.SoftmaxTrainBatchOp
 
 
+class LinearSvr(_LinearEstimator):
+    """(reference: pipeline/regression/LinearSvr.java)"""
+
+    _train_op_cls = _lin.LinearSvrTrainBatchOp
+    SVR_EPSILON = _lin.LinearSvrTrainBatchOp.SVR_EPSILON
+
+
+# -- regression breadth ------------------------------------------------------
+from ..operator.batch import regression as _reg
+
+
+class GlmModel(ModelBase):
+    _predict_op_cls = _reg.GlmPredictBatchOp
+
+
+class GeneralizedLinearRegression(EstimatorBase):
+    """(reference: pipeline/regression/GeneralizedLinearRegression.java)"""
+
+    _train_op_cls = _reg.GlmTrainBatchOp
+    _model_cls = GlmModel
+    LABEL_COL = _reg.GlmTrainBatchOp.LABEL_COL
+    FAMILY = _reg.GlmTrainBatchOp.FAMILY
+    LINK = _reg.GlmTrainBatchOp.LINK
+    MAX_ITER = _reg.GlmTrainBatchOp.MAX_ITER
+    FEATURE_COLS = _reg.HasFeatureCols.FEATURE_COLS
+    PREDICTION_COL = _reg.HasPredictionCol.PREDICTION_COL
+
+
+class IsotonicRegressionModel(ModelBase):
+    _predict_op_cls = _reg.IsotonicRegPredictBatchOp
+
+
+class IsotonicRegression(EstimatorBase):
+    """(reference: pipeline/regression/IsotonicRegression.java)"""
+
+    _train_op_cls = _reg.IsotonicRegTrainBatchOp
+    _model_cls = IsotonicRegressionModel
+    FEATURE_COL = _reg.IsotonicRegTrainBatchOp.FEATURE_COL
+    LABEL_COL = _reg.IsotonicRegTrainBatchOp.LABEL_COL
+    ISOTONIC = _reg.IsotonicRegTrainBatchOp.ISOTONIC
+    PREDICTION_COL = _reg.HasPredictionCol.PREDICTION_COL
+
+
+class AftSurvivalRegressionModel(ModelBase):
+    _predict_op_cls = _reg.AftSurvivalRegPredictBatchOp
+
+
+class AftSurvivalRegression(EstimatorBase):
+    """(reference: pipeline/regression/AftSurvivalRegression.java)"""
+
+    _train_op_cls = _reg.AftSurvivalRegTrainBatchOp
+    _model_cls = AftSurvivalRegressionModel
+    LABEL_COL = _reg.AftSurvivalRegTrainBatchOp.LABEL_COL
+    CENSOR_COL = _reg.AftSurvivalRegTrainBatchOp.CENSOR_COL
+    FEATURE_COLS = _reg.HasFeatureCols.FEATURE_COLS
+    PREDICTION_COL = _reg.HasPredictionCol.PREDICTION_COL
+
+
 # -- feature engineering -----------------------------------------------------
 class StandardScalerModel(ModelBase):
     _predict_op_cls = _feat.StandardScalerPredictBatchOp

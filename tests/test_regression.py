@@ -1,0 +1,133 @@
+"""Regression breadth tests: GLM, Isotonic, AFT, SVR.
+
+Mirrors the reference tests (reference: core/src/test/java/com/alibaba/alink/
+operator/batch/regression/GlmTrainBatchOpTest.java,
+IsotonicRegTrainBatchOpTest.java, AftSurvivalRegTrainBatchOpTest.java)."""
+
+import numpy as np
+import pytest
+
+from alink_tpu.operator.batch import (
+    AftSurvivalRegPredictBatchOp,
+    AftSurvivalRegTrainBatchOp,
+    GlmPredictBatchOp,
+    GlmTrainBatchOp,
+    IsotonicRegPredictBatchOp,
+    IsotonicRegTrainBatchOp,
+    LinearSvrPredictBatchOp,
+    LinearSvrTrainBatchOp,
+    MemSourceBatchOp,
+)
+
+
+def test_glm_poisson_log_link():
+    rng = np.random.default_rng(0)
+    x = rng.uniform(0, 2, 400)
+    lam = np.exp(0.5 + 1.2 * x)
+    y = rng.poisson(lam).astype(float)
+    src = MemSourceBatchOp(
+        [(float(a), float(b)) for a, b in zip(x, y)], "x double, y double")
+    model = GlmTrainBatchOp(featureCols=["x"], labelCol="y",
+                            family="Poisson").link_from(src)
+    from alink_tpu.common.model import table_to_model
+    meta, arrays = table_to_model(model.collect())
+    assert arrays["coefficients"][0] == pytest.approx(1.2, abs=0.15)
+    assert arrays["intercept"][0] == pytest.approx(0.5, abs=0.2)
+    out = GlmPredictBatchOp().link_from(model, src).collect()
+    # predictions are on the response scale (positive counts)
+    assert (np.asarray(out.col("pred")) > 0).all()
+
+
+def test_glm_binomial_logit():
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=600)
+    p = 1.0 / (1.0 + np.exp(-(2.0 * x - 0.5)))
+    y = (rng.random(600) < p).astype(float)
+    src = MemSourceBatchOp(
+        [(float(a), float(b)) for a, b in zip(x, y)], "x double, y double")
+    model = GlmTrainBatchOp(featureCols=["x"], labelCol="y",
+                            family="Binomial").link_from(src)
+    from alink_tpu.common.model import table_to_model
+    _, arrays = table_to_model(model.collect())
+    assert arrays["coefficients"][0] == pytest.approx(2.0, abs=0.4)
+    out = GlmPredictBatchOp().link_from(model, src).collect()
+    mu = np.asarray(out.col("pred"))
+    assert ((mu > 0) & (mu < 1)).all()
+
+
+def test_glm_gamma_inverse_runs():
+    rng = np.random.default_rng(2)
+    x = rng.uniform(1, 2, 300)
+    mu = 1.0 / (0.5 + 0.3 * x)
+    y = rng.gamma(5.0, mu / 5.0)
+    src = MemSourceBatchOp(
+        [(float(a), float(b)) for a, b in zip(x, y)], "x double, y double")
+    model = GlmTrainBatchOp(featureCols=["x"], labelCol="y", family="Gamma") \
+        .link_from(src)
+    out = GlmPredictBatchOp().link_from(model, src).collect()
+    pred = np.asarray(out.col("pred"))
+    assert np.corrcoef(pred, mu)[0, 1] > 0.9
+
+
+def test_isotonic_monotone_and_fits():
+    rng = np.random.default_rng(3)
+    x = np.sort(rng.uniform(0, 10, 200))
+    y = np.log1p(x) + rng.normal(scale=0.1, size=200)
+    src = MemSourceBatchOp(
+        [(float(a), float(b)) for a, b in zip(x, y)], "x double, y double")
+    model = IsotonicRegTrainBatchOp(featureCol="x", labelCol="y") \
+        .link_from(src)
+    out = IsotonicRegPredictBatchOp().link_from(model, src).collect()
+    pred = np.asarray(out.col("pred"))
+    assert (np.diff(pred[np.argsort(x)]) >= -1e-9).all()   # monotone
+    assert np.abs(pred - np.log1p(x)).mean() < 0.1
+
+
+def test_isotonic_decreasing():
+    x = np.arange(50, dtype=float)
+    y = -x + np.random.default_rng(4).normal(scale=0.5, size=50)
+    src = MemSourceBatchOp(
+        [(float(a), float(b)) for a, b in zip(x, y)], "x double, y double")
+    model = IsotonicRegTrainBatchOp(featureCol="x", labelCol="y",
+                                    isotonic=False).link_from(src)
+    out = IsotonicRegPredictBatchOp().link_from(model, src).collect()
+    pred = np.asarray(out.col("pred"))
+    assert (np.diff(pred) <= 1e-9).all()
+
+
+def test_aft_survival():
+    rng = np.random.default_rng(5)
+    n = 500
+    x = rng.normal(size=n)
+    # true model: log T = 1.0 + 0.8 x + 0.5 * gumbel
+    eps = np.log(rng.exponential(size=n))   # standard extreme-value
+    logt = 1.0 + 0.8 * x + 0.5 * eps
+    times = np.exp(logt)
+    censor_time = rng.exponential(scale=np.exp(2.0), size=n)
+    observed = (times <= censor_time).astype(float)
+    t_obs = np.minimum(times, censor_time)
+    src = MemSourceBatchOp(
+        [(float(a), float(b), float(c)) for a, b, c in zip(x, t_obs, observed)],
+        "x double, time double, status double")
+    model = AftSurvivalRegTrainBatchOp(
+        featureCols=["x"], labelCol="time", censorCol="status") \
+        .link_from(src)
+    from alink_tpu.common.model import table_to_model
+    meta, arrays = table_to_model(model.collect())
+    assert arrays["coefficients"][0] == pytest.approx(0.8, abs=0.15)
+    assert meta["scale"] == pytest.approx(0.5, abs=0.15)
+    out = AftSurvivalRegPredictBatchOp().link_from(model, src).collect()
+    assert (np.asarray(out.col("pred")) > 0).all()
+
+
+def test_linear_svr():
+    rng = np.random.default_rng(6)
+    x = rng.normal(size=300)
+    y = 3.0 * x + 1.0 + rng.normal(scale=0.05, size=300)
+    src = MemSourceBatchOp(
+        [(float(a), float(b)) for a, b in zip(x, y)], "x double, y double")
+    model = LinearSvrTrainBatchOp(featureCols=["x"], labelCol="y",
+                                  svrEpsilon=0.1).link_from(src)
+    out = LinearSvrPredictBatchOp().link_from(model, src).collect()
+    pred = np.asarray(out.col("pred"))
+    assert np.abs(pred - y).mean() < 0.2
