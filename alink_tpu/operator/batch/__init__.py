@@ -253,6 +253,14 @@ from .statistics import (
     VectorCorrelationBatchOp,
     VectorSummarizerBatchOp,
 )
+from .timeseries import (
+    ArimaBatchOp,
+    DifferenceBatchOp,
+    EvalTimeSeriesBatchOp,
+    GarchBatchOp,
+    HoltWintersBatchOp,
+    ShiftBatchOp,
+)
 from .huge import (
     DeepWalkBatchOp,
     DeepWalkEmbeddingBatchOp,

@@ -1,0 +1,118 @@
+"""Timeseries family tests: ARIMA, HoltWinters, GARCH, shift/diff, eval.
+
+Mirrors the reference tests (reference: core/src/test/java/com/alibaba/alink/
+operator/batch/timeseries/ArimaBatchOpTest.java, HoltWintersBatchOpTest.java,
+GarchBatchOpTest.java)."""
+
+import numpy as np
+import pytest
+
+from alink_tpu.operator.batch import (
+    ArimaBatchOp,
+    DifferenceBatchOp,
+    EvalTimeSeriesBatchOp,
+    GarchBatchOp,
+    HoltWintersBatchOp,
+    MemSourceBatchOp,
+    ShiftBatchOp,
+)
+
+
+def _series_src(values, group=None):
+    if group is None:
+        return MemSourceBatchOp([(float(v),) for v in values], "v double")
+    return MemSourceBatchOp(
+        [(g, float(v)) for g, v in zip(group, values)], "g string, v double")
+
+
+def test_arima_ar1_forecast():
+    # AR(1) with phi=0.8: forecasts should decay toward the mean
+    rng = np.random.default_rng(0)
+    y = np.zeros(300)
+    for t in range(1, 300):
+        y[t] = 0.8 * y[t - 1] + rng.normal(scale=0.1)
+    out = ArimaBatchOp(valueCol="v", order=[1, 0, 0], predictNum=5) \
+        .link_from(_series_src(y)).collect()
+    fc = out.col("forecast")[0].data
+    assert len(fc) == 5
+    # successive forecasts shrink geometrically (|phi| < 1)
+    assert abs(fc[1]) < abs(fc[0]) + 0.05
+    assert abs(fc[0] - 0.8 * y[-1]) < 0.3
+
+
+def test_arima_with_trend_d1():
+    y = np.arange(100, dtype=float) * 2.0 + 5.0
+    out = ArimaBatchOp(valueCol="v", order=[0, 1, 0], predictNum=3) \
+        .link_from(_series_src(y)).collect()
+    fc = out.col("forecast")[0].data
+    # differenced series is constant 2 → forecasts continue the line
+    assert fc == pytest.approx([y[-1] + 2, y[-1] + 4, y[-1] + 6], abs=0.5)
+
+
+def test_arima_grouped():
+    y1 = np.arange(50, dtype=float)
+    y2 = np.full(50, 7.0)
+    group = ["a"] * 50 + ["b"] * 50
+    out = ArimaBatchOp(valueCol="v", groupCol="g", order=[0, 1, 0],
+                       predictNum=2).link_from(
+        _series_src(np.concatenate([y1, y2]), group)).collect()
+    assert list(out.col("g")) == ["a", "b"]
+    assert out.col("forecast")[1].data == pytest.approx([7.0, 7.0], abs=0.3)
+
+
+def test_holtwinters_seasonal():
+    season = np.array([10.0, 0.0, -10.0, 0.0])
+    y = np.tile(season, 10) + np.arange(40) * 0.5
+    out = HoltWintersBatchOp(valueCol="v", frequency=4, predictNum=4) \
+        .link_from(_series_src(y)).collect()
+    fc = out.col("forecast")[0].data
+    # forecast keeps the seasonal shape: peak at h=1, trough at h=3
+    assert fc[0] > fc[2]
+    assert fc[0] - fc[2] == pytest.approx(20.0, abs=4.0)
+
+
+def test_holtwinters_fixed_params_trend_only():
+    y = 3.0 * np.arange(30, dtype=float)
+    out = HoltWintersBatchOp(valueCol="v", doSeasonal=False, alpha=0.5,
+                             beta=0.3, predictNum=2) \
+        .link_from(_series_src(y)).collect()
+    fc = out.col("forecast")[0].data
+    assert fc == pytest.approx([y[-1] + 3, y[-1] + 6], abs=1.0)
+
+
+def test_garch_volatility_clustering():
+    rng = np.random.default_rng(1)
+    n = 600
+    h = np.zeros(n)
+    r = np.zeros(n)
+    h[0] = 0.1
+    for t in range(1, n):
+        h[t] = 0.05 + 0.3 * r[t - 1] ** 2 + 0.6 * h[t - 1]
+        r[t] = rng.normal() * np.sqrt(h[t])
+    out = GarchBatchOp(valueCol="v", predictNum=3).link_from(
+        _series_src(r)).collect()
+    alpha = out.col("alpha")[0]
+    beta = out.col("beta")[0]
+    assert 0.05 < alpha < 0.6
+    assert 0.2 < beta < 0.95
+    fc = out.col("forecast")[0].data
+    assert (fc > 0).all()
+
+
+def test_shift_and_difference():
+    src = _series_src([1.0, 3.0, 6.0, 10.0])
+    out = ShiftBatchOp(selectedCol="v", shiftNum=1).link_from(src).collect()
+    assert np.isnan(out.col("shifted")[0])
+    assert list(out.col("shifted")[1:]) == [1.0, 3.0, 6.0]
+    out2 = DifferenceBatchOp(selectedCol="v").link_from(src).collect()
+    assert list(out2.col("diff")[1:]) == [2.0, 3.0, 4.0]
+
+
+def test_eval_timeseries():
+    src = MemSourceBatchOp(
+        [(1.0, 1.1), (2.0, 1.9), (3.0, 3.2)], "y double, p double")
+    m = EvalTimeSeriesBatchOp(labelCol="y", predictionCol="p") \
+        .link_from(src).collect_metrics()
+    assert m["mae"] == pytest.approx(0.1333, abs=1e-3)
+    assert m["rmse"] == pytest.approx(np.sqrt((0.01 + 0.01 + 0.04) / 3), abs=1e-6)
+    assert 0.9 < m["r2"] <= 1.0
