@@ -14,12 +14,19 @@ mini-batch loop is ONE compiled XLA program (``fori_loop`` over pair blocks,
 shard over the ``model`` axis and the same gather/scatter rides ICI.
 """
 
-from .skipgram import SkipGramConfig, train_skipgram, build_vocab, make_pairs
+from .skipgram import (
+    SkipGramConfig,
+    build_vocab,
+    make_pairs,
+    train_skipgram,
+    train_skipgram_sharded,
+)
 from .walks import random_walks, node2vec_walks
 
 __all__ = [
     "SkipGramConfig",
     "train_skipgram",
+    "train_skipgram_sharded",
     "build_vocab",
     "make_pairs",
     "random_walks",

@@ -183,3 +183,110 @@ def train_skipgram(
     pairs_dev = jax.device_put(pairs, NamedSharding(mesh, P(AXIS_DATA)))
     w_in, _ = f(pairs_dev, jnp.asarray(w_in0), jnp.asarray(w_out0))
     return np.asarray(jax.device_get(w_in))
+
+
+def train_skipgram_sharded(
+    pairs: np.ndarray,
+    vocab_size: int,
+    counts: np.ndarray,
+    cfg: SkipGramConfig,
+    *,
+    mesh=None,
+):
+    """SGNS with BOTH embedding tables sharded over the ``model`` axis — the
+    APS path for vocabularies larger than one chip's HBM (reference:
+    huge/impl/Word2VecImpl.java:82-91 over ApsEnv pull→train→push).
+
+    Each device trains its own pair shard; per step it PULLs the rows it
+    needs from the owning shards and PUSHes gradients back (parallel/aps.py
+    collectives). Returns the trained input-embedding ``ShardedEmbedding``
+    handle — call ``.to_numpy()`` to materialize on host.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..parallel.aps import ShardedEmbedding, model_mesh, pull, push
+    from ..parallel.mesh import AXIS_MODEL
+
+    mesh = mesh or model_mesh()
+    M = mesh.shape[AXIS_MODEL]
+    rng = np.random.default_rng(cfg.seed)
+    V, D = vocab_size, cfg.dim
+
+    w_in = ShardedEmbedding(mesh, V, D, seed=cfg.seed)
+    w_out = ShardedEmbedding(
+        mesh, V, D, init=lambda r: np.zeros((V, D), np.float32),
+        seed=cfg.seed)
+    rows = w_in.rows_per_shard
+
+    probs = counts ** 0.75
+    neg_logits = np.log(probs / probs.sum()).astype(np.float32)
+
+    n_pairs = pairs.shape[0]
+    if n_pairs == 0:
+        return w_in
+    order = rng.permutation(n_pairs)
+    pairs = pairs[order]
+    block = cfg.batch_size * M
+    n_blocks = max(1, n_pairs // block)
+    used = n_blocks * block
+    pairs = np.resize(pairs, (used, 2))
+
+    B = cfg.batch_size
+    negs = cfg.negatives
+    lr0 = cfg.learning_rate
+    total_steps = n_blocks * cfg.epochs
+    key0 = jax.random.PRNGKey(cfg.seed)
+
+    def body(pairs_l, win_l, wout_l):
+        neg_l = jnp.asarray(neg_logits)
+
+        def step(s, carry):
+            win_l, wout_l = carry
+            lr = lr0 * jnp.maximum(
+                0.0001, 1.0 - s.astype(jnp.float32) / total_steps)
+            b = jnp.mod(s, n_blocks)
+            blk = jax.lax.dynamic_slice_in_dim(pairs_l, b * B, B, 0)
+            center, ctx = blk[:, 0], blk[:, 1]
+            key = jax.random.fold_in(key0, s)
+            key = jax.random.fold_in(key, jax.lax.axis_index(AXIS_MODEL))
+            neg = jax.random.categorical(key, neg_l[None, :], shape=(B, negs))
+
+            # PULL the rows this device's batch touches
+            v = pull(win_l, center, AXIS_MODEL, rows)               # (B, D)
+            uids = jnp.concatenate([ctx, neg.reshape(-1)])
+            u = pull(wout_l, uids, AXIS_MODEL, rows)                # (B(1+N), D)
+            u_pos = u[:B]
+            u_neg = u[B:].reshape(B, negs, D)
+
+            s_pos = jax.nn.sigmoid((v * u_pos).sum(-1))
+            s_neg = jax.nn.sigmoid(jnp.einsum("bd,bnd->bn", v, u_neg))
+            g_pos = (s_pos - 1.0)[:, None]
+            g_neg = s_neg[..., None]
+
+            grad_v = g_pos * u_pos + (g_neg * u_neg).sum(1)
+            grad_u = jnp.concatenate(
+                [g_pos * v, (g_neg * v[:, None, :]).reshape(-1, D)])
+
+            # PUSH gradients to the owning shards (averaged over devices)
+            scale = lr / M
+            win_l = push(win_l, center, grad_v, AXIS_MODEL, rows, scale)
+            wout_l = push(wout_l, uids, grad_u, AXIS_MODEL, rows, scale)
+            return win_l, wout_l
+
+        return jax.lax.fori_loop(0, total_steps, step, (win_l, wout_l))
+
+    f = jax.jit(
+        jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(P(AXIS_MODEL), P(AXIS_MODEL), P(AXIS_MODEL)),
+            out_specs=(P(AXIS_MODEL), P(AXIS_MODEL)),
+            check_vma=False,
+        )
+    )
+    pairs_dev = jax.device_put(pairs, NamedSharding(mesh, P(AXIS_MODEL)))
+    new_in, new_out = f(pairs_dev, w_in.array, w_out.array)
+    w_in.array = new_in
+    w_out.array = new_out
+    return w_in

@@ -28,6 +28,7 @@ from ...embedding import (
     node2vec_walks,
     random_walks,
     train_skipgram,
+    train_skipgram_sharded,
 )
 from ...embedding.walks import build_csr
 from ...mapper import HasPredictionCol, HasReservedCols, ModelMapper
@@ -48,6 +49,10 @@ class HasWord2VecParams:
     BATCH_SIZE = ParamInfo("batchSize", int, default=1024)
     RANDOM_SEED = ParamInfo("randomSeed", int, default=0)
     WORD_DELIMITER = ParamInfo("wordDelimiter", str, default=" ")
+    SHARD_MODEL = ParamInfo(
+        "shardModel", bool, default=False,
+        desc="shard the embedding tables over the model mesh axis (the APS "
+             "path for vocab >> HBM/chip; reference: huge/Word2VecBatchOp)")
 
 
 def _w2v_model_table(vocab, emb: np.ndarray) -> MTable:
@@ -88,8 +93,12 @@ class Word2VecTrainBatchOp(BatchOperator, HasWord2VecParams):
         )
         pairs = make_pairs(docs, vocab, counts, cfg.window, cfg.subsample,
                            cfg.seed)
-        emb = train_skipgram(pairs, len(vocab), counts, cfg,
-                             mesh=self.env.mesh)
+        if self.get(self.SHARD_MODEL):
+            handle = train_skipgram_sharded(pairs, len(vocab), counts, cfg)
+            emb = handle.to_numpy()
+        else:
+            emb = train_skipgram(pairs, len(vocab), counts, cfg,
+                                 mesh=self.env.mesh)
         return _w2v_model_table(vocab, emb)
 
 

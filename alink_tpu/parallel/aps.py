@@ -1,0 +1,120 @@
+"""APS analog: model-axis sharded embedding tables with pull/push.
+
+Capability parity with the reference's Alink Parameter Server (reference:
+core/src/main/java/com/alibaba/alink/operator/common/aps/ApsEnv.java:39-370 —
+mini-batch pull→train→push with the model partitioned by key across tasks;
+ApsFuncIndex4Pull / ApsFuncTrain / ApsFuncUpdateModel; used by
+operator/batch/huge/impl/Word2VecImpl.java:82-91 and the DeepWalk/Node2Vec/
+MetaPath2Vec embedding family).
+
+TPU-first re-design: there are no PS processes. The embedding table is a
+``jax.Array`` row-sharded over the ``model`` mesh axis (each device owns
+V/M contiguous rows — the APS key partition). Inside ``shard_map``:
+
+- **pull(ids)** = ``all_gather`` of every device's id batch + a masked local
+  gather + one ``psum`` — each device ends with the embeddings for ITS ids,
+  fetched from whichever shard owns them. This is the reference's
+  ApsFuncIndex4Pull/pull RPC, expressed as two XLA collectives on ICI.
+- **push(ids, grads)** = ``all_gather`` of (ids, grads) + a masked local
+  scatter-add — each device applies exactly the updates belonging to its
+  shard. No collective on the table itself; only the (B, D) grads move.
+
+Memory per device is V/M rows — vocabularies larger than one chip's HBM
+train fine, which is the whole point of the reference's "huge" family.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import numpy as np
+
+from .mesh import AXIS_MODEL, default_mesh, make_mesh, pad_to_multiple
+
+
+def model_mesh(n_devices: Optional[int] = None):
+    """1-D mesh over the ``model`` axis — APS workers are both data and
+    model holders (reference: ApsEnv runs pull/train/push on the same tasks)."""
+    import jax
+
+    devices = jax.devices() if n_devices is None else jax.devices()[:n_devices]
+    return make_mesh([(AXIS_MODEL, len(devices))], devices)
+
+
+def shard_table(mesh, table: np.ndarray, axis: str = AXIS_MODEL):
+    """Place (V, D) onto the mesh row-sharded over ``axis``; pads V to a
+    multiple of the axis size. Returns (sharded_array, padded_rows)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    m = mesh.shape[axis]
+    v_pad = pad_to_multiple(table.shape[0], m)
+    if v_pad != table.shape[0]:
+        table = np.concatenate(
+            [table, np.zeros((v_pad - table.shape[0],) + table.shape[1:],
+                             table.dtype)])
+    return jax.device_put(table, NamedSharding(mesh, P(axis))), v_pad
+
+
+def pull(table_l, ids, axis: str, rows_per_shard: int):
+    """Inside shard_map: fetch rows for this device's ``ids`` from whichever
+    shard owns them. ``table_l``: (V/M, D) local shard; ``ids``: (B,) global
+    row ids. Returns (B, D)."""
+    import jax
+    import jax.numpy as jnp
+
+    m = jax.lax.axis_index(axis)
+    ids_all = jax.lax.all_gather(ids, axis)               # (M, B)
+    local_idx = jnp.clip(ids_all - m * rows_per_shard, 0, rows_per_shard - 1)
+    owned = (ids_all // rows_per_shard) == m              # (M, B)
+    contrib = table_l[local_idx] * owned[..., None]       # (M, B, D)
+    full = jax.lax.psum(contrib, axis)                    # (M, B, D)
+    return jax.lax.dynamic_index_in_dim(full, m, axis=0, keepdims=False)
+
+
+def push(table_l, ids, grads, axis: str, rows_per_shard: int,
+         scale: float = 1.0):
+    """Inside shard_map: apply ``-scale * grads`` for ``ids`` to the owning
+    shards. Each device scatter-adds only the rows it owns; clipped foreign
+    indices receive masked zeros."""
+    import jax
+    import jax.numpy as jnp
+
+    m = jax.lax.axis_index(axis)
+    ids_all = jax.lax.all_gather(ids, axis).reshape(-1)          # (M*B,)
+    grads_all = jax.lax.all_gather(grads, axis)                  # (M, B, D)
+    grads_all = grads_all.reshape(-1, grads.shape[-1])
+    local_idx = jnp.clip(ids_all - m * rows_per_shard, 0, rows_per_shard - 1)
+    owned = ((ids_all // rows_per_shard) == m)[:, None]
+    return table_l.at[local_idx].add(-scale * grads_all * owned)
+
+
+class ShardedEmbedding:
+    """Host-side handle for a model-sharded (V, D) table.
+
+    The table lives device-resident between training calls (the reference
+    keeps the APS model in task memory across iteration blocks,
+    ApsEnv.java:198-327); ``to_numpy()`` is the final persist
+    (persistentModel:328)."""
+
+    def __init__(self, mesh, vocab_size: int, dim: int,
+                 init: Optional[Callable[[np.random.Generator], np.ndarray]] = None,
+                 seed: int = 0, axis: str = AXIS_MODEL):
+        self.mesh = mesh
+        self.axis = axis
+        self.vocab_size = vocab_size
+        self.dim = dim
+        rng = np.random.default_rng(seed)
+        table = (init(rng) if init is not None
+                 else ((rng.random((vocab_size, dim)) - 0.5) / dim)
+                 .astype(np.float32))
+        self.array, self.padded_rows = shard_table(mesh, table, axis)
+        self.rows_per_shard = self.padded_rows // mesh.shape[axis]
+
+    def to_numpy(self) -> np.ndarray:
+        import jax
+
+        return np.asarray(jax.device_get(self.array))[:self.vocab_size]
+
+    def shard_shapes(self):
+        return [tuple(s.data.shape) for s in self.array.addressable_shards]
