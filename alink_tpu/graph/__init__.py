@@ -1,0 +1,12 @@
+from .engine import (
+    MemoryGraph,
+    connected_components,
+    iterate_supersteps,
+    kcore,
+    label_propagation,
+    louvain,
+    modularity,
+    pagerank,
+    sssp,
+    triangles,
+)

@@ -261,6 +261,19 @@ from .timeseries import (
     HoltWintersBatchOp,
     ShiftBatchOp,
 )
+from .graph import (
+    CommonNeighborsBatchOp,
+    CommunityDetectionClusterBatchOp,
+    ConnectedComponentsBatchOp,
+    EdgeClusterCoefficientBatchOp,
+    KCoreBatchOp,
+    LouvainBatchOp,
+    ModularityCalBatchOp,
+    PageRankBatchOp,
+    SingleSourceShortestPathBatchOp,
+    TriangleListBatchOp,
+    VertexClusterCoefficientBatchOp,
+)
 from .huge import (
     DeepWalkBatchOp,
     DeepWalkEmbeddingBatchOp,
