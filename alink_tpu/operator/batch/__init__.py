@@ -274,6 +274,16 @@ from .graph import (
     TriangleListBatchOp,
     VertexClusterCoefficientBatchOp,
 )
+from .similarity import (
+    StringNearestNeighborPredictBatchOp,
+    StringNearestNeighborTrainBatchOp,
+    StringSimilarityPairwiseBatchOp,
+    TextNearestNeighborPredictBatchOp,
+    TextNearestNeighborTrainBatchOp,
+    TextSimilarityPairwiseBatchOp,
+    VectorNearestNeighborPredictBatchOp,
+    VectorNearestNeighborTrainBatchOp,
+)
 from .huge import (
     DeepWalkBatchOp,
     DeepWalkEmbeddingBatchOp,

@@ -1,0 +1,400 @@
+"""Similarity family: string/text similarity + nearest-neighbour search.
+
+Capability parity with the reference similarity package (reference:
+core/src/main/java/com/alibaba/alink/operator/batch/similarity/
+StringSimilarityPairwiseBatchOp.java, TextSimilarityPairwiseBatchOp.java,
+StringNearestNeighborTrainBatchOp.java + PredictBatchOp,
+VectorNearestNeighborTrainBatchOp.java + PredictBatchOp (KDTree/LSH/brute in
+operator/common/similarity/ — Levenshtein/LCS/cosine/Jaccard/SimHash
+calculators in similarity/lcs/, SimHashHamming.java).
+
+TPU-first re-design: vector nearest-neighbour is a blocked dense distance
+matrix + ``lax.top_k`` on the MXU (one batched kernel, same shape as KNN
+classify); LSH is random-hyperplane signatures computed as one matmul with
+bucket-candidate rerank. String metrics are host-side DP (data-dependent
+loops), exactly the part XLA cannot help with.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ...common.exceptions import AkIllegalArgumentException
+from ...common.linalg import parse_vector
+from ...common.model import model_to_table, table_to_model
+from ...common.mtable import AlinkTypes, MTable, TableSchema
+from ...common.params import InValidator, MinValidator, ParamInfo
+from ...mapper import (
+    HasOutputCol,
+    HasReservedCols,
+    HasSelectedCol,
+    HasSelectedCols,
+    Mapper,
+    ModelMapper,
+)
+from .base import BatchOperator
+from .utils import MapBatchOp, ModelMapBatchOp, ModelTrainOpMixin
+
+
+# ---------------------------------------------------------------------------
+# String metrics (host-side; reference: operator/common/similarity/)
+# ---------------------------------------------------------------------------
+
+def levenshtein(a, b) -> int:
+    """Edit distance over character or token sequences."""
+    a, b = list(a), list(b)
+    if len(a) < len(b):
+        a, b = b, a
+    prev = list(range(len(b) + 1))
+    for i, ca in enumerate(a, 1):
+        cur = [i] + [0] * len(b)
+        for j, cb in enumerate(b, 1):
+            cur[j] = min(prev[j] + 1, cur[j - 1] + 1,
+                         prev[j - 1] + (ca != cb))
+        prev = cur
+    return prev[-1]
+
+
+def lcs(a, b) -> int:
+    """Longest common subsequence over character or token sequences."""
+    a, b = list(a), list(b)
+    if not a or not b:
+        return 0
+    prev = [0] * (len(b) + 1)
+    for ca in a:
+        cur = [0] * (len(b) + 1)
+        for j, cb in enumerate(b, 1):
+            cur[j] = prev[j - 1] + 1 if ca == cb else max(prev[j], cur[j - 1])
+        prev = cur
+    return prev[-1]
+
+
+def _ngrams(s, n=2):
+    toks = list(s)
+    if len(toks) < n:
+        return [tuple(toks)] if toks else []
+    return [tuple(toks[i:i + n]) for i in range(len(toks) - n + 1)]
+
+
+def _counter_cosine(ca: Dict, cb: Dict) -> float:
+    if not ca or not cb:
+        return 0.0
+    dot = sum(v * cb.get(k, 0) for k, v in ca.items())
+    na = np.sqrt(sum(v * v for v in ca.values()))
+    nb = np.sqrt(sum(v * v for v in cb.values()))
+    return float(dot / (na * nb)) if na > 0 and nb > 0 else 0.0
+
+
+def _counts(items) -> Dict:
+    d: Dict = {}
+    for it in items:
+        d[it] = d.get(it, 0) + 1
+    return d
+
+
+def _fnv64(s: str) -> int:
+    """Deterministic 64-bit FNV-1a (python hash() is salted per process)."""
+    h = 0xCBF29CE484222325
+    for byte in s.encode("utf-8"):
+        h = ((h ^ byte) * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
+    return h
+
+
+def simhash64(items) -> int:
+    """64-bit SimHash over hashed features (reference: SimHashHamming.java)."""
+    acc = np.zeros(64, np.int64)
+    for it in items:
+        h = _fnv64(str(it))
+        for bit in range(64):
+            acc[bit] += 1 if (h >> bit) & 1 else -1
+    out = 0
+    for bit in range(64):
+        if acc[bit] > 0:
+            out |= 1 << bit
+    return out
+
+
+def _metric(metric: str, a: str, b: str, text: bool) -> float:
+    """One similarity/distance value; ``text`` tokenizes on whitespace
+    (reference: TextSimilarityPairwiseBatchOp vs StringSimilarityPairwise)."""
+    a = "" if a is None else str(a)
+    b = "" if b is None else str(b)
+    ta = a.split() if text else list(a)
+    tb = b.split() if text else list(b)
+    if metric == "LEVENSHTEIN":
+        return float(levenshtein(ta, tb))
+    if metric == "LEVENSHTEIN_SIM":
+        m = max(len(ta), len(tb))
+        return 1.0 - levenshtein(ta, tb) / m if m > 0 else 1.0
+    if metric == "LCS":
+        return float(lcs(ta, tb))
+    if metric == "LCS_SIM":
+        m = max(len(ta), len(tb))
+        return lcs(ta, tb) / m if m > 0 else 1.0
+    if metric == "COSINE":
+        return _counter_cosine(_counts(_ngrams(" ".join(ta) if text else a)),
+                               _counts(_ngrams(" ".join(tb) if text else b)))
+    if metric == "JACCARD_SIM":
+        sa, sb = set(ta), set(tb)
+        return len(sa & sb) / len(sa | sb) if sa | sb else 1.0
+    if metric == "SIMHASH_HAMMING":
+        return float(bin(simhash64(ta) ^ simhash64(tb)).count("1"))
+    if metric == "SIMHASH_HAMMING_SIM":
+        return 1.0 - bin(simhash64(ta) ^ simhash64(tb)).count("1") / 64.0
+    raise AkIllegalArgumentException(f"unknown similarity metric {metric}")
+
+
+_METRICS = ("LEVENSHTEIN", "LEVENSHTEIN_SIM", "LCS", "LCS_SIM", "COSINE",
+            "JACCARD_SIM", "SIMHASH_HAMMING", "SIMHASH_HAMMING_SIM")
+
+
+class _PairwiseSimilarityMapper(Mapper, HasSelectedCols, HasOutputCol,
+                                HasReservedCols):
+    METRIC = ParamInfo("metric", str, default="LEVENSHTEIN_SIM",
+                       validator=InValidator(*_METRICS))
+
+    text_mode = False
+
+    def output_schema(self, input_schema):
+        out = self.get(HasOutputCol.OUTPUT_COL) or "similarity"
+        return self._append_result_schema(input_schema, [out],
+                                          [AlinkTypes.DOUBLE])
+
+    def map_table(self, t: MTable) -> MTable:
+        cols = self.get(HasSelectedCols.SELECTED_COLS)
+        if not cols or len(cols) != 2:
+            raise AkIllegalArgumentException(
+                "pairwise similarity needs selectedCols=[colA, colB]")
+        out = self.get(HasOutputCol.OUTPUT_COL) or "similarity"
+        metric = self.get(self.METRIC)
+        a_vals, b_vals = t.col(cols[0]), t.col(cols[1])
+        vals = np.asarray(
+            [_metric(metric, a, b, self.text_mode)
+             for a, b in zip(a_vals, b_vals)], np.float64)
+        return self._append_result(t, {out: vals}, {out: AlinkTypes.DOUBLE})
+
+
+class StringSimilarityPairwiseMapper(_PairwiseSimilarityMapper):
+    text_mode = False
+
+
+class TextSimilarityPairwiseMapper(_PairwiseSimilarityMapper):
+    text_mode = True
+
+
+class StringSimilarityPairwiseBatchOp(MapBatchOp, HasSelectedCols,
+                                      HasOutputCol, HasReservedCols):
+    mapper_cls = StringSimilarityPairwiseMapper
+    METRIC = _PairwiseSimilarityMapper.METRIC
+
+
+class TextSimilarityPairwiseBatchOp(MapBatchOp, HasSelectedCols,
+                                    HasOutputCol, HasReservedCols):
+    mapper_cls = TextSimilarityPairwiseMapper
+    METRIC = _PairwiseSimilarityMapper.METRIC
+
+
+# ---------------------------------------------------------------------------
+# String / text nearest neighbour (top-N join against a trained corpus)
+# ---------------------------------------------------------------------------
+
+class StringNearestNeighborTrainBatchOp(ModelTrainOpMixin, BatchOperator,
+                                        HasSelectedCol):
+    """Stores the corpus (id, string) — predict does the scan (reference:
+    StringNearestNeighborTrainBatchOp.java)."""
+
+    ID_COL = ParamInfo("idCol", str, optional=False)
+    METRIC = ParamInfo("metric", str, default="LEVENSHTEIN_SIM",
+                       validator=InValidator(*_METRICS))
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    text_mode = False
+
+    def _execute_impl(self, t: MTable) -> MTable:
+        ids = [str(v) for v in t.col(self.get(self.ID_COL))]
+        strs = [str(v) for v in t.col(self.get(HasSelectedCol.SELECTED_COL))]
+        meta = {
+            "modelName": "StringNearestNeighborModel",
+            "metric": self.get(self.METRIC),
+            "textMode": self.text_mode,
+            "ids": ids,
+            "corpus": strs,
+        }
+        return model_to_table(meta, {})
+
+    def _static_meta_keys(self, in_schema):
+        return {"modelName": "StringNearestNeighborModel"}
+
+
+class TextNearestNeighborTrainBatchOp(StringNearestNeighborTrainBatchOp):
+    text_mode = True
+
+
+class StringNearestNeighborModelMapper(ModelMapper, HasSelectedCol,
+                                       HasOutputCol, HasReservedCols):
+    TOP_N = ParamInfo("topN", int, default=3, validator=MinValidator(1))
+
+    def load_model(self, model: MTable):
+        self.meta, _ = table_to_model(model)
+        return self
+
+    def output_schema(self, input_schema):
+        out = self.get(HasOutputCol.OUTPUT_COL) or "topN"
+        return self._append_result_schema(input_schema, [out],
+                                          [AlinkTypes.STRING])
+
+    def map_table(self, t: MTable) -> MTable:
+        out = self.get(HasOutputCol.OUTPUT_COL) or "topN"
+        col = self.get(HasSelectedCol.SELECTED_COL)
+        metric = self.meta["metric"]
+        text = self.meta["textMode"]
+        higher_better = metric.endswith("_SIM") or metric == "COSINE"
+        k = int(self.get(self.TOP_N))
+        ids, corpus = self.meta["ids"], self.meta["corpus"]
+        results = []
+        for q in t.col(col):
+            scores = [_metric(metric, str(q), c, text) for c in corpus]
+            order = np.argsort(scores)
+            order = order[::-1] if higher_better else order
+            top = [(ids[i], float(scores[i])) for i in order[:k]]
+            results.append(json.dumps(dict(top)))
+        return self._append_result(
+            t, {out: np.asarray(results, object)}, {out: AlinkTypes.STRING})
+
+
+class StringNearestNeighborPredictBatchOp(ModelMapBatchOp, HasSelectedCol,
+                                          HasOutputCol, HasReservedCols):
+    mapper_cls = StringNearestNeighborModelMapper
+    TOP_N = StringNearestNeighborModelMapper.TOP_N
+
+
+class TextNearestNeighborPredictBatchOp(StringNearestNeighborPredictBatchOp):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Vector nearest neighbour
+# ---------------------------------------------------------------------------
+
+class VectorNearestNeighborTrainBatchOp(ModelTrainOpMixin, BatchOperator,
+                                        HasSelectedCol):
+    """(reference: VectorNearestNeighborTrainBatchOp.java — stores vectors;
+    KDTree/LSH/brute solvers live in the predict mapper)"""
+
+    ID_COL = ParamInfo("idCol", str, optional=False)
+    METRIC = ParamInfo("metric", str, default="EUCLIDEAN",
+                       validator=InValidator("EUCLIDEAN", "COSINE"))
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    def _execute_impl(self, t: MTable) -> MTable:
+        ids = [str(v) for v in t.col(self.get(self.ID_COL))]
+        X = np.stack([parse_vector(v).to_dense().data
+                      for v in t.col(self.get(HasSelectedCol.SELECTED_COL))])
+        meta = {
+            "modelName": "VectorNearestNeighborModel",
+            "metric": self.get(self.METRIC),
+            "ids": ids,
+            "dim": int(X.shape[1]),
+        }
+        return model_to_table(meta, {"X": X.astype(np.float32)})
+
+    def _static_meta_keys(self, in_schema):
+        return {"modelName": "VectorNearestNeighborModel"}
+
+
+class VectorNearestNeighborModelMapper(ModelMapper, HasSelectedCol,
+                                       HasOutputCol, HasReservedCols):
+    """Blocked brute-force top-N on device; optional LSH prefilter
+    (reference: operator/common/similarity/NearestNeighborsMapper + lsh/)."""
+
+    TOP_N = ParamInfo("topN", int, default=3, validator=MinValidator(1))
+    SOLVER = ParamInfo("solver", str, default="BRUTE",
+                       validator=InValidator("BRUTE", "LSH"))
+    NUM_HASH_BITS = ParamInfo("numHashBits", int, default=16)
+
+    def load_model(self, model: MTable):
+        import jax
+        import jax.numpy as jnp
+
+        self.meta, arrays = table_to_model(model)
+        self.X = arrays["X"]
+        cosine = self.meta["metric"] == "COSINE"
+        k = min(int(self.get(self.TOP_N)), self.X.shape[0])
+
+        def topn(Q, X):
+            if cosine:
+                Qn = Q / jnp.maximum(jnp.linalg.norm(Q, axis=1, keepdims=True),
+                                     1e-12)
+                Xn = X / jnp.maximum(jnp.linalg.norm(X, axis=1, keepdims=True),
+                                     1e-12)
+                d = 1.0 - Qn @ Xn.T
+            else:
+                d = ((Q * Q).sum(1, keepdims=True) - 2.0 * (Q @ X.T)
+                     + (X * X).sum(1)[None, :])
+            neg_d, idx = jax.lax.top_k(-d, k)
+            return idx, -neg_d
+
+        self._topn_jit = jax.jit(topn)
+        if self.get(self.SOLVER) == "LSH":
+            rng = np.random.default_rng(0)
+            bits = int(self.get(self.NUM_HASH_BITS))
+            self._planes = rng.normal(
+                size=(self.X.shape[1], bits)).astype(np.float32)
+            self._sigs = (self.X @ self._planes > 0)
+        return self
+
+    def output_schema(self, input_schema):
+        out = self.get(HasOutputCol.OUTPUT_COL) or "topN"
+        return self._append_result_schema(input_schema, [out],
+                                          [AlinkTypes.STRING])
+
+    def map_table(self, t: MTable) -> MTable:
+        import jax
+
+        out = self.get(HasOutputCol.OUTPUT_COL) or "topN"
+        col = self.get(HasSelectedCol.SELECTED_COL)
+        Q = np.stack([parse_vector(v).to_dense().data for v in t.col(col)]) \
+            .astype(np.float32)
+        ids = self.meta["ids"]
+        if self.get(self.SOLVER) == "LSH":
+            # hamming prefilter: rerank the best bucket candidates exactly
+            qs = (Q @ self._planes > 0)
+            results = []
+            k = int(self.get(self.TOP_N))
+            n_cand = min(max(4 * k, 32), self.X.shape[0])
+            for qi in range(Q.shape[0]):
+                ham = (qs[qi][None, :] != self._sigs).sum(axis=1)
+                cand = np.argsort(ham, kind="stable")[:n_cand]
+                d = ((self.X[cand] - Q[qi]) ** 2).sum(axis=1)
+                if self.meta["metric"] == "COSINE":
+                    xn = self.X[cand] / np.maximum(
+                        np.linalg.norm(self.X[cand], axis=1, keepdims=True),
+                        1e-12)
+                    qn = Q[qi] / max(np.linalg.norm(Q[qi]), 1e-12)
+                    d = 1.0 - xn @ qn
+                order = np.argsort(d, kind="stable")[:k]
+                results.append(json.dumps(
+                    {ids[int(cand[i])]: float(d[i]) for i in order}))
+        else:
+            idx, dist = jax.device_get(self._topn_jit(Q, self.X))
+            results = [
+                json.dumps({ids[int(i)]: float(dv)
+                            for i, dv in zip(row_i, row_d)})
+                for row_i, row_d in zip(np.asarray(idx), np.asarray(dist))
+            ]
+        return self._append_result(
+            t, {out: np.asarray(results, object)}, {out: AlinkTypes.STRING})
+
+
+class VectorNearestNeighborPredictBatchOp(ModelMapBatchOp, HasSelectedCol,
+                                          HasOutputCol, HasReservedCols):
+    mapper_cls = VectorNearestNeighborModelMapper
+    TOP_N = VectorNearestNeighborModelMapper.TOP_N
+    SOLVER = VectorNearestNeighborModelMapper.SOLVER

@@ -1,0 +1,93 @@
+"""Similarity family tests (reference: core/src/test/java/com/alibaba/alink/
+operator/batch/similarity/StringSimilarityPairwiseBatchOpTest.java, ...)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from alink_tpu.operator.batch import (
+    MemSourceBatchOp,
+    StringNearestNeighborPredictBatchOp,
+    StringNearestNeighborTrainBatchOp,
+    StringSimilarityPairwiseBatchOp,
+    TextSimilarityPairwiseBatchOp,
+    VectorNearestNeighborPredictBatchOp,
+    VectorNearestNeighborTrainBatchOp,
+)
+from alink_tpu.operator.batch.similarity import lcs, levenshtein, simhash64
+
+
+def test_levenshtein_and_lcs_basics():
+    assert levenshtein("kitten", "sitting") == 3
+    assert levenshtein("", "abc") == 3
+    assert lcs("ABCBDAB", "BDCABA") == 4
+    assert lcs("abc", "") == 0
+
+
+def test_string_similarity_pairwise():
+    src = MemSourceBatchOp(
+        [("kitten", "sitting"), ("same", "same")], "a string, b string")
+    out = StringSimilarityPairwiseBatchOp(
+        selectedCols=["a", "b"], metric="LEVENSHTEIN").link_from(src).collect()
+    assert list(out.col("similarity")) == [3.0, 0.0]
+    out2 = StringSimilarityPairwiseBatchOp(
+        selectedCols=["a", "b"], metric="LEVENSHTEIN_SIM").link_from(src) \
+        .collect()
+    assert out2.col("similarity")[1] == 1.0
+    assert 0 < out2.col("similarity")[0] < 1
+
+
+def test_text_similarity_word_level():
+    src = MemSourceBatchOp(
+        [("the quick brown fox", "the slow brown fox")], "a string, b string")
+    out = TextSimilarityPairwiseBatchOp(
+        selectedCols=["a", "b"], metric="LEVENSHTEIN").link_from(src).collect()
+    assert out.col("similarity")[0] == 1.0      # one word substitution
+    j = TextSimilarityPairwiseBatchOp(
+        selectedCols=["a", "b"], metric="JACCARD_SIM").link_from(src).collect()
+    assert j.col("similarity")[0] == pytest.approx(3 / 5)
+
+
+def test_simhash_deterministic_and_similar():
+    a = simhash64("the quick brown fox".split())
+    b = simhash64("the quick brown fox".split())
+    assert a == b
+    src = MemSourceBatchOp(
+        [("the quick brown fox jumps", "the quick brown fox leaps"),
+         ("alpha beta gamma", "xyz qrs tuv")], "a string, b string")
+    out = TextSimilarityPairwiseBatchOp(
+        selectedCols=["a", "b"], metric="SIMHASH_HAMMING_SIM") \
+        .link_from(src).collect()
+    sims = list(out.col("similarity"))
+    assert sims[0] > sims[1]
+
+
+def test_string_nearest_neighbor():
+    corpus = MemSourceBatchOp(
+        [("1", "apple"), ("2", "apply"), ("3", "zebra")],
+        "id string, word string")
+    model = StringNearestNeighborTrainBatchOp(
+        idCol="id", selectedCol="word", metric="LEVENSHTEIN_SIM") \
+        .link_from(corpus)
+    query = MemSourceBatchOp([("appel",)], "word string")
+    out = StringNearestNeighborPredictBatchOp(
+        selectedCol="word", topN=2).link_from(model, query).collect()
+    top = json.loads(out.col("topN")[0])
+    assert set(top.keys()) == {"1", "2"}
+
+
+def test_vector_nearest_neighbor_brute_and_lsh():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(100, 8)).astype(np.float32)
+    rows = [(str(i), " ".join(f"{v:.5f}" for v in X[i])) for i in range(100)]
+    corpus = MemSourceBatchOp(rows, "id string, vec string")
+    model = VectorNearestNeighborTrainBatchOp(idCol="id", selectedCol="vec") \
+        .link_from(corpus)
+    q = MemSourceBatchOp([(" ".join(f"{v:.5f}" for v in X[7]),)], "vec string")
+    out = VectorNearestNeighborPredictBatchOp(selectedCol="vec", topN=1) \
+        .link_from(model, q).collect()
+    assert list(json.loads(out.col("topN")[0]).keys()) == ["7"]
+    out_lsh = VectorNearestNeighborPredictBatchOp(
+        selectedCol="vec", topN=1, solver="LSH").link_from(model, q).collect()
+    assert list(json.loads(out_lsh.col("topN")[0]).keys()) == ["7"]
