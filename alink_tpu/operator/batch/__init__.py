@@ -284,6 +284,17 @@ from .similarity import (
     VectorNearestNeighborPredictBatchOp,
     VectorNearestNeighborTrainBatchOp,
 )
+from .nlp import (
+    DocCountVectorizerPredictBatchOp,
+    DocCountVectorizerTrainBatchOp,
+    DocWordCountBatchOp,
+    KeywordsExtractionBatchOp,
+    NGramBatchOp,
+    SegmentBatchOp,
+    StopWordsRemoverBatchOp,
+    TfidfBatchOp,
+    WordCountBatchOp,
+)
 from .huge import (
     DeepWalkBatchOp,
     DeepWalkEmbeddingBatchOp,

@@ -1,0 +1,99 @@
+"""NLP breadth tests (reference: core/src/test/java/com/alibaba/alink/
+operator/batch/nlp/SegmentBatchOpTest.java, TfidfBatchOpTest.java, ...)."""
+
+import numpy as np
+import pytest
+
+from alink_tpu.operator.batch import (
+    DocCountVectorizerPredictBatchOp,
+    DocCountVectorizerTrainBatchOp,
+    DocWordCountBatchOp,
+    KeywordsExtractionBatchOp,
+    MemSourceBatchOp,
+    NGramBatchOp,
+    SegmentBatchOp,
+    StopWordsRemoverBatchOp,
+    TfidfBatchOp,
+    WordCountBatchOp,
+)
+
+
+def test_segment_with_user_dict():
+    src = MemSourceBatchOp([("abcd",)], "txt string")
+    out = SegmentBatchOp(selectedCol="txt", outputCol="seg",
+                         userDefinedDict=["ab", "cd"]).link_from(src).collect()
+    assert out.col("seg")[0] == "ab cd"
+    # without a dict: falls back to single characters
+    out2 = SegmentBatchOp(selectedCol="txt", outputCol="seg") \
+        .link_from(src).collect()
+    assert out2.col("seg")[0] == "a b c d"
+
+
+def test_ngram():
+    src = MemSourceBatchOp([("a b c d",)], "txt string")
+    out = NGramBatchOp(selectedCol="txt", outputCol="ng", n=2) \
+        .link_from(src).collect()
+    assert out.col("ng")[0] == "a_b b_c c_d"
+
+
+def test_stop_words_remover():
+    src = MemSourceBatchOp([("The cat and the hat",)], "txt string")
+    out = StopWordsRemoverBatchOp(selectedCol="txt", outputCol="clean") \
+        .link_from(src).collect()
+    assert out.col("clean")[0] == "cat hat"
+    out2 = StopWordsRemoverBatchOp(
+        selectedCol="txt", outputCol="clean", stopWords=["cat"]) \
+        .link_from(src).collect()
+    assert out2.col("clean")[0] == "hat"
+
+
+def test_word_count_and_doc_word_count():
+    src = MemSourceBatchOp([("d1", "x y x"), ("d2", "y z")],
+                           "id string, txt string")
+    wc = WordCountBatchOp(selectedCol="txt").link_from(src).collect()
+    counts = dict(zip(wc.col("word"), wc.col("cnt")))
+    assert counts == {"x": 2, "y": 2, "z": 1}
+    dwc = DocWordCountBatchOp(docIdCol="id", contentCol="txt") \
+        .link_from(src).collect()
+    trip = {(r[0], r[1]): r[2] for r in dwc.rows()}
+    assert trip[("d1", "x")] == 2
+    assert trip[("d2", "z")] == 1
+
+
+def test_tfidf_chain():
+    src = MemSourceBatchOp([("d1", "x y x"), ("d2", "y z")],
+                           "id string, txt string")
+    dwc = DocWordCountBatchOp(docIdCol="id", contentCol="txt").link_from(src)
+    out = TfidfBatchOp().link_from(dwc).collect()
+    by_key = {(r[0], r[1]): r for r in out.rows()}
+    # 'y' appears in both docs → lower idf than 'x'
+    assert by_key[("d1", "x")][4] > by_key[("d1", "y")][4]
+    assert by_key[("d1", "x")][3] == pytest.approx(2 / 3)
+
+
+def test_doc_count_vectorizer():
+    train = MemSourceBatchOp([("x y",), ("y z",)], "txt string")
+    model = DocCountVectorizerTrainBatchOp(selectedCol="txt").link_from(train)
+    out = DocCountVectorizerPredictBatchOp(
+        selectedCol="txt", outputCol="vec", featureType="WORD_COUNT") \
+        .link_from(model, MemSourceBatchOp([("x x z unseen",)], "txt string")) \
+        .collect()
+    v = out.col("vec")[0]
+    assert v.n == 3        # vocab {x, y, z}
+    dense = v.to_dense(3).data
+    assert dense.sum() == 3.0         # x twice + z once; unseen dropped
+    tfidf = DocCountVectorizerPredictBatchOp(
+        selectedCol="txt", outputCol="vec", featureType="TF_IDF") \
+        .link_from(model, MemSourceBatchOp([("x y",)], "txt string")).collect()
+    dv = tfidf.col("vec")[0].to_dense(3).data
+    assert dv[0] > dv[1]   # x rarer than y in the corpus
+
+
+def test_keywords_extraction():
+    doc = ("graph ranking algorithm ranks graph nodes by graph structure "
+           "ranking uses graph edges")
+    src = MemSourceBatchOp([("d1", doc)], "id string, txt string")
+    out = KeywordsExtractionBatchOp(docIdCol="id", selectedCol="txt", topN=2) \
+        .link_from(src).collect()
+    kws = out.col("keywords")[0].split()
+    assert "graph" in kws
