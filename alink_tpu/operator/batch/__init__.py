@@ -295,6 +295,11 @@ from .nlp import (
     TfidfBatchOp,
     WordCountBatchOp,
 )
+from .associationrule import (
+    AprioriBatchOp,
+    FpGrowthBatchOp,
+    PrefixSpanBatchOp,
+)
 from .huge import (
     DeepWalkBatchOp,
     DeepWalkEmbeddingBatchOp,

@@ -1,0 +1,66 @@
+"""Association-rule tests (reference: core/src/test/java/com/alibaba/alink/
+operator/batch/associationrule/FpGrowthBatchOpTest.java, ...)."""
+
+import pytest
+
+from alink_tpu.operator.batch import (
+    AprioriBatchOp,
+    FpGrowthBatchOp,
+    MemSourceBatchOp,
+    PrefixSpanBatchOp,
+)
+
+BASKETS = [
+    ("milk,bread",),
+    ("milk,bread,butter",),
+    ("bread,butter",),
+    ("milk,bread,butter",),
+    ("beer,bread",),
+]
+
+
+def _freq_map(out):
+    return {r[0]: r[1] for r in out.rows()}
+
+
+def test_fpgrowth_itemsets_and_rules():
+    src = MemSourceBatchOp(BASKETS, "items string")
+    op = FpGrowthBatchOp(selectedCol="items", minSupportCount=2) \
+        .link_from(src)
+    freq = _freq_map(op.collect())
+    assert freq["bread"] == 5
+    assert freq["milk"] == 3
+    assert freq["bread,milk"] == 3
+    assert freq["bread,butter,milk"] == 2
+    rules = op.get_side_output(0).collect()
+    by_rule = {r[0]: r for r in rules.rows()}
+    # butter,milk => bread has confidence 1.0
+    assert by_rule["butter,milk=>bread"][4] == pytest.approx(1.0)
+    assert by_rule["butter,milk=>bread"][2] == pytest.approx(1.0)  # lift 1/(5/5)
+
+
+def test_apriori_matches_fpgrowth():
+    src = MemSourceBatchOp(BASKETS, "items string")
+    f1 = _freq_map(FpGrowthBatchOp(selectedCol="items", minSupportCount=2)
+                   .link_from(src).collect())
+    f2 = _freq_map(AprioriBatchOp(selectedCol="items", minSupportCount=2)
+                   .link_from(src).collect())
+    assert f1 == f2
+
+
+def test_prefixspan():
+    seqs = [
+        ("a;b;c",),
+        ("a;c",),
+        ("a;b",),
+        ("b;c",),
+    ]
+    src = MemSourceBatchOp(seqs, "seq string")
+    out = PrefixSpanBatchOp(selectedCol="seq", minSupportCount=2) \
+        .link_from(src).collect()
+    freq = {r[0]: r[1] for r in out.rows()}
+    assert freq["a"] == 3
+    assert freq["a;b"] == 2
+    assert freq["a;c"] == 2
+    assert freq["b;c"] == 2
+    assert "c;a" not in freq          # order matters
