@@ -300,6 +300,17 @@ from .associationrule import (
     FpGrowthBatchOp,
     PrefixSpanBatchOp,
 )
+from .sources import (
+    LibSvmSinkBatchOp,
+    LibSvmSourceBatchOp,
+    ParquetSinkBatchOp,
+    ParquetSourceBatchOp,
+    TextSourceBatchOp,
+    TFRecordSinkBatchOp,
+    TFRecordSourceBatchOp,
+    TsvSinkBatchOp,
+    TsvSourceBatchOp,
+)
 from .huge import (
     DeepWalkBatchOp,
     DeepWalkEmbeddingBatchOp,
