@@ -311,6 +311,11 @@ from .sources import (
     TsvSinkBatchOp,
     TsvSourceBatchOp,
 )
+from .finance import (
+    PsiBatchOp,
+    ScorecardPredictBatchOp,
+    ScorecardTrainBatchOp,
+)
 from .huge import (
     DeepWalkBatchOp,
     DeepWalkEmbeddingBatchOp,
