@@ -180,6 +180,8 @@ from .evaluation import (
     EvalBinaryClassBatchOp,
     EvalClusterBatchOp,
     EvalMultiClassBatchOp,
+    EvalMultiLabelBatchOp,
+    EvalRankingBatchOp,
     EvalRegressionBatchOp,
 )
 from .feature import (

@@ -257,3 +257,131 @@ class EvalClusterBatchOp(BaseEvalBatchOp, HasVectorCol, HasFeatureCols):
             ) / n
             metrics["Purity"] = float(purity)
         return _metrics_table(metrics)
+
+
+def _parse_items(v) -> List[str]:
+    """Parse a label-set cell: JSON array or delimiter-separated string."""
+    if v is None:
+        return []
+    s = str(v).strip()
+    if s.startswith("["):
+        try:
+            return [str(x) for x in json.loads(s)]
+        except json.JSONDecodeError:
+            pass
+    return [x for x in s.replace(";", ",").split(",") if x]
+
+
+class EvalMultiLabelBatchOp(BaseEvalBatchOp):
+    """Multi-label metrics: micro/macro precision-recall-F1, subset accuracy,
+    hamming loss, Jaccard accuracy (reference:
+    operator/batch/evaluation/EvalMultiLabelBatchOp.java +
+    common/evaluation/MultiLabelMetrics.java). Cells hold label sets as JSON
+    arrays or comma-separated strings."""
+
+    LABEL_COL = ParamInfo("labelCol", str, optional=False)
+    PREDICTION_COL = ParamInfo("predictionCol", str, optional=False)
+
+    _metric_cols = [("microF1", AlinkTypes.DOUBLE),
+                    ("macroF1", AlinkTypes.DOUBLE),
+                    ("subsetAccuracy", AlinkTypes.DOUBLE),
+                    ("hammingLoss", AlinkTypes.DOUBLE),
+                    ("accuracy", AlinkTypes.DOUBLE)]
+
+    def _execute_impl(self, t: MTable) -> MTable:
+        y_sets = [set(_parse_items(v)) for v in t.col(self.get(self.LABEL_COL))]
+        p_sets = [set(_parse_items(v))
+                  for v in t.col(self.get(self.PREDICTION_COL))]
+        all_labels = sorted(set().union(*y_sets, *p_sets) or {""})
+        n = len(y_sets)
+        tp = {l: 0 for l in all_labels}
+        fp = {l: 0 for l in all_labels}
+        fn = {l: 0 for l in all_labels}
+        subset_ok = 0
+        jacc_sum = 0.0
+        hamming = 0
+        for ys, ps in zip(y_sets, p_sets):
+            for l in ps - ys:
+                fp[l] += 1
+            for l in ys - ps:
+                fn[l] += 1
+            for l in ys & ps:
+                tp[l] += 1
+            subset_ok += ys == ps
+            union = ys | ps
+            jacc_sum += len(ys & ps) / len(union) if union else 1.0
+            hamming += len(ys ^ ps)
+        tp_sum, fp_sum, fn_sum = sum(tp.values()), sum(fp.values()), sum(fn.values())
+        micro_p = tp_sum / max(tp_sum + fp_sum, 1)
+        micro_r = tp_sum / max(tp_sum + fn_sum, 1)
+        micro_f1 = (2 * micro_p * micro_r / (micro_p + micro_r)
+                    if micro_p + micro_r > 0 else 0.0)
+        macro_f1s = []
+        for l in all_labels:
+            p = tp[l] / max(tp[l] + fp[l], 1)
+            r = tp[l] / max(tp[l] + fn[l], 1)
+            macro_f1s.append(2 * p * r / (p + r) if p + r > 0 else 0.0)
+        metrics = {
+            "microPrecision": micro_p,
+            "microRecall": micro_r,
+            "microF1": micro_f1,
+            "macroF1": float(np.mean(macro_f1s)),
+            "subsetAccuracy": subset_ok / max(n, 1),
+            "hammingLoss": hamming / max(n * len(all_labels), 1),
+            "accuracy": jacc_sum / max(n, 1),
+        }
+        return _metrics_table(metrics)
+
+
+class EvalRankingBatchOp(BaseEvalBatchOp):
+    """Ranking metrics: MAP, NDCG, precision/recall@k, hit rate (reference:
+    operator/batch/evaluation/EvalRankingBatchOp.java +
+    common/evaluation/RankingMetrics.java). labelCol holds the relevant item
+    set; predictionCol the ranked recommendation list."""
+
+    LABEL_COL = ParamInfo("labelCol", str, optional=False)
+    PREDICTION_COL = ParamInfo("predictionCol", str, optional=False)
+    K = ParamInfo("k", int, default=10)
+
+    _metric_cols = [("map", AlinkTypes.DOUBLE),
+                    ("ndcg", AlinkTypes.DOUBLE),
+                    ("precisionAtK", AlinkTypes.DOUBLE),
+                    ("recallAtK", AlinkTypes.DOUBLE),
+                    ("hitRate", AlinkTypes.DOUBLE)]
+
+    def _execute_impl(self, t: MTable) -> MTable:
+        k = int(self.get(self.K))
+        aps, ndcgs, p_at_k, r_at_k, hits = [], [], [], [], []
+        for yv, pv in zip(t.col(self.get(self.LABEL_COL)),
+                          t.col(self.get(self.PREDICTION_COL))):
+            rel = set(_parse_items(yv))
+            ranked = _parse_items(pv)
+            if not rel:
+                continue
+            topk = ranked[:k]
+            n_hit = sum(1 for x in topk if x in rel)
+            p_at_k.append(n_hit / max(len(topk), 1))
+            r_at_k.append(n_hit / len(rel))
+            hits.append(1.0 if n_hit > 0 else 0.0)
+            # average precision over the full ranked list
+            ap_hits, ap_sum = 0, 0.0
+            for i, x in enumerate(ranked, 1):
+                if x in rel:
+                    ap_hits += 1
+                    ap_sum += ap_hits / i
+            aps.append(ap_sum / len(rel))
+            # binary-relevance NDCG@k
+            dcg = sum(1.0 / np.log2(i + 1)
+                      for i, x in enumerate(topk, 1) if x in rel)
+            idcg = sum(1.0 / np.log2(i + 1)
+                       for i in range(1, min(len(rel), k) + 1))
+            ndcgs.append(dcg / idcg if idcg > 0 else 0.0)
+        metrics = {
+            "map": float(np.mean(aps)) if aps else float("nan"),
+            "ndcg": float(np.mean(ndcgs)) if ndcgs else float("nan"),
+            "precisionAtK": float(np.mean(p_at_k)) if p_at_k else float("nan"),
+            "recallAtK": float(np.mean(r_at_k)) if r_at_k else float("nan"),
+            "hitRate": float(np.mean(hits)) if hits else float("nan"),
+            "k": k,
+        }
+        return _metrics_table(metrics)

@@ -1,0 +1,55 @@
+"""Multilabel + ranking evaluation tests (reference: core/src/test/java/com/
+alibaba/alink/operator/batch/evaluation/EvalMultiLabelBatchOpTest.java,
+EvalRankingBatchOpTest.java)."""
+
+import pytest
+
+from alink_tpu.operator.batch import (
+    EvalMultiLabelBatchOp,
+    EvalRankingBatchOp,
+    MemSourceBatchOp,
+)
+
+
+def test_multilabel_perfect():
+    src = MemSourceBatchOp([("a,b", "a,b"), ("c", "c")],
+                           "label string, pred string")
+    m = EvalMultiLabelBatchOp(labelCol="label", predictionCol="pred") \
+        .link_from(src).collect_metrics()
+    assert m.microF1 == 1.0
+    assert m.subsetAccuracy == 1.0
+    assert m.hammingLoss == 0.0
+
+
+def test_multilabel_partial():
+    src = MemSourceBatchOp([("a,b", "a"), ("a", "a,b")],
+                           "label string, pred string")
+    m = EvalMultiLabelBatchOp(labelCol="label", predictionCol="pred") \
+        .link_from(src).collect_metrics()
+    # tp(a)=2, fn(b)=1, fp(b)=1
+    assert m.microPrecision == pytest.approx(2 / 3)
+    assert m.microRecall == pytest.approx(2 / 3)
+    assert m.subsetAccuracy == 0.0
+    assert m.accuracy == pytest.approx(0.5)  # mean Jaccard
+
+
+def test_ranking_metrics():
+    src = MemSourceBatchOp(
+        [("a,b", "a,c,b"),      # hits at ranks 1 and 3
+         ("x", "y,z")],         # miss
+        "rel string, ranked string")
+    m = EvalRankingBatchOp(labelCol="rel", predictionCol="ranked", k=2) \
+        .link_from(src).collect_metrics()
+    assert m.hitRate == 0.5
+    assert m.precisionAtK == pytest.approx((1 / 2 + 0) / 2)
+    # AP row1: (1/1 + 2/3)/2 = 5/6; row2: 0
+    assert m.map == pytest.approx((5 / 6) / 2)
+
+
+def test_ranking_json_array_format():
+    src = MemSourceBatchOp([('["a","b"]', '["b","a"]')],
+                           "rel string, ranked string")
+    m = EvalRankingBatchOp(labelCol="rel", predictionCol="ranked", k=2) \
+        .link_from(src).collect_metrics()
+    assert m.precisionAtK == 1.0
+    assert m.ndcg == pytest.approx(1.0)
