@@ -252,3 +252,13 @@ def stack_vectors(
             d = min(v.size(), size)
             out[r, :d] = v.data[:d]
     return out
+
+
+def pairwise_sq_dists(Q, X):
+    """Blocked squared Euclidean distance matrix ||q-x||² as three matmul-
+    friendly terms — the single home of this kernel (KNN, KMeans assign,
+    DBSCAN neighbourhoods, LOF, vector nearest-neighbour all call it).
+    Generic over numpy and jax arrays; fp32 cancellation can produce tiny
+    negatives, which callers taking sqrt should clip."""
+    return ((Q * Q).sum(1, keepdims=True) - 2.0 * (Q @ X.T)
+            + (X * X).sum(1)[None, :])

@@ -293,10 +293,12 @@ def louvain(g: MemoryGraph, max_passes: int = 10,
             break
         # compact community ids and aggregate the graph
         uniq, new_ids = np.unique(comm, return_inverse=True)
-        mapping = new_ids[comm[mapping]]
+        # new_ids[v] IS vertex v's compacted community (inverse of unique
+        # over comm) — indexing via comm[v] again would double-map
+        mapping = new_ids[mapping]
         agg: Dict[Tuple[int, int], float] = {}
         for a, b, wv in zip(cur_src, cur_dst, cur_w):
-            key = (int(new_ids[comm[a]]), int(new_ids[comm[b]]))
+            key = (int(new_ids[a]), int(new_ids[b]))
             agg[key] = agg.get(key, 0.0) + wv
         cur_src = np.asarray([k[0] for k in agg], np.int32)
         cur_dst = np.asarray([k[1] for k in agg], np.int32)

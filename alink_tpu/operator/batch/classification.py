@@ -29,6 +29,7 @@ from typing import List, Optional
 import numpy as np
 
 from ...common.exceptions import AkIllegalArgumentException, AkIllegalDataException
+from ...common.linalg import pairwise_sq_dists
 from ...common.model import model_to_table, table_to_model
 from ...common.mtable import AlinkTypes, MTable
 from ...common.params import InValidator, MinValidator, ParamInfo
@@ -272,10 +273,7 @@ class KnnModelMapper(RichModelMapper):
                 Xn = X / jnp.maximum(jnp.linalg.norm(X, axis=1, keepdims=True), 1e-12)
                 d = 1.0 - Qn @ Xn.T
             else:
-                d = (
-                    (Q * Q).sum(1, keepdims=True) - 2.0 * (Q @ X.T)
-                    + (X * X).sum(1)[None, :]
-                )
+                d = pairwise_sq_dists(Q, X)
             neg_d, idx = jax.lax.top_k(-d, k_neighbors)
             votes = jax.nn.one_hot(y[idx], num_labels).sum(axis=1)
             return votes, -neg_d

@@ -21,6 +21,7 @@ from typing import Optional
 import numpy as np
 
 from ...common.exceptions import AkIllegalDataException
+from ...common.linalg import pairwise_sq_dists
 from ...common.model import model_to_table, table_to_model
 from ...common.mtable import AlinkTypes, MTable
 from ...common.params import InValidator, MinValidator, ParamInfo
@@ -100,11 +101,7 @@ def _lloyd(mesh, X: np.ndarray, k: int, max_iter: int, tol: float,
                 cn = c / jnp.maximum(jnp.linalg.norm(c, axis=1, keepdims=True), 1e-12)
                 d = 1.0 - Xl @ cn.T
             else:
-                d = (
-                    (Xl * Xl).sum(1, keepdims=True)
-                    - 2.0 * (Xl @ c.T)
-                    + (c * c).sum(1)[None, :]
-                )
+                d = pairwise_sq_dists(Xl, c)
             return d
 
         def cond(carry):
@@ -203,10 +200,7 @@ class KMeansModelMapper(RichModelMapper):
                 cn = c / jnp.maximum(jnp.linalg.norm(c, axis=1, keepdims=True), 1e-12)
                 d = 1.0 - Xn @ cn.T
             else:
-                d = (
-                    (X * X).sum(1, keepdims=True) - 2.0 * (X @ c.T)
-                    + (c * c).sum(1)[None, :]
-                )
+                d = pairwise_sq_dists(X, c)
             return jnp.argmin(d, axis=1), d
 
         # compile once at model load; reused across every predict call

@@ -31,6 +31,7 @@ from typing import List
 import numpy as np
 
 from ...common.exceptions import AkIllegalDataException
+from ...common.linalg import pairwise_sq_dists
 from ...common.model import model_to_table, table_to_model
 from ...common.mtable import AlinkTypes, MTable, TableSchema
 from ...common.params import InValidator, MinValidator, ParamInfo
@@ -300,8 +301,7 @@ def _eps_neighbors(X: np.ndarray, eps: float, block: int = 2048):
 
     @jax.jit
     def dist_block(Q):
-        return ((Q * Q).sum(1, keepdims=True) - 2.0 * (Q @ Xd.T)
-                + (Xd * Xd).sum(1)[None, :])
+        return pairwise_sq_dists(Q, Xd)
 
     eps2 = eps * eps
     neighbors = []

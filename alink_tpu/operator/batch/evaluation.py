@@ -282,7 +282,9 @@ class EvalMultiLabelBatchOp(BaseEvalBatchOp):
     LABEL_COL = ParamInfo("labelCol", str, optional=False)
     PREDICTION_COL = ParamInfo("predictionCol", str, optional=False)
 
-    _metric_cols = [("microF1", AlinkTypes.DOUBLE),
+    _metric_cols = [("microPrecision", AlinkTypes.DOUBLE),
+                    ("microRecall", AlinkTypes.DOUBLE),
+                    ("microF1", AlinkTypes.DOUBLE),
                     ("macroF1", AlinkTypes.DOUBLE),
                     ("subsetAccuracy", AlinkTypes.DOUBLE),
                     ("hammingLoss", AlinkTypes.DOUBLE),
@@ -347,7 +349,8 @@ class EvalRankingBatchOp(BaseEvalBatchOp):
                     ("ndcg", AlinkTypes.DOUBLE),
                     ("precisionAtK", AlinkTypes.DOUBLE),
                     ("recallAtK", AlinkTypes.DOUBLE),
-                    ("hitRate", AlinkTypes.DOUBLE)]
+                    ("hitRate", AlinkTypes.DOUBLE),
+                    ("k", AlinkTypes.LONG)]
 
     def _execute_impl(self, t: MTable) -> MTable:
         k = int(self.get(self.K))

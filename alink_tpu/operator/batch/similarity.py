@@ -23,7 +23,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from ...common.exceptions import AkIllegalArgumentException
-from ...common.linalg import parse_vector
+from ...common.linalg import pairwise_sq_dists, parse_vector
 from ...common.model import model_to_table, table_to_model
 from ...common.mtable import AlinkTypes, MTable, TableSchema
 from ...common.params import InValidator, MinValidator, ParamInfo
@@ -135,8 +135,8 @@ def _metric(metric: str, a: str, b: str, text: bool) -> float:
         m = max(len(ta), len(tb))
         return lcs(ta, tb) / m if m > 0 else 1.0
     if metric == "COSINE":
-        return _counter_cosine(_counts(_ngrams(" ".join(ta) if text else a)),
-                               _counts(_ngrams(" ".join(tb) if text else b)))
+        # char bigrams for strings, word bigrams for text — words are atoms
+        return _counter_cosine(_counts(_ngrams(ta)), _counts(_ngrams(tb)))
     if metric == "JACCARD_SIM":
         sa, sb = set(ta), set(tb)
         return len(sa & sb) / len(sa | sb) if sa | sb else 1.0
@@ -336,8 +336,7 @@ class VectorNearestNeighborModelMapper(ModelMapper, HasSelectedCol,
                                      1e-12)
                 d = 1.0 - Qn @ Xn.T
             else:
-                d = ((Q * Q).sum(1, keepdims=True) - 2.0 * (Q @ X.T)
-                     + (X * X).sum(1)[None, :])
+                d = pairwise_sq_dists(Q, X)
             neg_d, idx = jax.lax.top_k(-d, k)
             return idx, -neg_d
 

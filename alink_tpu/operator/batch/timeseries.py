@@ -401,9 +401,11 @@ class ShiftBatchOp(BatchOperator, HasSelectedCol):
         arr = np.asarray(t.col(col), np.float64)
         shifted = np.full_like(arr, np.nan)
         if k >= 0:
-            shifted[k:] = arr[:len(arr) - k] if k else arr
+            k = min(k, len(arr))
+            shifted[k:] = arr[:len(arr) - k]
         else:
-            shifted[:k] = arr[-k:]
+            k = max(k, -len(arr))
+            shifted[:len(arr) + k] = arr[-k:]
         return t.with_column(out, shifted, AlinkTypes.DOUBLE)
 
     def _out_schema(self, in_schema):

@@ -136,11 +136,9 @@ def _pairwise_sq_dists(X: Arr, chunk: int = 4096) -> Arr:
     import jax
     import jax.numpy as jnp
 
-    @jax.jit
-    def block(A, B):
-        return (
-            (A * A).sum(1)[:, None] - 2.0 * (A @ B.T) + (B * B).sum(1)[None, :]
-        )
+    from ..common.linalg import pairwise_sq_dists
+
+    block = jax.jit(pairwise_sq_dists)
 
     n = X.shape[0]
     X32 = jnp.asarray(X, jnp.float32)
