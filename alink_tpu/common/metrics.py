@@ -1,0 +1,112 @@
+"""Structured step metrics + profiling hooks.
+
+The reference has almost no tracing (SURVEY §5: slf4j logs + a JUnit
+stopwatch; reference: common/AlinkGlobalConfiguration.java:21-27
+isPrintProcessInfo gate). The TPU build leans on ``jax.profiler`` and a
+structured in-process metrics recorder instead — SURVEY told the build to
+do this "from day one".
+
+Usage:
+    from alink_tpu.common.metrics import metrics, timed, profile_trace
+
+    with timed("gbdt.train"):
+        ...
+    metrics.record("bert.step", step=i, loss=l, samples_per_sec=sps)
+    with profile_trace("/tmp/trace"):   # Perfetto trace via jax.profiler
+        train()
+    metrics.summary()                   # {'gbdt.train': {...}, ...}
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import time
+from collections import defaultdict
+from typing import Any, Dict, List, Optional
+
+
+class StepMetrics:
+    """In-process metric streams: named series of {step, **values} dicts plus
+    aggregated timers. One global instance (``metrics``) serves the whole
+    session; algorithms record cheaply, callers read ``series``/``summary``."""
+
+    def __init__(self):
+        self._series: Dict[str, List[Dict[str, Any]]] = defaultdict(list)
+        self._timers: Dict[str, List[float]] = defaultdict(list)
+        self.enabled = True
+
+    def record(self, name: str, **values):
+        if self.enabled:
+            self._series[name].append(dict(values))
+
+    def add_time(self, name: str, seconds: float):
+        if self.enabled:
+            self._timers[name].append(seconds)
+
+    def series(self, name: str) -> List[Dict[str, Any]]:
+        return list(self._series.get(name, []))
+
+    def last(self, name: str) -> Optional[Dict[str, Any]]:
+        s = self._series.get(name)
+        return dict(s[-1]) if s else None
+
+    def timer_stats(self, name: str) -> Optional[Dict[str, float]]:
+        ts = self._timers.get(name)
+        if not ts:
+            return None
+        return {"count": len(ts), "total_s": sum(ts),
+                "mean_s": sum(ts) / len(ts), "max_s": max(ts)}
+
+    def summary(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for name in self._timers:
+            out[name] = self.timer_stats(name)
+        for name, s in self._series.items():
+            out.setdefault(name, {})
+            out[name] = {**(out[name] or {}), "points": len(s),
+                         "last": s[-1] if s else None}
+        return out
+
+    def to_json(self) -> str:
+        return json.dumps(self.summary(), default=str)
+
+    def reset(self):
+        self._series.clear()
+        self._timers.clear()
+
+
+metrics = StepMetrics()
+
+
+@contextlib.contextmanager
+def timed(name: str, recorder: Optional[StepMetrics] = None):
+    """Wall-clock timer context; feeds the global recorder by default."""
+    rec = recorder or metrics
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        rec.add_time(name, time.perf_counter() - t0)
+
+
+@contextlib.contextmanager
+def profile_trace(log_dir: str, *, host_tracer_level: int = 2):
+    """``jax.profiler`` trace context (Perfetto/TensorBoard viewable). No-op
+    fallback if the profiler cannot start (e.g. twice in one process)."""
+    import jax
+
+    started = False
+    try:
+        jax.profiler.start_trace(log_dir)
+        started = True
+    except Exception:
+        pass
+    try:
+        yield
+    finally:
+        if started:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass

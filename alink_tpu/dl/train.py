@@ -177,9 +177,13 @@ def train_model(
     def eval_logits(params, batch):
         return model.apply(params, **batch, deterministic=True)
 
+    from ..common.metrics import metrics as _metrics
+    import time as _time
+
     history = {"loss": [], "eval_metric": []}
     best_metric, best_params, patience_left = None, None, cfg.early_stopping_patience
     step = 0
+    t_start = _time.perf_counter()
     for epoch in range(cfg.num_epochs):
         order = rng.permutation(n_train)
         if n_train < bs:  # tile tiny datasets up to one full batch
@@ -196,9 +200,17 @@ def train_model(
             )
             step += 1
             if cfg.log_every and step % cfg.log_every == 0:
-                history["loss"].append(float(l))
+                lv = float(l)
+                history["loss"].append(lv)
+                elapsed = _time.perf_counter() - t_start
+                _metrics.record("dl.train", step=step, loss=lv,
+                                samples_per_sec=step * bs / max(elapsed, 1e-9))
         if not cfg.log_every:
-            history["loss"].append(float(l))
+            lv = float(l)
+            history["loss"].append(lv)
+            elapsed = _time.perf_counter() - t_start
+            _metrics.record("dl.train", step=step, loss=lv,
+                            samples_per_sec=step * bs / max(elapsed, 1e-9))
 
         if n_eval:
             logits = _batched_apply(eval_logits, params, ev_inputs, mesh,

@@ -77,7 +77,8 @@ class SampleBatchOp(SampleOp, BatchOperator):
     pass
 
 
-from .utils import MapBatchOp, ModelMapBatchOp, ModelTrainOpMixin
+from .utils import (LinearModelTrainInfoBatchOp, MapBatchOp, ModelMapBatchOp,
+                    ModelTrainOpMixin, TrainInfoBatchOp)
 from .modelpredict import (
     OnnxModelPredictBatchOp,
     StableHloModelPredictBatchOp,

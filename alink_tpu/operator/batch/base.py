@@ -30,6 +30,22 @@ class BatchOperator(AlgoOperator):
 
         return self.lazy_collect(_stats)
 
+    def lazy_print_train_info(self, title=None) -> "BatchOperator":
+        """Print the scalar training diagnostics of a model table
+        (reference: BatchOperator.lazyPrintTrainInfo)."""
+
+        def _info(t: MTable):
+            from ...common.model import table_to_model
+
+            if title:
+                print(title)
+            meta, _ = table_to_model(t)
+            for k, v in sorted(meta.items()):
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    print(f"{k} = {v}")
+
+        return self.lazy_collect(_info)
+
     def lazy_collect_statistics(self, callback) -> "BatchOperator":
         def _stats(t: MTable):
             from ...stats.summarizer import summarize

@@ -104,3 +104,37 @@ class ModelTrainOpMixin:
 
     def _static_meta_keys(self, in_schema: TableSchema) -> dict:
         return {}
+
+
+class TrainInfoBatchOp(BatchOperator):
+    """(name, value) rows of the scalar training diagnostics stored in a
+    model's meta — loss, gradNorm, numIters, inertia, logLikelihood, ...
+    (reference: the per-algorithm *TrainInfoBatchOp / *ModelInfoBatchOp
+    family, e.g. operator/batch/classification/LogisticRegressionTrainInfo
+    via lazyPrintTrainInfo, operator/batch/clustering/KMeansModelInfoBatchOp)."""
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    def _execute_impl(self, model: MTable) -> MTable:
+        from ...common.model import table_to_model
+        from ...common.mtable import AlinkTypes
+        import numpy as np
+
+        meta, _ = table_to_model(model)
+        rows = [(k, float(v)) for k, v in sorted(meta.items())
+                if isinstance(v, (int, float)) and not isinstance(v, bool)]
+        return MTable(
+            {"name": np.asarray([r[0] for r in rows], object),
+             "value": np.asarray([r[1] for r in rows], np.float64)},
+            self._out_schema())
+
+    def _out_schema(self, *in_schemas) -> TableSchema:
+        from ...common.mtable import AlinkTypes
+
+        return TableSchema(["name", "value"],
+                           [AlinkTypes.STRING, AlinkTypes.DOUBLE])
+
+
+class LinearModelTrainInfoBatchOp(TrainInfoBatchOp):
+    pass
