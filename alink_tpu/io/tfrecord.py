@@ -48,8 +48,22 @@ def _masked_crc(data: bytes) -> int:
 
 
 # -- TFRecord framing --------------------------------------------------------
+# The framing/checksum hot loop prefers the native codec (native/codec.cc,
+# slice-by-8 crc32c); the pure-python path below is the verified fallback.
+
+def _native():
+    from ..native import load
+
+    return load()
+
 
 def write_records(path: str, payloads: Iterable[bytes]):
+    payloads = list(payloads)
+    nat = _native()
+    if nat is not None:
+        with open(path, "wb") as f:
+            f.write(nat.frame_records([bytes(p) for p in payloads]))
+        return
     with open(path, "wb") as f:
         for payload in payloads:
             header = struct.pack("<Q", len(payload))
@@ -60,6 +74,10 @@ def write_records(path: str, payloads: Iterable[bytes]):
 
 
 def read_records(path: str) -> List[bytes]:
+    nat = _native()
+    if nat is not None:
+        with open(path, "rb") as f:
+            return nat.unframe_records(f.read())
     out = []
     with open(path, "rb") as f:
         while True:

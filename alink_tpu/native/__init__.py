@@ -1,0 +1,56 @@
+"""Native (C++) runtime components, built on demand with g++.
+
+The reference keeps its data plane native (reference:
+shaded_libraries/third_party_flink_ai_extended/.../spscqueue.h,
+java_file_python_binding.cc; TFRecord framing in common/dl/data/). Here the
+byte-level hot loops live in ``codec.cc`` as a CPython extension; every
+Python caller has a pure-python fallback, so a missing toolchain only costs
+speed, never correctness.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sysconfig
+import threading
+
+_lock = threading.Lock()
+_cached = None
+_tried = False
+
+
+def load():
+    """Return the ``_alink_native`` module, building it on first use.
+    None when the toolchain is unavailable or the build fails."""
+    global _cached, _tried
+    with _lock:
+        if _tried:
+            return _cached
+        _tried = True
+        try:
+            _cached = _build_and_import()
+        except Exception:
+            _cached = None
+        return _cached
+
+
+def _build_and_import():
+    here = os.path.dirname(os.path.abspath(__file__))
+    src = os.path.join(here, "codec.cc")
+    ext = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+    so = os.path.join(here, "_alink_native" + ext)
+    if (not os.path.exists(so)
+            or os.path.getmtime(so) < os.path.getmtime(src)):
+        include = sysconfig.get_paths()["include"]
+        cmd = [
+            "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+            f"-I{include}", src, "-o", so,
+        ]
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("_alink_native", so)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod

@@ -1,0 +1,74 @@
+"""Native codec tests: build the C extension and cross-check against the
+pure-python implementation."""
+
+import numpy as np
+import pytest
+
+from alink_tpu.io import tfrecord as tfr
+from alink_tpu.native import load
+
+
+@pytest.fixture(scope="module")
+def native():
+    mod = load()
+    if mod is None:
+        pytest.skip("native toolchain unavailable")
+    return mod
+
+
+def test_native_crc_matches_python(native):
+    rng = np.random.default_rng(0)
+    for n in (0, 1, 7, 8, 9, 63, 64, 1000):
+        data = bytes(rng.integers(0, 256, n, dtype=np.uint8))
+        assert native.crc32c(data) == tfr.crc32c(data)
+    assert native.crc32c(b"\x00" * 32) == 0x8A9136AA
+    assert native.crc32c(b"123456789") == 0xE3069283
+
+
+def test_native_frame_roundtrip(native):
+    payloads = [b"abc", b"", b"x" * 4096]
+    framed = native.frame_records(payloads)
+    assert native.unframe_records(framed) == payloads
+
+
+def test_native_python_cross_framing(native, tmp_path):
+    """Files written natively must read back through pure python and vice
+    versa — the wire format is the contract."""
+    payloads = [b"hello", b"\x00\x01\x02", b"y" * 257]
+    p = str(tmp_path / "a.tfrecord")
+    with open(p, "wb") as f:
+        f.write(native.frame_records(payloads))
+    # pure-python reader on natively-framed bytes
+    import struct
+    out = []
+    with open(p, "rb") as f:
+        while True:
+            header = f.read(8)
+            if len(header) < 8:
+                break
+            (length,) = struct.unpack("<Q", header)
+            f.read(4)
+            out.append(f.read(length))
+            f.read(4)
+    assert out == payloads
+
+
+def test_native_corruption_detected(native):
+    framed = bytearray(native.frame_records([b"payload"]))
+    framed[14] ^= 0xFF  # flip a payload byte
+    with pytest.raises(ValueError):
+        native.unframe_records(bytes(framed))
+
+
+def test_tfrecord_ops_use_native_path(tmp_path):
+    # end-to-end through the op layer still roundtrips (whichever path)
+    from alink_tpu.operator.batch import (MemSourceBatchOp,
+                                          TFRecordSinkBatchOp,
+                                          TFRecordSourceBatchOp)
+
+    p = str(tmp_path / "t.tfrecord")
+    src = MemSourceBatchOp([(1, "a")], "id bigint, s string")
+    TFRecordSinkBatchOp(filePath=p).link_from(src).collect()
+    out = TFRecordSourceBatchOp(filePath=p, schemaStr="id bigint, s string") \
+        .link_from().collect()
+    assert list(out.col("id")) == [1]
