@@ -1,0 +1,86 @@
+"""Mid-training checkpoint/resume + retry-based failure recovery.
+
+Capability parity with the reference's training resilience (reference:
+operator/common/aps/ApsEnv.java:328-366 ``persistentModel`` + ApsCheckpoint
+(model persisted every iteration block, RETRY_TIMES=10 at ApsEnv.java:41);
+TF-side checkpointing via Estimator in akdl/engine/train.py:29-39).
+
+TPU re-design: orbax checkpoints of the full jit-visible training state
+(params + optimizer state + progress counters) — restore is a pytree load
+straight back onto the mesh. ``run_with_retries`` is the ApsEnv retry loop:
+a crashed attempt resumes from the latest checkpoint instead of restarting.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, Optional, Tuple
+
+
+class TrainCheckpointManager:
+    """Thin orbax CheckpointManager wrapper over one training run's state."""
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True),
+        )
+
+    def save(self, step: int, params, opt_state, extra: Dict[str, Any]):
+        """Persist the full training state at ``step`` (blocking)."""
+        state = {"params": params, "opt_state": opt_state,
+                 "extra": dict(extra)}
+        self._mgr.save(step, args=self._ocp.args.StandardSave(state))
+        self._mgr.wait_until_finished()
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def restore_latest(self, params_target, opt_state_target
+                       ) -> Optional[Tuple[Any, Any, Dict[str, Any]]]:
+        """Restore (params, opt_state, extra) from the newest checkpoint,
+        using the given freshly-initialized pytrees as structure targets.
+        None when no checkpoint exists."""
+        step = self._mgr.latest_step()
+        if step is None:
+            return None
+        import jax
+
+        target = {
+            "params": jax.tree.map(lambda x: x, params_target),
+            "opt_state": jax.tree.map(lambda x: x, opt_state_target),
+            "extra": {"step": 0, "epoch": 0},
+        }
+        restored = self._mgr.restore(
+            step, args=self._ocp.args.StandardRestore(target))
+        return restored["params"], restored["opt_state"], restored["extra"]
+
+    def close(self):
+        self._mgr.close()
+
+
+def run_with_retries(fn: Callable[[], Any], retries: int = 3,
+                     on_failure: Optional[Callable[[Exception, int], None]]
+                     = None) -> Any:
+    """Run ``fn`` retrying on failure (reference: ApsEnv.java RETRY_TIMES).
+    With checkpointing enabled the retried attempt resumes from the latest
+    persisted state rather than from scratch."""
+    last: Optional[Exception] = None
+    for attempt in range(retries + 1):
+        try:
+            return fn()
+        except KeyboardInterrupt:
+            raise
+        except Exception as e:  # noqa: BLE001 — recovery boundary
+            last = e
+            if on_failure is not None:
+                on_failure(e, attempt)
+            if attempt == retries:
+                raise
+    raise last  # unreachable

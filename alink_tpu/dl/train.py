@@ -34,6 +34,10 @@ class TrainConfig:
     seed: int = 0
     loss: str = "auto"  # auto | softmax | mse
     log_every: int = 0
+    # mid-training checkpoint/resume (dl/checkpoint.py); None disables
+    checkpoint_dir: "str | None" = None
+    checkpoint_every: int = 0  # extra mid-epoch saves every N steps; 0 = only per epoch
+    resume: bool = True
 
 
 def _make_optimizer(cfg: TrainConfig, total_steps: int):
@@ -180,11 +184,36 @@ def train_model(
     from ..common.metrics import metrics as _metrics
     import time as _time
 
+    ckpt = None
+    start_epoch = 0
     history = {"loss": [], "eval_metric": []}
     best_metric, best_params, patience_left = None, None, cfg.early_stopping_patience
     step = 0
+    if cfg.checkpoint_dir:
+        from .checkpoint import TrainCheckpointManager
+
+        ckpt = TrainCheckpointManager(cfg.checkpoint_dir)
+        if cfg.resume:
+            restored = ckpt.restore_latest(params, opt_state)
+            if restored is not None:
+                r_params, r_opt, extra = restored
+                params = jax.device_put(r_params, p_shard)
+                # re-place the optimizer state: moment trees keep the
+                # shardings the fresh init derived from the sharded params;
+                # scalar counters (single-device after eager init) replicate
+                rep = NamedSharding(mesh, P())
+
+                def _place(cur, new):
+                    sh = getattr(cur, "sharding", None)
+                    if sh is None or len(sh.device_set) < mesh.size:
+                        sh = rep
+                    return jax.device_put(new, sh)
+
+                opt_state = jax.tree.map(_place, opt_state, r_opt)
+                step = int(extra.get("step", 0))
+                start_epoch = int(extra.get("epoch", -1)) + 1
     t_start = _time.perf_counter()
-    for epoch in range(cfg.num_epochs):
+    for epoch in range(start_epoch, cfg.num_epochs):
         order = rng.permutation(n_train)
         if n_train < bs:  # tile tiny datasets up to one full batch
             order = np.resize(order, bs)
@@ -199,6 +228,12 @@ def train_model(
                 params, opt_state, batch, yb, jax.random.fold_in(key, step)
             )
             step += 1
+            if ckpt is not None and cfg.checkpoint_every and \
+                    step % cfg.checkpoint_every == 0:
+                # mid-epoch save: resume restarts this epoch with this state
+                ckpt.save(step, jax.device_get(params),
+                          jax.device_get(opt_state),
+                          {"step": step, "epoch": epoch - 1})
             if cfg.log_every and step % cfg.log_every == 0:
                 lv = float(l)
                 history["loss"].append(lv)
@@ -212,6 +247,9 @@ def train_model(
             _metrics.record("dl.train", step=step, loss=lv,
                             samples_per_sec=step * bs / max(elapsed, 1e-9))
 
+        if ckpt is not None:
+            ckpt.save(step, jax.device_get(params), jax.device_get(opt_state),
+                      {"step": step, "epoch": epoch})
         if n_eval:
             logits = _batched_apply(eval_logits, params, ev_inputs, mesh,
                                     in_shard, bs)
