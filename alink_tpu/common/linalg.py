@@ -14,7 +14,7 @@ path is jax/XLA, so these classes are thin host-side value types whose job is:
 
 from __future__ import annotations
 
-from typing import Dict, Iterable, List, Optional, Sequence, Union
+from typing import NamedTuple, Dict, Iterable, List, Optional, Sequence, Union
 
 import numpy as np
 
@@ -262,3 +262,42 @@ def pairwise_sq_dists(Q, X):
     negatives, which callers taking sqrt should clip."""
     return ((Q * Q).sum(1, keepdims=True) - 2.0 * (Q @ X.T)
             + (X * X).sum(1)[None, :])
+
+
+class SparseBlock(NamedTuple):
+    """ELL-padded sparse row block: ``idx`` (n, k) int32 column indices
+    (0-padded), ``val`` (n, k) float32 (0-padded), so padded entries
+    contribute 0 to any product. The TPU-native "huge sparse" carrier
+    (reference: common/linalg/SparseVector.java + the HugeSparseVector
+    story): static shapes XLA can tile, gathers/scatter-adds instead of
+    dense materialization. SURVEY §7 hard-part #2.
+    """
+
+    idx: "np.ndarray"
+    val: "np.ndarray"
+
+
+def to_sparse_block(
+    cells: "Sequence[SparseVector]",
+    dim: Optional[int] = None,
+    append_intercept: bool = False,
+) -> "tuple[SparseBlock, int]":
+    """Pack SparseVector cells into one ELL block. Returns (block, dim).
+    ``append_intercept`` adds one slot per row with index ``dim`` value 1."""
+    n = len(cells)
+    if dim is None:
+        dim = max((int(c.n) if c.n >= 0 else
+                   (int(c.indices[-1]) + 1 if c.indices.size else 0))
+                  for c in cells) if n else 0
+    max_nnz = max((c.indices.size for c in cells), default=0)
+    extra = 1 if append_intercept else 0
+    idx = np.zeros((n, max_nnz + extra), np.int32)
+    val = np.zeros((n, max_nnz + extra), np.float32)
+    for i, c in enumerate(cells):
+        m = c.indices.size
+        idx[i, :m] = c.indices
+        val[i, :m] = c.values
+        if append_intercept:
+            idx[i, max_nnz] = dim
+            val[i, max_nnz] = 1.0
+    return SparseBlock(idx, val), int(dim)

@@ -15,6 +15,20 @@ from __future__ import annotations
 
 from typing import Callable, NamedTuple
 
+from ..common.linalg import SparseBlock
+
+
+def xw(X, w):
+    """``X @ w`` generic over dense blocks and ELL SparseBlocks; ``w`` may
+    be a vector (d,) or a matrix (d, k). Sparse path is a gather+reduce that
+    differentiates into a scatter-add — no dense materialization either way
+    (SURVEY §7 hard-part #2)."""
+    if isinstance(X, SparseBlock):
+        if w.ndim == 1:
+            return (X.val * w[X.idx]).sum(axis=1)
+        return (X.val[..., None] * w[X.idx]).sum(axis=1)
+    return X @ w
+
 
 class ObjFunc(NamedTuple):
     """local_loss(w, X, y, wt) -> weighted sum of per-row losses on this shard.
@@ -37,7 +51,7 @@ def logistic_obj(dim: int) -> ObjFunc:
     import jax.numpy as jnp
 
     def local_loss(w, X, y, wt):
-        margin = y * (X @ w)
+        margin = y * xw(X, w)
         # log(1 + exp(-m)) stably
         per_row = jnp.logaddexp(0.0, -margin)
         return _weighted_sum(per_row, wt)
@@ -49,7 +63,7 @@ def squared_obj(dim: int) -> ObjFunc:
     """Least squares (reference: unarylossfunc/SquareLossFunc.java)."""
 
     def local_loss(w, X, y, wt):
-        r = X @ w - y
+        r = xw(X, w) - y
         return _weighted_sum(0.5 * r * r, wt)
 
     return ObjFunc(local_loss, dim)
@@ -61,7 +75,7 @@ def hinge_obj(dim: int, smooth: bool = True) -> ObjFunc:
     import jax.numpy as jnp
 
     def local_loss(w, X, y, wt):
-        margin = y * (X @ w)
+        margin = y * xw(X, w)
         if smooth:
             # quadratically smoothed hinge (differentiable everywhere)
             per_row = jnp.where(
@@ -84,7 +98,7 @@ def softmax_obj(dim: int, num_classes: int) -> ObjFunc:
 
     def local_loss(w, X, y, wt):
         W = w.reshape(dim, num_classes)
-        logits = X @ W
+        logits = xw(X, W)
         logz = jax.scipy.special.logsumexp(logits, axis=1)
         true_logit = jnp.take_along_axis(
             logits, y.astype(jnp.int32)[:, None], axis=1
@@ -99,7 +113,7 @@ def perceptron_obj(dim: int) -> ObjFunc:
     import jax.numpy as jnp
 
     def local_loss(w, X, y, wt):
-        margin = y * (X @ w)
+        margin = y * xw(X, w)
         return _weighted_sum(jnp.maximum(0.0, -margin), wt)
 
     return ObjFunc(local_loss, dim)
@@ -112,7 +126,7 @@ def svr_obj(dim: int, epsilon: float = 0.1) -> ObjFunc:
     import jax.numpy as jnp
 
     def local_loss(w, X, y, wt):
-        r = X @ w - y
+        r = xw(X, w) - y
         excess = jnp.maximum(jnp.abs(r) - epsilon, 0.0)
         return _weighted_sum(0.5 * excess * excess, wt)
 
@@ -148,7 +162,7 @@ def huber_obj(dim: int, delta: float = 1.0) -> ObjFunc:
     import jax.numpy as jnp
 
     def local_loss(w, X, y, wt):
-        r = X @ w - y
+        r = xw(X, w) - y
         a = jnp.abs(r)
         per_row = jnp.where(a <= delta, 0.5 * r * r, delta * (a - 0.5 * delta))
         return _weighted_sum(per_row, wt)

@@ -73,11 +73,21 @@ def optimize(
     if l1 > 0.0 and method == "lbfgs":
         method = "owlqn"
 
+    from ..common.linalg import SparseBlock
+
     mesh = mesh or default_mesh()
-    n = X.shape[0]
+    sparse = isinstance(X, SparseBlock)
+    if sparse and method in ("sgd", "newton"):
+        raise ValueError(f"sparse feature blocks unsupported for {method}")
+    n = X.idx.shape[0] if sparse else X.shape[0]
     if sample_weights is None:
         sample_weights = np.ones(n, dtype=np.float32)
-    Xs, mask = shard_rows(mesh, np.asarray(X, np.float32), with_mask=True)
+    if sparse:
+        idx_s, mask = shard_rows(mesh, np.asarray(X.idx, np.int32),
+                                 with_mask=True)
+        Xs = SparseBlock(idx_s, shard_rows(mesh, np.asarray(X.val, np.float32)))
+    else:
+        Xs, mask = shard_rows(mesh, np.asarray(X, np.float32), with_mask=True)
     ys = shard_rows(mesh, np.asarray(y, np.float32))
     wts = shard_rows(mesh, np.asarray(sample_weights, np.float32))
     w_init = jnp.zeros(obj.num_params, jnp.float32) if w0 is None else jnp.asarray(
