@@ -1,19 +1,25 @@
-"""Hyper-parameter tuning: grid/random search with CV or train/test split.
+"""Hyper-parameter tuning: grid/random/Bayes search with CV or TV split.
 
 Capability parity with the reference's tuning package (reference:
 core/src/main/java/com/alibaba/alink/pipeline/tuning/ — 3.5k LoC:
 GridSearchCV.java, GridSearchTVSplit.java, RandomSearchCV.java, ParamGrid.java,
 ParamDist.java, BinaryClassificationTuningEvaluator.java,
 RegressionTuningEvaluator.java, MultiClassClassificationTuningEvaluator.java,
-ClusterTuningEvaluator.java; BaseTuning.findBest / kFoldCv).
+ClusterTuningEvaluator.java; BaseTuning.findBest / kFoldCv). BayesSearchCV is
+a TPE-style sequential model-based search the reference lacks (TPU-first
+addition).
 
-Candidates are embarrassingly parallel over shared CV folds; evaluation reuses
-the Eval*BatchOp metric ops.
+Parallelism: with ``num_threads > 1`` each candidate is applied to a deep
+copy of the estimator and (fit, transform, evaluate) runs in a thread pool —
+device work releases the GIL inside XLA, so candidates genuinely overlap.
+The grid/random searches stay deterministic either way.
 """
 
 from __future__ import annotations
 
+import copy
 import itertools
+from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -144,25 +150,52 @@ class TuningResult:
 
 class _BaseSearch:
     def __init__(self, estimator, evaluator: TuningEvaluator, num_folds: int = 3,
-                 train_ratio: Optional[float] = None, seed: int = 0):
+                 train_ratio: Optional[float] = None, seed: int = 0,
+                 num_threads: int = 1):
         self.estimator = estimator
         self.evaluator = evaluator
         self.num_folds = num_folds
         self.train_ratio = train_ratio
         self.seed = seed
+        self.num_threads = num_threads
 
     def _candidates(self):
         raise NotImplementedError
 
+    def _stage_list(self, est):
+        return est.stages if isinstance(est, Pipeline) else [est]
+
+    def _clone_with(self, combo):
+        """Deep-copy the estimator and apply the combo to the clone (combo
+        references the ORIGINAL stage objects; map by position)."""
+        est = copy.deepcopy(self.estimator)
+        pos = {id(s): i for i, s in enumerate(self._stage_list(self.estimator))}
+        clones = self._stage_list(est)
+        for stage, info, v in combo:
+            clones[pos[id(stage)]].set(info, v)
+        return est
+
+    def _eval_candidate(self, combo, t: MTable) -> float:
+        est = self._clone_with(combo)
+        scores = [self._score_split(t, tr, te, est)
+                  for tr, te in self._splits(t)]
+        return float(np.mean(scores))
+
     def fit(self, data) -> TuningResult:
         t = data.collect() if not isinstance(data, MTable) else data
+        candidates = list(self._candidates())
+        if self.num_threads > 1:
+            with ThreadPoolExecutor(self.num_threads) as pool:
+                scores = list(pool.map(
+                    lambda c: self._eval_candidate(c, t), candidates))
+        else:
+            scores = [self._eval_candidate(c, t) for c in candidates]
+        return self._finish(t, candidates, scores)
+
+    def _finish(self, t: MTable, candidates, scores) -> TuningResult:
         reports = []
         best_score, best_combo = None, None
-        for combo in self._candidates():
-            for stage, info, v in combo:
-                stage.set(info, v)
-            scores = [self._score_split(t, tr, te) for tr, te in self._splits(t)]
-            score = float(np.mean(scores))
+        for combo, score in zip(candidates, scores):
             reports.append(
                 {
                     "params": {f"{type(s).__name__}.{i.name}": v for s, i, v in combo},
@@ -208,9 +241,10 @@ class _BaseSearch:
             train = np.concatenate([f for j, f in enumerate(folds) if j != i])
             yield train, test
 
-    def _score_split(self, t: MTable, train_idx, test_idx) -> float:
+    def _score_split(self, t: MTable, train_idx, test_idx,
+                     est=None) -> float:
         train_t, test_t = t.take(train_idx), t.take(test_idx)
-        est = self.estimator
+        est = est if est is not None else self.estimator
         model = est.fit(train_t) if isinstance(est, Pipeline) else PipelineModel(
             est.fit(train_t)
         )
@@ -222,8 +256,9 @@ class GridSearchCV(_BaseSearch):
     """(reference: pipeline/tuning/GridSearchCV.java)"""
 
     def __init__(self, estimator, param_grid: ParamGrid, evaluator, num_folds=3,
-                 seed=0):
-        super().__init__(estimator, evaluator, num_folds=num_folds, seed=seed)
+                 seed=0, num_threads=1):
+        super().__init__(estimator, evaluator, num_folds=num_folds, seed=seed,
+                         num_threads=num_threads)
         self.param_grid = param_grid
 
     def _candidates(self):
@@ -234,8 +269,9 @@ class GridSearchTVSplit(_BaseSearch):
     """(reference: pipeline/tuning/GridSearchTVSplit.java)"""
 
     def __init__(self, estimator, param_grid: ParamGrid, evaluator,
-                 train_ratio=0.8, seed=0):
-        super().__init__(estimator, evaluator, train_ratio=train_ratio, seed=seed)
+                 train_ratio=0.8, seed=0, num_threads=1):
+        super().__init__(estimator, evaluator, train_ratio=train_ratio, seed=seed,
+                         num_threads=num_threads)
         self.param_grid = param_grid
 
     def _candidates(self):
@@ -246,8 +282,9 @@ class RandomSearchCV(_BaseSearch):
     """(reference: pipeline/tuning/RandomSearchCV.java)"""
 
     def __init__(self, estimator, param_dist: ParamDist, evaluator,
-                 num_candidates=10, num_folds=3, seed=0):
-        super().__init__(estimator, evaluator, num_folds=num_folds, seed=seed)
+                 num_candidates=10, num_folds=3, seed=0, num_threads=1):
+        super().__init__(estimator, evaluator, num_folds=num_folds, seed=seed,
+                         num_threads=num_threads)
         self.param_dist = param_dist
         self.num_candidates = num_candidates
 
@@ -259,10 +296,118 @@ class RandomSearchTVSplit(_BaseSearch):
     """(reference: pipeline/tuning/RandomSearchTVSplit.java)"""
 
     def __init__(self, estimator, param_dist: ParamDist, evaluator,
-                 num_candidates=10, train_ratio=0.8, seed=0):
-        super().__init__(estimator, evaluator, train_ratio=train_ratio, seed=seed)
+                 num_candidates=10, train_ratio=0.8, seed=0, num_threads=1):
+        super().__init__(estimator, evaluator, train_ratio=train_ratio, seed=seed,
+                         num_threads=num_threads)
         self.param_dist = param_dist
         self.num_candidates = num_candidates
 
     def _candidates(self):
         return self.param_dist.sample(self.num_candidates, seed=self.seed)
+
+
+class ParamRange:
+    """Search space for Bayes search: continuous/integer ranges (optionally
+    log-scaled) and categorical choices."""
+
+    def __init__(self):
+        self._items: List[Tuple] = []
+
+    def add_range(self, stage, info: "ParamInfo | str", low, high,
+                  log: bool = False, integer: bool = False):
+        if isinstance(info, str):
+            info = type(stage)._resolve_info(info)
+        self._items.append((stage, info, ("range", float(low), float(high),
+                                          log, integer)))
+        return self
+
+    def add_choices(self, stage, info: "ParamInfo | str", values):
+        if isinstance(info, str):
+            info = type(stage)._resolve_info(info)
+        self._items.append((stage, info, ("choice", list(values))))
+        return self
+
+
+class BayesSearchCV(_BaseSearch):
+    """TPE-style sequential model-based search: after ``num_initial`` random
+    draws, each next candidate maximizes the good/bad kernel-density ratio of
+    the observations so far (Bergstra et al. 2011). The reference tuning
+    package has grid/random only — this is the Bayes slot its docs leave
+    open."""
+
+    def __init__(self, estimator, param_range: ParamRange, evaluator,
+                 num_candidates=20, num_initial=5, gamma=0.3, num_folds=3,
+                 seed=0, num_threads=1):
+        super().__init__(estimator, evaluator, num_folds=num_folds, seed=seed,
+                         num_threads=num_threads)
+        self.param_range = param_range
+        self.num_candidates = num_candidates
+        self.num_initial = max(2, num_initial)
+        self.gamma = gamma
+
+    # -- sampling helpers ---------------------------------------------------
+    def _draw(self, rng, spec):
+        if spec[0] == "choice":
+            return spec[1][rng.integers(len(spec[1]))]
+        _, low, high, log, integer = spec
+        if log:
+            v = float(np.exp(rng.uniform(np.log(low), np.log(high))))
+        else:
+            v = float(rng.uniform(low, high))
+        return int(round(v)) if integer else v
+
+    def _tpe_draw(self, rng, spec, good_vals, bad_vals):
+        if spec[0] == "choice":
+            choices = spec[1]
+            counts = np.ones(len(choices))
+            for v in good_vals:
+                counts[choices.index(v)] += 1
+            return choices[rng.choice(len(choices), p=counts / counts.sum())]
+        _, low, high, log, integer = spec
+        to_s = np.log if log else (lambda x: np.asarray(x, float))
+        from_s = np.exp if log else (lambda x: x)
+        g = to_s(np.asarray(good_vals, float))
+        b = to_s(np.asarray(bad_vals, float)) if len(bad_vals) else g
+        bw = max(g.std(), (to_s(high) - to_s(low)) * 0.05, 1e-12)
+
+        def kde(x, centers):
+            z = (x[:, None] - centers[None, :]) / bw
+            return np.exp(-0.5 * z * z).mean(axis=1) + 1e-12
+
+        # propose from the good KDE, keep the best good/bad density ratio
+        props = rng.choice(g, size=32) + bw * rng.standard_normal(32)
+        props = np.clip(props, to_s(low), to_s(high))
+        ratio = kde(props, g) / kde(props, b)
+        v = float(from_s(props[int(np.argmax(ratio))]))
+        v = min(max(v, low), high)
+        return int(round(v)) if integer else v
+
+    def fit(self, data) -> TuningResult:
+        t = data.collect() if not isinstance(data, MTable) else data
+        rng = np.random.default_rng(self.seed)
+        items = self.param_range._items
+        observed: List[Tuple[tuple, float]] = []
+        candidates, scores = [], []
+        for k in range(self.num_candidates):
+            if k < self.num_initial or not observed:
+                values = tuple(self._draw(rng, spec) for _, _, spec in items)
+            else:
+                ordered = sorted(
+                    observed, key=lambda o: o[1],
+                    reverse=self.evaluator.larger_is_better)
+                n_good = max(1, int(np.ceil(self.gamma * len(ordered))))
+                good = [o[0] for o in ordered[:n_good]]
+                bad = [o[0] for o in ordered[n_good:]]
+                values = tuple(
+                    self._tpe_draw(rng, spec,
+                                   [gv[i] for gv in good],
+                                   [bv[i] for bv in bad])
+                    for i, (_, _, spec) in enumerate(items))
+            combo = tuple((stage, info, v)
+                          for (stage, info, _), v in zip(items, values))
+            score = self._eval_candidate(combo, t)
+            candidates.append(combo)
+            scores.append(score)
+            if not np.isnan(score):
+                observed.append((values, score))
+        return self._finish(t, candidates, scores)

@@ -110,3 +110,60 @@ def test_grid_search_cv():
     assert result.best_params["LogisticRegression.l2"] == 1e-4
     out = result.transform(t).collect()
     assert (np.asarray(out.col("pred")) == labels).mean() > 0.95
+
+
+def test_grid_search_parallel_matches_sequential():
+    import numpy as np
+
+    from alink_tpu.operator.batch import MemSourceBatchOp
+    from alink_tpu.pipeline import LogisticRegression
+    from alink_tpu.pipeline.tuning import (
+        BinaryClassificationTuningEvaluator, GridSearchCV, ParamGrid)
+
+    rng = np.random.default_rng(0)
+    rows = [(float(a), float(b), int(a + b > 0))
+            for a, b in rng.normal(size=(80, 2))]
+    src = MemSourceBatchOp(rows, "a double, b double, label int")
+
+    def search(num_threads):
+        lr = LogisticRegression(featureCols=["a", "b"], labelCol="label",
+                                predictionDetailCol="detail")
+        grid = ParamGrid().add_grid(lr, "l2", [0.0, 0.1, 1.0])
+        ev = BinaryClassificationTuningEvaluator(labelCol="label",
+                                                 predictionDetailCol="detail")
+        return GridSearchCV(lr, grid, ev, num_folds=2, seed=1,
+                            num_threads=num_threads).fit(src)
+
+    seq = search(1)
+    par = search(3)
+    assert seq.best_params == par.best_params
+    assert [r["score"] for r in seq.reports] == \
+        pytest.approx([r["score"] for r in par.reports], abs=1e-9)
+
+
+def test_bayes_search_cv():
+    import numpy as np
+
+    from alink_tpu.operator.batch import MemSourceBatchOp
+    from alink_tpu.pipeline import Ridge
+    from alink_tpu.pipeline.tuning import (BayesSearchCV, ParamRange,
+                                           RegressionTuningEvaluator)
+
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=120)
+    y = 2.0 * x + rng.normal(scale=0.1, size=120)
+    src = MemSourceBatchOp(
+        [(float(a), float(b)) for a, b in zip(x, y)], "x double, y double")
+    ridge = Ridge(featureCols=["x"], labelCol="y")
+    space = ParamRange().add_range(ridge, "lambda", 1e-4, 10.0, log=True)
+    ev = RegressionTuningEvaluator(labelCol="y", predictionCol="pred")
+    res = BayesSearchCV(ridge, space, ev, num_candidates=10, num_initial=4,
+                        num_folds=2, seed=3).fit(src)
+    assert len(res.reports) == 10
+    lam = res.best_params["Ridge.lambda"]
+    assert 1e-4 <= lam <= 10.0
+    # huge lambda shrinks the weight to ~0: on this data the best lambda is
+    # small, and the search's exploitation phase must find one < 1
+    assert lam < 1.0
+    out = res.transform(src).collect()
+    assert "pred" in out.names
