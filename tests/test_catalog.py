@@ -1,0 +1,46 @@
+"""Op catalog + docs generation tests (reference:
+common/annotation/PublicOperatorUtils.java, GeneratePyOp.java)."""
+
+import os
+
+from alink_tpu.common.catalog import (
+    generate_docs,
+    list_operators,
+    op_info,
+    params_of,
+    port_specs,
+)
+
+
+def test_catalog_lists_many_ops():
+    ops = list_operators()
+    assert len(ops["batch"]) > 200
+    assert len(ops["stream"]) >= 8
+    names = {c.__name__ for c in ops["batch"]}
+    for expected in ("KMeansTrainBatchOp", "FpGrowthBatchOp",
+                     "PageRankBatchOp", "ArimaBatchOp",
+                     "OnnxModelPredictBatchOp"):
+        assert expected in names
+
+
+def test_port_specs_and_params():
+    from alink_tpu.operator.batch import (CsvSourceBatchOp,
+                                          KMeansPredictBatchOp,
+                                          KMeansTrainBatchOp)
+
+    assert port_specs(CsvSourceBatchOp)["inputs"] == []
+    assert port_specs(KMeansTrainBatchOp)["outputs"] == ["MODEL"]
+    assert port_specs(KMeansPredictBatchOp)["inputs"] == ["MODEL", "DATA"]
+    pnames = {p.name for p in params_of(KMeansTrainBatchOp)}
+    assert {"k", "maxIter", "distanceType"} <= pnames
+    info = op_info(KMeansTrainBatchOp)
+    assert info["params"] and info["doc"]
+
+
+def test_generate_docs(tmp_path):
+    files = generate_docs(str(tmp_path))
+    assert len(files) > 20
+    stats = [f for f in files if f.endswith("statistics.md")]
+    assert stats
+    content = open(stats[0]).read()
+    assert "CorrelationBatchOp" in content and "| param |" in content
