@@ -319,6 +319,16 @@ from .finance import (
     ScorecardPredictBatchOp,
     ScorecardTrainBatchOp,
 )
+from .vector import (
+    ColumnsToVectorBatchOp,
+    UdfBatchOp,
+    UdtfBatchOp,
+    VectorElementwiseProductBatchOp,
+    VectorInteractionBatchOp,
+    VectorNormalizeBatchOp,
+    VectorSliceBatchOp,
+    VectorToColumnsBatchOp,
+)
 from .huge import (
     DeepWalkBatchOp,
     DeepWalkEmbeddingBatchOp,
