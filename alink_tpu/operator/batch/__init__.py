@@ -93,6 +93,8 @@ from .clustering import (
 )
 from .clustering2 import (
     AgnesBatchOp,
+    GroupDbscanBatchOp,
+    GroupKMeansBatchOp,
     BisectingKMeansPredictBatchOp,
     BisectingKMeansTrainBatchOp,
     DbscanBatchOp,

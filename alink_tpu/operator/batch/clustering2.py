@@ -615,3 +615,79 @@ class AgnesBatchOp(BatchOperator, HasVectorCol, HasFeatureCols,
         pred_col = self.get(HasPredictionCol.PREDICTION_COL)
         return TableSchema(list(in_schema.names) + [pred_col],
                            list(in_schema.types) + [AlinkTypes.LONG])
+
+
+class GroupKMeansBatchOp(BatchOperator, HasFeatureCols, HasPredictionCol,
+                         HasReservedCols):
+    """Independent KMeans per group key — parallelism pattern #4 in SURVEY
+    (reference: operator/batch/clustering/GroupKMeansBatchOp.java)."""
+
+    GROUP_COL = ParamInfo("groupCol", str, optional=False)
+    K = ParamInfo("k", int, default=2, validator=MinValidator(2))
+    MAX_ITER = ParamInfo("maxIter", int, default=30, validator=MinValidator(1))
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    def _execute_impl(self, t: MTable) -> MTable:
+        group_col = self.get(self.GROUP_COL)
+        feature_cols = resolve_feature_cols(t, self, exclude=[group_col])
+        X = t.to_numeric_block(feature_cols, dtype=np.float32)
+        groups = np.asarray(t.col(group_col), object)
+        k = self.get(self.K)
+        labels = np.full(t.num_rows, -1, np.int64)
+        for g in dict.fromkeys(groups):           # stable group order
+            rows = np.flatnonzero(groups == g)
+            Xg = X[rows]
+            if Xg.shape[0] < k:
+                labels[rows] = 0
+                continue
+            c, _, _ = _lloyd(self.env.mesh, Xg, k,
+                             self.get(self.MAX_ITER), 1e-4, False, 0)
+            d = ((Xg[:, None, :] - c[None]) ** 2).sum(axis=2)
+            labels[rows] = d.argmin(axis=1)
+        pred_col = self.get(HasPredictionCol.PREDICTION_COL)
+        return t.with_column(pred_col, labels, AlinkTypes.LONG)
+
+    def _out_schema(self, in_schema):
+        pred_col = self.get(HasPredictionCol.PREDICTION_COL)
+        return TableSchema(list(in_schema.names) + [pred_col],
+                           list(in_schema.types) + [AlinkTypes.LONG])
+
+
+class GroupDbscanBatchOp(BatchOperator, HasFeatureCols, HasPredictionCol,
+                         HasReservedCols):
+    """Independent DBSCAN per group key (reference:
+    operator/batch/clustering/GroupDbscanBatchOp.java)."""
+
+    GROUP_COL = ParamInfo("groupCol", str, optional=False)
+    EPSILON = ParamInfo("epsilon", float, optional=False)
+    MIN_POINTS = ParamInfo("minPoints", int, default=4,
+                           validator=MinValidator(1))
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    def _execute_impl(self, t: MTable) -> MTable:
+        group_col = self.get(self.GROUP_COL)
+        feature_cols = resolve_feature_cols(t, self, exclude=[group_col])
+        X = t.to_numeric_block(feature_cols, dtype=np.float32)
+        groups = np.asarray(t.col(group_col), object)
+        labels = np.full(t.num_rows, -1, np.int64)
+        sub = DbscanBatchOp(epsilon=self.get(self.EPSILON),
+                            minPoints=self.get(self.MIN_POINTS),
+                            featureCols=feature_cols)
+        for g in dict.fromkeys(groups):
+            rows = np.flatnonzero(groups == g)
+            cols = {c: np.asarray(t.col(c))[rows] for c in feature_cols}
+            sub_t = MTable(cols)
+            out = sub._execute_impl(sub_t)
+            labels[rows] = np.asarray(out.col(
+                sub.get(HasPredictionCol.PREDICTION_COL)), np.int64)
+        pred_col = self.get(HasPredictionCol.PREDICTION_COL)
+        return t.with_column(pred_col, labels, AlinkTypes.LONG)
+
+    def _out_schema(self, in_schema):
+        pred_col = self.get(HasPredictionCol.PREDICTION_COL)
+        return TableSchema(list(in_schema.names) + [pred_col],
+                           list(in_schema.types) + [AlinkTypes.LONG])

@@ -7,6 +7,11 @@ from .base import (
     TableSourceStreamOp,
 )
 from .evaluation import EvalBinaryClassStreamOp
+from .modelstream import (
+    FileModelStreamSink,
+    ModelStreamFileSourceStreamOp,
+    scan_model_dir,
+)
 from .modelpredict import (
     OnnxModelPredictStreamOp,
     StableHloModelPredictStreamOp,
@@ -23,6 +28,9 @@ __all__ = [
     "ModelMapStreamOp",
     "StreamOperator",
     "TableSourceStreamOp",
+    "FileModelStreamSink",
+    "ModelStreamFileSourceStreamOp",
+    "scan_model_dir",
     "EvalBinaryClassStreamOp",
     "OnnxModelPredictStreamOp",
     "StableHloModelPredictStreamOp",

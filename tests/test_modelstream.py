@@ -1,0 +1,108 @@
+"""Model-stream file scanner + grouped clustering tests (reference:
+operator/common/modelstream/ModelStreamFileScanner.java:41-178,
+GroupKMeansBatchOp / GroupDbscanBatchOp)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from alink_tpu.operator.batch import (
+    GroupDbscanBatchOp,
+    GroupKMeansBatchOp,
+    LinearRegTrainBatchOp,
+    MemSourceBatchOp,
+)
+from alink_tpu.operator.stream import (
+    FileModelStreamSink,
+    FtrlPredictStreamOp,
+    ModelStreamFileSourceStreamOp,
+    TableSourceStreamOp,
+    scan_model_dir,
+)
+
+
+def _train_model(slope):
+    rows = [(float(x), float(slope * x)) for x in range(-10, 10)]
+    src = MemSourceBatchOp(rows, "x double, y double")
+    return LinearRegTrainBatchOp(featureCols=["x"], labelCol="y") \
+        .link_from(src).collect()
+
+
+def test_sink_and_scanner_order(tmp_path):
+    d = str(tmp_path / "models")
+    sink = FileModelStreamSink(d)
+    m = _train_model(2.0)
+    sink.write(m, timestamp=100)
+    sink.write(m, timestamp=50)
+    sink.write(m, timestamp=200)
+    scanned = scan_model_dir(d)
+    assert [ts for ts, _ in scanned] == [50, 100, 200]
+    assert [ts for ts, _ in scan_model_dir(d, after=100)] == [200]
+
+
+def test_model_stream_source_yields_models(tmp_path):
+    d = str(tmp_path / "models")
+    sink = FileModelStreamSink(d)
+    sink.write(_train_model(2.0), timestamp=1)
+    sink.write(_train_model(3.0), timestamp=2)
+    src = ModelStreamFileSourceStreamOp(filePath=d, maxModels=2,
+                                        timeoutMs=2000)
+    chunks = list(src._stream())
+    assert len(chunks) == 2
+    # each chunk is a model table with the canonical schema
+    assert set(chunks[0].names) == {"key", "json", "tensor"}
+
+
+def test_models_land_while_streaming(tmp_path):
+    """A model written after streaming starts is still picked up."""
+    d = str(tmp_path / "models")
+    sink = FileModelStreamSink(d)
+    sink.write(_train_model(2.0), timestamp=1)
+
+    def late_writer():
+        time.sleep(0.3)
+        sink.write(_train_model(5.0), timestamp=2)
+
+    th = threading.Thread(target=late_writer)
+    th.start()
+    src = ModelStreamFileSourceStreamOp(filePath=d, maxModels=2,
+                                        timeoutMs=5000, pollIntervalMs=50)
+    chunks = list(src._stream())
+    th.join()
+    assert len(chunks) == 2
+
+
+def test_group_kmeans():
+    rng = np.random.default_rng(0)
+    rows = []
+    for g, centers in (("a", [(0, 0), (5, 5)]), ("b", [(10, 0), (0, 10)])):
+        for c in centers:
+            for _ in range(20):
+                p = rng.normal(c, 0.2, 2)
+                rows.append((g, float(p[0]), float(p[1])))
+    src = MemSourceBatchOp(rows, "g string, x double, y double")
+    out = GroupKMeansBatchOp(groupCol="g", k=2).link_from(src).collect()
+    labels = np.asarray(out.col("pred"))
+    # within each group, the two blobs get distinct clusters
+    assert len(set(labels[:20].tolist())) == 1
+    assert labels[0] != labels[20]
+    assert len(set(labels[40:60].tolist())) == 1
+    assert labels[40] != labels[60]
+
+
+def test_group_dbscan():
+    rng = np.random.default_rng(1)
+    rows = []
+    for g in ("a", "b"):
+        for c in ((0, 0), (8, 8)):
+            for _ in range(15):
+                p = rng.normal(c, 0.2, 2)
+                rows.append((g, float(p[0]), float(p[1])))
+    src = MemSourceBatchOp(rows, "g string, x double, y double")
+    out = GroupDbscanBatchOp(groupCol="g", epsilon=1.0, minPoints=3) \
+        .link_from(src).collect()
+    labels = np.asarray(out.col("pred"))
+    assert labels[0] != labels[15]          # two clusters within group a
+    assert (labels >= 0).all()
