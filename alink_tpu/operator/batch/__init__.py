@@ -331,6 +331,12 @@ from .vector import (
     VectorSliceBatchOp,
     VectorToColumnsBatchOp,
 )
+from .media import (
+    ExtractMfccFeatureBatchOp,
+    ReadAudioToTensorBatchOp,
+    ReadImageToTensorBatchOp,
+)
+from .insights import AutoDiscoveryBatchOp
 from .huge import (
     DeepWalkBatchOp,
     DeepWalkEmbeddingBatchOp,

@@ -1,0 +1,104 @@
+"""Auto-insights: automatic findings over a table.
+
+Capability parity with the reference's insight engine (reference:
+core/src/main/java/com/alibaba/alink/common/insights/AutoDiscovery.java —
+5.5k LoC of correlation/breakdown/impact detectors feeding the WebUI).
+
+Re-design: a compact detector suite over the columnar block — each finding
+is a (type, columns, score, description) row, ranked by score. Detectors:
+missing values, dominant category, high pairwise correlation, outlier-heavy
+columns, low-variance columns."""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import numpy as np
+
+from ...common.mtable import AlinkTypes, MTable, TableSchema
+from ...common.params import ParamInfo
+from ...mapper import HasSelectedCols
+from .base import BatchOperator
+
+_INSIGHT_SCHEMA = TableSchema(
+    ["type", "columns", "score", "description"],
+    [AlinkTypes.STRING, AlinkTypes.STRING, AlinkTypes.DOUBLE,
+     AlinkTypes.STRING])
+
+
+class AutoDiscoveryBatchOp(BatchOperator, HasSelectedCols):
+    """(reference: common/insights/AutoDiscovery.java)"""
+
+    TOP_N = ParamInfo("topN", int, default=20)
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    def _execute_impl(self, t: MTable) -> MTable:
+        findings: List[Tuple[str, str, float, str]] = []
+        cols = list(self.get(HasSelectedCols.SELECTED_COLS) or t.names)
+        numeric = [c for c in cols
+                   if AlinkTypes.is_numeric(t.schema.type_of(c))]
+        categorical = [c for c in cols
+                       if t.schema.type_of(c) == AlinkTypes.STRING]
+        n = max(t.num_rows, 1)
+
+        for c in numeric:
+            arr = np.asarray(t.col(c), np.float64)
+            miss = float(np.isnan(arr).mean())
+            if miss > 0.05:
+                findings.append((
+                    "missing_values", c, miss,
+                    f"{c}: {miss:.1%} of values are missing"))
+            ok = arr[~np.isnan(arr)]
+            if ok.size > 1:
+                std = ok.std()
+                if std < 1e-12:
+                    findings.append((
+                        "constant_column", c, 1.0,
+                        f"{c} is constant ({ok[0]:g})"))
+                else:
+                    z = np.abs(ok - ok.mean()) / std
+                    frac_out = float((z > 3).mean())
+                    if frac_out > 0.01:
+                        findings.append((
+                            "outliers", c, frac_out,
+                            f"{c}: {frac_out:.1%} of values beyond 3 sigma"))
+
+        for c in categorical:
+            vals, counts = np.unique(
+                np.asarray(t.col(c), object).astype(str), return_counts=True)
+            top_frac = float(counts.max() / n)
+            if len(vals) > 1 and top_frac > 0.8:
+                findings.append((
+                    "dominant_category", c, top_frac,
+                    f"{c}: {vals[counts.argmax()]!r} covers "
+                    f"{top_frac:.1%} of rows"))
+
+        if len(numeric) >= 2:
+            X = t.to_numeric_block(numeric, dtype=np.float64)
+            ok_rows = ~np.isnan(X).any(axis=1)
+            if ok_rows.sum() > 2:
+                with np.errstate(invalid="ignore", divide="ignore"):
+                    corr = np.corrcoef(X[ok_rows].T)
+                for i in range(len(numeric)):
+                    for j in range(i + 1, len(numeric)):
+                        r = float(corr[i, j])
+                        if abs(r) > 0.8:
+                            findings.append((
+                                "correlation",
+                                f"{numeric[i]},{numeric[j]}", abs(r),
+                                f"{numeric[i]} and {numeric[j]} correlate "
+                                f"(r={r:.3f})"))
+
+        findings.sort(key=lambda f: -f[2])
+        findings = findings[:self.get(self.TOP_N)]
+        if not findings:
+            return MTable({k: np.asarray([], object) if i in (0, 1, 3)
+                           else np.asarray([], np.float64)
+                           for i, k in enumerate(_INSIGHT_SCHEMA.names)},
+                          _INSIGHT_SCHEMA)
+        return MTable.from_rows(findings, _INSIGHT_SCHEMA)
+
+    def _out_schema(self, in_schema):
+        return _INSIGHT_SCHEMA

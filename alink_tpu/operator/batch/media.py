@@ -1,0 +1,193 @@
+"""Audio/image ops: read-to-tensor + MFCC featurization.
+
+Capability parity with the reference's media ops (reference:
+core/src/main/java/com/alibaba/alink/operator/batch/audio/
+ReadAudioToTensorBatchOp.java, ExtractMfccFeatureBatchOp.java
+(common/audio 0.4k LoC), operator/batch/image/ReadImageToTensorBatchOp.java
+(common/image 0.3k LoC)).
+
+Re-design: WAV decode via the stdlib ``wave`` module, images via PIL; MFCC
+is a numpy FFT → mel filterbank → DCT pipeline (the standard recipe), all
+host-side featurization producing DenseVector/tensor cells for the device
+path downstream."""
+
+from __future__ import annotations
+
+import os
+import wave
+from typing import List, Optional
+
+import numpy as np
+
+from ...common.exceptions import AkIllegalArgumentException
+from ...common.linalg import DenseVector
+from ...common.mtable import AlinkTypes, MTable, TableSchema
+from ...common.params import MinValidator, ParamInfo
+from ...mapper import HasOutputCol, HasReservedCols, HasSelectedCol
+from .base import BatchOperator
+
+
+def read_wav(path: str):
+    """(samples float32 in [-1,1] mono, sample_rate)"""
+    with wave.open(path, "rb") as w:
+        n = w.getnframes()
+        sr = w.getframerate()
+        width = w.getsampwidth()
+        channels = w.getnchannels()
+        raw = w.readframes(n)
+    if width == 2:
+        data = np.frombuffer(raw, np.int16).astype(np.float32) / 32768.0
+    elif width == 1:
+        data = (np.frombuffer(raw, np.uint8).astype(np.float32) - 128) / 128.0
+    elif width == 4:
+        data = np.frombuffer(raw, np.int32).astype(np.float32) / 2147483648.0
+    else:
+        raise AkIllegalArgumentException(f"unsupported WAV width {width}")
+    if channels > 1:
+        data = data.reshape(-1, channels).mean(axis=1)
+    return data, sr
+
+
+def _mel_filterbank(sr: int, n_fft: int, n_mels: int) -> np.ndarray:
+    def hz_to_mel(f):
+        return 2595.0 * np.log10(1.0 + f / 700.0)
+
+    def mel_to_hz(m):
+        return 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+
+    mels = np.linspace(hz_to_mel(0), hz_to_mel(sr / 2), n_mels + 2)
+    freqs = mel_to_hz(mels)
+    bins = np.floor((n_fft + 1) * freqs / sr).astype(int)
+    fb = np.zeros((n_mels, n_fft // 2 + 1))
+    for i in range(1, n_mels + 1):
+        lo, c, hi = bins[i - 1], bins[i], bins[i + 1]
+        for j in range(lo, c):
+            if c > lo:
+                fb[i - 1, j] = (j - lo) / (c - lo)
+        for j in range(c, hi):
+            if hi > c:
+                fb[i - 1, j] = (hi - j) / (hi - c)
+    return fb
+
+
+def mfcc(samples: np.ndarray, sr: int, n_mfcc: int = 13, n_fft: int = 512,
+         hop: int = 256, n_mels: int = 26) -> np.ndarray:
+    """(frames, n_mfcc) MFCC matrix — FFT → mel energies → log → DCT-II
+    (reference: common/audio MFCC extraction)."""
+    if samples.size < n_fft:
+        samples = np.pad(samples, (0, n_fft - samples.size))
+    frames = []
+    window = np.hanning(n_fft)
+    for s in range(0, samples.size - n_fft + 1, hop):
+        frames.append(samples[s:s + n_fft] * window)
+    F = np.stack(frames)                      # (t, n_fft)
+    spec = np.abs(np.fft.rfft(F, axis=1)) ** 2
+    fb = _mel_filterbank(sr, n_fft, n_mels)
+    mel = np.log(spec @ fb.T + 1e-10)         # (t, n_mels)
+    # DCT-II orthonormal
+    k = np.arange(n_mels)
+    basis = np.cos(np.pi / n_mels * (k[:, None] + 0.5) * np.arange(n_mfcc)[None, :])
+    return mel @ basis                        # (t, n_mfcc)
+
+
+class ReadAudioToTensorBatchOp(BatchOperator, HasSelectedCol, HasOutputCol,
+                               HasReservedCols):
+    """WAV file column → waveform vector (reference:
+    ReadAudioToTensorBatchOp.java)."""
+
+    ROOT_FILE_PATH = ParamInfo("rootFilePath", str, default="")
+    SAMPLE_RATE_COL = ParamInfo("sampleRateCol", str)
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    def _execute_impl(self, t: MTable) -> MTable:
+        root = self.get(self.ROOT_FILE_PATH)
+        out = self.get(HasOutputCol.OUTPUT_COL) or "audio"
+        vecs, srs = [], []
+        for rel in t.col(self.get(HasSelectedCol.SELECTED_COL)):
+            data, sr = read_wav(os.path.join(root, str(rel)))
+            vecs.append(DenseVector(data))
+            srs.append(sr)
+        res = t.with_column(out, np.asarray(vecs, object),
+                            AlinkTypes.DENSE_VECTOR)
+        sr_col = self.get(self.SAMPLE_RATE_COL)
+        if sr_col:
+            res = res.with_column(sr_col, np.asarray(srs, np.int64),
+                                  AlinkTypes.LONG)
+        return res
+
+    def _out_schema(self, in_schema):
+        out = self.get(HasOutputCol.OUTPUT_COL) or "audio"
+        names = list(in_schema.names) + [out]
+        types = list(in_schema.types) + [AlinkTypes.DENSE_VECTOR]
+        sr_col = self.get(self.SAMPLE_RATE_COL)
+        if sr_col:
+            names.append(sr_col)
+            types.append(AlinkTypes.LONG)
+        return TableSchema(names, types)
+
+
+class ExtractMfccFeatureBatchOp(BatchOperator, HasSelectedCol, HasOutputCol,
+                                HasReservedCols):
+    """Waveform vector column → mean-pooled MFCC vector (reference:
+    ExtractMfccFeatureBatchOp.java)."""
+
+    SAMPLE_RATE = ParamInfo("sampleRate", int, default=16000)
+    N_MFCC = ParamInfo("nMfcc", int, default=13, validator=MinValidator(2))
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    def _execute_impl(self, t: MTable) -> MTable:
+        from ...common.linalg import parse_vector
+
+        out = self.get(HasOutputCol.OUTPUT_COL) or "mfcc"
+        sr = self.get(self.SAMPLE_RATE)
+        n_mfcc = self.get(self.N_MFCC)
+        vecs = []
+        for v in t.col(self.get(HasSelectedCol.SELECTED_COL)):
+            m = mfcc(parse_vector(v).to_dense().data, sr, n_mfcc=n_mfcc)
+            vecs.append(DenseVector(m.mean(axis=0)))
+        return t.with_column(out, np.asarray(vecs, object),
+                             AlinkTypes.DENSE_VECTOR)
+
+    def _out_schema(self, in_schema):
+        out = self.get(HasOutputCol.OUTPUT_COL) or "mfcc"
+        return TableSchema(list(in_schema.names) + [out],
+                           list(in_schema.types) + [AlinkTypes.DENSE_VECTOR])
+
+
+class ReadImageToTensorBatchOp(BatchOperator, HasSelectedCol, HasOutputCol,
+                               HasReservedCols):
+    """Image file column → flattened float vector (H·W·C in [0,1]) with
+    optional resize (reference: ReadImageToTensorBatchOp.java)."""
+
+    ROOT_FILE_PATH = ParamInfo("rootFilePath", str, default="")
+    IMAGE_WIDTH = ParamInfo("imageWidth", int)
+    IMAGE_HEIGHT = ParamInfo("imageHeight", int)
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    def _execute_impl(self, t: MTable) -> MTable:
+        from PIL import Image
+
+        root = self.get(self.ROOT_FILE_PATH)
+        out = self.get(HasOutputCol.OUTPUT_COL) or "tensor"
+        w = self.get(self.IMAGE_WIDTH)
+        h = self.get(self.IMAGE_HEIGHT)
+        vecs = []
+        for rel in t.col(self.get(HasSelectedCol.SELECTED_COL)):
+            img = Image.open(os.path.join(root, str(rel))).convert("RGB")
+            if w and h:
+                img = img.resize((int(w), int(h)))
+            arr = np.asarray(img, np.float32) / 255.0
+            vecs.append(DenseVector(arr.ravel()))
+        return t.with_column(out, np.asarray(vecs, object),
+                             AlinkTypes.DENSE_VECTOR)
+
+    def _out_schema(self, in_schema):
+        out = self.get(HasOutputCol.OUTPUT_COL) or "tensor"
+        return TableSchema(list(in_schema.names) + [out],
+                           list(in_schema.types) + [AlinkTypes.DENSE_VECTOR])

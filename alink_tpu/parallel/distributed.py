@@ -1,0 +1,77 @@
+"""Multi-host initialization — the jax.distributed bootstrap.
+
+Capability parity with the reference's cluster formation (reference:
+common/dl/DLLauncherBatchOp.java:222-260 — the 2-step Flink iteration that
+collects each task's ip:port and broadcasts the cluster def; flink-ai-extended
+gRPC AM/node services). On TPU pods none of that machinery exists: each host
+process calls ``jax.distributed.initialize`` against a coordinator, after
+which ``jax.devices()`` spans the whole slice and every mesh/collective in
+this framework works unchanged over ICI+DCN.
+
+Environment-variable conventions follow the standard TPU pod launchers:
+COORDINATOR_ADDRESS / NUM_PROCESSES / PROCESS_ID (all optional on Cloud TPU,
+where jax autodetects them from the metadata server).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+_initialized = False
+
+
+def init_multi_host(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> dict:
+    """Join the multi-host cluster (idempotent). Returns a summary dict
+    {process_id, num_processes, local_devices, global_devices}.
+
+    On single-host environments this is a no-op that reports the local
+    topology — code written against it runs unchanged on one chip, an
+    8-chip host, or a multi-host pod."""
+    global _initialized
+    import jax
+
+    coordinator_address = coordinator_address or os.environ.get(
+        "COORDINATOR_ADDRESS")
+    num_processes = num_processes if num_processes is not None else (
+        int(os.environ["NUM_PROCESSES"])
+        if "NUM_PROCESSES" in os.environ else None)
+    process_id = process_id if process_id is not None else (
+        int(os.environ["PROCESS_ID"])
+        if "PROCESS_ID" in os.environ else None)
+
+    should_init = (coordinator_address is not None
+                   or (num_processes or 0) > 1)
+    if should_init and not _initialized:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+        _initialized = True
+    return {
+        "process_id": getattr(jax, "process_index", lambda: 0)(),
+        "num_processes": getattr(jax, "process_count", lambda: 1)(),
+        "local_devices": len(jax.local_devices()),
+        "global_devices": len(jax.devices()),
+    }
+
+
+def global_data_mesh():
+    """1-D data mesh over ALL devices in the cluster (every process sees the
+    same global mesh; shard_map/pjit place per-host shards automatically)."""
+    from .mesh import default_mesh
+
+    return default_mesh()
+
+
+def is_coordinator() -> bool:
+    """True on process 0 — the analog of the reference's 'chief exports the
+    model' rule (akdl/engine/train.py:34-39)."""
+    import jax
+
+    return jax.process_index() == 0

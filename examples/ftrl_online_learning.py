@@ -1,0 +1,24 @@
+"""Online learning: batch warm start -> FTRL stream train -> hot-swap predict
+(reference: pyalink ftrl_demo.ipynb; FtrlTrainStreamOp.java:63,133-178)."""
+
+import numpy as np
+
+from alink_tpu.common.mtable import MTable
+from alink_tpu.operator.batch import MemSourceBatchOp
+from alink_tpu.operator.stream import (FtrlPredictStreamOp, FtrlTrainStreamOp,
+                                       TableSourceStreamOp)
+
+rng = np.random.default_rng(2)
+X = rng.normal(size=(600, 4)).astype(np.float64)
+y = (X @ np.array([1.0, -2.0, 0.5, 0.0]) > 0).astype(np.int64)
+cols = {f"f{i}": X[:, i] for i in range(4)}
+cols["label"] = y
+stream = TableSourceStreamOp(MTable(cols), chunkSize=100)
+
+models = FtrlTrainStreamOp(labelCol="label",
+                           featureCols=[f"f{i}" for i in range(4)],
+                           modelSaveInterval=1).link_from(stream)
+pred = FtrlPredictStreamOp(predictionCol="pred").link_from(
+    models, TableSourceStreamOp(MTable(cols), chunkSize=100))
+out = pred.collect()
+print("online accuracy:", float((np.asarray(out.col("pred")) == y).mean()))

@@ -1,0 +1,16 @@
+"""KMeans quick-start — the reference README example, TPU-native
+(reference: examples/src/main/java/com/alibaba/alink/KMeansExample.java)."""
+
+import numpy as np
+
+from alink_tpu.operator.batch import MemSourceBatchOp
+from alink_tpu.pipeline import KMeans, Pipeline
+
+rng = np.random.default_rng(0)
+rows = [tuple(map(float, rng.normal(c, 0.3, 2)))
+        for c in ((0, 0), (5, 5), (0, 5)) for _ in range(50)]
+source = MemSourceBatchOp(rows, "x double, y double")
+
+model = Pipeline(KMeans(k=3, predictionCol="cluster")).fit(source)
+model.transform(source).collect().head(10)
+print(model.transform(source).collect().to_display_string(max_rows=8))

@@ -1,0 +1,24 @@
+"""Train, persist one .ak file, reload, serve — the Pipeline round trip
+(reference: examples/src/main/java/com/alibaba/alink/AkExample.java +
+pipeline/PipelineModel save/load)."""
+
+import tempfile, os
+import numpy as np
+
+from alink_tpu.operator.batch import MemSourceBatchOp
+from alink_tpu.pipeline import (LogisticRegression, Pipeline, PipelineModel,
+                                StandardScaler)
+
+rng = np.random.default_rng(1)
+rows = [(float(a), float(b), int(a + b > 0))
+        for a, b in rng.normal(size=(200, 2))]
+src = MemSourceBatchOp(rows, "f0 double, f1 double, label int")
+
+pipe = Pipeline(
+    StandardScaler(selectedCols=["f0", "f1"]),
+    LogisticRegression(featureCols=["f0", "f1"], labelCol="label"),
+)
+model = pipe.fit(src)
+path = os.path.join(tempfile.mkdtemp(), "model.ak")
+model.save(path)
+print("served:", PipelineModel.load(path).transform(src).collect().names)

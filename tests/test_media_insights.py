@@ -1,0 +1,85 @@
+"""Media ops + insights + multi-host helper tests."""
+
+import os
+import wave
+
+import numpy as np
+import pytest
+
+from alink_tpu.operator.batch import (
+    AutoDiscoveryBatchOp,
+    ExtractMfccFeatureBatchOp,
+    MemSourceBatchOp,
+    ReadAudioToTensorBatchOp,
+    ReadImageToTensorBatchOp,
+)
+
+
+def _write_wav(path, freq=440.0, sr=16000, seconds=0.5):
+    t = np.arange(int(sr * seconds)) / sr
+    samples = (0.5 * np.sin(2 * np.pi * freq * t) * 32767).astype(np.int16)
+    with wave.open(path, "wb") as w:
+        w.setnchannels(1)
+        w.setsampwidth(2)
+        w.setframerate(sr)
+        w.writeframes(samples.tobytes())
+
+
+def test_audio_to_tensor_and_mfcc(tmp_path):
+    p1 = str(tmp_path / "a.wav")
+    p2 = str(tmp_path / "b.wav")
+    _write_wav(p1, freq=440.0)
+    _write_wav(p2, freq=2000.0)
+    src = MemSourceBatchOp([("a.wav",), ("b.wav",)], "path string")
+    audio = ReadAudioToTensorBatchOp(
+        selectedCol="path", outputCol="audio", rootFilePath=str(tmp_path),
+        sampleRateCol="sr").link_from(src)
+    out = audio.collect()
+    assert out.col("sr")[0] == 16000
+    assert abs(float(np.abs(out.col("audio")[0].data).max()) - 0.5) < 0.01
+    feats = ExtractMfccFeatureBatchOp(
+        selectedCol="audio", outputCol="mfcc").link_from(audio).collect()
+    m1, m2 = feats.col("mfcc")[0].data, feats.col("mfcc")[1].data
+    assert m1.shape == (13,)
+    assert not np.allclose(m1, m2)  # different pitches, different cepstra
+
+
+def test_image_to_tensor(tmp_path):
+    from PIL import Image
+
+    img = Image.new("RGB", (8, 6), (255, 0, 0))
+    img.save(str(tmp_path / "red.png"))
+    src = MemSourceBatchOp([("red.png",)], "path string")
+    out = ReadImageToTensorBatchOp(
+        selectedCol="path", outputCol="t", rootFilePath=str(tmp_path),
+        imageWidth=4, imageHeight=4).link_from(src).collect()
+    arr = out.col("t")[0].data.reshape(4, 4, 3)
+    assert arr[..., 0].min() > 0.99    # red channel saturated
+    assert arr[..., 1].max() < 0.01
+
+
+def test_auto_discovery():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=200)
+    rows = [(float(a), float(2 * a + 0.01 * rng.normal()),
+             "A" if i % 20 else "B", 1.0)
+            for i, a in enumerate(x)]
+    src = MemSourceBatchOp(rows, "x double, y double, cat string, const double")
+    out = AutoDiscoveryBatchOp().link_from(src).collect()
+    types = set(out.col("type"))
+    assert "correlation" in types          # x ~ y
+    assert "constant_column" in types      # const
+    assert "dominant_category" in types    # 'A' covers 95%
+
+
+def test_multi_host_helper_single_host():
+    from alink_tpu.parallel.distributed import (global_data_mesh,
+                                                init_multi_host,
+                                                is_coordinator)
+
+    info = init_multi_host()       # single host: no-op topology report
+    assert info["num_processes"] == 1
+    assert info["global_devices"] == info["local_devices"] >= 1
+    assert is_coordinator()
+    mesh = global_data_mesh()
+    assert mesh.size == info["global_devices"]
