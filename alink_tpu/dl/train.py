@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import dataclasses
 from dataclasses import dataclass
+from functools import partial
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import numpy as np
@@ -86,7 +87,9 @@ def make_train_step(model, tx, loss_of):
     import jax
     import optax
 
-    @jax.jit
+    # donate params/opt_state buffers: the update writes in place on device
+    # (HBM headroom for large models; callers rebind to the returned state)
+    @partial(jax.jit, donate_argnums=(0, 1))
     def train_step(variables, opt_state, batch, y, dkey=None):
         params = variables["params"]
         stats = {k: v for k, v in variables.items() if k != "params"}
@@ -259,7 +262,9 @@ def train_model(
                 metric = float(np.mean(np.argmax(logits, -1) == ev_y))
             history["eval_metric"].append(metric)
             if best_metric is None or metric > best_metric:
-                best_metric, best_params = metric, params
+                # host copy: the next train_step DONATES the live buffers, so
+                # stashing the device tree directly would dangle
+                best_metric, best_params = metric, jax.device_get(params)
                 patience_left = cfg.early_stopping_patience
             elif cfg.early_stopping_patience:
                 patience_left -= 1

@@ -17,6 +17,8 @@ from .modelpredict import (
     StableHloModelPredictStreamOp,
     TorchModelPredictStreamOp,
 )
+from . import generated as _generated
+from .generated import *  # noqa: F401,F403 — stream twins of mapper ops
 from .onlinelearning import (
     BinaryClassModelFilterStreamOp,
     FtrlPredictStreamOp,
@@ -38,4 +40,4 @@ __all__ = [
     "BinaryClassModelFilterStreamOp",
     "FtrlPredictStreamOp",
     "FtrlTrainStreamOp",
-]
+] + list(_generated.__all__)

@@ -1,0 +1,68 @@
+"""Stream variants of the mapper-backed batch operators, generated from the
+batch registry.
+
+Capability parity with the reference's stream op column (reference: most of
+the ~190 ops under operator/stream/ are thin wrappers binding the SAME
+Mapper/ModelMapper used by the batch twin — e.g.
+operator/stream/dataproc/ImputerPredictStreamOp.java,
+operator/stream/nlp/SegmentStreamOp.java,
+operator/stream/classification/LogisticRegressionPredictStreamOp.java).
+
+Python-first collapse: instead of hand-writing each wrapper, this module
+reflects over the batch registry and emits one StreamOp per mapper-backed
+batch op — stateless mappers become MapStreamOp subclasses, model mappers
+become ModelMapStreamOp subclasses (with hot-swap support inherited). The
+classes are real module-level types (picklable, documented, cataloged).
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Dict, List, Type
+
+from .base import MapStreamOp, ModelMapStreamOp
+
+__all__: List[str] = []
+
+
+def _generate() -> Dict[str, type]:
+    from ..batch.utils import MapBatchOp, ModelMapBatchOp
+    from .. import batch as batch_mod
+
+    out: Dict[str, type] = {}
+    for name in dir(batch_mod):
+        cls = getattr(batch_mod, name)
+        if not inspect.isclass(cls) or not name.endswith("BatchOp"):
+            continue
+        mapper_cls = getattr(cls, "mapper_cls", None)
+        if mapper_cls is None:
+            continue
+        stream_name = name[: -len("BatchOp")] + "StreamOp"
+        if issubclass(cls, ModelMapBatchOp):
+            base = ModelMapStreamOp
+        elif issubclass(cls, MapBatchOp):
+            base = MapStreamOp
+        else:
+            continue
+        attrs = {
+            "mapper_cls": mapper_cls,
+            "__doc__": (f"Stream twin of {name} — same "
+                        f"{mapper_cls.__name__} per micro-batch "
+                        f"(reference: the corresponding "
+                        f"operator/stream/ wrapper)."),
+            "__module__": __name__,
+        }
+        # surface the batch op's own ParamInfo attrs on the stream twin
+        for attr, v in vars(cls).items():
+            from ...common.params import ParamInfo
+
+            if isinstance(v, ParamInfo):
+                attrs[attr] = v
+        out[stream_name] = type(stream_name, (base,), attrs)
+    return out
+
+
+for _name, _cls in _generate().items():
+    # don't clobber hand-written stream ops (FTRL, foreign-model predict, ...)
+    globals().setdefault(_name, _cls)
+    __all__.append(_name)

@@ -1,0 +1,58 @@
+"""Generated stream-twin operators (reference: the operator/stream/ wrapper
+column — e.g. SegmentStreamOp.java, KMeansPredictStreamOp.java)."""
+
+import numpy as np
+import pytest
+
+from alink_tpu.common.mtable import MTable
+from alink_tpu.operator.batch import KMeansTrainBatchOp, MemSourceBatchOp
+from alink_tpu.operator.stream import TableSourceStreamOp
+from alink_tpu.operator.stream.generated import (
+    ImputerPredictStreamOp,
+    KMeansPredictStreamOp,
+    SegmentStreamOp,
+)
+
+
+def test_generated_registry_size():
+    from alink_tpu.operator.stream import generated
+
+    assert len(generated.__all__) > 60
+
+
+def test_segment_stream():
+    t = MTable({"txt": np.asarray(["abcd", "ab"], object)})
+    src = TableSourceStreamOp(t, chunkSize=1)
+    out = SegmentStreamOp(selectedCol="txt", outputCol="seg",
+                          userDefinedDict=["ab", "cd"]).link_from(src) \
+        .collect()
+    assert list(out.col("seg")) == ["ab cd", "ab"]
+
+
+def test_kmeans_predict_stream_with_static_model():
+    rng = np.random.default_rng(0)
+    rows = [tuple(map(float, rng.normal(c, 0.2, 2)))
+            for c in ((0, 0), (8, 8)) for _ in range(30)]
+    model = KMeansTrainBatchOp(k=2, featureCols=["x", "y"]).link_from(
+        MemSourceBatchOp(rows, "x double, y double")).collect()
+    t = MTable({"x": np.asarray([0.1, 8.1]), "y": np.asarray([0.0, 7.9])})
+    # empty model stream + static model kwarg
+    empty = TableSourceStreamOp(model, numChunks=1)
+    op = KMeansPredictStreamOp(model=model).link_from(
+        empty, TableSourceStreamOp(t, chunkSize=1))
+    out = op.collect()
+    labels = list(out.col("pred"))
+    assert labels[0] != labels[1]
+
+
+def test_imputer_predict_stream():
+    from alink_tpu.operator.batch import ImputerTrainBatchOp
+
+    train = MemSourceBatchOp([(1.0,), (3.0,)], "v double")
+    model = ImputerTrainBatchOp(selectedCols=["v"]).link_from(train).collect()
+    t = MTable({"v": np.asarray([np.nan, 5.0])})
+    op = ImputerPredictStreamOp(model=model).link_from(
+        TableSourceStreamOp(model, numChunks=1),
+        TableSourceStreamOp(t, chunkSize=1))
+    out = op.collect()
+    assert list(out.col("v")) == [2.0, 5.0]
