@@ -16,6 +16,7 @@ pending sinks" contract without a Flink scheduler.
 
 from __future__ import annotations
 
+import threading
 from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -44,6 +45,10 @@ class AlgoOperator(WithParams):
         self._output: Optional[MTable] = None
         self._side_tables: List[MTable] = []
         self._executed = False
+        # per-op lock: concurrent lazy-sink evaluation (AlinkLocalSession
+        # thread pool) may reach shared upstream nodes from several threads;
+        # DAG acyclicity makes the per-edge lock order deadlock-free
+        self._eval_lock = threading.RLock()
 
     # -- environment -------------------------------------------------------
     @property
@@ -85,22 +90,34 @@ class AlgoOperator(WithParams):
         raise NotImplementedError(type(self).__name__)
 
     def _evaluate(self) -> MTable:
-        if not self._executed:
-            ins = [op._evaluate() for op in self._inputs]
-            result = self._execute_impl(*ins)
-            if isinstance(result, tuple):
-                self._output, sides = result
-                self._side_tables = list(sides)
-            else:
-                self._output = result
-                self._side_tables = []
-            self._executed = True
-        return self._output
+        with self._eval_lock:
+            if not self._executed:
+                ins = [op._evaluate() for op in self._inputs]
+                result = self._execute_impl(*ins)
+                if isinstance(result, tuple):
+                    self._output, sides = result
+                    self._side_tables = list(sides)
+                else:
+                    self._output = result
+                    self._side_tables = []
+                self._executed = True
+            return self._output
 
     def _flush_lazy(self):
+        # independent pending sinks run on the session thread pool — the
+        # AlinkLocalSession local-engine analog (reference:
+        # operator/local/AlinkLocalSession.java:20-45 fixed pools); shared
+        # upstreams are protected by the per-op evaluation lock
         mgr = self.env.lazy_manager
-        for op in mgr.pending_ops():
-            mgr.fill(op, op._evaluate())
+        pending = list(mgr.pending_ops())
+        if len(pending) > 1:
+            results = list(self.env.executor.map(
+                lambda op: op._evaluate(), pending))
+            for op, r in zip(pending, results):
+                mgr.fill(op, r)
+        else:
+            for op in pending:
+                mgr.fill(op, op._evaluate())
 
     # -- results -----------------------------------------------------------
     def get_output_table(self) -> MTable:

@@ -337,6 +337,10 @@ from .media import (
     ReadImageToTensorBatchOp,
 )
 from .insights import AutoDiscoveryBatchOp
+from .xgboost import (
+    XGBoostPredictBatchOp,
+    XGBoostTrainBatchOp,
+)
 from .huge import (
     DeepWalkBatchOp,
     DeepWalkEmbeddingBatchOp,

@@ -14,3 +14,4 @@ from .objfunc import (
     svr_obj,
 )
 from .optimizers import OptimResult, optimize
+from .constrained import constrained_optimize

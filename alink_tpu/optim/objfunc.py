@@ -33,12 +33,15 @@ def xw(X, w):
 class ObjFunc(NamedTuple):
     """local_loss(w, X, y, wt) -> weighted sum of per-row losses on this shard.
 
-    ``num_params`` is the flat weight dimension; ``predict`` maps scores for
-    inference parity checks.
+    ``num_params`` is the flat weight dimension. ``global_term``, when set,
+    is a data-independent penalty ``g(w) -> scalar`` added ONCE to the
+    psum-averaged loss (constraint penalties, augmented-Lagrangian terms —
+    reference: optim/objfunc/OptimObjFunc constraint hooks).
     """
 
     local_loss: Callable
     num_params: int
+    global_term: "Callable | None" = None
 
 
 def _weighted_sum(per_row, wt):

@@ -107,13 +107,20 @@ def optimize(
             G = jax.lax.psum(g, axis) / total_w
             L = L + 0.5 * jnp.sum(l2 * w * w)
             G = G + l2 * w
+            if obj.global_term is not None:
+                gl, gg = jax.value_and_grad(obj.global_term)(w)
+                L = L + gl
+                G = G + gg
             return L, G
 
         def losses_at(cands):
             # batched local losses for all candidate weight vectors: one psum
             local = jax.vmap(lambda w: obj.local_loss(w, Xl, yl, wt_eff))(cands)
             L = jax.lax.psum(local, axis) / total_w
-            return L + 0.5 * jnp.sum(l2 * cands * cands, axis=1)
+            L = L + 0.5 * jnp.sum(l2 * cands * cands, axis=1)
+            if obj.global_term is not None:
+                L = L + jax.vmap(obj.global_term)(cands)
+            return L
 
         def l1_term(w):
             return l1 * jnp.abs(w).sum() if l1 > 0 else 0.0
@@ -264,7 +271,10 @@ def optimize(
         def hess(w):
             Hl = jax.hessian(obj.local_loss)(w, Xl, yl, wt_eff)
             H = jax.lax.psum(Hl, axis) / total_w
-            return H + l2 * jnp.eye(obj.num_params)  # eye*vec == diag(vec)
+            H = H + l2 * jnp.eye(obj.num_params)  # eye*vec == diag(vec)
+            if obj.global_term is not None:
+                H = H + jax.hessian(obj.global_term)(w)
+            return H
 
         loss0, g0 = value_and_grad(w_init)
 

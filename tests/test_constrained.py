@@ -1,0 +1,60 @@
+"""Constrained optimizer tests (reference: optim/activeSet/Sqp.java,
+barrierIcq/LogBarrier.java, divergence/Alm.java)."""
+
+import numpy as np
+import pytest
+
+from alink_tpu.optim import constrained_optimize, squared_obj
+
+
+def _ls_data(seed=0, n=400, d=4):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    w_true = np.array([1.0, -2.0, 0.5, 3.0], np.float32)
+    y = X @ w_true + 0.01 * rng.normal(size=n).astype(np.float32)
+    return X, y, w_true
+
+
+def test_alm_equality_constraint():
+    X, y, w_true = _ls_data()
+    # constrain sum(w) = 0 (unconstrained optimum has sum 2.5)
+    A = np.ones((1, 4), np.float32)
+    b = np.zeros(1, np.float32)
+    res = constrained_optimize(squared_obj(4), X, y, A_eq=A, b_eq=b)
+    assert abs(res.weights.sum()) < 1e-3
+    # still close to the least-squares fit in the feasible subspace
+    assert res.loss < 1.5
+
+
+def test_alm_inequality_constraint():
+    X, y, w_true = _ls_data(seed=1)
+    # w[3] <= 1.0 (unconstrained optimum is 3.0) -> binds at 1.0
+    A = np.zeros((1, 4), np.float32)
+    A[0, 3] = 1.0
+    res = constrained_optimize(squared_obj(4), X, y, A_ub=A,
+                               b_ub=np.ones(1, np.float32))
+    assert res.weights[3] <= 1.0 + 1e-3
+    assert res.weights[3] > 0.9          # constraint active, not slack
+
+
+def test_alm_inactive_constraint_matches_unconstrained():
+    from alink_tpu.optim import optimize
+
+    X, y, w_true = _ls_data(seed=2)
+    A = np.zeros((1, 4), np.float32)
+    A[0, 3] = 1.0
+    res_c = constrained_optimize(squared_obj(4), X, y, A_ub=A,
+                                 b_ub=np.asarray([100.0], np.float32))
+    res_u = optimize(squared_obj(4), X, y, max_iter=60)
+    np.testing.assert_allclose(res_c.weights, res_u.weights, atol=5e-3)
+
+
+def test_barrier_inequality():
+    X, y, w_true = _ls_data(seed=3)
+    A = np.zeros((1, 4), np.float32)
+    A[0, 3] = 1.0
+    res = constrained_optimize(squared_obj(4), X, y, A_ub=A,
+                               b_ub=np.ones(1, np.float32),
+                               method="barrier")
+    assert res.weights[3] <= 1.0 + 1e-2
+    assert res.weights[3] > 0.85
