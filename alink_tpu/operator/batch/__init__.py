@@ -341,6 +341,13 @@ from .xgboost import (
     XGBoostPredictBatchOp,
     XGBoostTrainBatchOp,
 )
+from ..sqlengine import (
+    JdbcSinkBatchOp,
+    JdbcSourceBatchOp,
+    SqliteCatalog,
+    SqlQueryBatchOp,
+    sql_query,
+)
 from .huge import (
     DeepWalkBatchOp,
     DeepWalkEmbeddingBatchOp,

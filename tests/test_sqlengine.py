@@ -1,0 +1,71 @@
+"""SQL-string engine + JDBC + catalog tests (reference:
+operator/common/sql/MTableCalciteSqlExecutor.java, common/io/catalog/)."""
+
+import numpy as np
+import pytest
+
+from alink_tpu.common.mtable import MTable
+from alink_tpu.operator.batch import (
+    JdbcSinkBatchOp,
+    JdbcSourceBatchOp,
+    MemSourceBatchOp,
+    SqliteCatalog,
+    SqlQueryBatchOp,
+    sql_query,
+)
+
+
+def test_sql_query_function():
+    t = MTable({"a": np.asarray([1, 2, 3], np.int64),
+                "b": np.asarray(["x", "y", "x"], object)})
+    out = sql_query(
+        "SELECT b, SUM(a) AS total FROM t GROUP BY b ORDER BY b",
+        {"t": t})
+    assert list(out.col("b")) == ["x", "y"]
+    assert list(out.col("total")) == [4, 2]
+
+
+def test_sql_query_op_joins_two_inputs():
+    left = MemSourceBatchOp([(1, "ann"), (2, "bob")], "id bigint, name string")
+    right = MemSourceBatchOp([(1, 95.5), (2, 88.0), (1, 70.0)],
+                             "id bigint, score double")
+    out = SqlQueryBatchOp(
+        query="SELECT t0.name, AVG(t1.score) AS avg_score "
+              "FROM t0 JOIN t1 ON t0.id = t1.id "
+              "GROUP BY t0.name ORDER BY t0.name").link_from(left, right) \
+        .collect()
+    assert list(out.col("name")) == ["ann", "bob"]
+    assert out.col("avg_score")[0] == pytest.approx(82.75)
+
+
+def test_sql_window_function():
+    src = MemSourceBatchOp([(1, 10.0), (1, 20.0), (2, 5.0)],
+                           "g bigint, v double")
+    out = SqlQueryBatchOp(
+        query="SELECT g, v, RANK() OVER (PARTITION BY g ORDER BY v DESC) r "
+              "FROM t ORDER BY g, r").link_from(src).collect()
+    assert list(out.col("r")) == [1, 2, 1]
+
+
+def test_jdbc_roundtrip_and_catalog(tmp_path):
+    db = str(tmp_path / "warehouse.db")
+    src = MemSourceBatchOp([(1, 2.5, "a"), (2, float("nan"), "b")],
+                           "id bigint, v double, s string")
+    JdbcSinkBatchOp(dbPath=db, tableName="stuff").link_from(src).collect()
+
+    cat = SqliteCatalog(db)
+    assert cat.list_tables() == ["stuff"]
+    schema = cat.get_table_schema("stuff")
+    assert schema.type_of("id") == "LONG"
+    assert schema.type_of("v") == "DOUBLE"
+
+    out = JdbcSourceBatchOp(dbPath=db, tableName="stuff").link_from() \
+        .collect()
+    assert list(out.col("id")) == [1, 2]
+    assert np.isnan(out.col("v")[1])     # NaN -> NULL -> NaN roundtrip
+    out2 = JdbcSourceBatchOp(
+        dbPath=db, query="SELECT s FROM stuff WHERE id = 2").link_from() \
+        .collect()
+    assert list(out2.col("s")) == ["b"]
+    cat.drop_table("stuff")
+    assert cat.list_tables() == []
