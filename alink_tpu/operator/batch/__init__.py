@@ -87,6 +87,8 @@ from .modelpredict import (
     export_stablehlo,
 )
 from .clustering import (
+    GeoKMeansPredictBatchOp,
+    GeoKMeansTrainBatchOp,
     KMeansModelInfoBatchOp,
     KMeansPredictBatchOp,
     KMeansTrainBatchOp,

@@ -47,7 +47,7 @@ class HasKMeansParams(HasVectorCol, HasFeatureCols):
     EPSILON = ParamInfo("epsilon", float, default=1e-4)
     DISTANCE_TYPE = ParamInfo(
         "distanceType", str, default="EUCLIDEAN",
-        validator=InValidator("EUCLIDEAN", "COSINE"),
+        validator=InValidator("EUCLIDEAN", "COSINE", "HAVERSINE"),
     )
     RANDOM_SEED = ParamInfo("randomSeed", int, default=0, aliases=("seed",))
 
@@ -82,13 +82,36 @@ def _kmeanspp_init(X: np.ndarray, k: int, seed: int) -> np.ndarray:
     return np.stack(centers).astype(np.float32)
 
 
+_EARTH_RADIUS_KM = 6371.0
+
+
+def _haversine_dists(Xl, c):
+    """(n, k) great-circle distances; rows are (lat, lon) in degrees
+    (reference: common/distance/HaversineDistance.java)."""
+    import jax.numpy as jnp
+
+    a = jnp.deg2rad(Xl)[:, None, :]     # (n, 1, 2)
+    b = jnp.deg2rad(c)[None, :, :]      # (1, k, 2)
+    dlat = a[..., 0] - b[..., 0]
+    dlon = a[..., 1] - b[..., 1]
+    h = (jnp.sin(dlat / 2) ** 2
+         + jnp.cos(a[..., 0]) * jnp.cos(b[..., 0]) * jnp.sin(dlon / 2) ** 2)
+    return 2.0 * _EARTH_RADIUS_KM * jnp.arcsin(
+        jnp.sqrt(jnp.clip(h, 0.0, 1.0)))
+
+
 def _lloyd(mesh, X: np.ndarray, k: int, max_iter: int, tol: float,
-           cosine: bool, seed: int):
-    """The compiled Lloyd loop. Returns (centroids, num_iters, inertia)."""
+           metric, seed: int):
+    """The compiled Lloyd loop. Returns (centroids, num_iters, inertia).
+    ``metric``: "EUCLIDEAN" | "COSINE" | "HAVERSINE" (bool accepted for the
+    legacy cosine flag)."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
 
+    if isinstance(metric, bool):
+        metric = "COSINE" if metric else "EUCLIDEAN"
+    cosine = metric == "COSINE"
     if cosine:
         X = X / np.maximum(np.linalg.norm(X, axis=1, keepdims=True), 1e-12)
     init = _kmeanspp_init(X, k, seed)
@@ -100,6 +123,8 @@ def _lloyd(mesh, X: np.ndarray, k: int, max_iter: int, tol: float,
             if cosine:
                 cn = c / jnp.maximum(jnp.linalg.norm(c, axis=1, keepdims=True), 1e-12)
                 d = 1.0 - Xl @ cn.T
+            elif metric == "HAVERSINE":
+                d = _haversine_dists(Xl, c)
             else:
                 d = pairwise_sq_dists(Xl, c)
             return d
@@ -113,13 +138,30 @@ def _lloyd(mesh, X: np.ndarray, k: int, max_iter: int, tol: float,
             d = assign(c, Xl)
             a = jnp.argmin(d, axis=1)
             onehot = jax.nn.one_hot(a, k, dtype=Xl.dtype) * maskl[:, None]
-            sums = jax.lax.psum(onehot.T @ Xl, axis)        # (k, d) matmul on MXU
             counts = jax.lax.psum(onehot.sum(0), axis)      # (k,)
-            c_new = jnp.where(counts[:, None] > 0, sums / counts[:, None], c)
-            if cosine:
-                c_new = c_new / jnp.maximum(
-                    jnp.linalg.norm(c_new, axis=1, keepdims=True), 1e-12
-                )
+            if metric == "HAVERSINE":
+                # centroid = spherical mean (mean of unit 3-vectors): the
+                # degree-mean breaks at the antimeridian
+                lat = jnp.deg2rad(Xl[:, 0])
+                lon = jnp.deg2rad(Xl[:, 1])
+                xyz = jnp.stack([jnp.cos(lat) * jnp.cos(lon),
+                                 jnp.cos(lat) * jnp.sin(lon),
+                                 jnp.sin(lat)], axis=1)
+                s = jax.lax.psum(onehot.T @ xyz, axis)       # (k, 3)
+                m = s / jnp.maximum(
+                    jnp.linalg.norm(s, axis=1, keepdims=True), 1e-12)
+                lat_c = jnp.rad2deg(jnp.arcsin(jnp.clip(m[:, 2], -1.0, 1.0)))
+                lon_c = jnp.rad2deg(jnp.arctan2(m[:, 1], m[:, 0]))
+                c_new = jnp.where(counts[:, None] > 0,
+                                  jnp.stack([lat_c, lon_c], axis=1), c)
+            else:
+                sums = jax.lax.psum(onehot.T @ Xl, axis)    # (k, d) MXU matmul
+                c_new = jnp.where(counts[:, None] > 0,
+                                  sums / counts[:, None], c)
+                if cosine:
+                    c_new = c_new / jnp.maximum(
+                        jnp.linalg.norm(c_new, axis=1, keepdims=True), 1e-12
+                    )
             shift = jnp.abs(c_new - c).max()
             return i + 1, c_new, shift, jnp.asarray(0.0)
 
@@ -165,10 +207,9 @@ class KMeansTrainBatchOp(ModelTrainOpMixin, BatchOperator, HasKMeansParams):
                 f"k={k} but only {X.shape[0]} rows of data"
             )
         mesh = self.env.mesh
-        cosine = self.get(self.DISTANCE_TYPE) == "COSINE"
         c, iters, inertia = _lloyd(
             mesh, X, k, self.get(self.MAX_ITER), self.get(self.EPSILON),
-            cosine, self.get(self.RANDOM_SEED),
+            self.get(self.DISTANCE_TYPE), self.get(self.RANDOM_SEED),
         )
         meta = {
             "modelName": "KMeansModel",
@@ -192,13 +233,15 @@ class KMeansModelMapper(RichModelMapper):
 
         self.meta, arrays = table_to_model(model)
         self.centroids = arrays["centroids"].astype(np.float32)
-        cosine = self.meta.get("distanceType") == "COSINE"
+        metric = self.meta.get("distanceType", "EUCLIDEAN")
 
         def assign(X, c):
-            if cosine:
+            if metric == "COSINE":
                 Xn = X / jnp.maximum(jnp.linalg.norm(X, axis=1, keepdims=True), 1e-12)
                 cn = c / jnp.maximum(jnp.linalg.norm(c, axis=1, keepdims=True), 1e-12)
                 d = 1.0 - Xn @ cn.T
+            elif metric == "HAVERSINE":
+                d = _haversine_dists(X, c)
             else:
                 d = pairwise_sq_dists(X, c)
             return jnp.argmin(d, axis=1), d
@@ -257,3 +300,21 @@ class KMeansModelInfoBatchOp(BatchOperator):
 
         return TableSchema(["clusterId", "center"],
                            [AlinkTypes.LONG, AlinkTypes.STRING])
+
+
+class GeoKMeansTrainBatchOp(KMeansTrainBatchOp):
+    """KMeans over (lat, lon) degrees with great-circle distance
+    (reference: operator/batch/clustering/GeoKMeansTrainBatchOp.java)."""
+
+    LATITUDE_COL = ParamInfo("latitudeCol", str, optional=False)
+    LONGITUDE_COL = ParamInfo("longitudeCol", str, optional=False)
+
+    def _execute_impl(self, t: MTable) -> MTable:
+        self.set(self.DISTANCE_TYPE, "HAVERSINE")
+        self.set(HasFeatureCols.FEATURE_COLS,
+                 [self.get(self.LATITUDE_COL), self.get(self.LONGITUDE_COL)])
+        return super()._execute_impl(t)
+
+
+class GeoKMeansPredictBatchOp(KMeansPredictBatchOp):
+    pass

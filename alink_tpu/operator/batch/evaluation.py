@@ -81,7 +81,11 @@ class EvalBinaryClassBatchOp(BaseEvalBatchOp):
     common/evaluation/BinaryClassMetrics.java)."""
 
     LABEL_COL = ParamInfo("labelCol", str, optional=False)
-    PREDICTION_DETAIL_COL = ParamInfo("predictionDetailCol", str, optional=False)
+    PREDICTION_DETAIL_COL = ParamInfo("predictionDetailCol", str)
+    PREDICTION_SCORE_COL = ParamInfo(
+        "predictionScoreCol", str,
+        desc="numeric positive-class probability column — the JSON-free "
+             "path for large tables")
     POS_LABEL_VAL_STR = ParamInfo("positiveLabelValueString", str)
 
     _metric_cols = [
@@ -93,12 +97,30 @@ class EvalBinaryClassBatchOp(BaseEvalBatchOp):
 
     def _execute_impl(self, t: MTable) -> MTable:
         y = np.asarray([str(v) for v in t.col(self.get(self.LABEL_COL))])
-        details = [json.loads(d) for d in t.col(self.get(self.PREDICTION_DETAIL_COL))]
-        labels = sorted({k for d in details for k in d})
-        if len(labels) != 2:
-            raise AkIllegalDataException(f"binary eval needs 2 labels, got {labels}")
-        pos = self.get(self.POS_LABEL_VAL_STR) or labels[0]
-        p = np.asarray([d.get(pos, 0.0) for d in details], np.float64)
+        score_col = self.get(self.PREDICTION_SCORE_COL)
+        if score_col:
+            # JSON-free fast path for large tables
+            pos = self.get(self.POS_LABEL_VAL_STR)
+            if pos is None:
+                pos = sorted(set(y.tolist()))[0]
+            p = np.asarray(t.col(score_col), np.float64)
+        else:
+            detail_col = self.get(self.PREDICTION_DETAIL_COL)
+            if not detail_col:
+                raise AkIllegalDataException(
+                    "binary eval needs predictionDetailCol or "
+                    "predictionScoreCol")
+            # ONE json parse for the whole column (C loop) instead of a
+            # python-loop of per-row loads
+            details = json.loads(
+                "[" + ",".join(t.col(detail_col)) + "]"
+            ) if t.num_rows else []
+            labels = sorted({k for d in details for k in d})
+            if len(labels) != 2:
+                raise AkIllegalDataException(
+                    f"binary eval needs 2 labels, got {labels}")
+            pos = self.get(self.POS_LABEL_VAL_STR) or labels[0]
+            p = np.asarray([d.get(pos, 0.0) for d in details], np.float64)
         yb = (y == pos).astype(np.int64)
 
         n_pos, n_neg = yb.sum(), (1 - yb).sum()

@@ -117,3 +117,28 @@ def test_agnes_linkages():
     for linkage in ("MIN", "MAX", "AVERAGE"):
         out = AgnesBatchOp(k=2, linkage=linkage).link_from(src).collect()
         assert _cluster_purity(out.col("pred"), 15, 2) == 1.0
+
+
+def test_geo_kmeans_haversine():
+    from alink_tpu.operator.batch import (GeoKMeansPredictBatchOp,
+                                          GeoKMeansTrainBatchOp)
+
+    rng = np.random.default_rng(7)
+    # two city clusters on either side of the antimeridian: euclidean on raw
+    # degrees splits them wrongly, haversine keeps each city together
+    tokyo = [(35.7 + rng.normal(0, 0.1), 139.7 + rng.normal(0, 0.1))
+             for _ in range(20)]
+    fiji_east = [(-17.8 + rng.normal(0, 0.1), 179.9 + rng.normal(0, 0.03))
+                 for _ in range(10)]
+    fiji_west = [(-17.8 + rng.normal(0, 0.1), -179.9 + rng.normal(0, 0.03))
+                 for _ in range(10)]
+    rows = [(float(a), float(b)) for a, b in tokyo + fiji_east + fiji_west]
+    src = MemSourceBatchOp(rows, "lat double, lon double")
+    model = GeoKMeansTrainBatchOp(latitudeCol="lat", longitudeCol="lon",
+                                  k=2).link_from(src)
+    out = GeoKMeansPredictBatchOp().link_from(model, src).collect()
+    labels = np.asarray(out.col("pred"))
+    assert len(set(labels[:20].tolist())) == 1          # tokyo together
+    # both fiji halves land in the SAME cluster despite the lon wrap
+    assert set(labels[20:30].tolist()) == set(labels[30:40].tolist())
+    assert labels[0] != labels[20]
