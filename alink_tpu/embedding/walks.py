@@ -109,3 +109,121 @@ def node2vec_walks(
             walks[wi, t] = nxt
             prev, cur = cur, nxt
     return walks
+
+
+def metapath_walks(
+    indptr: np.ndarray,
+    indices: np.ndarray,
+    node_types: np.ndarray,
+    metapath: "list[str]",
+    num_walks: int,
+    seed: int = 0,
+) -> np.ndarray:
+    """Metapath-constrained random walks over a heterogeneous graph
+    (reference: operator/batch/graph/MetaPathWalkBatchOp +
+    huge/impl/MetaPath2VecImpl — HeteGraphEngine typed walks).
+
+    ``node_types[v]`` is the type tag of vertex v; ``metapath`` like
+    ["user", "item", "user"] constrains each step's target type; walks cycle
+    the path (len = num_walks of full path traversals rooted at every vertex
+    whose type matches metapath[0]). Unreachable steps truncate the walk
+    (padded with -1)."""
+    rng = np.random.default_rng(seed)
+    n = indptr.shape[0] - 1
+    walk_len = len(metapath)
+    starts = np.flatnonzero(np.asarray(node_types, object).astype(str)
+                            == str(metapath[0]))
+    walks = []
+    types = np.asarray(node_types, object).astype(str)
+    for _ in range(num_walks):
+        for v0 in starts:
+            walk = [v0]
+            cur = v0
+            for hop in range(1, walk_len):
+                lo, hi = indptr[cur], indptr[cur + 1]
+                nbrs = indices[lo:hi]
+                typed = nbrs[types[nbrs] == str(metapath[hop])]
+                if typed.size == 0:
+                    break
+                cur = int(typed[rng.integers(typed.size)])
+                walk.append(cur)
+            walks.append(walk + [-1] * (walk_len - len(walk)))
+    return np.asarray(walks, np.int64)
+
+
+def line_embeddings(
+    src: np.ndarray,
+    dst: np.ndarray,
+    num_nodes: int,
+    dim: int = 64,
+    order: int = 2,
+    num_negatives: int = 5,
+    num_steps: int = 2000,
+    batch_size: int = 512,
+    learning_rate: float = 0.025,
+    seed: int = 0,
+) -> np.ndarray:
+    """LINE first/second-order proximity embeddings (reference:
+    operator/batch/graph/LineBatchOp + huge LINE impl).
+
+    One jit: fori_loop over edge mini-batches; each step samples negatives,
+    computes the LINE objective gradient, and scatter-adds updates — the
+    same device pattern as SGNS (order=2 uses a context table)."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(seed)
+    E = src.shape[0]
+    if E == 0:
+        return ((rng.random((num_nodes, dim)) - 0.5) / dim).astype(np.float32)
+    edges = np.stack([src, dst], axis=1).astype(np.int32)
+    edges = edges[rng.permutation(E)]
+    # a batch larger than the edge set would tile duplicates into one
+    # scatter-add step (multiplying the effective learning rate) — clamp
+    batch_size = min(batch_size, E)
+    total = ((E + batch_size - 1) // batch_size) * batch_size
+    edges = np.resize(edges, (total, 2))  # cyclic tile up to a full batch
+    n_batches = edges.shape[0] // batch_size
+
+    emb0 = ((rng.random((num_nodes, dim)) - 0.5) / dim).astype(np.float32)
+    ctx0 = np.zeros((num_nodes, dim), np.float32)
+    key0 = jax.random.PRNGKey(seed)
+
+    @jax.jit
+    def run(edges_d, emb, ctx):
+        def step(s, carry):
+            emb, ctx = carry
+            lr = learning_rate * jnp.maximum(
+                0.0001, 1.0 - s.astype(jnp.float32) / num_steps)
+            b = jnp.mod(s, n_batches)
+            blk = jax.lax.dynamic_slice_in_dim(
+                edges_d, b * batch_size, batch_size, 0)
+            u, v = blk[:, 0], blk[:, 1]
+            key = jax.random.fold_in(key0, s)
+            neg = jax.random.randint(
+                key, (batch_size, num_negatives), 0, num_nodes)
+            target = ctx if order == 2 else emb
+            eu = emb[u]
+            pv = target[v]
+            nv = target[neg]                                  # (B, N, D)
+            s_pos = jax.nn.sigmoid((eu * pv).sum(-1))
+            s_neg = jax.nn.sigmoid(jnp.einsum("bd,bnd->bn", eu, nv))
+            g_pos = (s_pos - 1.0)[:, None]
+            g_neg = s_neg[..., None]
+            grad_u = g_pos * pv + (g_neg * nv).sum(1)
+            emb = emb.at[u].add(-lr * grad_u)
+            upd_pos = g_pos * eu
+            upd_neg = (g_neg * eu[:, None, :]).reshape(-1, dim)
+            if order == 2:
+                ctx = ctx.at[v].add(-lr * upd_pos)
+                ctx = ctx.at[neg.reshape(-1)].add(-lr * upd_neg)
+            else:
+                emb = emb.at[v].add(-lr * upd_pos)
+                emb = emb.at[neg.reshape(-1)].add(-lr * upd_neg)
+            return emb, ctx
+
+        return jax.lax.fori_loop(0, num_steps, step, (emb, ctx))
+
+    emb, _ = jax.device_get(run(jnp.asarray(edges), jnp.asarray(emb0),
+                                jnp.asarray(ctx0)))
+    return np.asarray(emb)

@@ -352,6 +352,9 @@ from ..sqlengine import (
 )
 from .huge import (
     DeepWalkBatchOp,
+    LineBatchOp,
+    MetaPath2VecBatchOp,
+    MetaPathWalkBatchOp,
     DeepWalkEmbeddingBatchOp,
     Node2VecEmbeddingBatchOp,
     Node2VecWalkBatchOp,

@@ -138,3 +138,63 @@ def test_uniform_walk_fast_path():
     start_w = {int(r[0]): r for r in walk_w}
     np.testing.assert_array_equal(start_u[0], np.arange(10))
     np.testing.assert_array_equal(start_w[0], np.arange(10))
+
+
+def test_metapath_walks_respect_types():
+    from alink_tpu.operator.batch import MemSourceBatchOp, MetaPathWalkBatchOp
+
+    edges = MemSourceBatchOp(
+        [("u1", "i1"), ("u2", "i1"), ("u1", "i2"), ("u2", "u1")],
+        "source string, target string")
+    types = MemSourceBatchOp(
+        [("u1", "user"), ("u2", "user"), ("i1", "item"), ("i2", "item")],
+        "vertex string, type string")
+    out = MetaPathWalkBatchOp(
+        sourceCol="source", targetCol="target", metaPath="user-item-user",
+        walkNum=4, randomSeed=0).link_from(edges, types).collect()
+    for path in out.col("path"):
+        toks = path.split()
+        assert toks[0].startswith("u")
+        if len(toks) > 1:
+            assert toks[1].startswith("i")    # middle hop must be an item
+        if len(toks) > 2:
+            assert toks[2].startswith("u")
+
+
+def test_metapath2vec_end_to_end():
+    from alink_tpu.operator.batch import MemSourceBatchOp, MetaPath2VecBatchOp
+
+    edges = [("u%d" % (i % 4), "i%d" % (i % 3)) for i in range(24)]
+    types = [("u%d" % i, "user") for i in range(4)] + \
+            [("i%d" % i, "item") for i in range(3)]
+    out = MetaPath2VecBatchOp(
+        sourceCol="source", targetCol="target", metaPath="user-item-user",
+        walkNum=20, vectorSize=8, numIter=2, randomSeed=1).link_from(
+        MemSourceBatchOp(edges, "source string, target string"),
+        MemSourceBatchOp(types, "vertex string, type string")).collect()
+    assert out.num_rows >= 5
+    assert out.col("vec")[0].data.shape == (8,)
+
+
+def test_line_embeddings_cluster_structure():
+    from alink_tpu.operator.batch import LineBatchOp, MemSourceBatchOp
+
+    # two cliques: LINE should embed intra-clique nodes closer
+    pairs = []
+    for grp in (["a1", "a2", "a3", "a4"], ["b1", "b2", "b3", "b4"]):
+        for i in range(4):
+            for j in range(i + 1, 4):
+                pairs.append((grp[i], grp[j]))
+    pairs.append(("a1", "b1"))
+    src = MemSourceBatchOp(pairs, "source string, target string")
+    out = LineBatchOp(sourceCol="source", targetCol="target", vectorSize=16,
+                      numSteps=1500, randomSeed=2, order=2).link_from(src) \
+        .collect()
+    emb = {w: v.data for w, v in zip(out.col("word"), out.col("vec"))}
+
+    def cos(a, b):
+        return float(a @ b / (np.linalg.norm(a) * np.linalg.norm(b) + 1e-12))
+
+    intra = cos(emb["a2"], emb["a3"])
+    inter = cos(emb["a2"], emb["b3"])
+    assert intra > inter
