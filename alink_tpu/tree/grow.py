@@ -44,16 +44,28 @@ def _level_fn(mesh_key, num_nodes: int, num_bins: int, l2: float,
     axis = AXIS_DATA
     L, B = num_nodes, num_bins
 
+    from .pallas_hist import interpret_mode, pallas_histogram, use_pallas_hist
+
+    pallas_on = use_pallas_hist()
+    interp = interpret_mode()
+
     def body(bins, g, h, c, node, fmask):
         d = bins.shape[1]
         ids = node[:, None] * B + bins  # (n, d) in [0, L*B)
 
-        def seg(vals):  # (n,) -> (d, L*B) -> (L, d, B)
-            out = jax.vmap(
-                lambda col: jax.ops.segment_sum(vals, col, num_segments=L * B),
-                in_axes=1,
-            )(ids)
-            return out.reshape(d, L, B).transpose(1, 0, 2)
+        if pallas_on:
+            def seg(vals):  # pallas VMEM-resident histogram (pallas_hist.py)
+                flat = pallas_histogram(ids, vals, num_segments=L * B,
+                                        interpret=interp)   # (L*B, d)
+                return flat.reshape(L, B, d).transpose(0, 2, 1)
+        else:
+            def seg(vals):  # (n,) -> (d, L*B) -> (L, d, B)
+                out = jax.vmap(
+                    lambda col: jax.ops.segment_sum(
+                        vals, col, num_segments=L * B),
+                    in_axes=1,
+                )(ids)
+                return out.reshape(d, L, B).transpose(1, 0, 2)
 
         hg = jax.lax.psum(seg(g), axis)
         hh = jax.lax.psum(seg(h), axis)
