@@ -216,6 +216,7 @@ def train_model(
                 step = int(extra.get("step", 0))
                 start_epoch = int(extra.get("epoch", -1)) + 1
     t_start = _time.perf_counter()
+    start_step = step   # resume restores the global counter; rate uses deltas
     for epoch in range(start_epoch, cfg.num_epochs):
         order = rng.permutation(n_train)
         if n_train < bs:  # tile tiny datasets up to one full batch
@@ -247,8 +248,9 @@ def train_model(
             lv = float(l)
             history["loss"].append(lv)
             elapsed = _time.perf_counter() - t_start
-            _metrics.record("dl.train", step=step, loss=lv,
-                            samples_per_sec=step * bs / max(elapsed, 1e-9))
+            _metrics.record(
+                "dl.train", step=step, loss=lv,
+                samples_per_sec=(step - start_step) * bs / max(elapsed, 1e-9))
 
         if ckpt is not None:
             ckpt.save(step, jax.device_get(params), jax.device_get(opt_state),

@@ -58,11 +58,18 @@ def _native():
 
 
 def write_records(path: str, payloads: Iterable[bytes]):
-    payloads = list(payloads)
     nat = _native()
     if nat is not None:
+        # frame in bounded chunks so generator inputs stream to disk
+        chunk: List[bytes] = []
         with open(path, "wb") as f:
-            f.write(nat.frame_records([bytes(p) for p in payloads]))
+            for payload in payloads:
+                chunk.append(bytes(payload))
+                if len(chunk) >= 1024:
+                    f.write(nat.frame_records(chunk))
+                    chunk.clear()
+            if chunk:
+                f.write(nat.frame_records(chunk))
         return
     with open(path, "wb") as f:
         for payload in payloads:

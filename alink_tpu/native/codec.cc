@@ -119,7 +119,7 @@ static PyObject* py_unframe_records(PyObject* self, PyObject* args) {
     uint32_t hcrc;
     memcpy(&hcrc, p + 8, 4);
     if (hcrc != masked(crc32c_raw(p, 8, 0)) ||
-        (Py_ssize_t)(16 + len) > remaining) {
+        len > (uint64_t)(remaining - 16)) {  // unsigned compare: no overflow
       Py_DECREF(out);
       PyBuffer_Release(&view);
       PyErr_SetString(PyExc_ValueError, "TFRecord corrupt length crc");

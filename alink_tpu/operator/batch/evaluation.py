@@ -99,10 +99,14 @@ class EvalBinaryClassBatchOp(BaseEvalBatchOp):
         y = np.asarray([str(v) for v in t.col(self.get(self.LABEL_COL))])
         score_col = self.get(self.PREDICTION_SCORE_COL)
         if score_col:
-            # JSON-free fast path for large tables
+            # JSON-free fast path for large tables. A bare score column
+            # carries no label orientation, so guessing the positive class
+            # would silently invert AUC — require it explicitly.
             pos = self.get(self.POS_LABEL_VAL_STR)
             if pos is None:
-                pos = sorted(set(y.tolist()))[0]
+                raise AkIllegalDataException(
+                    "predictionScoreCol needs positiveLabelValueString (the "
+                    "label whose probability the score column holds)")
             p = np.asarray(t.col(score_col), np.float64)
         else:
             detail_col = self.get(self.PREDICTION_DETAIL_COL)

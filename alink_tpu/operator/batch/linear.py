@@ -187,10 +187,15 @@ class BaseLinearModelTrainBatchOp(ModelTrainOpMixin, BatchOperator,
         if vec_col:
             from ...common.linalg import SparseVector, parse_vector
 
-            parsed = [parse_vector(v) for v in t.col(vec_col)]
-            if parsed and all(isinstance(p, SparseVector) for p in parsed):
-                # huge-sparse path: ELL block, no densification
-                return self._execute_sparse(t, parsed, label_col, weight_col)
+            col = t.col(vec_col)
+            # probe the first cell before parsing the whole column — dense
+            # input must not pay a full throwaway parse
+            if len(col) and isinstance(parse_vector(col[0]), SparseVector):
+                parsed = [parse_vector(v) for v in col]
+                if all(isinstance(p, SparseVector) for p in parsed):
+                    # huge-sparse path: ELL block, no densification
+                    return self._execute_sparse(t, parsed, label_col,
+                                                weight_col)
             feature_cols = None
             X = t.to_numeric_block([vec_col], dtype=np.float32)
         else:

@@ -133,7 +133,11 @@ class XGBoostModelMapper(RichModelMapper):
         if objective.startswith("binary"):
             probs = np.stack([1 - raw, raw], axis=1)
         elif objective.startswith("multi"):
-            probs = raw if raw.ndim == 2 else None
+            if raw.ndim == 2:       # multi:softprob
+                probs = raw
+            else:                   # multi:softmax emits class indices
+                k = len(self.meta["labels"])
+                probs = np.eye(k, dtype=np.float64)[raw.astype(np.int64)]
         else:
             return raw.astype(np.float64), AlinkTypes.DOUBLE, None
         labels = self.meta["labels"]

@@ -138,6 +138,30 @@ class SqlQueryBatchOp(BatchOperator):
         finally:
             conn.close()
 
+    def _out_schema(self, *in_schemas) -> TableSchema:
+        # probe the query over ONE dummy typed row per input: a zero-row
+        # sqlite result carries no value types and would mis-derive the
+        # static schema as all-STRING
+        def dummy(schema: TableSchema) -> MTable:
+            cols = {}
+            for n, tp in zip(schema.names, schema.types):
+                if tp in (AlinkTypes.DOUBLE, AlinkTypes.FLOAT):
+                    cols[n] = np.asarray([0.0])
+                elif tp in (AlinkTypes.LONG, AlinkTypes.INT,
+                            AlinkTypes.BOOLEAN):
+                    cols[n] = np.asarray([0], np.int64)
+                elif AlinkTypes.is_vector(tp):
+                    cols[n] = np.asarray(["0.0"], object)
+                else:
+                    cols[n] = np.asarray([""], object)
+            return MTable(cols, TableSchema(
+                list(schema.names),
+                [tp if not AlinkTypes.is_vector(tp) else AlinkTypes.STRING
+                 for tp in schema.types]))
+
+        return self._execute_impl(
+            *[dummy(s) for s in in_schemas]).schema
+
 
 class JdbcSourceBatchOp(BatchOperator):
     """Read a table (or query) from a sqlite database file (reference:

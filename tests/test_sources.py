@@ -109,3 +109,33 @@ def test_text_and_tsv(tmp_path):
         .link_from().collect()
     assert list(out2.col("id")) == [1, 2]
     assert list(out2.col("s")) == ["a b", "c"]
+
+
+def test_write_records_streams_generators(tmp_path):
+    p = str(tmp_path / "gen.tfrecord")
+
+    def gen():
+        for i in range(2500):     # crosses the native chunk boundary
+            yield f"rec{i}".encode()
+
+    write_records(p, gen())
+    out = read_records(p)
+    assert len(out) == 2500
+    assert out[0] == b"rec0" and out[-1] == b"rec2499"
+
+
+def test_native_rejects_huge_length_field(tmp_path):
+    from alink_tpu.io.tfrecord import _masked_crc
+    from alink_tpu.native import load
+
+    nat = load()
+    if nat is None:
+        import pytest
+        pytest.skip("native toolchain unavailable")
+    import struct
+    # crafted header: length 2^64-8 with a VALID header crc
+    header = struct.pack("<Q", (1 << 64) - 8)
+    blob = header + struct.pack("<I", _masked_crc(header)) + b"xxxx"
+    import pytest
+    with pytest.raises(ValueError):
+        nat.unframe_records(blob)

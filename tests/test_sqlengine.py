@@ -69,3 +69,12 @@ def test_jdbc_roundtrip_and_catalog(tmp_path):
     assert list(out2.col("s")) == ["b"]
     cat.drop_table("stuff")
     assert cat.list_tables() == []
+
+
+def test_sql_query_static_schema_typed():
+    src = MemSourceBatchOp([(1, 2.5)], "a bigint, v double")
+    op = SqlQueryBatchOp(query="SELECT a + 1 AS b, v * 2 AS w FROM t") \
+        .link_from(src)
+    # static schema (no execution of the real data) carries real types
+    assert op.schema.type_of("b") == "LONG"
+    assert op.schema.type_of("w") == "DOUBLE"
