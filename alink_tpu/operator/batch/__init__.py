@@ -163,6 +163,8 @@ from .outlier import (
     LofOutlierBatchOp,
     MadOutlier4GroupedDataBatchOp,
     MadOutlierBatchOp,
+    OcsvmOutlierBatchOp,
+    SosOutlierBatchOp,
     ShEsdOutlier4GroupedDataBatchOp,
     ShEsdOutlierBatchOp,
 )

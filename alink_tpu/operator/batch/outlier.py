@@ -212,6 +212,29 @@ class IForestOutlierBatchOp(_MultivariateOutlierOp):
                        self.get(self.RANDOM_SEED))
 
 
+class SosOutlierBatchOp(_MultivariateOutlierOp):
+    """(reference: SosOutlierBatchOp.java)"""
+
+    PERPLEXITY = ParamInfo("perplexity", float, default=4.5)
+
+    def _score(self, X):
+        from ...outlier import sos
+
+        return sos(X, self.get(self.PERPLEXITY))
+
+
+class OcsvmOutlierBatchOp(_MultivariateOutlierOp):
+    """(reference: OcsvmOutlierBatchOp.java)"""
+
+    NU = ParamInfo("nu", float, default=0.1)
+    GAMMA = ParamInfo("gamma", float)
+
+    def _score(self, X):
+        from ...outlier import ocsvm
+
+        return ocsvm(X, nu=self.get(self.NU), gamma=self.get(self.GAMMA))
+
+
 class EcodOutlierBatchOp(_MultivariateOutlierOp):
     """(reference: EcodOutlierBatchOp.java)"""
 

@@ -17,6 +17,8 @@ from .modelpredict import (
     StableHloModelPredictStreamOp,
     TorchModelPredictStreamOp,
 )
+from . import outlier as _outlier_stream
+from .outlier import *  # noqa: F401,F403 — stream outlier twins
 from . import generated as _generated
 from .generated import *  # noqa: F401,F403 — stream twins of mapper ops
 from .onlinelearning import (
@@ -40,4 +42,4 @@ __all__ = [
     "BinaryClassModelFilterStreamOp",
     "FtrlPredictStreamOp",
     "FtrlTrainStreamOp",
-] + list(_generated.__all__)
+] + list(_generated.__all__) + list(_outlier_stream.__all__)

@@ -1,0 +1,56 @@
+"""Streaming outlier detection: per-micro-batch (windowed) scoring.
+
+Capability parity with the reference's 25 stream outlier ops (reference:
+operator/stream/outlier/KSigmaOutlierStreamOp.java, BoxPlotOutlierStreamOp,
+... — each scores records over a sliding window). In the micro-batch
+runtime every chunk IS the window: each stream twin applies its batch
+detector to the current chunk."""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+from ...common.mtable import MTable
+from ...common.params import ParamInfo
+from .base import StreamOperator
+
+__all__ = []
+
+
+def _make_twin(batch_cls):
+    def _stream_impl(self, it: Iterator[MTable]) -> Iterator[MTable]:
+        for chunk in it:
+            op = batch_cls(self.get_params().clone())
+            yield op._execute_impl(chunk)
+
+    attrs = {
+        "_min_inputs": 1,
+        "_max_inputs": 1,
+        "_stream_impl": _stream_impl,
+        "__doc__": (f"Stream twin of {batch_cls.__name__}: each micro-batch "
+                    f"is the detection window (reference: the matching "
+                    f"operator/stream/outlier wrapper)."),
+        "__module__": __name__,
+    }
+    for attr, v in vars(batch_cls).items():
+        if isinstance(v, ParamInfo):
+            attrs[attr] = v
+    for base in batch_cls.__mro__[1:]:
+        for attr, v in vars(base).items():
+            if isinstance(v, ParamInfo) and attr not in attrs:
+                attrs[attr] = v
+    name = batch_cls.__name__.replace("BatchOp", "StreamOp")
+    return name, type(name, (StreamOperator,), attrs)
+
+
+def _generate():
+    from ..batch import outlier as batch_outlier
+
+    for attr in dir(batch_outlier):
+        if attr.endswith("OutlierBatchOp") and not attr.startswith("_"):
+            name, cls = _make_twin(getattr(batch_outlier, attr))
+            globals()[name] = cls
+            __all__.append(name)
+
+
+_generate()

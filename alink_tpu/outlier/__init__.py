@@ -11,10 +11,12 @@ from .detectors import (
     ksigma,
     lof,
     mad,
+    ocsvm,
     shesd,
+    sos,
 )
 
 __all__ = [
     "boxplot", "copod", "ecod", "esd", "hbos", "iforest", "kde",
-    "ksigma", "lof", "mad", "shesd",
+    "ksigma", "lof", "mad", "ocsvm", "shesd", "sos",
 ]

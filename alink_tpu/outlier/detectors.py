@@ -303,3 +303,107 @@ def iforest(X: Arr, num_trees: int = 100, subsample: int = 256,
     e_path = path / num_trees
     score = 2.0 ** (-e_path / max(_avg_path(psi), 1e-12))
     return score, score > 0.6
+
+
+def sos(X: Arr, perplexity: float = 4.5) -> Tuple[Arr, Arr]:
+    """Stochastic Outlier Selection (reference: common/outlier/SosDetector):
+    adaptive-bandwidth affinities (binary search to the target perplexity),
+    binding probabilities, outlier probability = prod(1 - b_ji)."""
+    n = X.shape[0]
+    if n < 3:
+        return np.zeros(n), np.zeros(n, bool)
+    d2 = _pairwise_sq_dists(np.asarray(X, np.float32)).astype(np.float64)
+    np.fill_diagonal(d2, np.inf)
+    target = np.log(min(perplexity, n - 1))
+    beta = np.ones(n)
+    # per-point binary search on precision so each row's entropy == target
+    for i in range(n):
+        lo, hi = 0.0, np.inf
+        for _ in range(50):
+            a = np.exp(-beta[i] * d2[i])
+            s = a.sum()
+            if s <= 0:
+                beta[i] /= 2.0
+                continue
+            p = a / s
+            ent = -(p[p > 0] * np.log(p[p > 0])).sum()
+            if abs(ent - target) < 1e-5:
+                break
+            if ent > target:
+                lo = beta[i]
+                beta[i] = beta[i] * 2 if hi == np.inf else (beta[i] + hi) / 2
+            else:
+                hi = beta[i]
+                beta[i] = (lo + beta[i]) / 2
+        else:
+            pass
+    A = np.exp(-beta[:, None] * d2)
+    B = A / np.maximum(A.sum(axis=1, keepdims=True), 1e-300)  # binding probs
+    with np.errstate(divide="ignore"):
+        log1m = np.log(np.maximum(1.0 - B, 1e-300))
+    prob = np.exp(log1m.sum(axis=0) - np.diag(log1m))  # prod over j != i
+    return prob, prob > 0.5
+
+
+def ocsvm(X: Arr, nu: float = 0.1, gamma: Optional[float] = None,
+          num_features: int = 256, num_steps: int = 400,
+          seed: int = 0) -> Tuple[Arr, Arr]:
+    """One-class SVM via Nyström RBF features (reference:
+    common/outlier/OcsvmDetector — the exact-kernel SMO solver; here the RBF
+    kernel is approximated with Nyström landmarks — unlike random Fourier
+    features these DECAY away from the data, so far outliers score outside —
+    and the primal one-class problem
+    min ½‖w‖² − ρ + 1/(νn)·Σ max(0, ρ − w·z(x)) solves on device)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    X = np.asarray(X, np.float32)
+    n, d = X.shape
+    if gamma is None:
+        gamma = 1.0 / max(d, 1)
+    rng = np.random.default_rng(seed)
+    m = min(num_features, n)
+    landmarks = X[rng.choice(n, m, replace=False)]
+
+    def _rbf(A, B):
+        d2 = ((A[:, None, :] - B[None, :, :]) ** 2).sum(-1)
+        return np.exp(-gamma * d2)
+
+    K_mm = _rbf(landmarks, landmarks) + 1e-6 * np.eye(m)
+    evals, evecs = np.linalg.eigh(K_mm)
+    evals = np.maximum(evals, 1e-8)
+    whiten = (evecs / np.sqrt(evals)).astype(np.float32)   # K_mm^{-1/2}
+
+    def featurize(x):
+        return (_rbf(np.asarray(x, np.float32), landmarks) @ whiten) \
+            .astype(np.float32)
+
+    Z = jnp.asarray(featurize(X))
+
+    def loss(params):
+        w, rho = params["w"], params["rho"]
+        margins = Z @ w
+        hinge = jnp.maximum(0.0, rho - margins).mean() / max(nu, 1e-6)
+        return 0.5 * (w @ w) - rho + hinge
+
+    opt = optax.adam(0.05)
+
+    @jax.jit
+    def fit():
+        params = {"w": jnp.zeros(m), "rho": jnp.asarray(0.0)}
+        state = opt.init(params)
+
+        def body(_, carry):
+            p, s = carry
+            g = jax.grad(loss)(p)
+            upd, s = opt.update(g, s)
+            return optax.apply_updates(p, upd), s
+
+        p, _ = jax.lax.fori_loop(0, num_steps, body, (params, state))
+        return p
+
+    p = jax.device_get(fit())
+    w, rho = np.asarray(p["w"]), float(p["rho"])
+    score = rho - featurize(X) @ w          # >0 = outside the boundary
+    return score, score > 0

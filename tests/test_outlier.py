@@ -8,6 +8,7 @@ import pytest
 
 from alink_tpu.common.mtable import AlinkTypes, MTable
 from alink_tpu.operator.batch import (
+    MemSourceBatchOp,
     BoxPlotOutlierBatchOp,
     CopodOutlierBatchOp,
     EcodOutlierBatchOp,
@@ -170,3 +171,52 @@ def test_lof_single_row():
         featureCols=["a", "b"], predictionCol="o"
     ).link_from(TableSourceBatchOp(t)).collect()
     assert not out.col("o")[0]
+
+
+def test_sos_and_ocsvm_detectors():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(80, 2)).astype(np.float32)
+    X[-1] = [8.0, 8.0]   # planted outlier
+    from alink_tpu.outlier import ocsvm, sos
+
+    s_scores, s_flags = sos(X)
+    assert s_scores[-1] > np.median(s_scores[:-1])
+    assert s_flags[-1]
+    o_scores, o_flags = ocsvm(X, nu=0.05)
+    assert o_scores[-1] > np.median(o_scores[:-1])
+    assert o_flags[-1]
+
+
+def test_sos_ocsvm_batch_ops():
+    from alink_tpu.operator.batch import (OcsvmOutlierBatchOp,
+                                          SosOutlierBatchOp)
+
+    rng = np.random.default_rng(1)
+    rows = [tuple(map(float, rng.normal(size=2))) for _ in range(60)]
+    rows.append((9.0, 9.0))
+    src = MemSourceBatchOp(rows, "x double, y double")
+    for op_cls in (SosOutlierBatchOp, OcsvmOutlierBatchOp):
+        out = op_cls(featureCols=["x", "y"]).link_from(src).collect()
+        flags = np.asarray(out.col("pred"))
+        assert flags[-1]
+
+
+def test_outlier_stream_twins():
+    from alink_tpu.operator.stream import TableSourceStreamOp
+    from alink_tpu.operator.stream.outlier import (BoxPlotOutlierStreamOp,
+                                                   KSigmaOutlierStreamOp)
+    from alink_tpu.common.mtable import MTable
+
+    rng = np.random.default_rng(2)
+    vals = rng.normal(size=100)
+    vals[10] = 40.0
+    vals[60] = -35.0
+    t = MTable({"v": vals})
+    src = TableSourceStreamOp(t, chunkSize=50)
+    out = KSigmaOutlierStreamOp(selectedCol="v", k=3.0).link_from(src) \
+        .collect()
+    flags = np.asarray(out.col("pred"))
+    assert flags[10] and flags[60]
+    assert flags.sum() <= 4
+    out2 = BoxPlotOutlierStreamOp(selectedCol="v").link_from(src).collect()
+    assert np.asarray(out2.col("pred"))[10]
