@@ -125,6 +125,7 @@ from .linear import (
 )
 from .regression import (
     AftSurvivalRegPredictBatchOp,
+    StepwiseLinearRegTrainBatchOp,
     AftSurvivalRegTrainBatchOp,
     GlmPredictBatchOp,
     GlmTrainBatchOp,
@@ -217,6 +218,9 @@ from .feature2 import (
 )
 from .dataproc import (
     ImputerPredictBatchOp,
+    RebalanceBatchOp,
+    StratifiedSampleBatchOp,
+    WeightSampleBatchOp,
     ImputerTrainBatchOp,
     JsonValueBatchOp,
     LookupBatchOp,
@@ -295,6 +299,8 @@ from .similarity import (
 )
 from .nlp import (
     DocCountVectorizerPredictBatchOp,
+    DocHashCountVectorizerPredictBatchOp,
+    DocHashCountVectorizerTrainBatchOp,
     DocCountVectorizerTrainBatchOp,
     DocWordCountBatchOp,
     KeywordsExtractionBatchOp,

@@ -400,3 +400,77 @@ class TypeConvertMapper(Mapper, HasSelectedCols, HasReservedCols):
 class TypeConvertBatchOp(MapBatchOp, HasSelectedCols, HasReservedCols):
     mapper_cls = TypeConvertMapper
     TARGET_TYPE = TypeConvertMapper.TARGET_TYPE
+
+
+class StratifiedSampleBatchOp(BatchOperator):
+    """Per-stratum sampling (reference: StratifiedSampleBatchOp.java —
+    strataRatio or per-value strataRatios 'a:0.1,b:0.5')."""
+
+    STRATA_COL = ParamInfo("strataCol", str, optional=False)
+    STRATA_RATIO = ParamInfo("strataRatio", float, default=-1.0)
+    STRATA_RATIOS = ParamInfo("strataRatios", str,
+                              desc="per-value ratios 'a:0.1,b:0.5'")
+    RANDOM_SEED = ParamInfo("randomSeed", int, default=0, aliases=("seed",))
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    def _execute_impl(self, t: MTable) -> MTable:
+        rng = np.random.default_rng(self.get(self.RANDOM_SEED))
+        strata = np.asarray(t.col(self.get(self.STRATA_COL)), object) \
+            .astype(str)
+        default = self.get(self.STRATA_RATIO)
+        per_value = {}
+        ratios_str = self.get(self.STRATA_RATIOS)
+        if ratios_str:
+            for part in ratios_str.split(","):
+                k, v = part.split(":")
+                per_value[k.strip()] = float(v)
+        keep = np.zeros(t.num_rows, bool)
+        for val in np.unique(strata):
+            ratio = per_value.get(val, default)
+            if ratio < 0:
+                raise AkIllegalArgumentException(
+                    f"no ratio for stratum {val!r} (set strataRatio or "
+                    f"strataRatios)")
+            rows = np.flatnonzero(strata == val)
+            n_keep = int(round(len(rows) * min(ratio, 1.0)))
+            keep[rng.choice(rows, n_keep, replace=False)] = True
+        return t.filter_mask(keep)
+
+
+class WeightSampleBatchOp(BatchOperator):
+    """Weighted sampling without replacement via exponential sort keys
+    (reference: WeightSampleBatchOp.java)."""
+
+    WEIGHT_COL = ParamInfo("weightCol", str, optional=False)
+    RATIO = ParamInfo("ratio", float, optional=False)
+    RANDOM_SEED = ParamInfo("randomSeed", int, default=0, aliases=("seed",))
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    def _execute_impl(self, t: MTable) -> MTable:
+        rng = np.random.default_rng(self.get(self.RANDOM_SEED))
+        w = np.asarray(t.col(self.get(self.WEIGHT_COL)), np.float64)
+        w = np.maximum(w, 1e-12)
+        n_keep = int(round(t.num_rows * min(self.get(self.RATIO), 1.0)))
+        # Efraimidis–Spirakis: keys u^(1/w); top-n_keep keys win
+        keys = rng.random(t.num_rows) ** (1.0 / w)
+        keep_idx = np.argsort(-keys)[:n_keep]
+        return t.take(np.sort(keep_idx))
+
+
+class RebalanceBatchOp(BatchOperator):
+    """Round-robin redistribution (reference: RebalanceBatchOp.java). The
+    columnar runtime has no skewed partitions to fix — this shuffles rows so
+    downstream row->shard striping is uniform."""
+
+    RANDOM_SEED = ParamInfo("randomSeed", int, default=0, aliases=("seed",))
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    def _execute_impl(self, t: MTable) -> MTable:
+        rng = np.random.default_rng(self.get(self.RANDOM_SEED))
+        return t.take(rng.permutation(t.num_rows))

@@ -414,3 +414,96 @@ class KeywordsExtractionBatchOp(BatchOperator):
 
     def _out_schema(self, in_schema):
         return _KEYWORDS_SCHEMA
+
+
+class DocHashCountVectorizerModelMapper(ModelMapper, HasSelectedCol,
+                                        HasOutputCol, HasReservedCols):
+    """Hashing-trick doc vectorizer serving (reference:
+    common/nlp/DocHashCountVectorizerModelMapper.java). Model carries the
+    IDF table over hash slots."""
+
+    FEATURE_TYPE = ParamInfo(
+        "featureType", str, default="WORD_COUNT",
+        validator=InValidator("TF", "IDF", "TF_IDF", "BINARY", "WORD_COUNT"))
+
+    def load_model(self, model: MTable):
+        self.meta, arrays = table_to_model(model)
+        self.idf = arrays["idf"]
+        return self
+
+    def output_schema(self, input_schema):
+        out = self.get(HasOutputCol.OUTPUT_COL) or "vec"
+        return self._append_result_schema(input_schema, [out],
+                                          [AlinkTypes.SPARSE_VECTOR])
+
+    def map_table(self, t: MTable) -> MTable:
+        from collections import Counter
+
+        from .feature2 import _hash32
+
+        out = self.get(HasOutputCol.OUTPUT_COL) or "vec"
+        col = self.get(HasSelectedCol.SELECTED_COL) or self.meta["selectedCol"]
+        ftype = self.get(self.FEATURE_TYPE)
+        m = self.meta["numFeatures"]
+        vecs = []
+        for doc in t.col(col):
+            counter = Counter(
+                _hash32(w) % m
+                for w in (str(doc).split() if doc is not None else []))
+            total = sum(counter.values()) or 1
+            idx, vals = [], []
+            for slot, c in counter.items():
+                if ftype == "WORD_COUNT":
+                    v = float(c)
+                elif ftype == "TF":
+                    v = c / total
+                elif ftype == "BINARY":
+                    v = 1.0
+                elif ftype == "IDF":
+                    v = float(self.idf[slot])
+                else:
+                    v = c / total * float(self.idf[slot])
+                idx.append(slot)
+                vals.append(v)
+            vecs.append(SparseVector(m, idx, vals))
+        return self._append_result(
+            t, {out: np.asarray(vecs, object)},
+            {out: AlinkTypes.SPARSE_VECTOR})
+
+
+class DocHashCountVectorizerTrainBatchOp(ModelTrainOpMixin, BatchOperator,
+                                         HasSelectedCol):
+    """(reference: DocHashCountVectorizerTrainBatchOp.java — IDF over hash
+    slots, no vocabulary table)."""
+
+    NUM_FEATURES = ParamInfo("numFeatures", int, default=1 << 18)
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    def _execute_impl(self, t: MTable) -> MTable:
+        from .feature2 import _hash32
+
+        m = int(self.get(self.NUM_FEATURES))
+        df = np.zeros(m, np.float64)
+        docs = [str(v).split() if v is not None else []
+                for v in t.col(self.get(HasSelectedCol.SELECTED_COL))]
+        for doc in docs:
+            for slot in {_hash32(w) % m for w in doc}:
+                df[slot] += 1
+        n_docs = max(len(docs), 1)
+        idf = np.log((1.0 + n_docs) / (1.0 + df))
+        meta = {"modelName": "DocHashCountVectorizerModel",
+                "selectedCol": self.get(HasSelectedCol.SELECTED_COL),
+                "numFeatures": m}
+        return model_to_table(meta, {"idf": idf})
+
+    def _static_meta_keys(self, in_schema):
+        return {"modelName": "DocHashCountVectorizerModel",
+                "numFeatures": self.get(self.NUM_FEATURES)}
+
+
+class DocHashCountVectorizerPredictBatchOp(ModelMapBatchOp, HasSelectedCol,
+                                           HasOutputCol, HasReservedCols):
+    mapper_cls = DocHashCountVectorizerModelMapper
+    FEATURE_TYPE = DocHashCountVectorizerModelMapper.FEATURE_TYPE

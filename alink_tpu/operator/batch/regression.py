@@ -423,3 +423,64 @@ class AftSurvivalRegModelMapper(RichModelMapper):
 class AftSurvivalRegPredictBatchOp(ModelMapBatchOp, HasPredictionCol,
                                    HasReservedCols):
     mapper_cls = AftSurvivalRegModelMapper
+
+
+class StepwiseLinearRegTrainBatchOp(ModelTrainOpMixin, BatchOperator,
+                                    HasFeatureCols):
+    """Forward-stepwise linear regression by AIC (reference:
+    operator/common/finance/stepwise + regression Stepwise ops): greedily
+    add the feature that lowers AIC most; stop when nothing improves. The
+    final model is a standard LinearModel over the selected columns."""
+
+    LABEL_COL = ParamInfo("labelCol", str, optional=False)
+    MAX_FEATURES = ParamInfo("maxFeatures", int, default=0,
+                             desc="0 = no cap")
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    def _static_meta_keys(self, in_schema):
+        return {"modelName": "LinearModel", "linearModelType": "LinearReg",
+                "labelType": in_schema.type_of(self.get(self.LABEL_COL))}
+
+    def _execute_impl(self, t: MTable) -> MTable:
+        from .linear import LinearRegTrainBatchOp
+
+        label_col = self.get(self.LABEL_COL)
+        candidates = list(self.get(HasFeatureCols.FEATURE_COLS) or
+                          resolve_feature_cols(t, self, exclude=[label_col]))
+        y = np.asarray(t.col(label_col), np.float64)
+        n = len(y)
+        cap = self.get(self.MAX_FEATURES) or len(candidates)
+
+        def aic(cols):
+            X = t.to_numeric_block(cols, dtype=np.float64)
+            Xb = np.concatenate([X, np.ones((n, 1))], axis=1)
+            beta, *_ = np.linalg.lstsq(Xb, y, rcond=None)
+            rss = float(((Xb @ beta - y) ** 2).sum())
+            k = len(cols) + 1
+            return n * np.log(max(rss / n, 1e-300)) + 2 * k
+
+        selected: list = []
+        best_aic = n * np.log(max(float(((y - y.mean()) ** 2).mean()),
+                                  1e-300)) + 2
+        improved = True
+        while improved and len(selected) < cap:
+            improved = False
+            best_col, best_val = None, best_aic
+            for c in candidates:
+                if c in selected:
+                    continue
+                val = aic(selected + [c])
+                if val < best_val - 1e-9:
+                    best_val, best_col = val, c
+            if best_col is not None:
+                selected.append(best_col)
+                best_aic = best_val
+                improved = True
+        if not selected:
+            selected = [candidates[0]]
+        trainer = LinearRegTrainBatchOp(featureCols=selected,
+                                        labelCol=label_col)
+        model = trainer._execute_impl(t)
+        return model

@@ -1,0 +1,81 @@
+"""Sampling ops, DocHashCountVectorizer, stepwise regression tests."""
+
+import numpy as np
+import pytest
+
+from alink_tpu.operator.batch import (
+    DocHashCountVectorizerPredictBatchOp,
+    DocHashCountVectorizerTrainBatchOp,
+    MemSourceBatchOp,
+    RebalanceBatchOp,
+    StepwiseLinearRegTrainBatchOp,
+    LinearRegPredictBatchOp,
+    StratifiedSampleBatchOp,
+    WeightSampleBatchOp,
+)
+
+
+def test_stratified_sample():
+    rows = [("a", float(i)) for i in range(100)] + \
+           [("b", float(i)) for i in range(50)]
+    src = MemSourceBatchOp(rows, "g string, v double")
+    out = StratifiedSampleBatchOp(strataCol="g",
+                                  strataRatios="a:0.1,b:0.5") \
+        .link_from(src).collect()
+    groups = np.asarray(out.col("g"))
+    assert (groups == "a").sum() == 10
+    assert (groups == "b").sum() == 25
+
+
+def test_weight_sample_biases_heavy_rows():
+    rng = np.random.default_rng(0)
+    rows = [(float(i), 100.0 if i < 10 else 0.01) for i in range(200)]
+    src = MemSourceBatchOp(rows, "id double, w double")
+    out = WeightSampleBatchOp(weightCol="w", ratio=0.1).link_from(src) \
+        .collect()
+    ids = np.asarray(out.col("id"))
+    assert out.num_rows == 20
+    assert (ids < 10).sum() >= 9     # heavy rows dominate the sample
+
+
+def test_rebalance_permutes():
+    rows = [(float(i),) for i in range(50)]
+    out = RebalanceBatchOp().link_from(
+        MemSourceBatchOp(rows, "v double")).collect()
+    assert sorted(out.col("v").tolist()) == [float(i) for i in range(50)]
+    assert out.col("v").tolist() != [float(i) for i in range(50)]
+
+
+def test_doc_hash_count_vectorizer():
+    train = MemSourceBatchOp([("x y",), ("y z",)], "txt string")
+    model = DocHashCountVectorizerTrainBatchOp(
+        selectedCol="txt", numFeatures=64).link_from(train)
+    out = DocHashCountVectorizerPredictBatchOp(
+        selectedCol="txt", outputCol="vec", featureType="TF_IDF") \
+        .link_from(model, MemSourceBatchOp([("x y unseen",)], "txt string")) \
+        .collect()
+    v = out.col("vec")[0]
+    assert v.n == 64
+    assert v.indices.size == 3   # x, y, unseen hash slots (idf still defined)
+
+
+def test_stepwise_selects_informative():
+    rng = np.random.default_rng(1)
+    n = 300
+    x1 = rng.normal(size=n)
+    x2 = rng.normal(size=n)
+    noise = [rng.normal(size=n) for _ in range(4)]
+    y = 3 * x1 - 2 * x2 + 0.05 * rng.normal(size=n)
+    cols = {"x1": x1, "x2": x2, "y": y}
+    for i, nz in enumerate(noise):
+        cols[f"n{i}"] = nz
+    from alink_tpu.common.mtable import MTable
+    from alink_tpu.operator.batch.base import TableSourceBatchOp
+
+    src = TableSourceBatchOp(MTable(cols))
+    model = StepwiseLinearRegTrainBatchOp(labelCol="y").link_from(src)
+    from alink_tpu.common.model import table_to_model
+    meta, arrays = table_to_model(model.collect())
+    assert set(meta["featureCols"]) == {"x1", "x2"}   # noise columns rejected
+    out = LinearRegPredictBatchOp().link_from(model, src).collect()
+    assert np.abs(np.asarray(out.col("pred")) - y).mean() < 0.1
