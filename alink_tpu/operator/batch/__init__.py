@@ -106,6 +106,8 @@ from .clustering2 import (
     KModesTrainBatchOp,
     LdaPredictBatchOp,
     LdaTrainBatchOp,
+    SomPredictBatchOp,
+    SomTrainBatchOp,
 )
 from .linear import (
     LassoRegPredictBatchOp,
@@ -215,6 +217,7 @@ from .feature2 import (
     PcaTrainBatchOp,
     QuantileDiscretizerPredictBatchOp,
     QuantileDiscretizerTrainBatchOp,
+    DCTBatchOp,
 )
 from .dataproc import (
     ImputerPredictBatchOp,

@@ -30,6 +30,17 @@ class BatchOperator(AlgoOperator):
 
         return self.lazy_collect(_stats)
 
+    def lazy_viz_statistics(self, file_path: str) -> "BatchOperator":
+        """Write a self-contained HTML stats page when this op executes
+        (reference: BatchOperator.lazyVizStatistics :675-686 — facets HTML
+        collapses to an inline-SVG page)."""
+
+        def _viz(t: MTable):
+            with open(file_path, "w") as f:
+                f.write(_stats_html(t))
+
+        return self.lazy_collect(_viz)
+
     def lazy_print_train_info(self, title=None) -> "BatchOperator":
         """Print the scalar training diagnostics of a model table
         (reference: BatchOperator.lazyPrintTrainInfo)."""
@@ -287,3 +298,47 @@ class FirstNBatchOp(BatchOperator):
 
     def _execute_impl(self, t: MTable) -> MTable:
         return t.head(self.get(self.SIZE))
+
+
+def _html_escape(s: str) -> str:
+    return (str(s).replace("&", "&amp;").replace("<", "&lt;")
+            .replace(">", "&gt;"))
+
+
+def _stats_html(t: "MTable") -> str:
+    """Self-contained HTML stats page: summary table + inline-SVG histograms
+    (reference: BatchOperator.lazyVizStatistics at :675-686 + the facets
+    templates under core/src/main/resources/html_viz/)."""
+    from ...stats.summarizer import summarize
+
+    summary = summarize(t)
+    parts = ["<html><head><meta charset='utf-8'><style>",
+             "body{font-family:sans-serif} table{border-collapse:collapse}",
+             "td,th{border:1px solid #999;padding:4px 8px}",
+             "</style></head><body><h2>Table statistics</h2>"]
+    st = summary.to_mtable()
+    parts.append("<table><tr>" + "".join(
+        f"<th>{_html_escape(n)}</th>" for n in st.names) + "</tr>")
+    for row in st.rows():
+        parts.append("<tr>" + "".join(
+            f"<td>{_html_escape(round(v, 5) if isinstance(v, float) else v)}"
+            f"</td>" for v in row) + "</tr>")
+    parts.append("</table><h2>Histograms</h2>")
+    for n, tp in zip(t.names, t.schema.types):
+        if not AlinkTypes.is_numeric(tp):
+            continue
+        arr = np.asarray(t.col(n), np.float64)
+        arr = arr[~np.isnan(arr)]
+        if arr.size == 0:
+            continue
+        hist, _ = np.histogram(arr, bins=20)
+        peak = max(hist.max(), 1)
+        bars = "".join(
+            f"<rect x='{i * 12}' y='{60 - 60 * h / peak}' width='10' "
+            f"height='{60 * h / peak}' fill='#48f'/>"
+            for i, h in enumerate(hist))
+        parts.append(
+            f"<div><b>{_html_escape(n)}</b><br>"
+            f"<svg width='240' height='60'>{bars}</svg></div>")
+    parts.append("</body></html>")
+    return "".join(parts)

@@ -691,3 +691,102 @@ class GroupDbscanBatchOp(BatchOperator, HasFeatureCols, HasPredictionCol,
         pred_col = self.get(HasPredictionCol.PREDICTION_COL)
         return TableSchema(list(in_schema.names) + [pred_col],
                            list(in_schema.types) + [AlinkTypes.LONG])
+
+
+def _som_fit(X: np.ndarray, xdim: int, ydim: int, num_steps: int,
+             sigma0: float, lr0: float, seed: int) -> np.ndarray:
+    """Batch SOM training as one jitted fori_loop (reference:
+    common/statistics/SomJni.java — pure-Java SOM despite the name).
+    Returns (xdim*ydim, d) unit weights."""
+    import jax
+    import jax.numpy as jnp
+
+    n, d = X.shape
+    u = xdim * ydim
+    gx, gy = np.meshgrid(np.arange(xdim), np.arange(ydim), indexing="ij")
+    grid = np.stack([gx.ravel(), gy.ravel()], axis=1).astype(np.float32)
+    rng = np.random.default_rng(seed)
+    w0 = X[rng.choice(n, u, replace=n < u)].astype(np.float32)
+    Xd = jnp.asarray(X, jnp.float32)
+    grid_d = jnp.asarray(grid)
+    batch = min(256, n)
+
+    @jax.jit
+    def fit(w0):
+        def step(s, w):
+            frac = s / num_steps
+            sigma = sigma0 * jnp.exp(-3.0 * frac) + 0.5
+            lr = lr0 * jnp.exp(-3.0 * frac) + 1e-3
+            start = (s * batch) % jnp.maximum(n - batch + 1, 1)
+            xb = jax.lax.dynamic_slice_in_dim(Xd, start, batch, 0)
+            d2 = ((xb[:, None, :] - w[None]) ** 2).sum(-1)   # (b, u)
+            bmu = jnp.argmin(d2, axis=1)
+            gd2 = ((grid_d[bmu][:, None, :] - grid_d[None]) ** 2).sum(-1)
+            h = jnp.exp(-gd2 / (2.0 * sigma * sigma))        # (b, u)
+            num = h.T @ xb                                   # (u, d)
+            den = h.sum(0)[:, None]
+            target = num / jnp.maximum(den, 1e-9)
+            blend = lr * jnp.minimum(den, 1.0)
+            return w + blend * (target - w)
+
+        return jax.lax.fori_loop(0, num_steps, step, w0)
+
+    return np.asarray(jax.device_get(fit(jnp.asarray(w0))))
+
+
+class SomTrainBatchOp(ModelTrainOpMixin, BatchOperator, HasVectorCol,
+                      HasFeatureCols):
+    """Self-organizing map (reference: operator/batch/statistics/
+    SomBatchOp.java + common/statistics/SomJni.java)."""
+
+    XDIM = ParamInfo("xdim", int, default=4, validator=MinValidator(1))
+    YDIM = ParamInfo("ydim", int, default=4, validator=MinValidator(1))
+    NUM_ITERS = ParamInfo("numIters", int, default=200)
+    SIGMA = ParamInfo("sigma", float, default=2.0)
+    LEARN_RATE = ParamInfo("learnRate", float, default=0.5)
+    RANDOM_SEED = ParamInfo("randomSeed", int, default=0, aliases=("seed",))
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    def _static_meta_keys(self, in_schema):
+        return {"modelName": "SomModel"}
+
+    def _execute_impl(self, t: MTable) -> MTable:
+        feature_cols = (None if self.get(HasVectorCol.VECTOR_COL)
+                        else resolve_feature_cols(t, self))
+        X = get_feature_block(t, self).astype(np.float32)
+        xdim, ydim = self.get(self.XDIM), self.get(self.YDIM)
+        w = _som_fit(X, xdim, ydim, self.get(self.NUM_ITERS),
+                     self.get(self.SIGMA), self.get(self.LEARN_RATE),
+                     self.get(self.RANDOM_SEED))
+        from ...common.model import model_to_table
+
+        meta = {"modelName": "SomModel", "xdim": xdim, "ydim": ydim,
+                "vectorCol": self.get(HasVectorCol.VECTOR_COL),
+                "featureCols": feature_cols, "dim": int(X.shape[1])}
+        return model_to_table(meta, {"weights": w})
+
+
+class SomPredictBatchOp(ModelMapBatchOp, HasPredictionCol, HasReservedCols):
+    """Maps each row to its best-matching unit id (row-major grid index)."""
+
+    class _Mapper(RichModelMapper):
+        def load_model(self, model):
+            from ...common.model import table_to_model
+
+            self.meta, arrays = table_to_model(model)
+            self.weights = arrays["weights"].astype(np.float32)
+            return self
+
+        def _pred_type(self):
+            return AlinkTypes.LONG
+
+        def predict_block(self, t):
+            X = get_feature_block(
+                t, merge_feature_params(self.get_params(), self.meta),
+                vector_size=self.meta["dim"]).astype(np.float32)
+            d2 = ((X[:, None, :] - self.weights[None]) ** 2).sum(-1)
+            return d2.argmin(axis=1).astype(np.int64), AlinkTypes.LONG, None
+
+    mapper_cls = _Mapper

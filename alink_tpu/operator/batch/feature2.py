@@ -33,6 +33,7 @@ from ...common.mtable import AlinkTypes, MTable, TableSchema
 from ...common.params import InValidator, MinValidator, ParamInfo
 from ...mapper import (
     HasOutputCol,
+    HasSelectedCol,
     HasReservedCols,
     HasSelectedCols,
     Mapper,
@@ -617,3 +618,37 @@ class MaxAbsScalerModelMapper(ModelMapper, HasReservedCols):
 
 class MaxAbsScalerPredictBatchOp(ModelMapBatchOp, HasReservedCols):
     mapper_cls = MaxAbsScalerModelMapper
+
+
+class DCTMapper(Mapper, HasSelectedCol, HasOutputCol, HasReservedCols):
+    """Orthonormal DCT-II of a vector column (reference:
+    operator/batch/feature/DCTBatchOp.java + common/feature/DCTMapper)."""
+
+    INVERSE = ParamInfo("inverse", bool, default=False)
+
+    def output_schema(self, input_schema):
+        out = (self.get(HasOutputCol.OUTPUT_COL) or
+               self.get(HasSelectedCol.SELECTED_COL))
+        return self._append_result_schema(
+            input_schema, [out], [AlinkTypes.DENSE_VECTOR])
+
+    def map_table(self, t: MTable) -> MTable:
+        from ...common.linalg import DenseVector
+
+        col = self.get(HasSelectedCol.SELECTED_COL)
+        out = self.get(HasOutputCol.OUTPUT_COL) or col
+        X = np.stack([parse_vector(v).to_dense().data for v in t.col(col)])
+        n = X.shape[1]
+        k = np.arange(n)
+        basis = np.cos(np.pi / n * (k[:, None] + 0.5) * k[None, :])
+        basis *= np.sqrt(2.0 / n)
+        basis[:, 0] = np.sqrt(1.0 / n)
+        Y = X @ basis.T if self.get(self.INVERSE) else X @ basis
+        vecs = np.asarray([DenseVector(row) for row in Y], object)
+        return self._append_result(t, {out: vecs},
+                                   {out: AlinkTypes.DENSE_VECTOR})
+
+
+class DCTBatchOp(MapBatchOp, HasSelectedCol, HasOutputCol, HasReservedCols):
+    mapper_cls = DCTMapper
+    INVERSE = DCTMapper.INVERSE

@@ -1,0 +1,53 @@
+"""SOM, DCT, HTML stats viz tests."""
+
+import numpy as np
+import pytest
+
+from alink_tpu.operator.batch import (
+    DCTBatchOp,
+    MemSourceBatchOp,
+    SomPredictBatchOp,
+    SomTrainBatchOp,
+)
+
+
+def test_som_maps_blobs_to_distant_units():
+    rng = np.random.default_rng(0)
+    rows = [tuple(map(float, rng.normal(c, 0.1, 2)))
+            for c in ((0, 0), (10, 10)) for _ in range(40)]
+    src = MemSourceBatchOp(rows, "x double, y double")
+    model = SomTrainBatchOp(xdim=3, ydim=3, numIters=150).link_from(src)
+    out = SomPredictBatchOp().link_from(model, src).collect()
+    units = np.asarray(out.col("pred"))
+    # each blob concentrates on one unit, and they differ
+    u1 = np.bincount(units[:40]).argmax()
+    u2 = np.bincount(units[40:]).argmax()
+    assert u1 != u2
+    assert (units[:40] == u1).mean() > 0.8
+
+
+def test_dct_roundtrip_and_energy():
+    src = MemSourceBatchOp([("1 2 3 4",)], "vec string")
+    fwd = DCTBatchOp(selectedCol="vec", outputCol="dct").link_from(src)
+    out = fwd.collect()
+    coefs = out.col("dct")[0].data
+    # orthonormal DCT preserves energy
+    assert np.sum(coefs ** 2) == pytest.approx(1 + 4 + 9 + 16)
+    # DC coefficient = mean * sqrt(n)
+    assert coefs[0] == pytest.approx(2.5 * 2.0)
+    back = DCTBatchOp(selectedCol="dct", outputCol="rec", inverse=True) \
+        .link_from(fwd).collect()
+    np.testing.assert_allclose(back.col("rec")[0].data, [1, 2, 3, 4],
+                               atol=1e-9)
+
+
+def test_lazy_viz_statistics(tmp_path):
+    rng = np.random.default_rng(1)
+    rows = [(float(v), "x") for v in rng.normal(size=50)]
+    src = MemSourceBatchOp(rows, "v double, s string")
+    path = str(tmp_path / "stats.html")
+    src.lazy_viz_statistics(path)
+    src.execute()
+    html = open(path).read()
+    assert "<html" in html and "Histograms" in html
+    assert "svg" in html and "standardDeviation" in html
