@@ -25,6 +25,9 @@ from .onlinelearning import (
     BinaryClassModelFilterStreamOp,
     FtrlPredictStreamOp,
     FtrlTrainStreamOp,
+    OnlineFmPredictStreamOp,
+    OnlineFmTrainStreamOp,
+    OnlineLearningStreamOp,
 )
 
 __all__ = [
@@ -40,6 +43,9 @@ __all__ = [
     "StableHloModelPredictStreamOp",
     "TorchModelPredictStreamOp",
     "BinaryClassModelFilterStreamOp",
+    "OnlineFmPredictStreamOp",
+    "OnlineFmTrainStreamOp",
+    "OnlineLearningStreamOp",
     "FtrlPredictStreamOp",
     "FtrlTrainStreamOp",
 ] + list(_generated.__all__) + list(_outlier_stream.__all__)

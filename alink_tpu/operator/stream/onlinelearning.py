@@ -251,3 +251,171 @@ class BinaryClassModelFilterStreamOp(StreamOperator):
                 del data_chunks[:-window]
             if data_chunks and passes(pending):
                 yield pending
+
+
+class OnlineFmTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols):
+    """Streaming factorization machine (binary) with AdaGrad updates; emits
+    FmModel snapshot tables servable by FmPredict (reference:
+    operator/stream/onlinelearning OnlineFM ops over the FtrlOnlineFm
+    kernel)."""
+
+    LABEL_COL = ParamInfo("labelCol", str, optional=False)
+    NUM_FACTOR = ParamInfo("numFactor", int, default=8)
+    LEARN_RATE = ParamInfo("learnRate", float, default=0.1)
+    INIT_STDEV = ParamInfo("initStdev", float, default=0.05)
+    MODEL_SAVE_INTERVAL = ParamInfo("modelSaveInterval", int, default=1)
+    RANDOM_SEED = ParamInfo("randomSeed", int, default=0, aliases=("seed",))
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    def _stream_impl(self, it: Iterator[MTable]) -> Iterator[MTable]:
+        import jax
+        import jax.numpy as jnp
+
+        from ...common.model import model_to_table
+        from ...optim import fm_pairwise
+
+        kf = self.get(self.NUM_FACTOR)
+        lr = self.get(self.LEARN_RATE)
+        interval = self.get(self.MODEL_SAVE_INTERVAL)
+        label_col = self.get(self.LABEL_COL)
+        vec_col = self.get(HasVectorCol.VECTOR_COL)
+        feat_cols = self.get(HasFeatureCols.FEATURE_COLS)
+
+        state = None
+        labels: Optional[list] = None
+        label_type = None
+        rng = np.random.default_rng(self.get(self.RANDOM_SEED))
+
+        @jax.jit
+        def update(params, accum, X, y):
+            def loss(p):
+                w0, w, V = p
+                s = w0 + X @ w + fm_pairwise(X, V)
+                return jnp.logaddexp(0.0, -y * s).mean()
+
+            g = jax.grad(loss)(params)
+            new_accum = jax.tree.map(lambda a, gg: a + gg * gg, accum, g)
+            new_params = jax.tree.map(
+                lambda p, gg, a: p - lr * gg / jnp.sqrt(a + 1e-8),
+                params, g, new_accum)
+            return new_params, new_accum
+
+        batch_no = 0
+        for chunk in it:
+            if chunk.num_rows == 0:
+                continue
+            if feat_cols is None and not vec_col:
+                feat_cols = resolve_feature_cols(chunk, self,
+                                                 exclude=[label_col])
+            X = chunk.to_numeric_block(
+                [vec_col] if vec_col else feat_cols,
+                dtype=np.float32)
+            y_raw = chunk.col(label_col)
+            if labels is None:
+                labels = sorted(set(np.asarray(y_raw).tolist()),
+                                key=lambda v: str(v))
+                label_type = chunk.schema.type_of(label_col)
+            y = np.where(np.asarray(y_raw) == labels[0], 1.0, -1.0) \
+                .astype(np.float32)
+            d = X.shape[1]
+            if state is None:
+                params = (jnp.asarray(0.0),
+                          jnp.zeros(d, jnp.float32),
+                          jnp.asarray(rng.normal(
+                              0, self.get(self.INIT_STDEV),
+                              (d, kf)).astype(np.float32)))
+                accum = jax.tree.map(
+                    lambda p: jnp.full_like(p, 1e-8), params)
+                state = (params, accum)
+            params, accum = state
+            params, accum = update(params, accum, jnp.asarray(X),
+                                   jnp.asarray(y))
+            state = (params, accum)
+            batch_no += 1
+            if batch_no % interval == 0:
+                w0, w, V = jax.device_get(params)
+                meta = {
+                    "modelName": "FmModel", "fmTask": "binary",
+                    "numFactor": kf, "vectorCol": vec_col,
+                    "featureCols": (list(feat_cols) if feat_cols else None),
+                    "labelCol": label_col, "labelType": label_type,
+                    "labels": labels, "dim": int(d),
+                }
+                yield model_to_table(meta, {
+                    "w0": np.asarray([w0], np.float32),
+                    "w": np.asarray(w, np.float32),
+                    "V": np.asarray(V, np.float32)})
+
+
+class OnlineFmPredictStreamOp(ModelMapStreamOp, HasPredictionCol,
+                              HasPredictionDetailCol, HasReservedCols,
+                              HasVectorCol, HasFeatureCols):
+    """Hot-swap FM serving over an OnlineFm model stream."""
+
+    from ...operator.batch.classification import FmModelMapper as _FmMapper
+
+    mapper_cls = _FmMapper
+
+
+class OnlineLearningStreamOp(StreamOperator):
+    """Generic online refinement of a batch-trained LinearModel: per-chunk
+    SGD on the matching loss (logistic for classifiers, squared for
+    regression), emitting updated model snapshots (reference:
+    operator/stream/onlinelearning/OnlineLearningStreamOp.java — online
+    update of a fitted pipeline stage)."""
+
+    LEARN_RATE = ParamInfo("learnRate", float, default=0.01)
+    MODEL_SAVE_INTERVAL = ParamInfo("modelSaveInterval", int, default=1)
+
+    _min_inputs = 2
+    _max_inputs = 2
+
+    def _stream_impl(self, model_it, data_it) -> Iterator[MTable]:
+        import jax
+        import jax.numpy as jnp
+
+        from ...common.model import model_to_table
+
+        lr = self.get(self.LEARN_RATE)
+        interval = self.get(self.MODEL_SAVE_INTERVAL)
+        meta, arrays = table_to_model(next(model_it))
+        mtype = meta["linearModelType"]
+        w = jnp.asarray(np.concatenate(
+            [arrays["weights"].reshape(-1),
+             arrays["intercept"].reshape(-1)]))
+        label_col = meta["labelCol"]
+        feat_cols = meta.get("featureCols")
+        vec_col = meta.get("vectorCol")
+        labels = meta.get("labels")
+
+        @jax.jit
+        def update(w, X, y):
+            def loss(w):
+                s = X @ w[:-1] + w[-1]
+                if mtype in ("LinearReg", "SVR"):
+                    return 0.5 * ((s - y) ** 2).mean()
+                return jnp.logaddexp(0.0, -y * s).mean()
+
+            return w - lr * jax.grad(loss)(w)
+
+        batch_no = 0
+        for chunk in data_it:
+            if chunk.num_rows == 0:
+                continue
+            X = chunk.to_numeric_block(
+                [vec_col] if vec_col else feat_cols, dtype=np.float32)
+            y_raw = chunk.col(label_col)
+            if mtype in ("LinearReg", "SVR"):
+                y = np.asarray(y_raw, np.float32)
+            else:
+                y = np.where(np.asarray(y_raw) == labels[0], 1.0, -1.0) \
+                    .astype(np.float32)
+            w = update(w, jnp.asarray(X), jnp.asarray(y))
+            batch_no += 1
+            if batch_no % interval == 0:
+                wv = np.asarray(jax.device_get(w))
+                yield model_to_table(meta, {
+                    "weights": wv[:-1].astype(np.float32),
+                    "intercept": np.asarray([wv[-1]], np.float32)})

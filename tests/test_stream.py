@@ -142,3 +142,56 @@ def test_ftrl_single_label_warmup_deferred():
     for m in models:
         meta, _ = table_to_model(m)
         assert None not in meta["labels"] and len(meta["labels"]) == 2
+
+
+def test_online_fm_stream():
+    import numpy as np
+
+    from alink_tpu.common.mtable import MTable
+    from alink_tpu.operator.stream import (OnlineFmPredictStreamOp,
+                                           OnlineFmTrainStreamOp,
+                                           TableSourceStreamOp)
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(600, 4)).astype(np.float64)
+    y = ((X[:, 0] * X[:, 1] + X[:, 2]) > 0).astype(np.int64)
+    cols = {f"f{i}": X[:, i] for i in range(4)}
+    cols["label"] = y
+    t = MTable(cols)
+    models = OnlineFmTrainStreamOp(
+        labelCol="label", featureCols=[f"f{i}" for i in range(4)],
+        numFactor=4, learnRate=0.3, modelSaveInterval=1).link_from(
+        TableSourceStreamOp(t, chunkSize=100))
+    pred = OnlineFmPredictStreamOp(predictionCol="pred").link_from(
+        models, TableSourceStreamOp(t, chunkSize=100))
+    out = pred.collect()
+    acc = float((np.asarray(out.col("pred")) == y).mean())
+    assert acc > 0.7
+
+
+def test_online_learning_refines_batch_model():
+    import numpy as np
+
+    from alink_tpu.common.mtable import MTable
+    from alink_tpu.operator.batch import (LinearRegTrainBatchOp,
+                                          MemSourceBatchOp)
+    from alink_tpu.operator.stream import (OnlineLearningStreamOp,
+                                           TableSourceStreamOp)
+
+    rng = np.random.default_rng(1)
+    # warm start on slope 2 data, stream carries slope 3 data: refinement
+    # should move the weight toward 3
+    warm_rows = [(float(x), float(2 * x)) for x in rng.normal(size=100)]
+    warm = LinearRegTrainBatchOp(featureCols=["x"], labelCol="y").link_from(
+        MemSourceBatchOp(warm_rows, "x double, y double")).collect()
+
+    xs = rng.normal(size=2000)
+    t = MTable({"x": xs, "y": 3.0 * xs})
+    out = OnlineLearningStreamOp(learnRate=0.2, modelSaveInterval=5) \
+        .link_from(TableSourceStreamOp(warm, numChunks=1),
+                   TableSourceStreamOp(t, chunkSize=100))
+    snapshots = list(out._stream())
+    assert snapshots
+    from alink_tpu.common.model import table_to_model
+    _, arrays = table_to_model(snapshots[-1])
+    assert abs(float(arrays["weights"][0]) - 3.0) < 0.3
