@@ -221,6 +221,7 @@ from .feature2 import (
 )
 from .dataproc import (
     ImputerPredictBatchOp,
+    OverWindowBatchOp,
     RebalanceBatchOp,
     StratifiedSampleBatchOp,
     WeightSampleBatchOp,

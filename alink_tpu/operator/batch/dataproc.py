@@ -474,3 +474,53 @@ class RebalanceBatchOp(BatchOperator):
     def _execute_impl(self, t: MTable) -> MTable:
         rng = np.random.default_rng(self.get(self.RANDOM_SEED))
         return t.take(rng.permutation(t.num_rows))
+
+
+class OverWindowBatchOp(BatchOperator):
+    """Per-group rolling-window aggregate features (reference:
+    common/fe/GenerateFeatureUtil + the over-window feature ops — e.g.
+    "sum of the previous N events per user"). Rides the embedded SQL
+    engine's window functions; each agg spec 'agg(col)' yields a column
+    '<agg>_<col>_<N>'."""
+
+    GROUP_COLS = ParamInfo("groupCols", list, optional=False)
+    ORDER_COL = ParamInfo("orderCol", str, optional=False)
+    AGG_SPECS = ParamInfo("aggSpecs", list, optional=False,
+                          desc="e.g. ['sum(amount)', 'avg(amount)']")
+    WINDOW_SIZE = ParamInfo("windowSize", int, default=10,
+                            desc="preceding rows included (current excluded)")
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    def _agg_cols(self):
+        out = []
+        for spec in self.get(self.AGG_SPECS):
+            fn, col = spec.rstrip(")").split("(")
+            out.append((fn.strip().lower(), col.strip()))
+        return out
+
+    def _execute_impl(self, t: MTable) -> MTable:
+        from ..sqlengine import sql_query
+
+        groups = ", ".join(f'"{c}"' for c in self.get(self.GROUP_COLS))
+        order = f'"{self.get(self.ORDER_COL)}"'
+        n = int(self.get(self.WINDOW_SIZE))
+        exprs = []
+        for fn, col in self._agg_cols():
+            exprs.append(
+                f'{fn}("{col}") OVER (PARTITION BY {groups} ORDER BY {order} '
+                f"ROWS BETWEEN {n} PRECEDING AND 1 PRECEDING) "
+                f'AS "{fn}_{col}_{n}"')
+        q = f'SELECT *, {", ".join(exprs)} FROM t'
+        return sql_query(q, {"t": t})
+
+    def _out_schema(self, in_schema):
+        names = list(in_schema.names)
+        types = list(in_schema.types)
+        n = int(self.get(self.WINDOW_SIZE))
+        for fn, col in self._agg_cols():
+            names.append(f"{fn}_{col}_{n}")
+            types.append(AlinkTypes.LONG if fn == "count"
+                         else AlinkTypes.DOUBLE)
+        return TableSchema(names, types)

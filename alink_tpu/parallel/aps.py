@@ -118,3 +118,25 @@ class ShardedEmbedding:
 
     def shard_shapes(self):
         return [tuple(s.data.shape) for s in self.array.addressable_shards]
+
+    def save(self, path: str):
+        """Persist the table as a .ak model file (the APS persistentModel
+        analog, reference: ApsEnv.java:328-366)."""
+        from ..common.model import model_to_table
+        from ..io.ak import write_ak
+
+        meta = {"modelName": "ShardedEmbedding",
+                "vocabSize": self.vocab_size, "dim": self.dim}
+        write_ak(path, model_to_table(meta, {"table": self.to_numpy()}))
+
+    @staticmethod
+    def load(mesh, path: str, axis: str = AXIS_MODEL) -> "ShardedEmbedding":
+        """Restore a saved table back onto the mesh, re-sharded."""
+        from ..common.model import table_to_model
+        from ..io.ak import read_ak
+
+        meta, arrays = table_to_model(read_ak(path))
+        handle = ShardedEmbedding(mesh, meta["vocabSize"], meta["dim"],
+                                  init=lambda rng: arrays["table"]
+                                  .astype(np.float32), axis=axis)
+        return handle

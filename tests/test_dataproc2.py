@@ -79,3 +79,37 @@ def test_stepwise_selects_informative():
     assert set(meta["featureCols"]) == {"x1", "x2"}   # noise columns rejected
     out = LinearRegPredictBatchOp().link_from(model, src).collect()
     assert np.abs(np.asarray(out.col("pred")) - y).mean() < 0.1
+
+
+def test_over_window_features():
+    from alink_tpu.operator.batch import OverWindowBatchOp
+
+    rows = [("u1", 1, 10.0), ("u1", 2, 20.0), ("u1", 3, 30.0),
+            ("u2", 1, 5.0), ("u2", 2, 7.0)]
+    src = MemSourceBatchOp(rows, "user string, ts bigint, amount double")
+    out = OverWindowBatchOp(
+        groupCols=["user"], orderCol="ts",
+        aggSpecs=["sum(amount)", "count(amount)"], windowSize=2) \
+        .link_from(src).collect()
+    by = {(r[0], r[1]): r for r in out.rows()}
+    assert by[("u1", 1)][3] is None or np.isnan(by[("u1", 1)][3])  # no history
+    assert by[("u1", 2)][3] == 10.0
+    assert by[("u1", 3)][3] == 30.0           # 10 + 20
+    assert by[("u2", 2)][3] == 5.0            # groups independent
+    assert by[("u1", 3)][4] == 2
+    # static schema declares the generated columns
+    op = OverWindowBatchOp(groupCols=["user"], orderCol="ts",
+                           aggSpecs=["sum(amount)"], windowSize=2)
+    assert "sum_amount_2" in op.link_from(src).schema.names
+
+
+def test_sharded_embedding_checkpoint(tmp_path):
+    from alink_tpu.parallel.aps import ShardedEmbedding, model_mesh
+
+    mesh = model_mesh()
+    emb = ShardedEmbedding(mesh, vocab_size=20, dim=4, seed=5)
+    path = str(tmp_path / "emb.ak")
+    emb.save(path)
+    back = ShardedEmbedding.load(mesh, path)
+    np.testing.assert_allclose(back.to_numpy(), emb.to_numpy())
+    assert len(back.shard_shapes()) == mesh.size
