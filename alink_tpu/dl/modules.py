@@ -219,6 +219,31 @@ class KerasSequential(nn.Module):
                 x = nn.LayerNorm(dtype=self.dtype, name=f"norm_{i}")(x)
             elif name == "flatten":
                 x = x.reshape(x.shape[0], -1)
+            elif name == "reshape":
+                x = x.reshape((x.shape[0],) + tuple(int(a) for a in args))
+            elif name == "conv1d":
+                filters = int(args[0])
+                kernel = int(args[1]) if len(args) > 1 else 3
+                strides = int(kwargs.get("strides", 1))
+                x = nn.Conv(filters, kernel_size=(kernel,),
+                            strides=(strides,), dtype=self.dtype,
+                            name=f"conv_{i}")(x)
+                act = kwargs.get("activation")
+                if act:
+                    x = _activation(act)(x)
+            elif name == "maxpool1d":
+                w = int(args[0]) if args else 2
+                x = nn.max_pool(x, window_shape=(w,), strides=(w,))
+            elif name == "globalavgpool1d":
+                x = x.mean(axis=1)
+            elif name in ("lstm", "gru"):
+                units = int(args[0])
+                cell = (nn.OptimizedLSTMCell(units, dtype=self.dtype)
+                        if name == "lstm"
+                        else nn.GRUCell(units, dtype=self.dtype))
+                x = nn.RNN(cell, name=f"{name}_{i}")(x)
+                if not kwargs.get("return_sequences"):
+                    x = x[:, -1, :]
             else:
                 raise AkIllegalArgumentException(f"unknown layer: {name!r}")
         return nn.Dense(self.out_dim, dtype=jnp.float32, name="head")(x)

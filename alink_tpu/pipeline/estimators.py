@@ -402,3 +402,67 @@ class MultilayerPerceptronClassifier(EstimatorBase, _RichPredictParams):
     MAX_ITER = _cls.MultilayerPerceptronTrainBatchOp.MAX_ITER
     FEATURE_COLS = _cls.HasFeatureCols.FEATURE_COLS
     VECTOR_COL = _cls.HasVectorCol.VECTOR_COL
+
+
+# -- trees / ensembles ---------------------------------------------------------
+from ..operator.batch import tree as _tree
+
+
+class DecisionTreeModel(ModelBase):
+    _predict_op_cls = _tree.DecisionTreePredictBatchOp
+
+
+class DecisionTreeClassifier(EstimatorBase, _RichPredictParams):
+    """(reference: pipeline/classification/DecisionTreeClassifier.java)"""
+
+    _train_op_cls = _tree.DecisionTreeTrainBatchOp
+    _model_cls = DecisionTreeModel
+    LABEL_COL = _tree.DecisionTreeTrainBatchOp.LABEL_COL
+    MAX_DEPTH = _tree.DecisionTreeTrainBatchOp.MAX_DEPTH
+    FEATURE_COLS = _cls.HasFeatureCols.FEATURE_COLS
+
+
+class RandomForestModel(ModelBase):
+    _predict_op_cls = _tree.RandomForestPredictBatchOp
+
+
+class RandomForestClassifier(EstimatorBase, _RichPredictParams):
+    """(reference: pipeline/classification/RandomForestClassifier.java)"""
+
+    _train_op_cls = _tree.RandomForestTrainBatchOp
+    _model_cls = RandomForestModel
+    LABEL_COL = _tree.RandomForestTrainBatchOp.LABEL_COL
+    NUM_TREES = _tree.RandomForestTrainBatchOp.NUM_TREES
+    MAX_DEPTH = _tree.RandomForestTrainBatchOp.MAX_DEPTH
+    FEATURE_COLS = _cls.HasFeatureCols.FEATURE_COLS
+
+
+class GbdtModel(ModelBase):
+    _predict_op_cls = _tree.GbdtPredictBatchOp
+
+
+class GbdtClassifier(EstimatorBase, _RichPredictParams):
+    """(reference: pipeline/classification/GbdtClassifier.java)"""
+
+    _train_op_cls = _tree.GbdtTrainBatchOp
+    _model_cls = GbdtModel
+    LABEL_COL = _tree.GbdtTrainBatchOp.LABEL_COL
+    NUM_TREES = _tree.GbdtTrainBatchOp.NUM_TREES
+    MAX_DEPTH = _tree.GbdtTrainBatchOp.MAX_DEPTH
+    LEARNING_RATE = _tree.GbdtTrainBatchOp.LEARNING_RATE
+    FEATURE_COLS = _cls.HasFeatureCols.FEATURE_COLS
+
+
+class GbdtRegModel(ModelBase):
+    _predict_op_cls = _tree.GbdtRegPredictBatchOp
+
+
+class GbdtRegressor(EstimatorBase, _RichPredictParams):
+    """(reference: pipeline/regression/GbdtRegressor.java)"""
+
+    _train_op_cls = _tree.GbdtRegTrainBatchOp
+    _model_cls = GbdtRegModel
+    LABEL_COL = _tree.GbdtRegTrainBatchOp.LABEL_COL
+    NUM_TREES = _tree.GbdtRegTrainBatchOp.NUM_TREES
+    MAX_DEPTH = _tree.GbdtRegTrainBatchOp.MAX_DEPTH
+    FEATURE_COLS = _cls.HasFeatureCols.FEATURE_COLS

@@ -51,3 +51,50 @@ def test_lazy_viz_statistics(tmp_path):
     html = open(path).read()
     assert "<html" in html and "Histograms" in html
     assert "svg" in html and "standardDeviation" in html
+
+
+def test_tree_pipeline_estimators():
+    from alink_tpu.pipeline import GbdtClassifier, Pipeline, RandomForestClassifier
+
+    rng = np.random.default_rng(2)
+    rows = [(float(a), float(b), int(a * b > 0))
+            for a, b in rng.normal(size=(200, 2))]
+    src = MemSourceBatchOp(rows, "a double, b double, label int")
+    for est in (GbdtClassifier(featureCols=["a", "b"], labelCol="label",
+                               numTrees=10, maxDepth=3),
+                RandomForestClassifier(featureCols=["a", "b"],
+                                       labelCol="label", numTrees=10)):
+        model = Pipeline(est).fit(src)
+        out = model.transform(src).collect()
+        acc = (np.asarray(out.col("pred")) ==
+               np.asarray([r[2] for r in rows])).mean()
+        assert acc > 0.85
+
+
+def test_keras_conv1d_lstm_grammar():
+    from alink_tpu.operator.batch import KerasSequentialClassifierTrainBatchOp, \
+        KerasSequentialClassifierPredictBatchOp
+
+    rng = np.random.default_rng(3)
+    n, seq = 120, 16
+    X = rng.normal(size=(n, seq))
+    # label = sign of the mean of the second half (temporal pattern)
+    y = (X[:, seq // 2:].mean(axis=1) > 0).astype(int)
+    cols = {f"f{i}": X[:, i] for i in range(seq)}
+    cols["label"] = y
+    from alink_tpu.common.mtable import MTable
+    from alink_tpu.operator.batch.base import TableSourceBatchOp
+
+    src = TableSourceBatchOp(MTable(cols))
+    for layers in (["Reshape(16, 1)", "Conv1D(8, 3, activation='relu')",
+                    "MaxPool1D(2)", "Flatten"],
+                   ["Reshape(16, 1)", "LSTM(8)"],
+                   ["Reshape(16, 1)", "GRU(8)"]):
+        train = KerasSequentialClassifierTrainBatchOp(
+            featureCols=[f"f{i}" for i in range(seq)], labelCol="label",
+            layers=layers, numEpochs=60, batchSize=32,
+            learningRate=5e-3).link_from(src)
+        out = KerasSequentialClassifierPredictBatchOp().link_from(train, src) \
+            .collect()
+        acc = (np.asarray(out.col("pred")) == y).mean()
+        assert acc > 0.75, (layers, acc)
