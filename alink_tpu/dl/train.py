@@ -73,6 +73,14 @@ def _loss_fn(kind: str, regression: bool):
         def f(logits, y):
             return jnp.mean((logits.squeeze(-1) - y.astype(jnp.float32)) ** 2)
         return f
+    if kind == "gaussian_nll":
+        # logits (n, 2) = (mu, log_sigma); probabilistic regression (DeepAR)
+        def f(logits, y):
+            mu, log_sigma = logits[..., 0], logits[..., 1]
+            sigma2 = jnp.exp(2.0 * log_sigma)
+            return jnp.mean(log_sigma
+                            + 0.5 * (y.astype(jnp.float32) - mu) ** 2 / sigma2)
+        return f
     raise ValueError(f"unknown loss {kind!r}")
 
 

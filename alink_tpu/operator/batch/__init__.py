@@ -272,6 +272,7 @@ from .statistics import (
 )
 from .timeseries import (
     ArimaBatchOp,
+    DeepARBatchOp,
     DifferenceBatchOp,
     EvalTimeSeriesBatchOp,
     GarchBatchOp,

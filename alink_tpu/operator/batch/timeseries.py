@@ -76,8 +76,10 @@ class _BaseForecastOp(BatchOperator):
                 extras.append(self._extra_outputs(y))
         else:
             out_groups = None
-            extras = [self._extra_outputs(vals)]
+            # forecast BEFORE extras — same order as the grouped branch
+            # (extras may reuse state from the fit, e.g. DeepAR's sigma)
             out_vecs = [DenseVector(self._forecast(vals, horizon))]
+            extras = [self._extra_outputs(vals)]
         cols: Dict = {}
         names, types = [], []
         if out_groups is not None:
@@ -485,3 +487,83 @@ class EvalTimeSeriesBatchOp(BatchOperator):
     def collect_metrics(self) -> dict:
         self.collect()
         return self._metrics
+
+
+class DeepARBatchOp(_BaseForecastOp):
+    """Probabilistic LSTM forecaster with Gaussian output head (reference:
+    akdl deepar model via DLLauncher — operator/batch/timeseries/
+    DeepARTrainBatchOp + core/src/main/resources/entries/deepar_entry.py).
+
+    Rides the shared DL train loop: sliding lookback windows train an LSTM
+    whose head emits (mu, log_sigma) under Gaussian NLL; forecasting rolls
+    the window forward on the predicted mean. ``predictionCol`` holds the
+    mean path; sigma of the one-step-ahead distribution lands in the
+    ``sigma`` column."""
+
+    LOOKBACK = ParamInfo("lookback", int, default=24, validator=MinValidator(2))
+    HIDDEN = ParamInfo("hiddenSize", int, default=32)
+    NUM_EPOCHS = ParamInfo("numEpochs", int, default=40)
+    BATCH_SIZE = ParamInfo("batchSize", int, default=64)
+    LEARNING_RATE = ParamInfo("learningRate", float, default=5e-3)
+    RANDOM_SEED = ParamInfo("randomSeed", int, default=0, aliases=("seed",))
+
+    def _extra_schema_keys(self):
+        return ["sigma"]
+
+    def _fit_forecast(self, y: np.ndarray, horizon: int):
+        import flax.linen as nn
+        import jax
+        import jax.numpy as jnp
+
+        from ...dl.train import TrainConfig, train_model
+
+        L = min(self.get(self.LOOKBACK), max(len(y) - 1, 2))
+        mu_y, sd_y = float(np.mean(y)), float(np.std(y) + 1e-9)
+        z = (np.asarray(y, np.float32) - mu_y) / sd_y
+        windows, targets = [], []
+        for s in range(len(z) - L):
+            windows.append(z[s:s + L])
+            targets.append(z[s + L])
+        X = np.asarray(windows, np.float32)[..., None]   # (n, L, 1)
+        t = np.asarray(targets, np.float32)
+
+        hidden = self.get(self.HIDDEN)
+
+        class Net(nn.Module):
+            @nn.compact
+            def __call__(self, x, deterministic=True):
+                h = nn.RNN(nn.OptimizedLSTMCell(hidden))(x)[:, -1, :]
+                return nn.Dense(2)(h)
+
+        cfg = TrainConfig(num_epochs=self.get(self.NUM_EPOCHS),
+                          batch_size=self.get(self.BATCH_SIZE),
+                          learning_rate=self.get(self.LEARNING_RATE),
+                          loss="gaussian_nll", seed=self.get(self.RANDOM_SEED))
+        net = Net()
+        params, _ = train_model(net, {"x": X}, t, cfg, regression=True,
+                                seq_axis=None)
+
+        @jax.jit
+        def predict(params, window):
+            return net.apply(params, window[None], deterministic=True)[0]
+
+        window = z[-L:].copy()
+        means, sigmas = [], []
+        for _ in range(horizon):
+            out = np.asarray(jax.device_get(
+                predict(params, jnp.asarray(window[..., None]))))
+            mu, log_sigma = float(out[0]), float(out[1])
+            means.append(mu * sd_y + mu_y)
+            sigmas.append(float(np.exp(log_sigma)) * sd_y)
+            window = np.concatenate([window[1:], [mu]])
+        return np.asarray(means), sigmas[0]
+
+    def _forecast(self, y: np.ndarray, horizon: int) -> np.ndarray:
+        # the base loop calls _forecast then _extra_outputs for each series:
+        # stash sigma from this fit so the extra column reuses it
+        means, sigma = self._fit_forecast(y, horizon)
+        self._last_sigma = sigma
+        return means
+
+    def _extra_outputs(self, y: np.ndarray):
+        return {"sigma": self._last_sigma}

@@ -116,3 +116,18 @@ def test_eval_timeseries():
     assert m["mae"] == pytest.approx(0.1333, abs=1e-3)
     assert m["rmse"] == pytest.approx(np.sqrt((0.01 + 0.01 + 0.04) / 3), abs=1e-6)
     assert 0.9 < m["r2"] <= 1.0
+
+
+def test_deepar_learns_sine():
+    from alink_tpu.operator.batch import DeepARBatchOp
+
+    t = np.arange(200)
+    y = np.sin(2 * np.pi * t / 20)
+    out = DeepARBatchOp(valueCol="v", lookback=40, predictNum=10,
+                        numEpochs=30, randomSeed=0) \
+        .link_from(_series_src(y)).collect()
+    fc = out.col("forecast")[0].data
+    expected = np.sin(2 * np.pi * np.arange(200, 210) / 20)
+    # mean path tracks the oscillation (period 20, amplitude 1)
+    assert np.abs(fc - expected).mean() < 0.45
+    assert out.col("sigma")[0] > 0
