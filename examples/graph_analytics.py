@@ -1,0 +1,21 @@
+"""Graph analytics tour: PageRank, communities, k-core on one edge list
+(reference: examples ALSExample.java-style quickstarts; graph ops under
+operator/batch/graph/)."""
+
+from alink_tpu.operator.batch import (ConnectedComponentsBatchOp,
+                                      KCoreBatchOp, LouvainBatchOp,
+                                      MemSourceBatchOp, PageRankBatchOp)
+
+edges = MemSourceBatchOp(
+    [("a", "b"), ("b", "c"), ("c", "a"), ("c", "d"),
+     ("e", "f"), ("f", "g"), ("g", "e")],
+    "source string, target string")
+
+print("PageRank:")
+PageRankBatchOp().link_from(edges).print()
+print("Connected components:")
+ConnectedComponentsBatchOp().link_from(edges).print()
+print("Louvain communities:")
+LouvainBatchOp().link_from(edges).print()
+print("3-core edges:")
+KCoreBatchOp(k=2).link_from(edges).print()
