@@ -521,6 +521,12 @@ class OverWindowBatchOp(BatchOperator):
         n = int(self.get(self.WINDOW_SIZE))
         for fn, col in self._agg_cols():
             names.append(f"{fn}_{col}_{n}")
-            types.append(AlinkTypes.LONG if fn == "count"
-                         else AlinkTypes.DOUBLE)
+            if fn == "count":
+                types.append(AlinkTypes.LONG)   # count over empty window = 0
+            elif in_schema.type_of(col) == AlinkTypes.STRING:
+                types.append(AlinkTypes.STRING)  # min/max over strings
+            else:
+                # numeric aggregates: each group's FIRST row has an empty
+                # window -> NULL, and the reader coerces int+NULL to DOUBLE
+                types.append(AlinkTypes.DOUBLE)
         return TableSchema(names, types)

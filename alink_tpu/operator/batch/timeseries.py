@@ -517,6 +517,10 @@ class DeepARBatchOp(_BaseForecastOp):
 
         from ...dl.train import TrainConfig, train_model
 
+        if len(y) < 8:
+            raise AkIllegalArgumentException(
+                f"DeepAR needs at least 8 observations per series, got "
+                f"{len(y)}")
         L = min(self.get(self.LOOKBACK), max(len(y) - 1, 2))
         mu_y, sd_y = float(np.mean(y)), float(np.std(y) + 1e-9)
         z = (np.asarray(y, np.float32) - mu_y) / sd_y

@@ -303,20 +303,33 @@ class OnlineFmTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols):
             return new_params, new_accum
 
         batch_no = 0
+        warmup: list = []  # chunks buffered until 2 distinct labels arrive
+        seen_labels: set = set()
         for chunk in it:
             if chunk.num_rows == 0:
                 continue
             if feat_cols is None and not vec_col:
                 feat_cols = resolve_feature_cols(chunk, self,
                                                  exclude=[label_col])
+            seen_labels.update(np.asarray(chunk.col(label_col)).tolist())
+            if labels is None:
+                # same warm-up contract as FTRL: a label-skewed first chunk
+                # must not freeze a one-label (or 3+-label) model
+                if len(seen_labels) > 2:
+                    raise AkIllegalDataException(
+                        f"OnlineFm is binary; saw labels {sorted(map(str, seen_labels))}")
+                if len(seen_labels) < 2:
+                    warmup.append(chunk)
+                    continue
+                labels = sorted(seen_labels, key=lambda v: str(v))
+                label_type = chunk.schema.type_of(label_col)
+                if warmup:
+                    chunk = MTable.concat(warmup + [chunk])
+                    warmup = []
             X = chunk.to_numeric_block(
                 [vec_col] if vec_col else feat_cols,
                 dtype=np.float32)
             y_raw = chunk.col(label_col)
-            if labels is None:
-                labels = sorted(set(np.asarray(y_raw).tolist()),
-                                key=lambda v: str(v))
-                label_type = chunk.schema.type_of(label_col)
             y = np.where(np.asarray(y_raw) == labels[0], 1.0, -1.0) \
                 .astype(np.float32)
             d = X.shape[1]
@@ -380,7 +393,9 @@ class OnlineLearningStreamOp(StreamOperator):
 
         lr = self.get(self.LEARN_RATE)
         interval = self.get(self.MODEL_SAVE_INTERVAL)
-        meta, arrays = table_to_model(next(model_it))
+        # the initial model may arrive split over micro-batches: drain it
+        model_chunks = list(model_it)
+        meta, arrays = table_to_model(MTable.concat(model_chunks))
         mtype = meta["linearModelType"]
         w = jnp.asarray(np.concatenate(
             [arrays["weights"].reshape(-1),

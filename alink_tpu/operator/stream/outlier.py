@@ -47,7 +47,9 @@ def _generate():
     from ..batch import outlier as batch_outlier
 
     for attr in dir(batch_outlier):
-        if attr.endswith("OutlierBatchOp") and not attr.startswith("_"):
+        if (attr.endswith("OutlierBatchOp") and not attr.startswith("_")
+                and not attr.startswith("Eval")):  # Eval* are metrics ops,
+                # not detectors — a per-chunk twin would mis-aggregate
             name, cls = _make_twin(getattr(batch_outlier, attr))
             globals()[name] = cls
             __all__.append(name)

@@ -379,7 +379,8 @@ def ocsvm(X: Arr, nu: float = 0.1, gamma: Optional[float] = None,
         return (_rbf(np.asarray(x, np.float32), landmarks) @ whiten) \
             .astype(np.float32)
 
-    Z = jnp.asarray(featurize(X))
+    F = featurize(X)
+    Z = jnp.asarray(F)
 
     def loss(params):
         w, rho = params["w"], params["rho"]
@@ -405,5 +406,5 @@ def ocsvm(X: Arr, nu: float = 0.1, gamma: Optional[float] = None,
 
     p = jax.device_get(fit())
     w, rho = np.asarray(p["w"]), float(p["rho"])
-    score = rho - featurize(X) @ w          # >0 = outside the boundary
+    score = rho - F @ w                     # >0 = outside the boundary
     return score, score > 0

@@ -195,3 +195,23 @@ def test_online_learning_refines_batch_model():
     from alink_tpu.common.model import table_to_model
     _, arrays = table_to_model(snapshots[-1])
     assert abs(float(arrays["weights"][0]) - 3.0) < 0.3
+
+
+def test_online_fm_label_warmup():
+    import numpy as np
+
+    from alink_tpu.common.mtable import MTable
+    from alink_tpu.operator.stream import (OnlineFmTrainStreamOp,
+                                           TableSourceStreamOp)
+
+    # label-sorted stream: the first chunks carry only label 0
+    X = np.random.default_rng(4).normal(size=(200, 2))
+    y = np.concatenate([np.zeros(100), np.ones(100)]).astype(np.int64)
+    t = MTable({"a": X[:, 0], "b": X[:, 1], "label": y})
+    models = list(OnlineFmTrainStreamOp(
+        labelCol="label", featureCols=["a", "b"], modelSaveInterval=1)
+        .link_from(TableSourceStreamOp(t, chunkSize=40))._stream())
+    assert models  # emitted once both labels arrived
+    from alink_tpu.common.model import table_to_model
+    meta, _ = table_to_model(models[0])
+    assert len(meta["labels"]) == 2
