@@ -141,6 +141,8 @@ from .classification import (
     FmRegressorPredictBatchOp,
     FmRegressorTrainBatchOp,
     KnnPredictBatchOp,
+    KnnRegPredictBatchOp,
+    KnnRegTrainBatchOp,
     KnnTrainBatchOp,
     MultilayerPerceptronPredictBatchOp,
     MultilayerPerceptronTrainBatchOp,

@@ -306,6 +306,88 @@ class KnnPredictBatchOp(ModelMapBatchOp, HasPredictionCol,
     K = KnnModelMapper.K
 
 
+class KnnRegTrainBatchOp(ModelTrainOpMixin, BatchOperator, HasVectorCol,
+                         HasFeatureCols):
+    """KNN regression: the model is the training block with float targets
+    (reference: operator/batch/regression/KnnRegTrainBatchOp.java)."""
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    LABEL_COL = ParamInfo("labelCol", str, optional=False)
+    DISTANCE_TYPE = KnnTrainBatchOp.DISTANCE_TYPE
+
+    def _static_meta_keys(self, in_schema):
+        return {"modelName": "KnnRegModel"}
+
+    def _execute_impl(self, t: MTable) -> MTable:
+        label_col = self.get(self.LABEL_COL)
+        X, feature_cols = _train_features(self, t, label_col)
+        y = np.asarray(t.col(label_col), np.float32)
+        meta = {
+            "modelName": "KnnRegModel",
+            "distanceType": self.get(self.DISTANCE_TYPE),
+            "vectorCol": self.get(HasVectorCol.VECTOR_COL),
+            "featureCols": feature_cols,
+            "labelCol": label_col,
+            "dim": int(X.shape[1]),
+        }
+        return model_to_table(meta, {"X": X.astype(np.float32),
+                                     "y": y})
+
+
+class KnnRegModelMapper(RichModelMapper):
+    """Inverse-distance-weighted mean of the k nearest targets."""
+
+    K = ParamInfo("k", int, default=10, validator=MinValidator(1))
+
+    def load_model(self, model: MTable):
+        import jax
+        import jax.numpy as jnp
+
+        self.meta, arrays = table_to_model(model)
+        self.X_train = arrays["X"]
+        self.y_train = arrays["y"].astype(np.float32)
+        k = min(self.get(self.K), self.X_train.shape[0])
+        cosine = self.meta.get("distanceType") == "COSINE"
+
+        def knn(Q, X, y):
+            if cosine:
+                Qn = Q / jnp.maximum(jnp.linalg.norm(Q, axis=1, keepdims=True),
+                                     1e-12)
+                Xn = X / jnp.maximum(jnp.linalg.norm(X, axis=1, keepdims=True),
+                                     1e-12)
+                d = 1.0 - Qn @ Xn.T
+            else:
+                d = pairwise_sq_dists(Q, X)
+            neg_d, idx = jax.lax.top_k(-d, k)
+            w = 1.0 / (jnp.sqrt(jnp.maximum(-neg_d, 0.0)) + 1e-6)
+            return (w * y[idx]).sum(1) / w.sum(1)
+
+        self._knn_jit = jax.jit(knn)
+        return self
+
+    def _pred_type(self) -> str:
+        return AlinkTypes.DOUBLE
+
+    def predict_block(self, t: MTable):
+        import jax
+
+        Q = get_feature_block(
+            t, merge_feature_params(self.get_params(), self.meta),
+            vector_size=self.meta["dim"],
+        ).astype(np.float32)
+        pred = np.asarray(jax.device_get(
+            self._knn_jit(Q, self.X_train, self.y_train)))
+        return pred.astype(np.float64), AlinkTypes.DOUBLE, None
+
+
+class KnnRegPredictBatchOp(ModelMapBatchOp, HasPredictionCol,
+                           HasReservedCols, HasVectorCol, HasFeatureCols):
+    mapper_cls = KnnRegModelMapper
+    K = KnnRegModelMapper.K
+
+
 # ---------------------------------------------------------------------------
 # Factorization machines
 # ---------------------------------------------------------------------------

@@ -1,6 +1,7 @@
 """Stream operator layer — micro-batch streaming runtime."""
 
 from .base import (
+    CsvSourceStreamOp,
     MapStreamOp,
     ModelMapStreamOp,
     StreamOperator,
@@ -31,6 +32,7 @@ from .onlinelearning import (
 )
 
 __all__ = [
+    "CsvSourceStreamOp",
     "MapStreamOp",
     "ModelMapStreamOp",
     "StreamOperator",

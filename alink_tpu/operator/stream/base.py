@@ -192,3 +192,30 @@ def _drain(it: Iterator[MTable], limit: int = 1) -> List[MTable]:
         except StopIteration:
             break
     return out
+
+
+class CsvSourceStreamOp(StreamOperator):
+    """CSV file as a micro-batch stream (reference:
+    operator/stream/source/CsvSourceStreamOp.java)."""
+
+    FILE_PATH = ParamInfo("filePath", str, optional=False)
+    SCHEMA_STR = ParamInfo("schemaStr", str, optional=False,
+                           aliases=("schema",))
+    FIELD_DELIMITER = ParamInfo("fieldDelimiter", str, default=",")
+    IGNORE_FIRST_LINE = ParamInfo("ignoreFirstLine", bool, default=False)
+    CHUNK_SIZE = ParamInfo("chunkSize", int, default=1024)
+
+    _max_inputs = 0
+
+    def _stream_impl(self) -> Iterator[MTable]:
+        from ..batch.base import CsvSourceBatchOp
+
+        table = CsvSourceBatchOp(
+            filePath=self.get(self.FILE_PATH),
+            schemaStr=self.get(self.SCHEMA_STR),
+            fieldDelimiter=self.get(self.FIELD_DELIMITER),
+            ignoreFirstLine=self.get(self.IGNORE_FIRST_LINE),
+        )._execute_impl()
+        cs = max(1, self.get(self.CHUNK_SIZE))
+        for s in range(0, table.num_rows, cs):
+            yield table.slice(s, min(s + cs, table.num_rows))

@@ -56,3 +56,48 @@ def _generate():
 
 
 _generate()
+
+
+class EvalOutlierStreamOp(StreamOperator):
+    """Cumulative streaming outlier evaluation: each emitted row carries the
+    metrics over ALL records seen so far (reference:
+    operator/stream/evaluation/EvalOutlierStreamOp.java windowed+cumulative
+    statistics)."""
+
+    LABEL_COL = ParamInfo("labelCol", str, optional=False)
+    PREDICTION_COL = ParamInfo("predictionCol", str, optional=False)
+    OUTLIER_VALUE_STRINGS = ParamInfo("outlierValueStrings", list)
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    def _stream_impl(self, it):
+        import numpy as np
+
+        from ...common.mtable import MTable, TableSchema
+
+        pos_vals = set(str(v) for v in (
+            self.get(self.OUTLIER_VALUE_STRINGS) or
+            ["true", "True", "1", "1.0"]))
+        tp = fp = fn = tn = 0
+        schema = TableSchema(
+            ["Statistics", "Precision", "Recall", "F1", "Count"],
+            ["STRING", "DOUBLE", "DOUBLE", "DOUBLE", "LONG"])
+        for chunk in it:
+            y = np.asarray([str(v) in pos_vals
+                            for v in chunk.col(self.get(self.LABEL_COL))])
+            pred = np.asarray(
+                chunk.col(self.get(self.PREDICTION_COL))).astype(bool)
+            tp += int((pred & y).sum())
+            fp += int((pred & ~y).sum())
+            fn += int((~pred & y).sum())
+            tn += int((~pred & ~y).sum())
+            precision = tp / (tp + fp) if tp + fp else 0.0
+            recall = tp / (tp + fn) if tp + fn else 0.0
+            f1 = (2 * precision * recall / (precision + recall)
+                  if precision + recall else 0.0)
+            yield MTable.from_rows(
+                [("all", precision, recall, f1, tp + fp + fn + tn)], schema)
+
+
+__all__.append("EvalOutlierStreamOp")

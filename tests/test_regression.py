@@ -131,3 +131,21 @@ def test_linear_svr():
     out = LinearSvrPredictBatchOp().link_from(model, src).collect()
     pred = np.asarray(out.col("pred"))
     assert np.abs(pred - y).mean() < 0.2
+
+
+def test_knn_regression():
+    from alink_tpu.operator.batch import (KnnRegPredictBatchOp,
+                                          KnnRegTrainBatchOp)
+
+    rng = np.random.default_rng(7)
+    x = rng.uniform(-3, 3, 300)
+    y = np.sin(x)
+    src = MemSourceBatchOp(
+        [(float(a), float(b)) for a, b in zip(x, y)], "x double, y double")
+    model = KnnRegTrainBatchOp(featureCols=["x"], labelCol="y") \
+        .link_from(src)
+    test = MemSourceBatchOp([(0.5,), (-1.2,)], "x double")
+    out = KnnRegPredictBatchOp(k=5).link_from(model, test).collect()
+    pred = np.asarray(out.col("pred"))
+    assert pred[0] == pytest.approx(np.sin(0.5), abs=0.1)
+    assert pred[1] == pytest.approx(np.sin(-1.2), abs=0.1)

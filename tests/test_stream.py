@@ -215,3 +215,38 @@ def test_online_fm_label_warmup():
     from alink_tpu.common.model import table_to_model
     meta, _ = table_to_model(models[0])
     assert len(meta["labels"]) == 2
+
+
+def test_eval_outlier_stream_cumulative():
+    import numpy as np
+
+    from alink_tpu.common.mtable import MTable
+    from alink_tpu.operator.stream import TableSourceStreamOp
+    from alink_tpu.operator.stream.outlier import EvalOutlierStreamOp
+
+    # 100 rows: predictions perfect in the first half, wrong in the second
+    y = np.asarray([1] * 10 + [0] * 40 + [1] * 10 + [0] * 40)
+    pred = np.concatenate([y[:50].astype(bool), ~y[50:].astype(bool)])
+    t = MTable({"label": y.astype(np.int64), "pred": pred})
+    rows = list(EvalOutlierStreamOp(labelCol="label", predictionCol="pred")
+                .link_from(TableSourceStreamOp(t, chunkSize=50))._stream())
+    first, last = rows[0], rows[-1]
+    assert first.col("F1")[0] == 1.0          # perfect so far
+    assert last.col("F1")[0] < 0.5            # cumulative drops
+    assert last.col("Count")[0] == 100
+
+
+def test_csv_stream_source(tmp_path):
+    import numpy as np
+
+    from alink_tpu.operator.stream import CsvSourceStreamOp
+
+    p = str(tmp_path / "data.csv")
+    with open(p, "w") as f:
+        for i in range(10):
+            f.write(f"{i},{i * 2.5}\n")
+    src = CsvSourceStreamOp(filePath=p, schemaStr="id bigint, v double",
+                            chunkSize=4)
+    chunks = list(src._stream())
+    assert [c.num_rows for c in chunks] == [4, 4, 2]
+    assert chunks[0].col("v")[1] == 2.5
