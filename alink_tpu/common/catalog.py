@@ -142,3 +142,57 @@ def generate_docs(out_dir: str) -> List[str]:
                 f.write("\n".join(lines))
             written.append(path)
     return written
+
+
+_PY_OF_TYPE = {"str": "str", "int": "int", "float": "float", "bool": "bool",
+               "list": "list", "dict": "dict"}
+
+
+def generate_stubs(out_dir: Optional[str] = None) -> List[str]:
+    """Emit .pyi stubs with typed constructor keywords for every public op —
+    the analog of the reference's generated PyAlink operator stubs
+    (reference: python/src/main/java/.../GeneratePyOp.java:76,322). IDEs get
+    parameter completion without importing jax."""
+    import os as _os
+
+    from .. import operator as _op_pkg
+
+    out_dir = out_dir or _os.path.dirname(_os.path.abspath(_op_pkg.__file__))
+    written = []
+    for flavor, ops in list_operators().items():
+        lines = [
+            "# Generated by alink_tpu.common.catalog.generate_stubs — typed",
+            "# operator constructor stubs (do not edit).",
+            "from typing import Any, Optional",
+            "",
+        ]
+        import keyword as _kw
+
+        for cls in ops:
+            lines.append(f"class {cls.__name__}:")
+            args = ["self", "params: Any = ..."]
+            for p in params_of(cls):
+                # python keywords (e.g. ALS's `lambda`) stay settable via
+                # kwargs at runtime but cannot appear in a stub signature
+                if _kw.iskeyword(p.name) or not p.name.isidentifier():
+                    continue
+                py_t = _PY_OF_TYPE.get(
+                    getattr(p.value_type, "__name__", "Any"), "Any")
+                args.append(f"{p.name}: Optional[{py_t}] = ...")
+            args.append("**kwargs: Any")
+            lines.append(f"    def __init__({', '.join(args)}) -> None: ...")
+            lines.append(
+                "    def link_from(self, *inputs: Any) -> "
+                f"'{cls.__name__}': ...")
+            lines.append("    def collect(self) -> Any: ...")
+            lines.append("")
+        # incomplete-stub marker: names not stubbed here (helpers, registries)
+        # resolve as Any instead of disappearing from type checkers
+        lines.append("def __getattr__(name: str) -> Any: ...")
+        lines.append("")
+        _os.makedirs(_os.path.join(out_dir, flavor), exist_ok=True)
+        path = _os.path.join(out_dir, flavor, "__init__.pyi")
+        with open(path, "w") as f:
+            f.write("\n".join(lines))
+        written.append(path)
+    return written

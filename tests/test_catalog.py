@@ -44,3 +44,19 @@ def test_generate_docs(tmp_path):
     assert stats
     content = open(stats[0]).read()
     assert "CorrelationBatchOp" in content and "| param |" in content
+
+
+def test_generate_stubs(tmp_path):
+    import ast
+
+    from alink_tpu.common.catalog import generate_stubs
+
+    files = generate_stubs(str(tmp_path))
+    assert len(files) == 2
+    for f in files:
+        src = open(f).read()
+        ast.parse(src)                       # valid python syntax
+        assert "__getattr__" in src          # incomplete-stub fallback
+    batch = open([f for f in files if "batch" in f][0]).read()
+    assert "class KMeansTrainBatchOp" in batch
+    assert "k: Optional[int]" in batch
