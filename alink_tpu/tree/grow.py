@@ -251,6 +251,21 @@ class TreeEnsemble:
 # ---------------------------------------------------------------------------
 
 
+_MAX_DEPTH = 14  # 2^D heap nodes x num_bins histogram rows: beyond this the
+# static perfect-depth layout (L*B segment space) outgrows HBM — the same
+# bound the reference's TreeObj memory planning enforces
+
+
+def _check_depth(depth: int):
+    from ..common.exceptions import AkIllegalArgumentException
+
+    if depth > _MAX_DEPTH:
+        raise AkIllegalArgumentException(
+            f"tree depth {depth} > {_MAX_DEPTH}: the perfect-depth heap "
+            f"layout allocates 2^depth x num_bins histogram slots; use more "
+            f"trees instead of deeper ones")
+
+
 def _grow_tree(bins_s, g_s, h_s, c_s, mesh, edges, depth, num_bins, l2,
                min_samples, min_gain, fmask, n_local) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Grow one tree; returns (feat_heap (2^D-1,), thr_heap raw (2^D-1,),
@@ -326,6 +341,7 @@ def train_gbdt(
     mesh=None,
 ) -> TreeEnsemble:
     """Histogram gradient boosting. task: regression | binary | multiclass."""
+    _check_depth(depth)
     import jax.numpy as jnp
 
     mesh = mesh or default_mesh()
@@ -449,6 +465,7 @@ def train_forest(
     Classification fits one-vs-all class indicators; predict averages and
     argmaxes — the reference's per-class info-gain forest re-based on the
     shared histogram machinery."""
+    _check_depth(depth)
     mesh = mesh or default_mesh()
     dp = mesh.shape[AXIS_DATA]
     rng = np.random.default_rng(seed)
