@@ -7,7 +7,7 @@ from .base import (
     StreamOperator,
     TableSourceStreamOp,
 )
-from .evaluation import EvalBinaryClassStreamOp
+from .evaluation import EvalBinaryClassStreamOp, SummarizerStreamOp
 from .modelstream import (
     FileModelStreamSink,
     ModelStreamFileSourceStreamOp,
@@ -41,6 +41,7 @@ __all__ = [
     "ModelStreamFileSourceStreamOp",
     "scan_model_dir",
     "EvalBinaryClassStreamOp",
+    "SummarizerStreamOp",
     "OnnxModelPredictStreamOp",
     "StableHloModelPredictStreamOp",
     "TorchModelPredictStreamOp",

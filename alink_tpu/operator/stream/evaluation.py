@@ -83,3 +83,49 @@ def _auc(y: np.ndarray, s: np.ndarray) -> float:
     return float(
         (ranks[y == 1].sum() - n_pos * (n_pos + 1) / 2) / (n_pos * n_neg)
     )
+
+
+class SummarizerStreamOp(StreamOperator):
+    """Cumulative streaming summary statistics: each chunk emits the summary
+    over everything seen so far (reference: operator/stream/statistics/
+    SummarizerStreamOp.java — merged TableSummary over windows). The merge
+    is the summarizer's (count, sum, sum2, min, max) moment algebra."""
+
+    SELECTED_COLS = ParamInfo("selectedCols", list)
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    def _stream_impl(self, it):
+        import numpy as np
+
+        from ...common.mtable import AlinkTypes, MTable, TableSchema
+        from ...stats.summarizer import SUMMARY_KEYS, summary_schema
+
+        state = {}  # col -> [count, sum, sum2, min, max, missing]
+        cols = self.get(self.SELECTED_COLS)
+        for chunk in it:
+            use = cols or [
+                n for n, tp in zip(chunk.names, chunk.schema.types)
+                if AlinkTypes.is_numeric(tp)]
+            for c in use:
+                arr = np.asarray(chunk.col(c), np.float64)
+                ok = arr[~np.isnan(arr)]
+                st = state.setdefault(
+                    c, [0.0, 0.0, 0.0, np.inf, -np.inf, 0.0])
+                st[0] += ok.size
+                st[1] += float(ok.sum())
+                st[2] += float((ok * ok).sum())
+                if ok.size:
+                    st[3] = min(st[3], float(ok.min()))
+                    st[4] = max(st[4], float(ok.max()))
+                st[5] += float(np.isnan(arr).sum())
+            rows = []
+            for c, st in state.items():
+                cnt = st[0]
+                mean = st[1] / cnt if cnt else float("nan")
+                var = (st[2] / cnt - mean * mean) * cnt / (cnt - 1) \
+                    if cnt > 1 else 0.0
+                rows.append((c, cnt, st[5], st[1], mean, var,
+                             float(np.sqrt(max(var, 0.0))), st[3], st[4]))
+            yield MTable.from_rows(rows, summary_schema())

@@ -466,3 +466,24 @@ class GbdtRegressor(EstimatorBase, _RichPredictParams):
     NUM_TREES = _tree.GbdtRegTrainBatchOp.NUM_TREES
     MAX_DEPTH = _tree.GbdtRegTrainBatchOp.MAX_DEPTH
     FEATURE_COLS = _cls.HasFeatureCols.FEATURE_COLS
+
+
+# -- nlp ----------------------------------------------------------------------
+from ..operator.batch import huge as _huge
+
+
+class Word2VecModel(ModelBase):
+    _predict_op_cls = _huge.Word2VecPredictBatchOp
+
+
+class Word2Vec(EstimatorBase):
+    """(reference: pipeline/nlp/Word2Vec.java)"""
+
+    _train_op_cls = _huge.Word2VecTrainBatchOp
+    _model_cls = Word2VecModel
+    SELECTED_COL = _huge.HasWord2VecParams.SELECTED_COL
+    VECTOR_SIZE = _huge.HasWord2VecParams.VECTOR_SIZE
+    WINDOW = _huge.HasWord2VecParams.WINDOW
+    NUM_ITER = _huge.HasWord2VecParams.NUM_ITER
+    MIN_COUNT = _huge.HasWord2VecParams.MIN_COUNT
+    PREDICTION_COL = _huge.HasPredictionCol.PREDICTION_COL

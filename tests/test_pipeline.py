@@ -167,3 +167,17 @@ def test_bayes_search_cv():
     assert lam < 1.0
     out = res.transform(src).collect()
     assert "pred" in out.names
+
+
+def test_word2vec_pipeline():
+    import numpy as np
+
+    from alink_tpu.operator.batch import MemSourceBatchOp
+    from alink_tpu.pipeline import Pipeline, Word2Vec
+
+    docs = ["cat dog cat dog", "sun moon sun moon"] * 20
+    src = MemSourceBatchOp([(d,) for d in docs], "doc string")
+    model = Pipeline(Word2Vec(selectedCol="doc", vectorSize=12, numIter=4,
+                              predictionCol="vec")).fit(src)
+    out = model.transform(src).collect()
+    assert out.col("vec")[0].data.shape == (12,)

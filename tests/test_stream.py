@@ -250,3 +250,23 @@ def test_csv_stream_source(tmp_path):
     chunks = list(src._stream())
     assert [c.num_rows for c in chunks] == [4, 4, 2]
     assert chunks[0].col("v")[1] == 2.5
+
+
+def test_summarizer_stream_cumulative():
+    import numpy as np
+
+    from alink_tpu.common.mtable import MTable
+    from alink_tpu.operator.stream import (SummarizerStreamOp,
+                                           TableSourceStreamOp)
+
+    vals = np.arange(100, dtype=np.float64)
+    t = MTable({"v": vals})
+    rows = list(SummarizerStreamOp().link_from(
+        TableSourceStreamOp(t, chunkSize=25))._stream())
+    assert len(rows) == 4
+    first, last = rows[0], rows[-1]
+    assert first.col("count")[0] == 25
+    assert last.col("count")[0] == 100
+    assert last.col("mean")[0] == 49.5
+    assert last.col("max")[0] == 99.0
+    assert abs(last.col("variance")[0] - vals.var(ddof=1)) < 1e-9
