@@ -170,7 +170,10 @@ def generate_stubs(out_dir: Optional[str] = None) -> List[str]:
 
         for cls in ops:
             lines.append(f"class {cls.__name__}:")
-            args = ["self", "params: Any = ..."]
+            # *args accepts each op's real positional constructor shape
+            # (MemSourceBatchOp(rows, schema), NumSeqSource(from_, to), ...)
+            # while the typed keywords drive completion
+            args = ["self", "*args: Any"]
             for p in params_of(cls):
                 # python keywords (e.g. ALS's `lambda`) stay settable via
                 # kwargs at runtime but cannot appear in a stub signature

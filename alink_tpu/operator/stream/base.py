@@ -203,6 +203,7 @@ class CsvSourceStreamOp(StreamOperator):
                            aliases=("schema",))
     FIELD_DELIMITER = ParamInfo("fieldDelimiter", str, default=",")
     IGNORE_FIRST_LINE = ParamInfo("ignoreFirstLine", bool, default=False)
+    QUOTE_CHAR = ParamInfo("quoteChar", str, default='"')
     CHUNK_SIZE = ParamInfo("chunkSize", int, default=1024)
 
     _max_inputs = 0
@@ -210,12 +211,8 @@ class CsvSourceStreamOp(StreamOperator):
     def _stream_impl(self) -> Iterator[MTable]:
         from ..batch.base import CsvSourceBatchOp
 
-        table = CsvSourceBatchOp(
-            filePath=self.get(self.FILE_PATH),
-            schemaStr=self.get(self.SCHEMA_STR),
-            fieldDelimiter=self.get(self.FIELD_DELIMITER),
-            ignoreFirstLine=self.get(self.IGNORE_FIRST_LINE),
-        )._execute_impl()
+        # forward ALL params so batch-reader options are never dropped
+        table = CsvSourceBatchOp(self.get_params().clone())._execute_impl()
         cs = max(1, self.get(self.CHUNK_SIZE))
         for s in range(0, table.num_rows, cs):
             yield table.slice(s, min(s + cs, table.num_rows))

@@ -100,14 +100,25 @@ class SummarizerStreamOp(StreamOperator):
         import numpy as np
 
         from ...common.mtable import AlinkTypes, MTable, TableSchema
-        from ...stats.summarizer import SUMMARY_KEYS, summary_schema
+        from ...stats.summarizer import summary_schema
 
         state = {}  # col -> [count, sum, sum2, min, max, missing]
+        text_state = {}  # non-numeric col -> [count, missing]
         cols = self.get(self.SELECTED_COLS)
         for chunk in it:
-            use = cols or [
-                n for n, tp in zip(chunk.names, chunk.schema.types)
-                if AlinkTypes.is_numeric(tp)]
+            selected = cols or list(chunk.names)
+            use = [c for c in selected
+                   if AlinkTypes.is_numeric(chunk.schema.type_of(c))]
+            # non-numeric columns track count/missing only (same contract as
+            # the batch summarize() add_non_numeric path)
+            for c in selected:
+                if c in use:
+                    continue
+                vals = chunk.col(c)
+                st = text_state.setdefault(c, [0.0, 0.0])
+                miss = sum(1 for v in vals if v is None)
+                st[0] += len(vals) - miss
+                st[1] += miss
             for c in use:
                 arr = np.asarray(chunk.col(c), np.float64)
                 ok = arr[~np.isnan(arr)]
@@ -128,4 +139,7 @@ class SummarizerStreamOp(StreamOperator):
                     if cnt > 1 else 0.0
                 rows.append((c, cnt, st[5], st[1], mean, var,
                              float(np.sqrt(max(var, 0.0))), st[3], st[4]))
+            nan = float("nan")
+            for c, st in text_state.items():
+                rows.append((c, st[0], st[1], nan, nan, nan, nan, nan, nan))
             yield MTable.from_rows(rows, summary_schema())
