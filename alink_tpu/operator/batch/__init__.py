@@ -219,6 +219,8 @@ from .feature2 import (
     PcaTrainBatchOp,
     QuantileDiscretizerPredictBatchOp,
     QuantileDiscretizerTrainBatchOp,
+    AutoCrossBatchOp,
+    AutoCrossPredictBatchOp,
     DCTBatchOp,
 )
 from .dataproc import (

@@ -652,3 +652,119 @@ class DCTMapper(Mapper, HasSelectedCol, HasOutputCol, HasReservedCols):
 class DCTBatchOp(MapBatchOp, HasSelectedCol, HasOutputCol, HasReservedCols):
     mapper_cls = DCTMapper
     INVERSE = DCTMapper.INVERSE
+
+
+class AutoCrossBatchOp(ModelTrainOpMixin, BatchOperator):
+    """Greedy categorical feature-cross search (reference:
+    operator/batch/feature/AutoCrossTrainBatchOp.java + common/fe AutoCross —
+    beam search over crosses scored by downstream LR gain).
+
+    Re-design (compact): candidate pairwise crosses of the categorical
+    columns are scored by the holdout AUC gain of a logistic regression on
+    (base one-hot + cross one-hot); the top ``numCross`` winners persist in
+    the model, and serving appends each cross as a combined categorical
+    column crossed_a_b = "a=..#b=..". Chain OneHot afterwards for vectors."""
+
+    CATEGORICAL_COLS = ParamInfo("categoricalCols", list, optional=False)
+    LABEL_COL = ParamInfo("labelCol", str, optional=False)
+    NUM_CROSS = ParamInfo("numCross", int, default=2,
+                          validator=MinValidator(1))
+    POSITIVE_LABEL = ParamInfo("positiveLabelValueString", str)
+    RANDOM_SEED = ParamInfo("randomSeed", int, default=0, aliases=("seed",))
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    def _encode(self, cols_vals):
+        """one-hot index encode a list of string columns -> CSR-ish dense."""
+        mats = []
+        for vals in cols_vals:
+            uniq, inv = np.unique(vals, return_inverse=True)
+            m = np.zeros((len(vals), len(uniq)), np.float32)
+            m[np.arange(len(vals)), inv] = 1.0
+            mats.append(m)
+        return np.concatenate(mats, axis=1) if mats else \
+            np.zeros((0, 0), np.float32)
+
+    def _auc(self, X, y, seed):
+        from ...optim import logistic_obj, optimize
+        from .evaluation import rank_auc
+
+        rng = np.random.default_rng(seed)
+        n = len(y)
+        perm = rng.permutation(n)
+        cut = int(n * 0.7)
+        tr, te = perm[:cut], perm[cut:]
+        Xb = np.concatenate([X, np.ones((n, 1), np.float32)], axis=1)
+        res = optimize(logistic_obj(Xb.shape[1]), Xb[tr], y[tr],
+                       max_iter=40, l2=1e-3)
+        scores = Xb[te] @ res.weights
+        return rank_auc(scores, y[te] > 0)
+
+    def _execute_impl(self, t: MTable) -> MTable:
+        from itertools import combinations
+
+        cols = list(self.get(self.CATEGORICAL_COLS))
+        label_col = self.get(self.LABEL_COL)
+        y_raw = np.asarray(t.col(label_col), object).astype(str)
+        pos = self.get(self.POSITIVE_LABEL) or sorted(set(y_raw))[0]
+        y = np.where(y_raw == str(pos), 1.0, -1.0).astype(np.float32)
+        seed = self.get(self.RANDOM_SEED)
+
+        base_vals = {c: np.asarray(t.col(c), object).astype(str)
+                     for c in cols}
+        base_X = self._encode([base_vals[c] for c in cols])
+        base_auc = self._auc(base_X, y, seed)
+
+        scored = []
+        for a, b in combinations(cols, 2):
+            crossed = np.asarray(
+                [f"{x}#{z}" for x, z in zip(base_vals[a], base_vals[b])],
+                object)
+            X = np.concatenate(
+                [base_X, self._encode([crossed])], axis=1)
+            gain = self._auc(X, y, seed) - base_auc
+            scored.append(((a, b), float(gain)))
+        scored.sort(key=lambda s: -s[1])
+        chosen = [list(pair) for pair, gain in
+                  scored[:self.get(self.NUM_CROSS)] if gain > 0]
+        meta = {
+            "modelName": "AutoCrossModel",
+            "categoricalCols": cols,
+            "crosses": chosen,
+            "baseAuc": float(base_auc),
+            "gains": {f"{a}#{b}": g for (a, b), g in scored},
+        }
+        return model_to_table(meta, {})
+
+    def _static_meta_keys(self, in_schema):
+        return {"modelName": "AutoCrossModel"}
+
+
+class AutoCrossModelMapper(ModelMapper, HasReservedCols):
+    """Appends one combined categorical column per learned cross."""
+
+    def load_model(self, model: MTable):
+        self.meta, _ = table_to_model(model)
+        return self
+
+    def output_schema(self, input_schema):
+        names = list(input_schema.names)
+        types = list(input_schema.types)
+        for a, b in self.meta["crosses"]:
+            names.append(f"cross_{a}_{b}")
+            types.append(AlinkTypes.STRING)
+        return TableSchema(names, types)
+
+    def map_table(self, t: MTable) -> MTable:
+        out = t
+        for a, b in self.meta["crosses"]:
+            va = np.asarray(t.col(a), object).astype(str)
+            vb = np.asarray(t.col(b), object).astype(str)
+            crossed = np.asarray([f"{x}#{z}" for x, z in zip(va, vb)], object)
+            out = out.with_column(f"cross_{a}_{b}", crossed, AlinkTypes.STRING)
+        return out
+
+
+class AutoCrossPredictBatchOp(ModelMapBatchOp, HasReservedCols):
+    mapper_cls = AutoCrossModelMapper

@@ -231,3 +231,45 @@ def test_onehot_pipeline_estimator():
                           outputCol="v").fit(src)
     out = model.transform(src).collect()
     assert out.col("v")[0].n == 3
+
+
+def test_directreader_bridges(tmp_path):
+    from alink_tpu.io.ak import write_ak
+    from alink_tpu.io.directreader import (DirectReader, LocalFileDataBridge,
+                                           MemoryDataBridge)
+    from alink_tpu.operator.batch import StandardScalerTrainBatchOp
+
+    src = MemSourceBatchOp([(1.0,), (3.0,)], "v double")
+    train = StandardScalerTrainBatchOp(selectedCols=["v"]).link_from(src)
+    model = train.collect()
+    # memory, file, and op references all normalize to the same table
+    p = str(tmp_path / "m.ak")
+    write_ak(p, model)
+    for ref in (model, p, train, MemoryDataBridge(model),
+                LocalFileDataBridge(p)):
+        got = DirectReader.read(ref)
+        assert list(got.col("key")) == list(model.col("key"))
+
+
+def test_autocross_finds_interaction():
+    rng = np.random.default_rng(0)
+    n = 600
+    a = rng.choice(["x", "y"], n)
+    b = rng.choice(["p", "q"], n)
+    c = rng.choice(["m", "n"], n)          # noise column
+    # label is the XOR of a and b — invisible to marginals, visible to a#b
+    label = ((a == "x") ^ (b == "p")).astype(int)
+    rows = list(zip(a, b, c, label))
+    src = MemSourceBatchOp(rows, "a string, b string, c string, label int")
+    from alink_tpu.operator.batch import (AutoCrossBatchOp,
+                                          AutoCrossPredictBatchOp)
+
+    model = AutoCrossBatchOp(categoricalCols=["a", "b", "c"],
+                             labelCol="label", numCross=1,
+                             positiveLabelValueString="1").link_from(src)
+    from alink_tpu.common.model import table_to_model
+    meta, _ = table_to_model(model.collect())
+    assert meta["crosses"] == [["a", "b"]]   # the XOR pair wins
+    out = AutoCrossPredictBatchOp().link_from(model, src).collect()
+    assert "cross_a_b" in out.names
+    assert out.col("cross_a_b")[0] == f"{a[0]}#{b[0]}"
