@@ -10,6 +10,10 @@ mappers replacing per-row JVM inference.
 
 __version__ = "0.1.0"
 
+from .common.env import enable_compilation_cache as _enable_cc  # noqa: E402
+
+_enable_cc()
+
 from .common import (  # noqa: F401
     AlinkTypes,
     DenseMatrix,

@@ -56,6 +56,58 @@ class AlinkGlobalConfiguration:
         return "jax-xla"
 
 
+_cache_enabled = False
+
+
+def enable_compilation_cache(cache_dir: Optional[str] = None) -> None:
+    """Point JAX at a persistent XLA compilation cache so short jobs (e.g. a
+    KMeans fit) pay compile cost once per machine, not once per process.
+
+    Called at package import; calling again with an explicit ``cache_dir``
+    re-points the cache. When jax is not yet imported this only sets env
+    vars (jax reads them at init) so ``import alink_tpu`` stays jax-free.
+    Env override: ``ALINK_COMPILATION_CACHE_DIR`` (empty string disables)."""
+    global _cache_enabled
+    env = os.environ.get("ALINK_COMPILATION_CACHE_DIR")
+    if env == "":
+        return
+    if cache_dir is None:
+        if _cache_enabled:
+            return
+        # CPU-only processes (tests, virtual meshes) skip the default-on
+        # cache: XLA:CPU AOT entries are machine-feature-pinned and reload
+        # with SIGILL-risk warnings; the win this targets is the real TPU
+        # chip, where compiles cost 20-40s
+        if env is None and os.environ.get("JAX_PLATFORMS",
+                                          "").strip() == "cpu":
+            return
+    d = cache_dir or env or os.path.join(
+        os.path.expanduser("~"), ".cache", "alink_tpu", "xla_cache")
+    try:
+        import sys
+
+        os.makedirs(d, exist_ok=True)
+        if "jax" in sys.modules:
+            import jax
+
+            jax.config.update("jax_compilation_cache_dir", d)
+            # cache everything: the default 1s floor skips exactly the
+            # small per-op programs this framework compiles most often
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 0.0)
+            jax.config.update(
+                "jax_persistent_cache_min_entry_size_bytes", -1)
+        else:
+            os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", d)
+            os.environ.setdefault(
+                "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.0")
+            os.environ.setdefault(
+                "JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")
+        _cache_enabled = True
+    except Exception:  # pragma: no cover — older jax w/o these flags
+        pass
+
+
 class MLEnvironment:
     """One session: device mesh + lazy manager + host thread pool."""
 

@@ -26,10 +26,14 @@ class TrainCheckpointManager:
         self._ocp = ocp
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
+        # item_handlers makes item_metadata() work on a fresh manager (the
+        # restart case), which restore_latest uses to discover the saved
+        # `extra` structure without materializing arrays
         self._mgr = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
                 max_to_keep=max_to_keep, create=True),
+            item_handlers=ocp.StandardCheckpointHandler(),
         )
 
     def save(self, step: int, params, opt_state, extra: Dict[str, Any]):
@@ -42,20 +46,42 @@ class TrainCheckpointManager:
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
 
-    def restore_latest(self, params_target, opt_state_target
+    def restore_latest(self, params_target, opt_state_target,
+                       extra_target: Optional[Dict[str, Any]] = None
                        ) -> Optional[Tuple[Any, Any, Dict[str, Any]]]:
         """Restore (params, opt_state, extra) from the newest checkpoint,
         using the given freshly-initialized pytrees as structure targets.
+        ``extra_target`` mirrors whatever dict was passed to ``save``; when
+        omitted, ``extra`` restores structure-free so arbitrary keys saved
+        by the caller round-trip instead of being forced into step/epoch.
         None when no checkpoint exists."""
         step = self._mgr.latest_step()
         if step is None:
             return None
         import jax
 
+        if extra_target is None:
+            # discover extra's saved structure from checkpoint METADATA (no
+            # array materialization — a full untargeted restore would read
+            # params twice and ignore the caller's shardings)
+            import numpy as _np
+
+            def _leaf_target(m):
+                dtype = getattr(m, "dtype", None)
+                if dtype is None:
+                    return m
+                return _np.zeros(getattr(m, "shape", ()) or (), dtype)
+
+            try:
+                meta = self._mgr.item_metadata(step)
+                tree = meta.tree if hasattr(meta, "tree") else meta
+                extra_target = jax.tree.map(_leaf_target, tree["extra"])
+            except Exception:  # pragma: no cover — older orbax metadata API
+                extra_target = self._mgr.restore(step)["extra"]
         target = {
             "params": jax.tree.map(lambda x: x, params_target),
             "opt_state": jax.tree.map(lambda x: x, opt_state_target),
-            "extra": {"step": 0, "epoch": 0},
+            "extra": jax.tree.map(lambda x: x, extra_target),
         }
         restored = self._mgr.restore(
             step, args=self._ocp.args.StandardRestore(target))

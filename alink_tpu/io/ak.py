@@ -19,6 +19,7 @@ from typing import List, Optional
 
 from ..common.exceptions import AkParseErrorException
 from ..common.mtable import MTable
+from .filesystem import file_open
 
 META_ENTRY = "alink_meta.json"
 DATA_PREFIX = "data/part-"
@@ -29,7 +30,8 @@ def write_ak(path: str, table: MTable, num_partitions: int = 1, extra_meta: Opti
     n = table.num_rows
     num_partitions = max(1, min(num_partitions, max(1, n)))
     bounds = [round(i * n / num_partitions) for i in range(num_partitions + 1)]
-    with zipfile.ZipFile(path, "w", compression=zipfile.ZIP_DEFLATED) as zf:
+    with file_open(path, "wb") as fobj, \
+            zipfile.ZipFile(fobj, "w", compression=zipfile.ZIP_DEFLATED) as zf:
         metas: List[str] = []
         for p in range(num_partitions):
             import numpy as np
@@ -51,7 +53,7 @@ def write_ak(path: str, table: MTable, num_partitions: int = 1, extra_meta: Opti
 
 
 def read_ak(path: str) -> MTable:
-    with zipfile.ZipFile(path, "r") as zf:
+    with file_open(path, "rb") as fobj, zipfile.ZipFile(fobj, "r") as zf:
         try:
             header = json.loads(zf.read(META_ENTRY))
         except KeyError:
@@ -64,5 +66,5 @@ def read_ak(path: str) -> MTable:
 
 
 def read_ak_meta(path: str) -> dict:
-    with zipfile.ZipFile(path, "r") as zf:
+    with file_open(path, "rb") as fobj, zipfile.ZipFile(fobj, "r") as zf:
         return json.loads(zf.read(META_ENTRY))

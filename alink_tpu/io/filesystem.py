@@ -1,0 +1,202 @@
+"""Pluggable filesystem abstraction — every file-touching op works on any
+``scheme://`` URI.
+
+Capability parity with the reference's filesystem layer (reference:
+core/src/main/java/com/alibaba/alink/common/io/filesystem/BaseFileSystem.java
+— local/HDFS/OSS/S3 behind one interface; FilePath.java pairs a path with its
+filesystem; AkUtils.java:52 reads ``.ak`` files off any of them; the remote
+drivers arrive through the plugin downloader).
+
+Re-design: scheme-dispatched. Plain paths (no ``://``) use the stdlib local
+implementation with zero dependencies; any URI routes through **fsspec**
+(``memory://``, ``file://``, ``s3://``, ``gs://``, ``hdfs://``, ``oss://``,
+…), which plays the plugin-registry role — the protocol's driver package
+(s3fs, gcsfs, …) is resolved lazily and a missing driver raises the same
+actionable install guidance the reference's plugin system prints.
+``memory://`` ships with fsspec itself and is the test double for a remote
+store (the MiniCluster analog for IO)."""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import posixpath
+import shutil
+from typing import Callable, Dict, IO, List
+
+from ..common.exceptions import AkIllegalArgumentException, AkPluginNotExistException
+
+
+def _has_scheme(path: str) -> bool:
+    if "://" not in path:
+        return False
+    scheme = path.split("://", 1)[0]
+    return bool(scheme) and all(c.isalnum() or c in "+-." for c in scheme)
+
+
+class BaseFileSystem:
+    """The surface the framework needs: open / exists / list / mkdir /
+    delete / rename. Subclass + :func:`register_file_system` to add a
+    scheme."""
+
+    scheme: str = ""
+
+    def open(self, path: str, mode: str = "r") -> IO:
+        raise NotImplementedError
+
+    def exists(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def isdir(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def listdir(self, path: str) -> List[str]:
+        """Basenames of entries in ``path`` (not full URIs)."""
+        raise NotImplementedError
+
+    def makedirs(self, path: str) -> None:
+        raise NotImplementedError
+
+    def delete(self, path: str, recursive: bool = False) -> None:
+        raise NotImplementedError
+
+    def rename(self, src: str, dst: str) -> None:
+        """Atomic where the store supports it (local POSIX); remote stores
+        fall back to copy+delete."""
+        raise NotImplementedError
+
+    def join(self, *parts: str) -> str:
+        return posixpath.join(*parts)
+
+
+class LocalFileSystem(BaseFileSystem):
+    """(reference: common/io/filesystem/LocalFileSystem.java)"""
+
+    scheme = "file"
+
+    @staticmethod
+    def _strip(path: str) -> str:
+        return path[len("file://"):] if path.startswith("file://") else path
+
+    def open(self, path: str, mode: str = "r") -> IO:
+        return open(self._strip(path), mode)
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(self._strip(path))
+
+    def isdir(self, path: str) -> bool:
+        return os.path.isdir(self._strip(path))
+
+    def listdir(self, path: str) -> List[str]:
+        return os.listdir(self._strip(path))
+
+    def makedirs(self, path: str) -> None:
+        os.makedirs(self._strip(path), exist_ok=True)
+
+    def delete(self, path: str, recursive: bool = False) -> None:
+        p = self._strip(path)
+        if os.path.isdir(p):
+            if recursive:
+                shutil.rmtree(p)
+            else:
+                os.rmdir(p)
+        elif os.path.exists(p):
+            os.remove(p)
+
+    def rename(self, src: str, dst: str) -> None:
+        os.replace(self._strip(src), self._strip(dst))
+
+    def join(self, *parts: str) -> str:
+        return os.path.join(*parts)
+
+
+class FsspecFileSystem(BaseFileSystem):
+    """Any fsspec protocol (memory/s3/gs/hdfs/oss/…). The driver package for
+    remote protocols is plugin-gated exactly like the reference's downloaded
+    connector jars."""
+
+    def __init__(self, scheme: str):
+        self.scheme = scheme
+        try:
+            import fsspec
+        except ImportError as e:  # pragma: no cover — fsspec is baked in
+            raise AkPluginNotExistException(
+                "remote file URIs need the 'fsspec' package") from e
+        try:
+            self._fs = fsspec.filesystem(scheme)
+        except (ImportError, ValueError) as e:
+            raise AkPluginNotExistException(
+                f"filesystem scheme '{scheme}://' needs its fsspec driver "
+                f"package installed (e.g. s3fs for s3://, gcsfs for gs://); "
+                f"underlying error: {e}") from e
+
+    def open(self, path: str, mode: str = "r") -> IO:
+        return self._fs.open(path, mode)
+
+    def exists(self, path: str) -> bool:
+        return self._fs.exists(path)
+
+    def isdir(self, path: str) -> bool:
+        return self._fs.isdir(path)
+
+    def listdir(self, path: str) -> List[str]:
+        out = []
+        for p in self._fs.ls(path, detail=False):
+            out.append(posixpath.basename(p.rstrip("/")))
+        return out
+
+    def makedirs(self, path: str) -> None:
+        self._fs.makedirs(path, exist_ok=True)
+
+    def delete(self, path: str, recursive: bool = False) -> None:
+        if self._fs.exists(path):
+            self._fs.rm(path, recursive=recursive)
+
+    def rename(self, src: str, dst: str) -> None:
+        # single-writer stores have no atomic rename; copy+delete is the
+        # honest portable contract (reference remote FS do the same)
+        self._fs.mv(src, dst)
+
+
+_registry: Dict[str, Callable[[], BaseFileSystem]] = {}
+_instances: Dict[str, BaseFileSystem] = {}
+
+
+def register_file_system(scheme: str,
+                         factory: Callable[[], BaseFileSystem]) -> None:
+    """Register a custom scheme (tests and embedded stores)."""
+    _registry[scheme] = factory
+    _instances.pop(scheme, None)
+
+
+def get_file_system(path: str) -> BaseFileSystem:
+    """Scheme-dispatch: plain paths and ``file://`` → local; anything else →
+    registered factory or fsspec."""
+    if not _has_scheme(path):
+        scheme = "file"
+    else:
+        scheme = path.split("://", 1)[0]
+    if scheme not in _instances:
+        if scheme in _registry:
+            _instances[scheme] = _registry[scheme]()
+        elif scheme == "file":
+            _instances[scheme] = LocalFileSystem()
+        else:
+            _instances[scheme] = FsspecFileSystem(scheme)
+    return _instances[scheme]
+
+
+@contextlib.contextmanager
+def file_open(path: str, mode: str = "r"):
+    """Open ``path`` on whatever filesystem its scheme names."""
+    if not isinstance(path, (str, os.PathLike)):
+        raise AkIllegalArgumentException(f"not a path: {path!r}")
+    f = get_file_system(str(path)).open(str(path), mode)
+    try:
+        yield f
+    finally:
+        f.close()
+
+
+def path_join(base: str, *parts: str) -> str:
+    return get_file_system(base).join(base, *parts)

@@ -18,6 +18,8 @@ from __future__ import annotations
 import struct
 from typing import Dict, Iterable, List, Tuple
 
+from .filesystem import file_open
+
 # -- CRC32C (Castagnoli), table-driven ---------------------------------------
 
 _CRC_TABLE = []
@@ -62,7 +64,7 @@ def write_records(path: str, payloads: Iterable[bytes]):
     if nat is not None:
         # frame in bounded chunks so generator inputs stream to disk
         chunk: List[bytes] = []
-        with open(path, "wb") as f:
+        with file_open(path, "wb") as f:
             for payload in payloads:
                 chunk.append(bytes(payload))
                 if len(chunk) >= 1024:
@@ -71,7 +73,7 @@ def write_records(path: str, payloads: Iterable[bytes]):
             if chunk:
                 f.write(nat.frame_records(chunk))
         return
-    with open(path, "wb") as f:
+    with file_open(path, "wb") as f:
         for payload in payloads:
             header = struct.pack("<Q", len(payload))
             f.write(header)
@@ -83,10 +85,10 @@ def write_records(path: str, payloads: Iterable[bytes]):
 def read_records(path: str) -> List[bytes]:
     nat = _native()
     if nat is not None:
-        with open(path, "rb") as f:
+        with file_open(path, "rb") as f:
             return nat.unframe_records(f.read())
     out = []
-    with open(path, "rb") as f:
+    with file_open(path, "rb") as f:
         while True:
             header = f.read(8)
             if len(header) < 8:

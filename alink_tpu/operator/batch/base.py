@@ -111,15 +111,18 @@ class CsvSourceBatchOp(BatchOperator):
     def _execute_impl(self) -> MTable:
         import pandas as pd
 
+        from ...io.filesystem import file_open
+
         schema = TableSchema.parse(self.get(self.SCHEMA_STR))
-        df = pd.read_csv(
-            self.get(self.FILE_PATH),
-            sep=self.get(self.FIELD_DELIMITER),
-            header=0 if self.get(self.IGNORE_FIRST_LINE) else None,
-            names=schema.names,
-            quotechar=self.get(self.QUOTE_CHAR),
-            skipinitialspace=True,
-        )
+        with file_open(self.get(self.FILE_PATH)) as f:
+            df = pd.read_csv(
+                f,
+                sep=self.get(self.FIELD_DELIMITER),
+                header=0 if self.get(self.IGNORE_FIRST_LINE) else None,
+                names=schema.names,
+                quotechar=self.get(self.QUOTE_CHAR),
+                skipinitialspace=True,
+            )
         cols = {}
         for n, t in zip(schema.names, schema.types):
             s = df[n]
@@ -192,14 +195,19 @@ class CsvSinkBatchOp(BatchOperator):
     _max_inputs = 1
 
     def _execute_impl(self, t: MTable) -> MTable:
+        from ...io.filesystem import file_open, get_file_system
+
         path = self.get(self.FILE_PATH)
-        if os.path.exists(path) and not self.get(self.OVERWRITE_SINK):
+        if get_file_system(path).exists(path) \
+                and not self.get(self.OVERWRITE_SINK):
             raise AkIllegalArgumentException(
                 f"sink path {path} exists; set overwriteSink=True"
             )
-        t.to_dataframe().to_csv(
-            path, sep=self.get(self.FIELD_DELIMITER), index=False, header=False
-        )
+        with file_open(path, "w") as f:
+            t.to_dataframe().to_csv(
+                f, sep=self.get(self.FIELD_DELIMITER), index=False,
+                header=False
+            )
         return t
 
     def _out_schema(self, in_schema: TableSchema) -> TableSchema:
@@ -250,8 +258,11 @@ class AkSinkBatchOp(BatchOperator):
     def _execute_impl(self, t: MTable) -> MTable:
         from ...io.ak import write_ak
 
+        from ...io.filesystem import get_file_system
+
         path = self.get(self.FILE_PATH)
-        if os.path.exists(path) and not self.get(self.OVERWRITE_SINK):
+        if get_file_system(path).exists(path) \
+                and not self.get(self.OVERWRITE_SINK):
             raise AkIllegalArgumentException(
                 f"sink path {path} exists; set overwriteSink=True"
             )

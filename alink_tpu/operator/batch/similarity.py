@@ -336,7 +336,9 @@ class VectorNearestNeighborModelMapper(ModelMapper, HasSelectedCol,
                                      1e-12)
                 d = 1.0 - Qn @ Xn.T
             else:
-                d = pairwise_sq_dists(Q, X)
+                # true Euclidean distance in the emitted JSON, matching the
+                # reference's EuclideanDistance (clip guards fp32 negatives)
+                d = jnp.sqrt(jnp.maximum(pairwise_sq_dists(Q, X), 0.0))
             neg_d, idx = jax.lax.top_k(-d, k)
             return idx, -neg_d
 
@@ -371,7 +373,8 @@ class VectorNearestNeighborModelMapper(ModelMapper, HasSelectedCol,
             for qi in range(Q.shape[0]):
                 ham = (qs[qi][None, :] != self._sigs).sum(axis=1)
                 cand = np.argsort(ham, kind="stable")[:n_cand]
-                d = ((self.X[cand] - Q[qi]) ** 2).sum(axis=1)
+                d = np.sqrt(np.maximum(
+                    ((self.X[cand] - Q[qi]) ** 2).sum(axis=1), 0.0))
                 if self.meta["metric"] == "COSINE":
                     xn = self.X[cand] / np.maximum(
                         np.linalg.norm(self.X[cand], axis=1, keepdims=True),

@@ -19,6 +19,7 @@ from ...common.exceptions import AkIllegalArgumentException
 from ...common.linalg import SparseVector, format_vector, parse_vector
 from ...common.mtable import AlinkTypes, MTable, TableSchema
 from ...common.params import ParamInfo
+from ...io.filesystem import file_open
 from .base import BatchOperator
 
 _LIBSVM_SCHEMA = TableSchema(["label", "features"],
@@ -41,7 +42,7 @@ class LibSvmSourceBatchOp(BatchOperator):
         vecs: List[SparseVector] = []
         max_dim = 0
         parsed = []
-        with open(self.get(self.FILE_PATH)) as f:
+        with file_open(self.get(self.FILE_PATH)) as f:
             for line in f:
                 line = line.strip()
                 if not line or line.startswith("#"):
@@ -79,7 +80,7 @@ class LibSvmSinkBatchOp(BatchOperator):
 
     def _execute_impl(self, t: MTable) -> MTable:
         start = int(self.get(self.START_INDEX))
-        with open(self.get(self.FILE_PATH), "w") as f:
+        with file_open(self.get(self.FILE_PATH), "w") as f:
             for label, vec in zip(t.col(self.get(self.LABEL_COL)),
                                   t.col(self.get(self.VECTOR_COL))):
                 v = parse_vector(vec)
@@ -178,7 +179,8 @@ class ParquetSourceBatchOp(BatchOperator):
     def _execute_impl(self) -> MTable:
         import pandas as pd
 
-        df = pd.read_parquet(self.get(self.FILE_PATH))
+        with file_open(self.get(self.FILE_PATH), "rb") as f:
+            df = pd.read_parquet(f)
         return MTable({c: df[c].to_numpy() for c in df.columns})
 
     def _out_schema(self) -> TableSchema:
@@ -186,7 +188,8 @@ class ParquetSourceBatchOp(BatchOperator):
         # loading the data (pyarrow reads the footer only)
         import pyarrow.parquet as pq
 
-        pa_schema = pq.read_schema(self.get(self.FILE_PATH))
+        with file_open(self.get(self.FILE_PATH), "rb") as f:
+            pa_schema = pq.read_schema(f)
         names, types = [], []
         for field in pa_schema:
             names.append(field.name)
@@ -218,7 +221,8 @@ class ParquetSinkBatchOp(BatchOperator):
                 data[n] = [format_vector(parse_vector(v)) for v in col]
             else:
                 data[n] = col
-        pd.DataFrame(data).to_parquet(self.get(self.FILE_PATH), index=False)
+        with file_open(self.get(self.FILE_PATH), "wb") as f:
+            pd.DataFrame(data).to_parquet(f, index=False)
         return t
 
     def _out_schema(self, in_schema):
@@ -237,7 +241,7 @@ class TextSourceBatchOp(BatchOperator):
     _max_inputs = 0
 
     def _execute_impl(self) -> MTable:
-        with open(self.get(self.FILE_PATH)) as f:
+        with file_open(self.get(self.FILE_PATH)) as f:
             lines = [line.rstrip("\n") for line in f]
         col = self.get(self.TEXT_COL)
         return MTable({col: np.asarray(lines, object)},
@@ -259,7 +263,7 @@ class TsvSourceBatchOp(BatchOperator):
     def _execute_impl(self) -> MTable:
         schema = TableSchema.parse(self.get(self.SCHEMA_STR))
         rows = []
-        with open(self.get(self.FILE_PATH)) as f:
+        with file_open(self.get(self.FILE_PATH)) as f:
             for line in f:
                 line = line.rstrip("\n")
                 if not line:
@@ -278,7 +282,7 @@ class TsvSinkBatchOp(BatchOperator):
     _max_inputs = 1
 
     def _execute_impl(self, t: MTable) -> MTable:
-        with open(self.get(self.FILE_PATH), "w") as f:
+        with file_open(self.get(self.FILE_PATH), "w") as f:
             for row in t.rows():
                 f.write("\t".join("" if v is None else str(v)
                                   for v in row) + "\n")

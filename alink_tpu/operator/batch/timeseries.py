@@ -197,8 +197,9 @@ class ArimaBatchOp(_BaseForecastOp):
         # re-run the residual recursion host-side, then iterate forward
         m = max(p, q)
         e_hist = [0.0] * max(q, 1)
-        w_hist = list(w[:m][::-1]) + [0.0] * max(p - m, 0)
-        w_hist = (w_hist + [0.0] * p)[:max(p, 1)]
+        # zero-seed the history exactly as the CSS scan in _arma_css_fit does,
+        # so forecast residuals match what the optimizer minimized
+        w_hist = [0.0] * max(p, 1)
         errs = []
         for t in range(m, len(w)):
             pred = c + sum(ph * wh for ph, wh in zip(phi, w_hist)) \

@@ -98,6 +98,15 @@ def _result_to_mtable(cursor: sqlite3.Cursor) -> MTable:
     return MTable(cols, TableSchema(names, types))
 
 
+def _register_inputs(conn: sqlite3.Connection, tables: Sequence[MTable]):
+    """The one place encoding the op-input naming contract: input i is
+    ``t{i}``; ``t`` aliases ``t0``."""
+    for i, t in enumerate(tables):
+        register_mtable(conn, f"t{i}", t)
+    if tables:
+        conn.execute("CREATE TEMP VIEW t AS SELECT * FROM t0")
+
+
 def sql_query(query: str, tables: Dict[str, MTable]) -> MTable:
     """Run one SQL statement over named MTables (the Calcite-executor
     analog)."""
@@ -127,21 +136,20 @@ class SqlQueryBatchOp(BatchOperator):
     _max_inputs = None
 
     def _execute_impl(self, *tables: MTable) -> MTable:
-        named = {f"t{i}": t for i, t in enumerate(tables)}
         q = self.get(self.QUERY)
         conn = sqlite3.connect(":memory:")
         try:
-            for name, t in named.items():
-                register_mtable(conn, name, t)
-            conn.execute("CREATE TEMP VIEW t AS SELECT * FROM t0")
+            _register_inputs(conn, tables)
             return _result_to_mtable(conn.execute(q))
         finally:
             conn.close()
 
     def _out_schema(self, *in_schemas) -> TableSchema:
-        # probe the query over ONE dummy typed row per input: a zero-row
-        # sqlite result carries no value types and would mis-derive the
-        # static schema as all-STRING
+        # probe the query over ONE dummy typed row per input; when the
+        # query's predicate filters that row (zero-row results carry no
+        # sqlite value types), fall back to declared-type metadata from a
+        # temp view over the same query (PRAGMA table_info) plus the
+        # registered input column types — never the value of the dummy row
         def dummy(schema: TableSchema) -> MTable:
             cols = {}
             for n, tp in zip(schema.names, schema.types):
@@ -159,8 +167,30 @@ class SqlQueryBatchOp(BatchOperator):
                 [tp if not AlinkTypes.is_vector(tp) else AlinkTypes.STRING
                  for tp in schema.types]))
 
-        return self._execute_impl(
-            *[dummy(s) for s in in_schemas]).schema
+        probed = self._execute_impl(*[dummy(s) for s in in_schemas])
+        if probed.num_rows > 0:
+            return probed.schema
+        # name → declared type across all inputs (later inputs don't shadow)
+        by_name: Dict[str, str] = {}
+        for s in in_schemas:
+            for n, tp in zip(s.names, s.types):
+                by_name.setdefault(n, tp)
+        conn = sqlite3.connect(":memory:")
+        try:
+            _register_inputs(conn, [dummy(s) for s in in_schemas])
+            conn.execute(
+                f"CREATE TEMP VIEW __probe AS {self.get(self.QUERY)}")
+            decl = {"REAL": AlinkTypes.DOUBLE, "INTEGER": AlinkTypes.LONG,
+                    "TEXT": AlinkTypes.STRING}
+            names, types = [], []
+            for row in conn.execute("PRAGMA table_info(__probe)"):
+                col, dtype = row[1], (row[2] or "").upper()
+                names.append(col)
+                types.append(decl.get(dtype) or by_name.get(col)
+                             or AlinkTypes.STRING)
+            return TableSchema(names, types)
+        finally:
+            conn.close()
 
 
 class JdbcSourceBatchOp(BatchOperator):

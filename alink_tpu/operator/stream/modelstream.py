@@ -24,6 +24,7 @@ from ...common.exceptions import AkIllegalArgumentException
 from ...common.mtable import MTable, TableSchema
 from ...common.params import ParamInfo
 from ...io.ak import read_ak, write_ak
+from ...io.filesystem import get_file_system
 from .base import StreamOperator
 
 
@@ -32,15 +33,16 @@ class FileModelStreamSink:
     FileModelStreamSink.java)."""
 
     def __init__(self, path: str):
-        self.path = os.path.abspath(path)
-        os.makedirs(self.path, exist_ok=True)
+        self._fs = get_file_system(path)
+        self.path = path if "://" in path else os.path.abspath(path)
+        self._fs.makedirs(self.path)
 
     def write(self, model: MTable, timestamp: Optional[int] = None) -> str:
         ts = int(time.time() * 1000) if timestamp is None else int(timestamp)
-        final = os.path.join(self.path, f"{ts}.ak")
+        final = self._fs.join(self.path, f"{ts}.ak")
         tmp = final + ".tmp"
         write_ak(tmp, model)
-        os.replace(tmp, final)  # atomic landing — scanners never see partials
+        self._fs.rename(tmp, final)  # atomic landing on POSIX; mv elsewhere
         return final
 
 
@@ -48,9 +50,10 @@ def scan_model_dir(path: str, after: int = -1) -> List[Tuple[int, str]]:
     """(timestamp, file) pairs newer than ``after``, in timestamp order
     (reference: ModelStreamFileScanner.scanToFile)."""
     out = []
-    if not os.path.isdir(path):
+    fs = get_file_system(path)
+    if not fs.isdir(path):
         return out
-    for name in os.listdir(path):
+    for name in fs.listdir(path):
         if not name.endswith(".ak"):
             continue
         stem = name[:-3]
@@ -58,7 +61,7 @@ def scan_model_dir(path: str, after: int = -1) -> List[Tuple[int, str]]:
             continue
         ts = int(stem)
         if ts > after:
-            out.append((ts, os.path.join(path, name)))
+            out.append((ts, fs.join(path, name)))
     out.sort()
     return out
 

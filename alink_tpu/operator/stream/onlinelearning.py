@@ -35,6 +35,10 @@ from ...mapper import (
 from ..batch.linear import LinearModelMapper
 from .base import ModelMapStreamOp, StreamOperator
 
+# warm-up chunks buffer host-side until both classes arrive; bound the
+# buffer so a one-label stream fails fast instead of accumulating RAM
+_WARMUP_MAX_ROWS = 100_000
+
 
 @functools.lru_cache(maxsize=8)
 def _ftrl_step_fn(alpha: float, beta: float, l1: float, l2: float):
@@ -122,9 +126,32 @@ class FtrlTrainStreamOp(StreamOperator, HasFtrlParams):
             n = jnp.zeros_like(z)
 
         batch_no = 0
+        warmup: list = []  # chunks buffered until 2 distinct labels arrive
+        seen_labels: set = set(labels or [])
         for chunk in it:
             if chunk.num_rows == 0:
                 continue
+            seen_labels.update(np.asarray(chunk.col(label_col)).tolist())
+            if len(seen_labels) > 2:
+                raise AkIllegalDataException(
+                    "FTRL is binary; saw labels "
+                    f"{sorted(map(str, seen_labels))}")
+            if labels is None or len(labels) < 2:
+                # same warm-up contract as OnlineFm: a label-skewed first
+                # chunk must not train a one-label model
+                if len(seen_labels) < 2:
+                    warmup.append(chunk)
+                    if sum(c.num_rows for c in warmup) > _WARMUP_MAX_ROWS:
+                        raise AkIllegalDataException(
+                            "FTRL warm-up saw only one label in the first "
+                            f"{_WARMUP_MAX_ROWS} rows; a binary stream must "
+                            "deliver both classes early (or warm-start from "
+                            "a batch model carrying the label set)")
+                    continue
+                labels = sorted(seen_labels, key=str)
+                if warmup:
+                    chunk = MTable.concat(warmup + [chunk])
+                    warmup = []
             if vec_col:
                 X = chunk.to_numeric_block(
                     [vec_col],
@@ -138,16 +165,6 @@ class FtrlTrainStreamOp(StreamOperator, HasFtrlParams):
                 X = chunk.to_numeric_block(feat_cols).astype(np.float32)
             Xb = np.concatenate([X, np.ones((X.shape[0], 1), np.float32)], 1)
             y_raw = np.asarray(chunk.col(label_col)).tolist()
-            # accumulate distinct labels across chunks; snapshots are held
-            # back until both classes have been observed
-            if labels is None:
-                labels = sorted(set(y_raw), key=str)[:2]
-            elif len(labels) < 2:
-                for v in y_raw:
-                    if v not in labels:
-                        labels = labels + [v]
-                        if len(labels) == 2:
-                            break
             y = np.asarray(
                 [1.0 if v == labels[0] else 0.0 for v in y_raw], np.float32
             )
@@ -320,6 +337,11 @@ class OnlineFmTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols):
                         f"OnlineFm is binary; saw labels {sorted(map(str, seen_labels))}")
                 if len(seen_labels) < 2:
                     warmup.append(chunk)
+                    if sum(c.num_rows for c in warmup) > _WARMUP_MAX_ROWS:
+                        raise AkIllegalDataException(
+                            "OnlineFm warm-up saw only one label in the "
+                            f"first {_WARMUP_MAX_ROWS} rows; a binary stream "
+                            "must deliver both classes early")
                     continue
                 labels = sorted(seen_labels, key=lambda v: str(v))
                 label_type = chunk.schema.type_of(label_col)
