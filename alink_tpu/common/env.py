@@ -69,7 +69,7 @@ def enable_compilation_cache(cache_dir: Optional[str] = None) -> None:
     Env override: ``ALINK_COMPILATION_CACHE_DIR`` (empty string disables)."""
     global _cache_enabled
     env = os.environ.get("ALINK_COMPILATION_CACHE_DIR")
-    if env == "":
+    if env == "" and cache_dir is None:
         return
     if cache_dir is None:
         if _cache_enabled:
@@ -97,6 +97,12 @@ def enable_compilation_cache(cache_dir: Optional[str] = None) -> None:
                 "jax_persistent_cache_min_compile_time_secs", 0.0)
             jax.config.update(
                 "jax_persistent_cache_min_entry_size_bytes", -1)
+        elif cache_dir is not None:
+            # explicit re-point before jax import must override any earlier
+            # default this function wrote
+            os.environ["JAX_COMPILATION_CACHE_DIR"] = d
+            os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] = "0.0"
+            os.environ["JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES"] = "-1"
         else:
             os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", d)
             os.environ.setdefault(

@@ -367,6 +367,10 @@ from ..sqlengine import (
     SqlQueryBatchOp,
     sql_query,
 )
+from ...io.kv import (
+    KvSinkBatchOp,
+    LookupKvBatchOp,
+)
 from .huge import (
     DeepWalkBatchOp,
     LineBatchOp,

@@ -6,7 +6,6 @@ Capability parity with reference operator/batch/BatchOperator.java:67 (collect a
 
 from __future__ import annotations
 
-import os
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np

@@ -30,6 +30,12 @@ from .onlinelearning import (
     OnlineFmTrainStreamOp,
     OnlineLearningStreamOp,
 )
+from .connectors import (
+    KafkaSinkStreamOp,
+    KafkaSourceStreamOp,
+    KvSinkStreamOp,
+    LookupKvStreamOp,
+)
 
 __all__ = [
     "CsvSourceStreamOp",
@@ -51,4 +57,8 @@ __all__ = [
     "OnlineLearningStreamOp",
     "FtrlPredictStreamOp",
     "FtrlTrainStreamOp",
+    "KafkaSinkStreamOp",
+    "KafkaSourceStreamOp",
+    "KvSinkStreamOp",
+    "LookupKvStreamOp",
 ] + list(_generated.__all__) + list(_outlier_stream.__all__)

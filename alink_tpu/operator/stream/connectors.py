@@ -1,0 +1,155 @@
+"""Connector stream ops: Kafka topic source/sink + KV lookup/sink twins
+(reference: operator/stream/source/KafkaSourceStreamOp.java, connector-kafka;
+LookupRedisStreamOp, LookupHBaseStreamOp, RedisSinkStreamOp)."""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+from ...common.params import InValidator, ParamInfo
+from ...common.mtable import MTable, TableSchema
+from ...io.kafka import _decode_rows, _encode_row, _open_consumer, _open_producer
+from ...io.kv import KvSinkBatchOp, LookupKvBatchOp
+from ...io.kv import open_kv_store
+from ...mapper import HasOutputCols, HasSelectedCols
+from .base import StreamOperator
+
+
+class LookupKvStreamOp(StreamOperator):
+    """Per-chunk KV decoration (reference: LookupRedisStreamOp /
+    LookupHBaseStreamOp). Same params as the batch twin; the store handle
+    stays open across chunks."""
+
+    STORE_URI = LookupKvBatchOp.STORE_URI
+    OUTPUT_TYPES = LookupKvBatchOp.OUTPUT_TYPES
+    SELECTED_COLS = HasSelectedCols.SELECTED_COLS
+    OUTPUT_COLS = HasOutputCols.OUTPUT_COLS
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    def _stream_impl(self, it):
+        inner = LookupKvBatchOp(self.get_params().clone())
+        store = open_kv_store(self.get(self.STORE_URI))
+        try:
+            for chunk in it:
+                yield inner._decorate(chunk, store)
+        finally:
+            store.close()
+
+    def _out_schema(self, in_schema):
+        return LookupKvBatchOp(self.get_params().clone())._out_schema(
+            in_schema)
+
+
+class KvSinkStreamOp(StreamOperator):
+    """Per-chunk KV writes (reference: RedisSinkStreamOp)."""
+
+    STORE_URI = ParamInfo("storeUri", str, optional=False)
+    KEY_COL = ParamInfo("keyCol", str, optional=False)
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    def _stream_impl(self, it):
+        inner = KvSinkBatchOp(self.get_params().clone())
+        store = open_kv_store(self.get(self.STORE_URI))
+        try:
+            for chunk in it:
+                inner._write(chunk, store)
+                yield chunk
+        finally:
+            store.close()
+
+    def _out_schema(self, in_schema):
+        return in_schema
+
+
+class KafkaSourceStreamOp(StreamOperator):
+    """Consume a topic as micro-batch MTable chunks (reference:
+    KafkaSourceStreamOp.java — properties bootstrapServers/topic/groupId/
+    startingOffsets; message format CSV or JSON).
+
+    Bounded by ``maxMessages``/``idleTimeoutMs`` so batch-style replays and
+    tests terminate (the reference stream polls forever)."""
+
+    BOOTSTRAP_SERVERS = ParamInfo("bootstrapServers", str, optional=False,
+                                  aliases=("properties.bootstrap.servers",))
+    TOPIC = ParamInfo("topic", str, optional=False)
+    GROUP_ID = ParamInfo("groupId", str, default=None)
+    STARTUP_MODE = ParamInfo("startupMode", str, default="EARLIEST",
+                             validator=InValidator("EARLIEST", "LATEST"))
+    FORMAT = ParamInfo("format", str, default="JSON",
+                       validator=InValidator("JSON", "CSV"))
+    FIELD_DELIMITER = ParamInfo("fieldDelimiter", str, default=",")
+    SCHEMA_STR = ParamInfo("schemaStr", str, optional=False,
+                           aliases=("schema",))
+    CHUNK_SIZE = ParamInfo("chunkSize", int, default=256)
+    MAX_MESSAGES = ParamInfo("maxMessages", int, default=0,
+                             desc="stop after N messages; 0 = until idle")
+    IDLE_TIMEOUT_MS = ParamInfo("idleTimeoutMs", int, default=1000,
+                                desc="stop when the topic stays empty this "
+                                     "long")
+
+    _max_inputs = 0
+
+    def _stream_impl(self) -> Iterator[MTable]:
+        schema = TableSchema.parse(self.get(self.SCHEMA_STR))
+        fmt = self.get(self.FORMAT)
+        delim = self.get(self.FIELD_DELIMITER)
+        chunk = max(1, self.get(self.CHUNK_SIZE))
+        max_messages = self.get(self.MAX_MESSAGES)
+        idle_ms = self.get(self.IDLE_TIMEOUT_MS)
+        consumer = _open_consumer(
+            self.get(self.BOOTSTRAP_SERVERS), self.get(self.TOPIC),
+            self.get(self.GROUP_ID), self.get(self.STARTUP_MODE))
+        taken = 0
+        try:
+            while True:
+                budget = chunk if not max_messages \
+                    else min(chunk, max_messages - taken)
+                if budget <= 0:
+                    return
+                payloads = consumer.poll_batch(budget, idle_ms)
+                if not payloads:
+                    return  # idle past the bound — terminate the replay
+                taken += len(payloads)
+                yield _decode_rows(payloads, schema, fmt, delim)
+        finally:
+            consumer.close()
+
+    def _out_schema(self) -> TableSchema:
+        return TableSchema.parse(self.get(self.SCHEMA_STR))
+
+
+class KafkaSinkStreamOp(StreamOperator):
+    """Produce every row of every chunk to a topic (reference:
+    KafkaSinkStreamOp.java — dataFormat CSV|JSON)."""
+
+    BOOTSTRAP_SERVERS = ParamInfo("bootstrapServers", str, optional=False)
+    TOPIC = ParamInfo("topic", str, optional=False)
+    FORMAT = ParamInfo("format", str, default="JSON",
+                       validator=InValidator("JSON", "CSV"),
+                       aliases=("dataFormat",))
+    FIELD_DELIMITER = ParamInfo("fieldDelimiter", str, default=",")
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    def _stream_impl(self, it: Iterator[MTable]) -> Iterator[MTable]:
+        producer = _open_producer(self.get(self.BOOTSTRAP_SERVERS))
+        topic = self.get(self.TOPIC)
+        fmt = self.get(self.FORMAT)
+        delim = self.get(self.FIELD_DELIMITER)
+        try:
+            for t in it:
+                for row in t.rows():
+                    producer.send(
+                        topic, _encode_row(t.names, row, fmt, delim))
+                yield t
+        finally:
+            producer.flush()
+            producer.close()
+
+    def _out_schema(self, in_schema: TableSchema) -> TableSchema:
+        return in_schema

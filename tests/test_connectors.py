@@ -1,0 +1,179 @@
+"""Kafka + KV connector edges (reference: connectors/connector-kafka,
+LookupRedisBatchOp/LookupHBaseBatchOp, RedisSinkStreamOp), driven against
+the in-process broker / memory KV store the way the reference tests run
+against embedded servers."""
+
+import json
+
+import numpy as np
+import pytest
+
+from alink_tpu.common.exceptions import AkPluginNotExistException
+from alink_tpu.common.mtable import MTable
+from alink_tpu.io.kafka import (
+    KafkaSinkStreamOp,
+    KafkaSourceStreamOp,
+    MemoryKafkaBroker,
+)
+from alink_tpu.io.kv import (
+    KvSinkBatchOp,
+    LookupKvBatchOp,
+    MemoryKvStore,
+    open_kv_store,
+)
+from alink_tpu.operator.batch.base import MemSourceBatchOp
+from alink_tpu.operator.stream import (
+    KvSinkStreamOp,
+    LookupKvStreamOp,
+    TableSourceStreamOp,
+)
+
+
+def test_kafka_source_json():
+    broker = MemoryKafkaBroker.named("t-src")
+    for i in range(10):
+        broker.produce("events", json.dumps(
+            {"id": i, "x": i * 0.5}).encode())
+    src = KafkaSourceStreamOp(
+        bootstrapServers="memory://t-src", topic="events",
+        schemaStr="id long, x double", chunkSize=4, idleTimeoutMs=50)
+    chunks = list(src._stream())
+    assert sum(c.num_rows for c in chunks) == 10
+    got = [r for c in chunks for r in c.rows()]
+    assert got[0][0] == 0 and abs(got[9][1] - 4.5) < 1e-9
+
+
+def test_kafka_source_csv_and_max_messages():
+    broker = MemoryKafkaBroker.named("t-csv")
+    for i in range(8):
+        broker.produce("lines", f"{i},{i * 2}".encode())
+    src = KafkaSourceStreamOp(
+        bootstrapServers="memory://t-csv", topic="lines", format="CSV",
+        schemaStr="a long, b long", maxMessages=5, idleTimeoutMs=50)
+    total = sum(c.num_rows for c in src._stream())
+    assert total == 5
+
+
+def test_kafka_sink_roundtrip():
+    t = MTable.from_rows([(1, "x"), (2, "y")], "id long, s string")
+    sink = KafkaSinkStreamOp(
+        bootstrapServers="memory://t-sink", topic="out").link_from(
+        TableSourceStreamOp(t, chunkSize=1))
+    list(sink._stream())
+    broker = MemoryKafkaBroker.named("t-sink")
+    msgs = [json.loads(p) for p in broker._topics["out"]]
+    assert msgs == [{"id": 1, "s": "x"}, {"id": 2, "s": "y"}]
+
+
+def test_kafka_startup_mode_latest():
+    broker = MemoryKafkaBroker.named("t-latest")
+    broker.produce("tp", b'{"a": 1}')
+    consumer = broker.consumer("tp", "LATEST")
+    broker.produce("tp", b'{"a": 2}')
+    got = consumer.poll_batch(10, 10)
+    assert [json.loads(p)["a"] for p in got] == [2]
+
+
+def test_ftrl_from_kafka_end_to_end():
+    """The VERDICT done-criterion: FTRL consumes a Kafka topic through the
+    public stream DAG and emits servable model snapshots."""
+    from alink_tpu.common.model import table_to_model
+    from alink_tpu.operator.stream import FtrlTrainStreamOp
+
+    rng = np.random.default_rng(0)
+    broker = MemoryKafkaBroker.named("t-ftrl")
+    w_true = np.array([2.0, -1.5])
+    for i in range(400):
+        x = rng.normal(size=2)
+        y = "pos" if x @ w_true + 0.1 * rng.normal() > 0 else "neg"
+        broker.produce("clicks", json.dumps(
+            {"f0": float(x[0]), "f1": float(x[1]), "label": y}).encode())
+    src = KafkaSourceStreamOp(
+        bootstrapServers="memory://t-ftrl", topic="clicks",
+        schemaStr="f0 double, f1 double, label string",
+        chunkSize=50, idleTimeoutMs=50)
+    ftrl = FtrlTrainStreamOp(
+        featureCols=["f0", "f1"], labelCol="label",
+        alpha=0.5, modelSaveInterval=2).link_from(src)
+    models = list(ftrl._stream())
+    assert len(models) >= 3
+    meta, arrays = table_to_model(models[-1])
+    assert sorted(meta["labels"]) == ["neg", "pos"]
+    # labels[0] ("neg") is the modeled class, so weights point along
+    # -w_true: sign pattern flips
+    w = arrays["weights"].reshape(-1)
+    assert w[0] < 0 and w[1] > 0
+
+
+def test_kv_sink_then_lookup_batch():
+    MemoryKvStore._named.pop("users", None)
+    profile = MemSourceBatchOp(
+        [("u1", 25, 0.9), ("u2", 31, 0.4)], "uid string, age long, score double")
+    profile.link(KvSinkBatchOp(storeUri="memory://users",
+                               keyCol="uid")).collect()
+    events = MemSourceBatchOp(
+        [("e1", "u2"), ("e2", "u1"), ("e3", "u9")], "eid string, uid string")
+    out = events.link(LookupKvBatchOp(
+        storeUri="memory://users", selectedCols=["uid"],
+        outputCols=["age", "score"],
+        outputTypes=["LONG", "DOUBLE"])).collect()
+    # numeric outputs are nullable → DOUBLE with NaN misses
+    ages = np.asarray(out.col("age"), float)
+    assert ages[0] == 31 and ages[1] == 25 and np.isnan(ages[2])
+    scores = np.asarray(out.col("score"), float)
+    assert abs(scores[0] - 0.4) < 1e-9 and np.isnan(scores[2])
+    assert out.schema.names[-2:] == ["age", "score"]
+
+
+def test_kv_stream_twins():
+    MemoryKvStore._named.pop("kvstream", None)
+    t = MTable.from_rows([("k1", 1.0), ("k2", 2.0)], "k string, v double")
+    sink = KvSinkStreamOp(storeUri="memory://kvstream", keyCol="k") \
+        .link_from(TableSourceStreamOp(t, chunkSize=1))
+    list(sink._stream())
+    assert open_kv_store("memory://kvstream").get("k2") == {"v": 2.0}
+    data = MTable.from_rows([("k1",), ("k2",)], "k string")
+    look = LookupKvStreamOp(
+        storeUri="memory://kvstream", selectedCols=["k"],
+        outputCols=["v"], outputTypes=["DOUBLE"]) \
+        .link_from(TableSourceStreamOp(data, chunkSize=1))
+    rows = [r for c in look._stream() for r in c.rows()]
+    assert [r[1] for r in rows] == [1.0, 2.0]
+
+
+def test_real_kafka_plugin_gated():
+    src = KafkaSourceStreamOp(
+        bootstrapServers="broker:9092", topic="t", schemaStr="a long")
+    with pytest.raises(AkPluginNotExistException, match="kafka-python"):
+        list(src._stream())
+
+
+def test_redis_plugin_gated():
+    with pytest.raises(AkPluginNotExistException, match="redis"):
+        open_kv_store("redis://localhost:6379/0")
+
+
+def test_kafka_csv_quoting_roundtrip():
+    from alink_tpu.io.kafka import _decode_rows, _encode_row
+    from alink_tpu.common.mtable import TableSchema
+
+    schema = TableSchema.parse("name string, n long")
+    payload = _encode_row(["name", "n"], ("Smith, John", 3), "CSV", ",")
+    t = _decode_rows([payload], schema, "CSV", ",")
+    assert t.get_row(0) == ("Smith, John", 3)
+
+
+def test_lookup_kv_reserved_cols():
+    MemoryKvStore._named.pop("rkv", None)
+    MemSourceBatchOp([("u1", 7.0)], "uid string, v double").link(
+        KvSinkBatchOp(storeUri="memory://rkv", keyCol="uid")).collect()
+    events = MemSourceBatchOp(
+        [("e1", "u1", "junk")], "eid string, uid string, extra string")
+    op = LookupKvBatchOp(
+        storeUri="memory://rkv", selectedCols=["uid"], outputCols=["v"],
+        outputTypes=["DOUBLE"], reservedCols=["eid"])
+    out = events.link(op).collect()
+    assert out.schema.names == ["eid", "v"]
+    assert out.get_row(0) == ("e1", 7.0)
+    # static schema agrees with runtime
+    assert op._out_schema(events._out_schema()).names == ["eid", "v"]
