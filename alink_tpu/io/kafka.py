@@ -178,15 +178,33 @@ def _open_producer(servers: str):
 
 def _decode_rows(payloads: Sequence[bytes], schema: TableSchema,
                  fmt: str, delimiter: str) -> MTable:
+    from ..common.exceptions import AkIllegalDataException
+    from ..common.mtable import AlinkTypes
+
+    numeric = [AlinkTypes.is_numeric(tp) or tp == AlinkTypes.BOOLEAN
+               for tp in schema.types]
+    int_cols = [tp in (AlinkTypes.LONG, AlinkTypes.INT)
+                for tp in schema.types]
     rows = []
     for p in payloads:
         text = p.decode("utf-8")
         if fmt == "JSON":
             obj = json.loads(text)
-            rows.append(tuple(obj.get(n) for n in schema.names))
-        else:  # CSV — proper quoting so delimiter-bearing fields survive
+            row = tuple(obj.get(n) for n in schema.names)
+        else:  # CSV — proper quoting so delimiter-bearing fields survive;
+            # empty numeric fields are NULLs (the sink writes None as "")
             parsed = next(csv.reader([text], delimiter=delimiter))
-            rows.append(tuple(parsed))
+            row = tuple(
+                None if (v == "" and num) else v
+                for v, num in zip(parsed, numeric))
+        for v, is_int, name in zip(row, int_cols, schema.names):
+            if v is None and is_int:
+                # integer columns have no NULL representation (nullable
+                # numerics are DOUBLE+NaN framework-wide)
+                raise AkIllegalDataException(
+                    f"NULL in integer column '{name}' of a Kafka message; "
+                    "declare the column as double to carry NULLs as NaN")
+        rows.append(row)
     return MTable.from_rows(rows, schema)
 
 

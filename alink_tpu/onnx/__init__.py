@@ -9,10 +9,13 @@ single jit-compiled XLA program instead of bridged to a foreign runtime.
 """
 
 from .proto import OnnxGraph, OnnxModel, NodeProto, TensorProto, ValueInfo
-from .convert import OnnxToJax, load_onnx_fn
+from .convert import OnnxToJax, load_onnx_fn, supported_onnx_ops
 from .torchfx import TorchToJax, load_torch_fn
+from .tfsaved import TFGraphToJax, load_saved_model_fn, supported_tf_ops
 
 __all__ = [
     "OnnxGraph", "OnnxModel", "NodeProto", "TensorProto", "ValueInfo",
-    "OnnxToJax", "load_onnx_fn", "TorchToJax", "load_torch_fn",
+    "OnnxToJax", "load_onnx_fn", "supported_onnx_ops",
+    "TorchToJax", "load_torch_fn",
+    "TFGraphToJax", "load_saved_model_fn", "supported_tf_ops",
 ]

@@ -661,3 +661,11 @@ def _ensure_registered():
         _register_elementwise()
         _register_reduce()
         _registered = True
+
+
+def supported_onnx_ops() -> List[str]:
+    """The published conformance manifest: every ONNX op type the
+    ONNX->JAX compiler understands. Graphs using anything else raise
+    AkUnsupportedOperationException naming the op."""
+    _ensure_registered()
+    return sorted(_OPS)

@@ -367,7 +367,7 @@ from ..sqlengine import (
     SqlQueryBatchOp,
     sql_query,
 )
-from ...io.kv import (
+from .connectors import (
     KvSinkBatchOp,
     LookupKvBatchOp,
 )

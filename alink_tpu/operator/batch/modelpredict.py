@@ -11,10 +11,12 @@ mapper-open time (see alink_tpu.onnx); prediction is a batched device launch —
 no plugin processes, no per-row JNI hops. Fixed-size batching with tail
 padding keeps a single compiled executable hot for any table size.
 
-SavedModel note: TensorFlow is not a dependency of this framework. The
-SavedModel path is served by exporting to StableHLO (jax.export) or ONNX;
-``TFSavedModelPredictBatchOp`` exists for API parity and raises with that
-guidance unless tensorflow is importable in the environment.
+SavedModel note: TensorFlow is not a runtime dependency of this framework.
+``TFSavedModelPredictBatchOp`` freezes the serving signature and compiles its
+GraphDef into one JAX/XLA program (alink_tpu/onnx/tfsaved.py); tensorflow is
+needed only at LOAD time to parse the artifact (plugin-gated). Environments
+without tensorflow serve SavedModels by exporting to StableHLO (jax.export)
+or ONNX first.
 """
 
 from __future__ import annotations
@@ -279,33 +281,31 @@ class StableHloModelPredictBatchOp(MapBatchOp, HasIngestParams):
     mapper_cls = StableHloModelMapper
 
 
-class TFSavedModelPredictBatchOp(BatchOperator, HasIngestParams):
-    """API-parity shim (reference: TFSavedModelPredictBatchOp.java).
+class TFSavedModelMapper(_BaseIngestMapper, HasIngestParams):
+    """SavedModel serving signature → one compiled XLA program (reference:
+    predictor-tf TFPredictorServiceImpl.java:139 SavedModelBundle.load; here
+    the frozen GraphDef compiles through alink_tpu/onnx/tfsaved.py and the
+    TF runtime never runs a batch)."""
 
-    TensorFlow is not part of this framework's environment; SavedModels are
-    served by converting to StableHLO (jax.export) or ONNX first. If a
-    tensorflow installation is present, the SavedModel is loaded and executed
-    via tf's own runtime as a host fallback.
-    """
+    SIGNATURE_DEF_KEY = ParamInfo(
+        "signatureDefKey", str, default="serving_default",
+        aliases=("signatureDef",))
 
-    _min_inputs = 1
-    _max_inputs = 1
+    def _load(self, path: str):
+        from ...onnx.tfsaved import load_saved_model_fn
 
-    def _execute_impl(self, t: MTable) -> MTable:
-        try:
-            import tensorflow  # noqa: F401
-        except ImportError:
-            raise AkUnsupportedOperationException(
-                "tensorflow is not installed; export the SavedModel to "
-                "StableHLO (jax.export) and use StableHloModelPredictBatchOp, "
-                "or to ONNX and use OnnxModelPredictBatchOp"
-            )
-        raise AkUnsupportedOperationException(
-            "direct SavedModel execution is not implemented in this build"
-        )
+        jfn, in_names, out_info = load_saved_model_fn(
+            path, self.get(self.SIGNATURE_DEF_KEY))
+        self._in_names = in_names
+        self._out_info = out_info
+        self._fn = jfn
 
-    def _out_schema(self, in_schema: TableSchema) -> TableSchema:
-        return in_schema
+
+class TFSavedModelPredictBatchOp(MapBatchOp, HasIngestParams):
+    """(reference: operator/batch/tensorflow/TFSavedModelPredictBatchOp.java)"""
+
+    mapper_cls = TFSavedModelMapper
+    SIGNATURE_DEF_KEY = TFSavedModelMapper.SIGNATURE_DEF_KEY
 
 
 def export_stablehlo(fn, example_args: Sequence, path: str):

@@ -16,6 +16,7 @@ from .modelstream import (
 from .modelpredict import (
     OnnxModelPredictStreamOp,
     StableHloModelPredictStreamOp,
+    TFSavedModelPredictStreamOp,
     TorchModelPredictStreamOp,
 )
 from . import outlier as _outlier_stream
@@ -50,6 +51,7 @@ __all__ = [
     "SummarizerStreamOp",
     "OnnxModelPredictStreamOp",
     "StableHloModelPredictStreamOp",
+    "TFSavedModelPredictStreamOp",
     "TorchModelPredictStreamOp",
     "BinaryClassModelFilterStreamOp",
     "OnlineFmPredictStreamOp",

@@ -9,8 +9,8 @@ from typing import Iterator
 from ...common.params import InValidator, ParamInfo
 from ...common.mtable import MTable, TableSchema
 from ...io.kafka import _decode_rows, _encode_row, _open_consumer, _open_producer
-from ...io.kv import KvSinkBatchOp, LookupKvBatchOp
 from ...io.kv import open_kv_store
+from ..batch.connectors import KvSinkBatchOp, LookupKvBatchOp
 from ...mapper import HasOutputCols, HasSelectedCols
 from .base import StreamOperator
 
@@ -104,15 +104,24 @@ class KafkaSourceStreamOp(StreamOperator):
             self.get(self.BOOTSTRAP_SERVERS), self.get(self.TOPIC),
             self.get(self.GROUP_ID), self.get(self.STARTUP_MODE))
         taken = 0
+        # cumulative-idle bound: short poll slices accumulate toward
+        # idleTimeoutMs and reset on data, so a slow first poll (real-broker
+        # consumer-group join) doesn't end the stream before any message
+        poll_slice = max(50, min(idle_ms, 200))
+        idle_spent = 0
         try:
             while True:
                 budget = chunk if not max_messages \
                     else min(chunk, max_messages - taken)
                 if budget <= 0:
                     return
-                payloads = consumer.poll_batch(budget, idle_ms)
+                payloads = consumer.poll_batch(budget, poll_slice)
                 if not payloads:
-                    return  # idle past the bound — terminate the replay
+                    idle_spent += poll_slice
+                    if idle_spent >= idle_ms:
+                        return  # idle past the bound — end the replay
+                    continue
+                idle_spent = 0
                 taken += len(payloads)
                 yield _decode_rows(payloads, schema, fmt, delim)
         finally:

@@ -12,6 +12,7 @@ from ..batch.modelpredict import (
     HasIngestParams,
     OnnxModelMapper,
     StableHloModelMapper,
+    TFSavedModelMapper,
     TorchModelMapper,
 )
 from .base import MapStreamOp
@@ -27,3 +28,8 @@ class TorchModelPredictStreamOp(MapStreamOp, HasIngestParams):
 
 class StableHloModelPredictStreamOp(MapStreamOp, HasIngestParams):
     mapper_cls = StableHloModelMapper
+
+
+class TFSavedModelPredictStreamOp(MapStreamOp, HasIngestParams):
+    mapper_cls = TFSavedModelMapper
+    SIGNATURE_DEF_KEY = TFSavedModelMapper.SIGNATURE_DEF_KEY
