@@ -29,7 +29,10 @@ def test_kernel_matches_scatter(n, d, S):
     np.testing.assert_allclose(out, _reference(ids, vals, S), atol=1e-4)
 
 
-def test_gbdt_same_trees_with_pallas(monkeypatch):
+def test_forest_same_trees_with_pallas(monkeypatch):
+    # train_forest still rides the per-level kernel (_level_fn), which is
+    # where the pallas histogram lives; GBDT moved to the fused MXU-matmul
+    # program, so forest is the op-level parity surface for this kernel
     from alink_tpu.tree import grow
 
     rng = np.random.default_rng(1)
@@ -38,14 +41,30 @@ def test_gbdt_same_trees_with_pallas(monkeypatch):
 
     monkeypatch.setenv("ALINK_GBDT_PALLAS", "0")
     grow._level_fn.cache_clear()   # kernels capture the flag at build time
-    ens_off = grow.train_gbdt(X, y, task="binary", num_trees=3, depth=3,
-                              num_bins=16)
+    ens_off = grow.train_forest(X, y, task="binary", num_trees=3, depth=3,
+                                num_bins=16, bootstrap=False,
+                                feature_fraction=1.0)
     base = ens_off.raw_predict(X)
 
     monkeypatch.setenv("ALINK_GBDT_PALLAS", "1")
     grow._level_fn.cache_clear()
-    ens_on = grow.train_gbdt(X, y, task="binary", num_trees=3, depth=3,
-                             num_bins=16)
+    ens_on = grow.train_forest(X, y, task="binary", num_trees=3, depth=3,
+                               num_bins=16, bootstrap=False,
+                               feature_fraction=1.0)
     np.testing.assert_allclose(ens_on.raw_predict(X), base, atol=1e-5)
     grow._level_fn.cache_clear()   # don't leak pallas kernels to other tests
     monkeypatch.setenv("ALINK_GBDT_PALLAS", "0")
+
+
+def test_gbdt_mxu_hist_matches_exact_reference():
+    # the fused GBDT computes histograms as bf16 one-hot matmuls; verify a
+    # small ensemble still matches labels the exact-arithmetic way would fit
+    from alink_tpu.tree import grow
+
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(400, 6)).astype(np.float32)
+    y = (X[:, 0] - 0.7 * X[:, 2] > 0.1).astype(np.float32)
+    ens = grow.train_gbdt(X, y, task="binary", num_trees=8, depth=4,
+                          num_bins=32)
+    acc = (((ens.raw_predict(X)[:, 0] > 0)) == (y > 0)).mean()
+    assert acc > 0.97
