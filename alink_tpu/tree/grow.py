@@ -32,6 +32,44 @@ from .binning import apply_bins, quantile_bins
 # ---------------------------------------------------------------------------
 
 
+def _split_search(hg, hh, hc, fmask, l2, min_samples, min_gain):
+    """Histograms (L, d, B) -> (feat (L,), thr (L,)). THE split contract:
+    cumsum left/right gains, min-child-count + last-bin masks, flat argmax;
+    feat -1 = no split. Shared by the per-level kernel (forest) and the
+    fused GBDT program so the semantics cannot drift."""
+    import jax.numpy as jnp
+
+    L, d, B = hg.shape
+    GL = jnp.cumsum(hg, axis=-1)
+    HL = jnp.cumsum(hh, axis=-1)
+    CL = jnp.cumsum(hc, axis=-1)
+    G, H, C = GL[..., -1:], HL[..., -1:], CL[..., -1:]
+    GR, HR, CR = G - GL, H - HL, C - CL
+    gain = (GL * GL / (HL + l2) + GR * GR / (HR + l2) - G * G / (H + l2))
+    ok = (CL >= min_samples) & (CR >= min_samples)
+    # last bin position means "everything left" — not a split
+    ok = ok & (jnp.arange(B)[None, None, :] < B - 1)
+    gain = jnp.where(ok & (fmask[None, :, None] > 0), gain, -jnp.inf)
+    flat = gain.reshape(L, d * B)
+    best = jnp.argmax(flat, axis=1)
+    best_gain = jnp.take_along_axis(flat, best[:, None], 1)[:, 0]
+    feat = jnp.where(best_gain > min_gain, best // B, -1).astype(jnp.int32)
+    thr = jnp.where(best_gain > min_gain, best % B, B - 1).astype(jnp.int32)
+    return feat, thr
+
+
+def _route(bins, node, feat, thr):
+    """Send each sample to its child: f<0 routes left (no split)."""
+    import jax.numpy as jnp
+
+    f_s = feat[node]
+    t_s = thr[node]
+    safe_f = jnp.maximum(f_s, 0)
+    x_bin = jnp.take_along_axis(bins, safe_f[:, None], 1)[:, 0]
+    go_left = (f_s < 0) | (x_bin <= t_s)
+    return node * 2 + (1 - go_left.astype(jnp.int32))
+
+
 @functools.lru_cache(maxsize=64)
 def _level_fn(mesh_key, num_nodes: int, num_bins: int, l2: float,
               min_samples: float, min_gain: float):
@@ -70,39 +108,9 @@ def _level_fn(mesh_key, num_nodes: int, num_bins: int, l2: float,
         hg = jax.lax.psum(seg(g), axis)
         hh = jax.lax.psum(seg(h), axis)
         hc = jax.lax.psum(seg(c), axis)
-
-        GL = jnp.cumsum(hg, axis=-1)
-        HL = jnp.cumsum(hh, axis=-1)
-        CL = jnp.cumsum(hc, axis=-1)
-        G = GL[..., -1:]
-        H = HL[..., -1:]
-        C = CL[..., -1:]
-        GR, HR, CR = G - GL, H - HL, C - CL
-
-        gain = (
-            GL * GL / (HL + l2)
-            + GR * GR / (HR + l2)
-            - G * G / (H + l2)
-        )
-        ok = (CL >= min_samples) & (CR >= min_samples)
-        # last bin position means "everything left" — not a split
-        ok = ok & (jnp.arange(B)[None, None, :] < B - 1)
-        gain = jnp.where(ok & (fmask[None, :, None] > 0), gain, -jnp.inf)
-
-        flat = gain.reshape(L, d * B)
-        best = jnp.argmax(flat, axis=1)
-        best_gain = jnp.take_along_axis(flat, best[:, None], 1)[:, 0]
-        feat = jnp.where(best_gain > min_gain, best // B, -1).astype(jnp.int32)
-        thr = jnp.where(best_gain > min_gain, best % B, B - 1).astype(jnp.int32)
-
-        # node parameter lookups per sample, then route
-        f_s = feat[node]  # (n,)
-        t_s = thr[node]
-        safe_f = jnp.maximum(f_s, 0)
-        x_bin = jnp.take_along_axis(bins, safe_f[:, None], 1)[:, 0]
-        go_left = (f_s < 0) | (x_bin <= t_s)
-        new_node = node * 2 + (1 - go_left.astype(jnp.int32))
-        return feat, thr, new_node
+        feat, thr = _split_search(hg, hh, hc, fmask, l2, min_samples,
+                                  min_gain)
+        return feat, thr, _route(bins, node, feat, thr)
 
     return jax.jit(
         jax.shard_map(
@@ -327,10 +335,16 @@ def _pad_rows(arr, dp):
 # ---------------------------------------------------------------------------
 
 
+# one-hot histogram operand budget per shard (bf16 elements): above this the
+# fused program streams row chunks through the matmul instead of holding the
+# whole (n_local, d*B) one-hot in HBM
+_HIST_ONEHOT_BUDGET_ELEMS = 128 * 1024 * 1024
+
+
 @functools.lru_cache(maxsize=32)
 def _gbdt_train_fn(mesh_key, task: str, num_trees: int, depth: int,
                    num_bins: int, K: int, subsample_on: bool,
-                   colsample_on: bool, d: int):
+                   colsample_on: bool, d: int, num_chunks: int):
     """ONE compiled program for the whole boosting run: a ``lax.fori_loop``
     over trees inside one ``shard_map`` — gradients, histograms (+psum),
     split search, sample routing, leaf values and score updates all stay on
@@ -358,27 +372,59 @@ def _gbdt_train_fn(mesh_key, task: str, num_trees: int, depth: int,
         leaves0 = jnp.zeros((num_trees, K, LEAF), jnp.float32)
         shard_id = jax.lax.axis_index(axis)
 
-        # Histograms as MXU matmuls: the bins one-hot O (n, d*B) is built
-        # ONCE and every level's (g, h, count) histograms are a single
-        # (3L, n) @ (n, d*B) contraction with f32 accumulation — the
-        # systolic array does the scatter, not the VPU. one-hot entries are
-        # exact in bf16; g/h round to bf16 (~0.4% per element), well inside
-        # histogram-split tolerance (LightGBM quantizes harder).
-        O = (bins[:, :, None] == jnp.arange(B, dtype=bins.dtype)
-             ).astype(jnp.bfloat16).reshape(n_local, d * B)
+        # Histograms as MXU matmuls: every level's (g, h, count) histograms
+        # are ONE (3L, n) @ (n, d*B) contraction against the bins one-hot
+        # with f32 accumulation — the systolic array does the scatter, not
+        # the VPU. one-hot entries are exact in bf16; g/h round to bf16
+        # (~0.4% per element), well inside histogram-split tolerance
+        # (LightGBM quantizes harder). When the full one-hot would blow the
+        # HBM budget (num_chunks > 1), row chunks stream through the same
+        # matmul under lax.scan and only a (chunk, d*B) slab materializes.
+        def _onehot_bins(b):
+            return (b[:, :, None] == jnp.arange(B, dtype=b.dtype)
+                    ).astype(jnp.bfloat16).reshape(b.shape[0], d * B)
 
-        def hists(node, g, h, w, L):
-            N = (node[:, None] == jnp.arange(L, dtype=node.dtype)[None, :]
-                 ).astype(jnp.bfloat16)  # (n, L)
-            V = jnp.concatenate(
-                [N * g.astype(jnp.bfloat16)[:, None],
-                 N * h.astype(jnp.bfloat16)[:, None],
-                 N * w.astype(jnp.bfloat16)[:, None]], axis=1)  # (n, 3L)
-            hist = jax.lax.dot_general(
-                V, O, (((0,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)  # (3L, d*B)
-            hist = hist.reshape(3, L, d, B)
-            return hist[0], hist[1], hist[2]
+        def _vmat(node_c, g_c, h_c, w_c, L):
+            N = (node_c[:, None]
+                 == jnp.arange(L, dtype=node_c.dtype)[None, :]
+                 ).astype(jnp.bfloat16)
+            return jnp.concatenate(
+                [N * g_c.astype(jnp.bfloat16)[:, None],
+                 N * h_c.astype(jnp.bfloat16)[:, None],
+                 N * w_c.astype(jnp.bfloat16)[:, None]], axis=1)
+
+        if num_chunks == 1:
+            O = _onehot_bins(bins)
+
+            def hists(node, g, h, w, L):
+                hist = jax.lax.dot_general(
+                    _vmat(node, g, h, w, L), O, (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)  # (3L, d*B)
+                hist = hist.reshape(3, L, d, B)
+                return hist[0], hist[1], hist[2]
+        else:
+            chunk = n_local // num_chunks
+            bins_c = bins.reshape(num_chunks, chunk, d)
+
+            def hists(node, g, h, w, L):
+                def step(acc, xs):
+                    nc, gc, hc_, wc, bc = xs
+                    part = jax.lax.dot_general(
+                        _vmat(nc, gc, hc_, wc, L), _onehot_bins(bc),
+                        (((0,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32)
+                    return acc + part, None
+
+                hist0 = jnp.zeros((3 * L, d * B), jnp.float32)
+                hist, _ = jax.lax.scan(
+                    step, hist0,
+                    (node.reshape(num_chunks, chunk),
+                     g.reshape(num_chunks, chunk),
+                     h.reshape(num_chunks, chunk),
+                     w.reshape(num_chunks, chunk),
+                     bins_c))
+                hist = hist.reshape(3, L, d, B)
+                return hist[0], hist[1], hist[2]
 
         def tree_body(it, carry):
             F, feats_acc, thrs_acc, leaves_acc = carry
@@ -423,40 +469,15 @@ def _gbdt_train_fn(mesh_key, task: str, num_trees: int, depth: int,
                     hg = jax.lax.psum(hg, axis)
                     hh = jax.lax.psum(hh, axis)
                     hc = jax.lax.psum(hc, axis)
-
-                    GL = jnp.cumsum(hg, axis=-1)
-                    HL = jnp.cumsum(hh, axis=-1)
-                    CL = jnp.cumsum(hc, axis=-1)
-                    G, H, C = GL[..., -1:], HL[..., -1:], CL[..., -1:]
-                    GR, HR, CR = G - GL, H - HL, C - CL
-                    gain = (GL * GL / (HL + l2) + GR * GR / (HR + l2)
-                            - G * G / (H + l2))
-                    ok = (CL >= min_samples) & (CR >= min_samples)
-                    ok = ok & (jnp.arange(B)[None, None, :] < B - 1)
-                    gain = jnp.where(ok & (fmask[None, :, None] > 0), gain,
-                                     -jnp.inf)
-                    flat = gain.reshape(L, d * B)
-                    best = jnp.argmax(flat, axis=1)
-                    best_gain = jnp.take_along_axis(flat, best[:, None],
-                                                    1)[:, 0]
-                    feat = jnp.where(best_gain > min_gain, best // B,
-                                     -1).astype(jnp.int32)
-                    thr = jnp.where(best_gain > min_gain, best % B,
-                                    B - 1).astype(jnp.int32)
+                    feat, thr = _split_search(hg, hh, hc, fmask, l2,
+                                              min_samples, min_gain)
 
                     hbase = 2 ** level - 1  # static heap offset
                     feats_acc = jax.lax.dynamic_update_slice(
                         feats_acc, feat[None, None, :], (it, kcls, hbase))
                     thrs_acc = jax.lax.dynamic_update_slice(
                         thrs_acc, thr[None, None, :], (it, kcls, hbase))
-
-                    f_s = feat[node]
-                    t_s = thr[node]
-                    safe_f = jnp.maximum(f_s, 0)
-                    x_bin = jnp.take_along_axis(bins, safe_f[:, None],
-                                                1)[:, 0]
-                    go_left = (f_s < 0) | (x_bin <= t_s)
-                    node = node * 2 + (1 - go_left.astype(jnp.int32))
+                    node = _route(bins, node, feat, thr)
 
                 # leaf sums ride the MXU too: (LEAF, n) @ (n, 2)
                 NL = (node[:, None]
@@ -532,7 +553,12 @@ def train_gbdt(
     bins = apply_bins(X32, edges)
     t_binned = _time.perf_counter()
 
-    bins_pad = _pad_rows(bins, dp)
+    # row-chunk the one-hot histogram operand when it would blow HBM; pad
+    # rows so every shard splits evenly into chunks
+    per_shard = -(-n // dp)
+    num_chunks = max(1, -(-(per_shard * d * num_bins)
+                          // _HIST_ONEHOT_BUDGET_ELEMS))
+    bins_pad = _pad_rows(bins, dp * num_chunks)
     n_pad = bins_pad.shape[0]
     valid = np.zeros(n_pad, np.float32)
     valid[:n] = 1.0
@@ -551,7 +577,7 @@ def train_gbdt(
         y_enc = np.eye(K, dtype=np.float32)[np.asarray(y, int)]
     else:
         y_enc = np.asarray(y, np.float32)[:, None]
-    y_pad = _pad_rows(y_enc, dp)
+    y_pad = _pad_rows(y_enc, dp * num_chunks)
 
     bins_s = _shard(mesh, bins_pad)
     y_s = _shard(mesh, y_pad)
@@ -561,7 +587,7 @@ def train_gbdt(
 
     fn = _gbdt_train_fn(
         _mesh_key(mesh), task, int(num_trees), int(depth), int(num_bins),
-        K, subsample < 1.0, colsample < 1.0, d)
+        K, subsample < 1.0, colsample < 1.0, d, int(num_chunks))
     key = jax.random.PRNGKey(seed)
     hp = jnp.asarray([learning_rate, l2, min_samples, min_gain,
                       subsample, colsample], jnp.float32)

@@ -1,0 +1,390 @@
+"""stdlib HTTP server + experiment store + DAG runner for the WebUI.
+
+(reference: webui/server — ExperimentController/NodeController/
+EdgeController REST over JPA, embedded job execution; here one module.)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import traceback
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..common.catalog import list_operators, op_info
+from ..common.exceptions import AkIllegalArgumentException
+from ..common.mtable import MTable
+
+
+# -- op registry --------------------------------------------------------------
+
+
+def _op_index() -> Dict[str, type]:
+    idx: Dict[str, type] = {}
+    for kind, classes in list_operators().items():
+        for cls in classes:
+            idx[cls.__name__] = cls
+    return idx
+
+
+_INDEX: Optional[Dict[str, type]] = None
+
+
+def op_index() -> Dict[str, type]:
+    global _INDEX
+    if _INDEX is None:
+        _INDEX = _op_index()
+    return _INDEX
+
+
+# -- DAG execution ------------------------------------------------------------
+
+
+def _table_payload(t: MTable, limit: int = 50) -> dict:
+    rows = []
+    for i, row in enumerate(t.rows()):
+        if i >= limit:
+            break
+        rows.append([_json_cell(v) for v in row])
+    return {
+        "schema": [{"name": n, "type": tp}
+                   for n, tp in zip(t.names, t.schema.types)],
+        "num_rows": t.num_rows,
+        "rows": rows,
+    }
+
+
+def _json_cell(v):
+    if v is None:
+        return None
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating, float)):
+        f = float(v)
+        return None if f != f else f
+    if isinstance(v, (np.bool_,)):
+        return bool(v)
+    if isinstance(v, (str, int, bool)):
+        return v
+    return str(v)
+
+
+def run_experiment(exp: dict) -> Dict[str, dict]:
+    """Execute an experiment {nodes: [{id, op, params}], edges: [{src, dst,
+    dstPort?}]} and return per-node output payloads (table head + schema).
+
+    ``MemSourceBatchOp`` nodes take ``rows`` + ``schemaStr`` params inline
+    (the WebUI's data-entry node)."""
+    nodes = {n["id"]: n for n in exp.get("nodes", [])}
+    edges = exp.get("edges", [])
+    idx = op_index()
+
+    incoming: Dict[str, List[Tuple[int, str]]] = {nid: [] for nid in nodes}
+    for e in edges:
+        if e["src"] not in nodes or e["dst"] not in nodes:
+            raise AkIllegalArgumentException(
+                f"edge {e} references a missing node")
+        incoming[e["dst"]].append((int(e.get("dstPort", 0)), e["src"]))
+
+    # topological order (DFS)
+    order: List[str] = []
+    state: Dict[str, int] = {}
+
+    def visit(nid: str):
+        st = state.get(nid)
+        if st == 1:
+            return
+        if st == 0:
+            raise AkIllegalArgumentException(f"cycle at node '{nid}'")
+        state[nid] = 0
+        for _, src in sorted(incoming[nid]):
+            visit(src)
+        state[nid] = 1
+        order.append(nid)
+
+    for nid in nodes:
+        visit(nid)
+
+    built: Dict[str, Any] = {}
+    results: Dict[str, dict] = {}
+    for nid in order:
+        spec = nodes[nid]
+        op_name = spec["op"]
+        params = dict(spec.get("params") or {})
+        cls = idx.get(op_name)
+        if cls is None:
+            raise AkIllegalArgumentException(f"unknown operator '{op_name}'")
+        try:
+            if op_name == "MemSourceBatchOp":
+                op = cls(params.pop("rows", []),
+                         params.pop("schemaStr", ""), **params)
+            else:
+                # sugar ops (Select/Filter/GroupBy...) take positional ctor
+                # args; the UI passes them as the "__args__" list
+                pos = params.pop("__args__", [])
+                op = cls(*pos, **params)
+            ins = [built[src]
+                   for _, src in sorted(incoming[nid])]
+            if ins:
+                op = op.link_from(*ins)
+            built[nid] = op
+            results[nid] = {"status": "ok",
+                            "table": _table_payload(op.collect())}
+        except Exception as e:  # per-node failure surfaces in the UI
+            results[nid] = {"status": "error",
+                            "error": f"{type(e).__name__}: {e}",
+                            "trace": traceback.format_exc(limit=5)}
+            # downstream nodes of a failed node are skipped
+            built[nid] = None
+    # mark nodes skipped due to failed inputs
+    for nid in order:
+        if results.get(nid, {}).get("status") == "ok":
+            continue
+        for e in edges:
+            if e["src"] == nid and results.get(e["dst"], {}).get(
+                    "status") == "error":
+                results[e["dst"]]["status"] = "skipped"
+    return results
+
+
+# -- experiment store ---------------------------------------------------------
+
+
+class ExperimentStore:
+    """JSON-file-backed experiment CRUD (the JPA repositories analog)."""
+
+    def __init__(self, path: Optional[str] = None):
+        self.path = path or os.path.join(
+            os.path.expanduser("~"), ".alink_tpu", "experiments.json")
+        self._lock = threading.Lock()
+        self._data: Dict[str, dict] = {}
+        self._next_id = 1
+        if os.path.exists(self.path):
+            try:
+                with open(self.path) as f:
+                    blob = json.load(f)
+                self._data = blob.get("experiments", {})
+                self._next_id = blob.get("next_id", len(self._data) + 1)
+            except Exception:
+                pass
+
+    def _persist(self):
+        os.makedirs(os.path.dirname(self.path), exist_ok=True)
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"experiments": self._data,
+                       "next_id": self._next_id}, f)
+        os.replace(tmp, self.path)
+
+    def list(self) -> List[dict]:
+        with self._lock:
+            return [{"id": k, "name": v.get("name", k),
+                     "num_nodes": len(v.get("nodes", []))}
+                    for k, v in sorted(self._data.items(),
+                                       key=lambda kv: int(kv[0]))]
+
+    def get(self, eid: str) -> Optional[dict]:
+        with self._lock:
+            exp = self._data.get(eid)
+            return None if exp is None else {"id": eid, **exp}
+
+    def create(self, payload: dict) -> dict:
+        with self._lock:
+            eid = str(self._next_id)
+            self._next_id += 1
+            self._data[eid] = {
+                "name": payload.get("name", f"experiment-{eid}"),
+                "nodes": payload.get("nodes", []),
+                "edges": payload.get("edges", []),
+            }
+            self._persist()
+            return {"id": eid, **self._data[eid]}
+
+    def update(self, eid: str, payload: dict) -> Optional[dict]:
+        with self._lock:
+            if eid not in self._data:
+                return None
+            exp = self._data[eid]
+            for k in ("name", "nodes", "edges"):
+                if k in payload:
+                    exp[k] = payload[k]
+            self._persist()
+            return {"id": eid, **exp}
+
+    def delete(self, eid: str) -> bool:
+        with self._lock:
+            gone = self._data.pop(eid, None) is not None
+            if gone:
+                self._persist()
+            return gone
+
+
+# -- HTTP server --------------------------------------------------------------
+
+
+_STATIC_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "static")
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "AlinkTpuWebUI/1.0"
+    store: ExperimentStore = None  # set by WebUIServer
+
+    # -- helpers --
+    def _send_json(self, obj, code: int = 200):
+        data = json.dumps(obj).encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _body(self) -> dict:
+        length = int(self.headers.get("Content-Length", 0) or 0)
+        if not length:
+            return {}
+        return json.loads(self.rfile.read(length))
+
+    def log_message(self, fmt, *args):  # quiet by default
+        pass
+
+    # -- routing --
+    def do_GET(self):
+        parts = [p for p in self.path.split("?")[0].split("/") if p]
+        try:
+            if not parts or parts == ["index.html"]:
+                return self._static("index.html")
+            if parts[0] == "api":
+                return self._api_get(parts[1:])
+            return self._static("/".join(parts))
+        except BrokenPipeError:
+            pass
+        except Exception as e:
+            self._send_json({"error": f"{type(e).__name__}: {e}"}, 500)
+
+    def do_POST(self):
+        parts = [p for p in self.path.split("?")[0].split("/") if p]
+        try:
+            if parts[:2] == ["api", "experiments"]:
+                if len(parts) == 2:
+                    return self._send_json(self.store.create(self._body()))
+                if len(parts) == 4 and parts[3] == "run":
+                    exp = self.store.get(parts[2])
+                    if exp is None:
+                        return self._send_json(
+                            {"error": "no such experiment"}, 404)
+                    return self._send_json(
+                        {"results": run_experiment(exp)})
+            self._send_json({"error": "not found"}, 404)
+        except BrokenPipeError:
+            pass
+        except Exception as e:
+            self._send_json({"error": f"{type(e).__name__}: {e}"}, 500)
+
+    def do_PUT(self):
+        parts = [p for p in self.path.split("?")[0].split("/") if p]
+        try:
+            if parts[:2] == ["api", "experiments"] and len(parts) == 3:
+                out = self.store.update(parts[2], self._body())
+                if out is None:
+                    return self._send_json({"error": "no such experiment"},
+                                           404)
+                return self._send_json(out)
+            self._send_json({"error": "not found"}, 404)
+        except Exception as e:
+            self._send_json({"error": f"{type(e).__name__}: {e}"}, 500)
+
+    def do_DELETE(self):
+        parts = [p for p in self.path.split("?")[0].split("/") if p]
+        try:
+            if parts[:2] == ["api", "experiments"] and len(parts) == 3:
+                if self.store.delete(parts[2]):
+                    return self._send_json({"deleted": parts[2]})
+                return self._send_json({"error": "no such experiment"}, 404)
+            self._send_json({"error": "not found"}, 404)
+        except Exception as e:
+            self._send_json({"error": f"{type(e).__name__}: {e}"}, 500)
+
+    # -- GET endpoints --
+    def _api_get(self, parts: List[str]):
+        if parts == ["ops"]:
+            cats: Dict[str, List[str]] = {}
+            for kind, classes in list_operators().items():
+                for cls in classes:
+                    cat = cls.__module__.rsplit(".", 1)[-1]
+                    cats.setdefault(f"{kind}/{cat}", []).append(cls.__name__)
+            return self._send_json(
+                {"categories": {k: sorted(v) for k, v in sorted(cats.items())}})
+        if len(parts) == 2 and parts[0] == "ops":
+            cls = op_index().get(parts[1])
+            if cls is None:
+                return self._send_json({"error": "unknown op"}, 404)
+            return self._send_json(op_info(cls))
+        if parts == ["experiments"]:
+            return self._send_json({"experiments": self.store.list()})
+        if len(parts) == 2 and parts[0] == "experiments":
+            exp = self.store.get(parts[1])
+            if exp is None:
+                return self._send_json({"error": "no such experiment"}, 404)
+            return self._send_json(exp)
+        return self._send_json({"error": "not found"}, 404)
+
+    def _static(self, rel: str):
+        path = os.path.normpath(os.path.join(_STATIC_DIR, rel))
+        if not path.startswith(_STATIC_DIR + os.sep) \
+                or not os.path.isfile(path):
+            return self._send_json({"error": "not found"}, 404)
+        ctype = "text/html" if path.endswith(".html") else \
+            "text/javascript" if path.endswith(".js") else \
+            "text/css" if path.endswith(".css") else "application/octet-stream"
+        with open(path, "rb") as f:
+            data = f.read()
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+
+class WebUIServer:
+    """``WebUIServer(port=8765).start()`` then open http://localhost:8765.
+    ``start(background=True)`` serves from a daemon thread (tests)."""
+
+    def __init__(self, port: int = 8765, host: str = "127.0.0.1",
+                 store: Optional[ExperimentStore] = None):
+        handler = type("BoundHandler", (_Handler,),
+                       {"store": store or ExperimentStore()})
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.port = self.httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self, background: bool = False):
+        if background:
+            self._thread = threading.Thread(
+                target=self.httpd.serve_forever, daemon=True)
+            self._thread.start()
+            return self
+        self.httpd.serve_forever()
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def main():  # pragma: no cover — CLI entry
+    import argparse
+
+    ap = argparse.ArgumentParser(description="alink_tpu WebUI")
+    ap.add_argument("--port", type=int, default=8765)
+    ap.add_argument("--host", default="127.0.0.1")
+    args = ap.parse_args()
+    print(f"alink_tpu WebUI on http://{args.host}:{args.port}")
+    WebUIServer(port=args.port, host=args.host).start()
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

@@ -1,0 +1,104 @@
+"""WebUI server: catalog API, experiment CRUD, DAG build/run/inspect
+(reference: webui/server ServerApplication.java + controllers)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from alink_tpu.webui import ExperimentStore, WebUIServer, run_experiment
+
+
+def _req(port, path, method="GET", body=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", method=method,
+        data=None if body is None else json.dumps(body).encode())
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return json.loads(r.read())
+
+
+@pytest.fixture()
+def server(tmp_path):
+    srv = WebUIServer(port=0, store=ExperimentStore(
+        str(tmp_path / "exp.json")))
+    srv.start(background=True)
+    yield srv
+    srv.stop()
+
+
+THREE_NODE_DAG = {
+    "name": "demo",
+    "nodes": [
+        {"id": "src", "op": "MemSourceBatchOp",
+         "params": {"rows": [[1, "a", 2.0], [2, "b", 4.0], [3, "a", 9.0]],
+                    "schemaStr": "id long, g string, x double"}},
+        {"id": "sql", "op": "SqlQueryBatchOp",
+         "params": {"query":
+                    "SELECT g, SUM(x) AS total FROM t GROUP BY g"}},
+        {"id": "sel", "op": "SelectBatchOp",
+         "params": {"__args__": ["total"]}},
+    ],
+    "edges": [{"src": "src", "dst": "sql"},
+              {"src": "sql", "dst": "sel"}],
+}
+
+
+def test_run_experiment_directly():
+    results = run_experiment(THREE_NODE_DAG)
+    assert results["src"]["status"] == "ok"
+    assert results["sql"]["status"] == "ok"
+    tbl = results["sql"]["table"]
+    assert [c["name"] for c in tbl["schema"]] == ["g", "total"]
+    got = {row[0]: row[1] for row in tbl["rows"]}
+    assert got == {"a": 11.0, "b": 4.0}
+    assert results["sel"]["table"]["schema"][0]["name"] == "total"
+
+
+def test_ops_catalog_api(server):
+    cats = _req(server.port, "/api/ops")["categories"]
+    all_ops = [o for v in cats.values() for o in v]
+    assert "KMeansTrainBatchOp" in all_ops and "SqlQueryBatchOp" in all_ops
+    info = _req(server.port, "/api/ops/SqlQueryBatchOp")
+    assert any(p["name"] == "query" for p in info["params"])
+    assert info["ports"]["outputs"] == ["DATA"]
+
+
+def test_experiment_crud_and_run(server):
+    created = _req(server.port, "/api/experiments", "POST", THREE_NODE_DAG)
+    eid = created["id"]
+    assert _req(server.port, f"/api/experiments/{eid}")["name"] == "demo"
+    listed = _req(server.port, "/api/experiments")["experiments"]
+    assert any(e["id"] == eid for e in listed)
+
+    out = _req(server.port, f"/api/experiments/{eid}/run", "POST")
+    assert out["results"]["sql"]["status"] == "ok"
+
+    upd = _req(server.port, f"/api/experiments/{eid}", "PUT",
+               {"name": "renamed"})
+    assert upd["name"] == "renamed"
+    assert _req(server.port, f"/api/experiments/{eid}", "DELETE")[
+        "deleted"] == eid
+
+
+def test_store_persists_across_instances(tmp_path):
+    p = str(tmp_path / "exp.json")
+    s1 = ExperimentStore(p)
+    eid = s1.create({"name": "keep", "nodes": [], "edges": []})["id"]
+    s2 = ExperimentStore(p)
+    assert s2.get(eid)["name"] == "keep"
+
+
+def test_run_surfaces_node_errors(server):
+    bad = {"name": "bad", "nodes": [
+        {"id": "a", "op": "SqlQueryBatchOp", "params": {"query": "x"}}],
+        "edges": []}
+    eid = _req(server.port, "/api/experiments", "POST", bad)["id"]
+    out = _req(server.port, f"/api/experiments/{eid}/run", "POST")
+    assert out["results"]["a"]["status"] == "error"
+
+
+def test_index_page_serves(server):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/", timeout=10) as r:
+        html = r.read().decode()
+    assert "alink_tpu" in html and "api/ops" in html
