@@ -276,7 +276,10 @@ from .statistics import (
 )
 from .timeseries import (
     ArimaBatchOp,
+    AutoArimaBatchOp,
     DeepARBatchOp,
+    LSTNetBatchOp,
+    ProphetBatchOp,
     DifferenceBatchOp,
     EvalTimeSeriesBatchOp,
     GarchBatchOp,
@@ -370,6 +373,11 @@ from ..sqlengine import (
 from .connectors import (
     KvSinkBatchOp,
     LookupKvBatchOp,
+)
+from .windowfe import (
+    GenerateFeatureOfLatestBatchOp,
+    GenerateFeatureOfLatestNDaysBatchOp,
+    GenerateFeatureOfWindowBatchOp,
 )
 from .huge import (
     DeepWalkBatchOp,

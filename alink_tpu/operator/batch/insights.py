@@ -75,6 +75,53 @@ class AutoDiscoveryBatchOp(BatchOperator, HasSelectedCols):
                     f"{c}: {vals[counts.argmax()]!r} covers "
                     f"{top_frac:.1%} of rows"))
 
+        # breakdown + impact detectors (reference: AutoDiscovery.java's
+        # BreakdownDetector/ImpactDetector — per-segment deltas and
+        # top-segment contribution over (categorical, numeric) pairs)
+        for c in categorical:
+            seg_raw = np.asarray(t.col(c), object).astype(str)
+            seg_vals_np, seg_inv = np.unique(seg_raw, return_inverse=True)
+            seg_vals = [str(v) for v in seg_vals_np]
+            if not (2 <= len(seg_vals) <= 50):
+                continue
+            for m in numeric:
+                arr = np.asarray(t.col(m), np.float64)
+                ok = ~np.isnan(arr)
+                if ok.sum() < 10:
+                    continue
+                counts = np.bincount(seg_inv[ok], minlength=len(seg_vals))
+                sums = np.bincount(seg_inv[ok], weights=arr[ok],
+                                   minlength=len(seg_vals))
+                overall_mean = arr[ok].mean()
+                overall_std = arr[ok].std()
+                with np.errstate(invalid="ignore", divide="ignore"):
+                    means = sums / np.maximum(counts, 1)
+                    # z-score of each segment mean vs the overall mean,
+                    # scaled by the standard error of that segment
+                    se = overall_std / np.sqrt(np.maximum(counts, 1))
+                    z = np.abs(means - overall_mean) / np.maximum(se, 1e-12)
+                big = (counts >= 5) & (z > 3.0)
+                for si in np.flatnonzero(big):
+                    delta = means[si] - overall_mean
+                    findings.append((
+                        "breakdown", f"{m} by {c}={seg_vals[si]}",
+                        min(float(z[si]) / 10.0, 1.0),
+                        f"{m} averages {means[si]:g} for {c}="
+                        f"{seg_vals[si]!r} vs {overall_mean:g} overall "
+                        f"({'+' if delta >= 0 else ''}{delta:g}, "
+                        f"z={z[si]:.1f}, n={int(counts[si])})"))
+                total = sums.sum()
+                if abs(total) > 1e-12 and np.all(sums >= 0):
+                    contrib = sums / total
+                    si = int(np.argmax(contrib))
+                    if contrib[si] > 0.5 and len(seg_vals) > 2:
+                        findings.append((
+                            "impact", f"{m} from {c}={seg_vals[si]}",
+                            float(contrib[si]),
+                            f"{c}={seg_vals[si]!r} contributes "
+                            f"{contrib[si]:.1%} of total {m} "
+                            f"across {len(seg_vals)} segments"))
+
         if len(numeric) >= 2:
             X = t.to_numeric_block(numeric, dtype=np.float64)
             ok_rows = ~np.isnan(X).any(axis=1)

@@ -22,7 +22,7 @@ import numpy as np
 from ...common.exceptions import AkIllegalArgumentException
 from ...common.linalg import DenseVector
 from ...common.mtable import AlinkTypes, MTable, TableSchema
-from ...common.params import MinValidator, ParamInfo
+from ...common.params import InValidator, MinValidator, ParamInfo
 from ...mapper import HasOutputCol, HasReservedCols, HasSelectedCol
 from .base import BatchOperator
 
@@ -130,11 +130,15 @@ class ReadAudioToTensorBatchOp(BatchOperator, HasSelectedCol, HasOutputCol,
 
 class ExtractMfccFeatureBatchOp(BatchOperator, HasSelectedCol, HasOutputCol,
                                 HasReservedCols):
-    """Waveform vector column → mean-pooled MFCC vector (reference:
-    ExtractMfccFeatureBatchOp.java)."""
+    """Waveform vector column → MFCC features. Default emits the FULL
+    (frames x coeffs) tensor — the time axis downstream DL consumes
+    (reference: ExtractMfccFeatureBatchOp.java emits the frame tensor);
+    ``poolingMode=MEAN`` keeps the old mean-pooled vector."""
 
     SAMPLE_RATE = ParamInfo("sampleRate", int, default=16000)
     N_MFCC = ParamInfo("nMfcc", int, default=13, validator=MinValidator(2))
+    POOLING_MODE = ParamInfo("poolingMode", str, default="NONE",
+                             validator=InValidator("NONE", "MEAN"))
 
     _min_inputs = 1
     _max_inputs = 1
@@ -145,17 +149,28 @@ class ExtractMfccFeatureBatchOp(BatchOperator, HasSelectedCol, HasOutputCol,
         out = self.get(HasOutputCol.OUTPUT_COL) or "mfcc"
         sr = self.get(self.SAMPLE_RATE)
         n_mfcc = self.get(self.N_MFCC)
-        vecs = []
+        pool = self.get(self.POOLING_MODE) == "MEAN"
+        cells = []
         for v in t.col(self.get(HasSelectedCol.SELECTED_COL)):
             m = mfcc(parse_vector(v).to_dense().data, sr, n_mfcc=n_mfcc)
-            vecs.append(DenseVector(m.mean(axis=0)))
-        return t.with_column(out, np.asarray(vecs, object),
-                             AlinkTypes.DENSE_VECTOR)
+            cells.append(DenseVector(m.mean(axis=0)) if pool
+                         else np.asarray(m, np.float32))
+        # element-wise fill: np.asarray(list_of_2d_arrays, object) would
+        # broadcast equal-shaped tensors into one big object ndarray
+        col = np.empty(len(cells), object)
+        for i, cell in enumerate(cells):
+            col[i] = cell
+        return t.with_column(out, col, self._out_type())
+
+    def _out_type(self):
+        return (AlinkTypes.DENSE_VECTOR
+                if self.get(self.POOLING_MODE) == "MEAN"
+                else AlinkTypes.TENSOR)
 
     def _out_schema(self, in_schema):
         out = self.get(HasOutputCol.OUTPUT_COL) or "mfcc"
         return TableSchema(list(in_schema.names) + [out],
-                           list(in_schema.types) + [AlinkTypes.DENSE_VECTOR])
+                           list(in_schema.types) + [self._out_type()])
 
 
 class ReadImageToTensorBatchOp(BatchOperator, HasSelectedCol, HasOutputCol,

@@ -26,7 +26,8 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ...common.exceptions import AkIllegalArgumentException
+from ...common.exceptions import (AkIllegalArgumentException,
+                                  AkIllegalDataException)
 from ...common.linalg import DenseVector
 from ...common.mtable import AlinkTypes, MTable, TableSchema
 from ...common.params import InValidator, MinValidator, ParamInfo
@@ -117,21 +118,23 @@ class _BaseForecastOp(BatchOperator):
 # ARIMA
 # ---------------------------------------------------------------------------
 
-def _arma_css_fit(w: np.ndarray, p: int, q: int, steps: int = 400,
-                  lr: float = 0.05):
-    """Conditional-sum-of-squares ARMA(p,q) fit on the (differenced) series.
-    Returns (c, phi, theta, sigma2). The residual recursion is a lax.scan;
-    adam minimizes the scan'd CSS (reference: arima/ArimaEstimate.java CSS
-    method)."""
+import functools as _functools
+
+
+@_functools.lru_cache(maxsize=64)
+def _arma_fit_fn(p: int, q: int, steps: int, lr: float):
+    """Compiled CSS fitter for a given (p, q) — cached so AutoArima's order
+    search compiles each candidate order ONCE (and jax re-traces only when
+    the series length changes)."""
     import jax
     import jax.numpy as jnp
     import optax
 
-    n = w.shape[0]
     m = max(p, q)
-    wj = jnp.asarray(w, jnp.float32)
+    opt = optax.adam(lr)
 
-    def css(params):
+    def css(params, wj):
+        n = wj.shape[0]
         c = params[0]
         phi = params[1:1 + p]
         theta = params[1 + p:1 + p + q]
@@ -155,28 +158,75 @@ def _arma_css_fit(w: np.ndarray, p: int, q: int, steps: int = 400,
         _, errs = jax.lax.scan(step, (w0, e0), jnp.arange(m, n))
         return (errs * errs).sum() / (n - m)
 
-    params0 = jnp.zeros(1 + p + q, jnp.float32)
-    params0 = params0.at[0].set(float(w.mean()))
-    opt = optax.adam(lr)
-
     @jax.jit
-    def fit(params0):
+    def fit(params0, wj):
         state0 = opt.init(params0)
 
         def body(_, carry):
             params, state = carry
-            g = jax.grad(css)(params)
+            g = jax.grad(css)(params, wj)
             updates, state = opt.update(g, state)
             return optax.apply_updates(params, updates), state
 
         params, _ = jax.lax.fori_loop(0, steps, body, (params0, state0))
-        return params, css(params)
+        return params, css(params, wj)
 
-    params, sigma2 = jax.device_get(fit(params0))
+    return fit
+
+
+def _arma_css_fit(w: np.ndarray, p: int, q: int, steps: int = 400,
+                  lr: float = 0.05):
+    """Conditional-sum-of-squares ARMA(p,q) fit on the (differenced) series.
+    Returns (c, phi, theta, sigma2). The residual recursion is a lax.scan;
+    adam minimizes the scan'd CSS (reference: arima/ArimaEstimate.java CSS
+    method)."""
+    import jax
+    import jax.numpy as jnp
+
+    fit = _arma_fit_fn(p, q, steps, lr)
+    params0 = jnp.zeros(1 + p + q, jnp.float32)
+    params0 = params0.at[0].set(float(w.mean()))
+    params, sigma2 = jax.device_get(
+        fit(params0, jnp.asarray(w, jnp.float32)))
     c = float(params[0])
     phi = np.asarray(params[1:1 + p], np.float64)
     theta = np.asarray(params[1 + p:1 + p + q], np.float64)
     return c, phi, theta, float(sigma2)
+
+
+def _arima_forecast(y: np.ndarray, p: int, d: int, q: int,
+                    horizon: int) -> np.ndarray:
+    """Fit ARIMA(p,d,q) by CSS and forecast ``horizon`` steps (shared by
+    ArimaBatchOp and AutoArimaBatchOp)."""
+    w = np.diff(y, n=d) if d else y.astype(np.float64)
+    c, phi, theta, _ = _arma_css_fit(w, p, q)
+    # re-run the residual recursion host-side, then iterate forward
+    m = max(p, q)
+    e_hist = [0.0] * max(q, 1)
+    # zero-seed the history exactly as the CSS scan in _arma_css_fit does,
+    # so forecast residuals match what the optimizer minimized
+    w_hist = [0.0] * max(p, 1)
+    for t in range(m, len(w)):
+        pred = c + sum(ph * wh for ph, wh in zip(phi, w_hist)) \
+            + sum(th * eh for th, eh in zip(theta, e_hist))
+        e = w[t] - pred
+        w_hist = [w[t]] + w_hist[:-1]
+        e_hist = [e] + e_hist[:-1]
+    fc_w = []
+    for _ in range(horizon):
+        pred = c + sum(ph * wh for ph, wh in zip(phi, w_hist)) \
+            + sum(th * eh for th, eh in zip(theta, e_hist))
+        fc_w.append(pred)
+        w_hist = [pred] + w_hist[:-1]
+        e_hist = [0.0] + e_hist[:-1]
+    # invert differencing: integrate back up through each diff level
+    levels = [np.asarray(y, np.float64)]
+    for _ in range(d):
+        levels.append(np.diff(levels[-1]))
+    fc = np.asarray(fc_w, np.float64)
+    for k in range(d, 0, -1):
+        fc = np.cumsum(fc) + levels[k - 1][-1]
+    return fc
 
 
 class ArimaBatchOp(_BaseForecastOp):
@@ -192,37 +242,52 @@ class ArimaBatchOp(_BaseForecastOp):
 
     def _forecast(self, y: np.ndarray, horizon: int) -> np.ndarray:
         p, d, q = self._fit_params()
-        w = np.diff(y, n=d) if d else y.astype(np.float64)
-        c, phi, theta, _ = _arma_css_fit(w, p, q)
-        # re-run the residual recursion host-side, then iterate forward
-        m = max(p, q)
-        e_hist = [0.0] * max(q, 1)
-        # zero-seed the history exactly as the CSS scan in _arma_css_fit does,
-        # so forecast residuals match what the optimizer minimized
-        w_hist = [0.0] * max(p, 1)
-        errs = []
-        for t in range(m, len(w)):
-            pred = c + sum(ph * wh for ph, wh in zip(phi, w_hist)) \
-                + sum(th * eh for th, eh in zip(theta, e_hist))
-            e = w[t] - pred
-            errs.append(e)
-            w_hist = [w[t]] + w_hist[:-1]
-            e_hist = [e] + e_hist[:-1]
-        fc_w = []
-        for _ in range(horizon):
-            pred = c + sum(ph * wh for ph, wh in zip(phi, w_hist)) \
-                + sum(th * eh for th, eh in zip(theta, e_hist))
-            fc_w.append(pred)
-            w_hist = [pred] + w_hist[:-1]
-            e_hist = [0.0] + e_hist[:-1]
-        # invert differencing: integrate back up through each diff level
-        levels = [np.asarray(y, np.float64)]
-        for _ in range(d):
-            levels.append(np.diff(levels[-1]))
-        fc = np.asarray(fc_w, np.float64)
-        for k in range(d, 0, -1):
-            fc = np.cumsum(fc) + levels[k - 1][-1]
-        return fc
+        return _arima_forecast(y, p, d, q, horizon)
+
+
+class AutoArimaBatchOp(_BaseForecastOp):
+    """Order search over (p, d, q) by AIC on the CSS fit (reference:
+    AutoArimaBatchOp.java — its ICQ grid evaluation collapses to a host
+    loop over the jitted CSS objective; AIC = n*log(sigma2) + 2*(p+q+1)).
+    The chosen order is emitted in p/d/q columns."""
+
+    MAX_P = ParamInfo("maxP", int, default=3, aliases=("maxOrder",))
+    MAX_D = ParamInfo("maxD", int, default=2)
+    MAX_Q = ParamInfo("maxQ", int, default=3)
+
+    def _pick_order(self, y: np.ndarray):
+        best = None
+        for d in range(int(self.get(self.MAX_D)) + 1):
+            w = np.diff(y, n=d) if d else y.astype(np.float64)
+            if len(w) < 8:
+                continue
+            n = len(w)
+            for p_ in range(int(self.get(self.MAX_P)) + 1):
+                for q_ in range(int(self.get(self.MAX_Q)) + 1):
+                    if p_ == 0 and q_ == 0 and d == 0:
+                        continue
+                    _, _, _, sigma2 = _arma_css_fit(w, p_, q_)
+                    if not np.isfinite(sigma2) or sigma2 <= 0:
+                        continue
+                    aic = n * np.log(sigma2) + 2 * (p_ + q_ + 1)
+                    if best is None or aic < best[0]:
+                        best = (aic, p_, d, q_)
+        if best is None:
+            raise AkIllegalDataException(
+                "series too short for AutoArima order search")
+        return best[1], best[2], best[3]
+
+    def _forecast(self, y: np.ndarray, horizon: int) -> np.ndarray:
+        p, d, q = self._pick_order(y)
+        self._chosen = (p, d, q)
+        return _arima_forecast(y, p, d, q, horizon)
+
+    def _extra_outputs(self, y: np.ndarray) -> Dict[str, float]:
+        p, d, q = self._chosen
+        return {"p": float(p), "d": float(d), "q": float(q)}
+
+    def _extra_schema_keys(self) -> List[str]:
+        return ["p", "d", "q"]
 
 
 class HoltWintersBatchOp(_BaseForecastOp):
@@ -572,3 +637,115 @@ class DeepARBatchOp(_BaseForecastOp):
 
     def _extra_outputs(self, y: np.ndarray):
         return {"sigma": self._last_sigma}
+
+
+class LSTNetBatchOp(_BaseForecastOp):
+    """LSTNet forecaster: Conv feature extraction + GRU + skip-GRU + an
+    autoregressive highway component (reference: akdl lstnet model via
+    DLLauncher — core/src/main/python/akdl/akdl/models/tf/lstnet/ +
+    resources/entries/lstnet_entry.py).
+
+    Rides the shared DL train loop like DeepAR; forecasting rolls the
+    window forward on predictions."""
+
+    LOOKBACK = ParamInfo("lookback", int, default=24,
+                         validator=MinValidator(4))
+    HIDDEN = ParamInfo("hiddenSize", int, default=32)
+    KERNEL_SIZE = ParamInfo("kernelSize", int, default=3)
+    SKIP = ParamInfo("skip", int, default=4)
+    AR_WINDOW = ParamInfo("arWindow", int, default=8)
+    NUM_EPOCHS = ParamInfo("numEpochs", int, default=40)
+    BATCH_SIZE = ParamInfo("batchSize", int, default=64)
+    LEARNING_RATE = ParamInfo("learningRate", float, default=5e-3)
+    RANDOM_SEED = ParamInfo("randomSeed", int, default=0, aliases=("seed",))
+
+    def _forecast(self, y: np.ndarray, horizon: int) -> np.ndarray:
+        import flax.linen as nn
+        import jax
+        import jax.numpy as jnp
+
+        from ...dl.train import TrainConfig, train_model
+
+        if len(y) < 12:
+            raise AkIllegalArgumentException(
+                f"LSTNet needs at least 12 observations, got {len(y)}")
+        L = min(self.get(self.LOOKBACK), max(len(y) - 1, 4))
+        mu_y, sd_y = float(np.mean(y)), float(np.std(y) + 1e-9)
+        z = (np.asarray(y, np.float64) - mu_y) / sd_y
+        z32 = z.astype(np.float32)
+        X = np.stack([z32[s:s + L] for s in range(len(z) - L)])[..., None]
+        t = z32[L:]
+
+        hidden = self.get(self.HIDDEN)
+        kernel = self.get(self.KERNEL_SIZE)
+        skip = max(1, min(self.get(self.SKIP), L - 1))
+        ar_w = max(1, min(self.get(self.AR_WINDOW), L))
+
+        class Net(nn.Module):
+            @nn.compact
+            def __call__(self, x, deterministic=True):  # (b, L, 1)
+                c = nn.relu(nn.Conv(hidden, (kernel,))(x))   # (b, L, h)
+                r = nn.RNN(nn.GRUCell(hidden))(c)[:, -1, :]
+                # skip recurrence: last-aligned every-skip-th timestep
+                sk = c[:, (c.shape[1] - 1) % skip::skip, :]
+                sk = nn.RNN(nn.GRUCell(hidden // 2))(sk)[:, -1, :]
+                out = nn.Dense(1)(jnp.concatenate([r, sk], -1))
+                ar = nn.Dense(1)(x[:, -ar_w:, 0])   # highway AR
+                return out + ar                      # (b, 1) — mse squeezes
+
+        cfg = TrainConfig(num_epochs=self.get(self.NUM_EPOCHS),
+                          batch_size=self.get(self.BATCH_SIZE),
+                          learning_rate=self.get(self.LEARNING_RATE),
+                          loss="mse", seed=self.get(self.RANDOM_SEED))
+        net = Net()
+        params, _ = train_model(net, {"x": X}, t, cfg, regression=True,
+                                seq_axis=None)
+
+        @jax.jit
+        def predict(params, window):
+            return net.apply(params, window[None],
+                             deterministic=True)[0, 0]
+
+        window = z32[-L:].copy()
+        preds = []
+        for _ in range(horizon):
+            nxt = float(jax.device_get(predict(
+                params, jnp.asarray(window[..., None]))))
+            preds.append(nxt)
+            window = np.roll(window, -1)
+            window[-1] = nxt
+        return np.asarray(preds, np.float64) * sd_y + mu_y
+
+
+class ProphetBatchOp(_BaseForecastOp):
+    """Prophet forecaster, plugin-gated on the ``prophet`` package
+    (reference: operator/common/timeseries/ProphetMapper.java — the
+    reference spawns a python subprocess running prophet per mapper; here
+    prophet runs in-process when installed, and its absence raises the
+    same actionable missing-plugin guidance)."""
+
+    FREQ = ParamInfo("freq", str, default="D",
+                     desc="pandas offset alias for the synthetic fit index "
+                          "(the series is modeled positionally)")
+
+    def _forecast(self, y: np.ndarray, horizon: int) -> np.ndarray:
+        try:
+            from prophet import Prophet
+        except ImportError as e:
+            from ...common.exceptions import AkPluginNotExistException
+
+            raise AkPluginNotExistException(
+                "ProphetBatchOp needs the 'prophet' package (the reference "
+                "runs it as a python subprocess plugin): pip install "
+                "prophet. Built-in alternatives: HoltWintersBatchOp, "
+                "AutoArimaBatchOp, DeepARBatchOp, LSTNetBatchOp.") from e
+        import pandas as pd
+
+        ds = pd.date_range("2000-01-01", periods=len(y),
+                           freq=self.get(self.FREQ))
+        m = Prophet()
+        m.fit(pd.DataFrame({"ds": ds, "y": y}))
+        future = m.make_future_dataframe(periods=horizon,
+                                         freq=self.get(self.FREQ))
+        fc = m.predict(future)["yhat"].to_numpy()
+        return np.asarray(fc[-horizon:], np.float64)

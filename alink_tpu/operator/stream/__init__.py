@@ -32,6 +32,7 @@ from .onlinelearning import (
     OnlineLearningStreamOp,
 )
 from .connectors import (
+    GenerateFeatureOfWindowStreamOp,
     KafkaSinkStreamOp,
     KafkaSourceStreamOp,
     KvSinkStreamOp,
@@ -59,6 +60,7 @@ __all__ = [
     "OnlineLearningStreamOp",
     "FtrlPredictStreamOp",
     "FtrlTrainStreamOp",
+    "GenerateFeatureOfWindowStreamOp",
     "KafkaSinkStreamOp",
     "KafkaSourceStreamOp",
     "KvSinkStreamOp",

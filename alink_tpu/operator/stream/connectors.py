@@ -162,3 +162,29 @@ class KafkaSinkStreamOp(StreamOperator):
 
     def _out_schema(self, in_schema: TableSchema) -> TableSchema:
         return in_schema
+
+
+class GenerateFeatureOfWindowStreamOp(StreamOperator):
+    """Stream twin of the window feature generator: windows close per
+    micro-batch (reference: the fe stream ops over GenerateFeatureUtil)."""
+
+    TIME_COL = ParamInfo("timeCol", str, optional=False)
+    FEATURE_DEFINITIONS = ParamInfo("featureDefinitions", (list, dict, str),
+                                    optional=False)
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    def _stream_impl(self, it):
+        from ..batch.windowfe import GenerateFeatureOfWindowBatchOp
+
+        inner = GenerateFeatureOfWindowBatchOp(self.get_params().clone())
+        for chunk in it:
+            if chunk.num_rows:
+                yield inner._execute_impl(chunk)
+
+    def _out_schema(self, in_schema):
+        from ..batch.windowfe import GenerateFeatureOfWindowBatchOp
+
+        return GenerateFeatureOfWindowBatchOp(
+            self.get_params().clone())._out_schema(in_schema)

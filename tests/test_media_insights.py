@@ -38,7 +38,8 @@ def test_audio_to_tensor_and_mfcc(tmp_path):
     assert out.col("sr")[0] == 16000
     assert abs(float(np.abs(out.col("audio")[0].data).max()) - 0.5) < 0.01
     feats = ExtractMfccFeatureBatchOp(
-        selectedCol="audio", outputCol="mfcc").link_from(audio).collect()
+        selectedCol="audio", outputCol="mfcc",
+        poolingMode="MEAN").link_from(audio).collect()
     m1, m2 = feats.col("mfcc")[0].data, feats.col("mfcc")[1].data
     assert m1.shape == (13,)
     assert not np.allclose(m1, m2)  # different pitches, different cepstra
@@ -83,3 +84,51 @@ def test_multi_host_helper_single_host():
     assert is_coordinator()
     mesh = global_data_mesh()
     assert mesh.size == info["global_devices"]
+
+
+def test_mfcc_emits_frame_tensor_by_default():
+    from alink_tpu.common.mtable import AlinkTypes, MTable
+    from alink_tpu.common.linalg import DenseVector
+    from alink_tpu.operator.batch.base import TableSourceBatchOp
+    from alink_tpu.operator.batch.media import ExtractMfccFeatureBatchOp
+
+    rng = np.random.default_rng(0)
+    wave = DenseVector(rng.standard_normal(16000).astype(np.float64))
+    t = MTable.from_rows([(wave,)], "audio DENSE_VECTOR")
+    op = ExtractMfccFeatureBatchOp(selectedCol="audio", outputCol="mfcc",
+                                   nMfcc=13)
+    out = op.link_from(TableSourceBatchOp(t)).collect()
+    m = out.col("mfcc")[0]
+    assert isinstance(m, np.ndarray) and m.ndim == 2 and m.shape[1] == 13
+    assert m.shape[0] > 10  # the time axis survives
+    assert op._out_schema(t.schema).types[-1] == AlinkTypes.TENSOR
+    # pooled mode preserved as an option
+    op2 = ExtractMfccFeatureBatchOp(selectedCol="audio", outputCol="mfcc",
+                                    poolingMode="MEAN")
+    out2 = op2.link_from(TableSourceBatchOp(t)).collect()
+    v = out2.col("mfcc")[0]
+    np.testing.assert_allclose(np.asarray(v.data), m.mean(axis=0),
+                               rtol=1e-5)
+
+
+def test_insights_breakdown_and_impact():
+    from alink_tpu.common.mtable import MTable
+    from alink_tpu.operator.batch import AutoDiscoveryBatchOp
+    from alink_tpu.operator.batch.base import TableSourceBatchOp
+
+    rng = np.random.default_rng(1)
+    n = 300
+    seg = np.asarray(["a"] * 100 + ["b"] * 100 + ["c"] * 100, object)
+    # segment c runs 10 units hotter -> breakdown; region 'x' carries most
+    # of the revenue -> impact
+    metric = rng.standard_normal(n) + np.where(seg == "c", 10.0, 0.0)
+    region = np.asarray(["x"] * 220 + ["y"] * 40 + ["z"] * 40, object)
+    revenue = np.abs(rng.standard_normal(n)) + np.where(region == "x", 5, 0)
+    t = MTable({"seg": seg, "metric": metric,
+                "region": region, "revenue": revenue})
+    out = AutoDiscoveryBatchOp().link_from(TableSourceBatchOp(t)).collect()
+    kinds = list(out.col("type"))
+    descs = " | ".join(out.col("description"))
+    assert "breakdown" in kinds, descs
+    assert "impact" in kinds, descs
+    assert "seg='c'" in descs or "seg=c" in descs.replace("'", "")

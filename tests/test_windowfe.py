@@ -1,0 +1,199 @@
+"""Window feature generation vs hand-computed windows (reference:
+common/fe/GenerateFeatureUtil.java + GenerateFeatureOf*BatchOp)."""
+
+import numpy as np
+import pytest
+
+from alink_tpu.common.mtable import MTable
+from alink_tpu.operator.batch import (
+    GenerateFeatureOfLatestBatchOp,
+    GenerateFeatureOfLatestNDaysBatchOp,
+    GenerateFeatureOfWindowBatchOp,
+)
+from alink_tpu.operator.batch.base import TableSourceBatchOp
+
+
+def _table():
+    # two users, events at known seconds
+    rows = [
+        ("u1", 10.0, 1.0), ("u1", 20.0, 2.0), ("u1", 70.0, 3.0),
+        ("u1", 75.0, 4.0),
+        ("u2", 5.0, 10.0), ("u2", 130.0, 20.0),
+    ]
+    return MTable.from_rows(rows, "user string, t double, x double")
+
+
+def test_tumble_window_sums():
+    op = GenerateFeatureOfWindowBatchOp(
+        timeCol="t",
+        featureDefinitions={
+            "groupCols": ["user"], "windowType": "TUMBLE", "windowTime": 60,
+            "targetCols": ["x"], "statTypes": ["SUM", "COUNT", "MAX"]})
+    out = op.link_from(TableSourceBatchOp(_table())).collect()
+    got = {(r[0], r[1]): (r[3], r[4], r[5]) for r in out.rows()}
+    # u1: [0,60): x=1+2, [60,120): 3+4 ; u2: [0,60): 10, [120,180): 20
+    assert got[("u1", 0.0)] == (3.0, 2.0, 2.0)
+    assert got[("u1", 60.0)] == (7.0, 2.0, 4.0)
+    assert got[("u2", 0.0)] == (10.0, 1.0, 10.0)
+    assert got[("u2", 120.0)] == (20.0, 1.0, 20.0)
+    # empty middle windows are dropped
+    assert ("u2", 60.0) not in got
+
+
+def test_hop_window_overlap():
+    op = GenerateFeatureOfWindowBatchOp(
+        timeCol="t",
+        featureDefinitions={
+            "groupCols": [], "windowType": "HOP", "windowTime": 60,
+            "hopTime": 30, "targetCols": ["x"], "statTypes": ["COUNT"]})
+    rows = [(float(s), 1.0) for s in (10, 40, 70)]
+    t = MTable.from_rows(rows, "t double, x double")
+    out = op.link_from(TableSourceBatchOp(t)).collect()
+    counts = {r[0]: r[2] for r in out.rows()}
+    # [0,60):2  [30,90):2  [60,120):1
+    assert counts[0.0] == 2.0 and counts[30.0] == 2.0 and counts[60.0] == 1.0
+
+
+def test_session_window_gap():
+    op = GenerateFeatureOfWindowBatchOp(
+        timeCol="t",
+        featureDefinitions={
+            "groupCols": ["user"], "windowType": "SESSION",
+            "sessionGapTime": 30, "windowTime": 30,
+            "targetCols": ["x"], "statTypes": ["SUM"]})
+    out = op.link_from(TableSourceBatchOp(_table())).collect()
+    sums = sorted(r[3] for r in out.rows() if r[0] == "u1")
+    # u1 sessions: {10,20} and {70,75} -> sums 3 and 7
+    assert sums == [3.0, 7.0]
+
+
+def test_latest_n_rows_trailing():
+    op = GenerateFeatureOfLatestBatchOp(
+        timeCol="t", groupCols=["user"], targetCols=["x"],
+        statTypes=["SUM", "MEAN", "MIN"], number=2)
+    out = op.link_from(TableSourceBatchOp(_table())).collect()
+    by_key = {(r[0], r[1]): r for r in out.rows()}
+    # u1@70: latest 2 rows = x(20)=2, x(70)=3 -> sum 5, mean 2.5, min 2
+    r = by_key[("u1", 70.0)]
+    assert r[3] == 5.0 and r[4] == 2.5 and r[5] == 2.0
+    # first row of a group sees only itself
+    r0 = by_key[("u2", 5.0)]
+    assert r0[3] == 10.0 and r0[5] == 10.0
+    # original row order and columns preserved
+    assert out.schema.names[:3] == ["user", "t", "x"]
+    assert list(out.col("user")) == list(_table().col("user"))
+
+
+def test_latest_ndays_time_span():
+    # "days" of 1/86400 -> 1-second trailing windows over numeric seconds
+    op = GenerateFeatureOfLatestNDaysBatchOp(
+        timeCol="t", targetCols=["x"], statTypes=["SUM"],
+        nDays=60.0 / 86400.0)
+    rows = [(0.0, 1.0), (30.0, 2.0), (90.0, 4.0)]
+    t = MTable.from_rows(rows, "t double, x double")
+    out = op.link_from(TableSourceBatchOp(t)).collect()
+    col = out.schema.names[-1]
+    sums = list(out.col(col))
+    # 60s trailing: row0: 1 ; row1: 1+2 ; row2: 4 (row at 30 is exactly 60s
+    # before 90 -> included by left search)
+    assert sums[0] == 1.0 and sums[1] == 3.0 and sums[2] in (4.0, 6.0)
+
+
+def test_stddev_matches_numpy():
+    vals = [3.0, 5.0, 9.0, 11.0]
+    rows = [(float(i), v) for i, v in enumerate(vals)]
+    t = MTable.from_rows(rows, "t double, x double")
+    op = GenerateFeatureOfWindowBatchOp(
+        timeCol="t",
+        featureDefinitions={"groupCols": [], "windowType": "TUMBLE",
+                            "windowTime": 100, "targetCols": ["x"],
+                            "statTypes": ["STDDEV"]})
+    out = op.link_from(TableSourceBatchOp(t)).collect()
+    got = list(out.rows())[0][-1]
+    assert abs(got - np.std(vals, ddof=1)) < 1e-9
+
+
+def test_window_stream_twin():
+    from alink_tpu.operator.stream import (
+        GenerateFeatureOfWindowStreamOp,
+        TableSourceStreamOp,
+    )
+
+    src = TableSourceStreamOp(_table(), chunkSize=6)  # one chunk
+    op = GenerateFeatureOfWindowStreamOp(
+        timeCol="t",
+        featureDefinitions={"groupCols": ["user"], "windowType": "TUMBLE",
+                            "windowTime": 60, "targetCols": ["x"],
+                            "statTypes": ["SUM"]}).link_from(src)
+    chunks = list(op._stream())
+    assert sum(c.num_rows for c in chunks) == 4
+
+
+def test_tumble_boundary_row_kept():
+    op = GenerateFeatureOfWindowBatchOp(
+        timeCol="t",
+        featureDefinitions={"groupCols": [], "windowType": "TUMBLE",
+                            "windowTime": 60, "targetCols": ["x"],
+                            "statTypes": ["SUM"]})
+    t = MTable.from_rows([(0.0, 1.0), (10.0, 2.0), (120.0, 7.0)],
+                         "t double, x double")
+    out = op.link_from(TableSourceBatchOp(t)).collect()
+    sums = {r[0]: r[2] for r in out.rows()}
+    assert sums[0.0] == 3.0 and sums[120.0] == 7.0  # boundary row kept
+
+
+def test_hop_covers_first_event():
+    op = GenerateFeatureOfWindowBatchOp(
+        timeCol="t",
+        featureDefinitions={"groupCols": [], "windowType": "HOP",
+                            "windowTime": 60, "hopTime": 30,
+                            "targetCols": ["x"], "statTypes": ["COUNT"]})
+    t = MTable.from_rows([(40.0, 1.0), (70.0, 1.0)], "t double, x double")
+    out = op.link_from(TableSourceBatchOp(t)).collect()
+    counts = {r[0]: r[2] for r in out.rows()}
+    # [0,60) contains t=40 and must exist
+    assert counts[0.0] == 1.0 and counts[30.0] == 2.0 and counts[60.0] == 1.0
+
+
+def test_multi_definition_same_window_joins_columns():
+    op = GenerateFeatureOfWindowBatchOp(
+        timeCol="t",
+        featureDefinitions=[
+            {"groupCols": [], "windowType": "TUMBLE", "windowTime": 60,
+             "targetCols": ["x"], "statTypes": ["SUM"]},
+            {"groupCols": [], "windowType": "TUMBLE", "windowTime": 60,
+             "targetCols": ["x"], "statTypes": ["MAX"]}])
+    t = MTable.from_rows([(0.0, 1.0), (10.0, 5.0)], "t double, x double")
+    out = op.link_from(TableSourceBatchOp(t)).collect()
+    assert "x_sum_w60" in out.names and "x_max_w60" in out.names
+    row = list(out.rows())[0]
+    assert row[2] == 6.0 and row[3] == 5.0
+
+
+def test_multi_definition_different_windows_raises():
+    from alink_tpu.common.exceptions import AkIllegalArgumentException
+
+    op = GenerateFeatureOfWindowBatchOp(
+        timeCol="t",
+        featureDefinitions=[
+            {"groupCols": [], "windowTime": 60, "targetCols": ["x"]},
+            {"groupCols": ["u"], "windowTime": 30, "targetCols": ["x"]}])
+    t = MTable.from_rows([("a", 0.0, 1.0)], "u string, t double, x double")
+    with pytest.raises(AkIllegalArgumentException, match="share"):
+        op.link_from(TableSourceBatchOp(t)).collect()
+
+
+def test_trailing_extremes_use_declared_window():
+    # MAX must agree with SUM about the same declared 7-day window
+    days = np.asarray([0.0, 0.1, 0.2, 5.0, 11.0]) * 86400.0
+    vals = [1.0, 1.0, 1.0, 100.0, 1.0]
+    t = MTable.from_rows(list(zip(days, vals)), "t double, x double")
+    op = GenerateFeatureOfLatestNDaysBatchOp(
+        timeCol="t", targetCols=["x"], statTypes=["SUM", "MAX"], nDays=7)
+    out = op.link_from(TableSourceBatchOp(t)).collect()
+    last = list(out.rows())[-1]
+    s_col = out.schema.index_of("x_sum_d7")
+    m_col = out.schema.index_of("x_max_d7")
+    # 7-day trailing from day 11 covers days 5 and 11
+    assert last[s_col] == 101.0
+    assert last[m_col] == 100.0
