@@ -374,6 +374,30 @@ from .connectors import (
     KvSinkBatchOp,
     LookupKvBatchOp,
 )
+from .recommendation import (
+    FmItemsPerUserRecommBatchOp,
+    FmRateRecommBatchOp,
+    FmRecommTrainBatchOp,
+    FmUsersPerItemRecommBatchOp,
+    LeaveKObjectOutBatchOp,
+    LeaveTopKObjectOutBatchOp,
+)
+from .tree import (
+    GbdtEncoderBatchOp,
+)
+from .dataproc import (
+    HugeMultiStringIndexerPredictBatchOp,
+    HugeStringIndexerPredictBatchOp,
+)
+from .sources import (
+    XlsSourceBatchOp,
+)
+from .finance import (
+    GroupScorecardPredictBatchOp,
+    GroupScorecardTrainBatchOp,
+)
+from . import format as _format
+from .format import *  # noqa: F401,F403 — format conversion family
 from .windowfe import (
     GenerateFeatureOfLatestBatchOp,
     GenerateFeatureOfLatestNDaysBatchOp,

@@ -530,3 +530,33 @@ class OverWindowBatchOp(BatchOperator):
                 # window -> NULL, and the reader coerces int+NULL to DOUBLE
                 types.append(AlinkTypes.DOUBLE)
         return TableSchema(names, types)
+
+
+class HugeStringIndexerPredictBatchOp(StringIndexerPredictBatchOp):
+    """Huge-vocabulary StringIndexer serving (reference:
+    dataproc/HugeStringIndexerPredictBatchOp.java — the reference swaps the
+    broadcast model for a distributed join when the dictionary outgrows one
+    TM; here the lookup table already lives host-side once per process, so
+    the huge variant processes the DATA in bounded row blocks instead of
+    one giant object-array materialization)."""
+
+    BLOCK_SIZE = ParamInfo("blockSize", int, default=200_000)
+
+    def _execute_impl(self, model: MTable, t: MTable) -> MTable:
+        block = max(1, int(self.get(self.BLOCK_SIZE)))
+        if t.num_rows <= block:
+            return super()._execute_impl(model, t)
+        # load the huge dictionary ONCE; only the data flows in blocks
+        mapper = self._make_mapper(model.schema, t.schema)
+        mapper.load_model(model)
+        parts = []
+        for s in range(0, t.num_rows, block):
+            parts.append(mapper.map_table(
+                t.slice(s, min(s + block, t.num_rows))))
+        return MTable.concat(parts)
+
+
+class HugeMultiStringIndexerPredictBatchOp(HugeStringIndexerPredictBatchOp):
+    """Multi-column huge StringIndexer serving (reference:
+    dataproc/HugeMultiStringIndexerPredictBatchOp.java); the shared mapper
+    already handles multiple selectedCols."""

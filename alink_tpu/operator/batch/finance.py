@@ -209,3 +209,80 @@ class PsiBatchOp(BatchOperator, HasSelectedCols):
 
     def _out_schema(self, *in_schemas):
         return _PSI_SCHEMA
+
+
+class GroupScorecardTrainBatchOp(BatchOperator, HasSelectedCols):
+    """One scorecard per group value, all stages in one model table keyed
+    by the group column (reference: finance/GroupScorecardTrainBatchOp.java
+    — per-group binning+WOE+scaled LR)."""
+
+    GROUP_COL = ParamInfo("groupCol", str, optional=False,
+                          aliases=("groupCols",))
+    LABEL_COL = ScorecardTrainBatchOp.LABEL_COL
+    POSITIVE_LABEL = ScorecardTrainBatchOp.POSITIVE_LABEL
+    NUM_BUCKETS = ScorecardTrainBatchOp.NUM_BUCKETS
+    SCALED_VALUE = ScorecardTrainBatchOp.SCALED_VALUE
+    ODDS = ScorecardTrainBatchOp.ODDS
+    PDO = ScorecardTrainBatchOp.PDO
+    L_2 = ScorecardTrainBatchOp.L_2
+    MAX_ITER = ScorecardTrainBatchOp.MAX_ITER
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    def _execute_impl(self, t: MTable) -> MTable:
+        group_col = self.get(self.GROUP_COL)
+        groups = np.asarray(t.col(group_col), object).astype(str)
+        sub_params = self.get_params().clone()
+        parts = []
+        for g in np.unique(groups):
+            sub = t.filter_mask(groups == g).drop([group_col])
+            inner = ScorecardTrainBatchOp(sub_params.clone())
+            model = inner._execute_impl(sub)
+            parts.append(model.with_column(
+                "group_value", np.asarray([g] * model.num_rows, object),
+                AlinkTypes.STRING))
+        return MTable.concat(parts)
+
+    def _out_schema(self, in_schema):
+        from ...common.model import MODEL_SCHEMA
+
+        return TableSchema(list(MODEL_SCHEMA.names) + ["group_value"],
+                           list(MODEL_SCHEMA.types) + [AlinkTypes.STRING])
+
+
+class GroupScorecardPredictBatchOp(BatchOperator, HasReservedCols):
+    """Serve the per-group scorecards: each row routes to its group's model
+    (reference: GroupScorecardPredictBatchOp.java)."""
+
+    GROUP_COL = ParamInfo("groupCol", str, optional=False)
+    PREDICTION_COL = ParamInfo("predictionCol", str, default="score")
+
+    _min_inputs = 2
+    _max_inputs = 2
+
+    def _execute_impl(self, model: MTable, t: MTable) -> MTable:
+        group_col = self.get(self.GROUP_COL)
+        pred_col = self.get(self.PREDICTION_COL)
+        model_groups = np.asarray(model.col("group_value"), object)
+        data_groups = np.asarray(t.col(group_col), object).astype(str)
+        scores = np.full(t.num_rows, np.nan)
+        for g in np.unique(data_groups):
+            sub_model = model.filter_mask(
+                model_groups.astype(str) == g).drop(["group_value"])
+            if sub_model.num_rows == 0:
+                continue  # unseen group -> NaN scores
+            rows = data_groups == g
+            sub = t.filter_mask(rows).drop([group_col])
+            mapper = ScorecardModelMapper(
+                sub_model.schema, sub.schema,
+                self.get_params().clone()).load_model(sub_model)
+            out = mapper.map_table(sub)
+            score_col = mapper.get(ScorecardModelMapper.PREDICTION_SCORE_COL)
+            scores[rows] = np.asarray(out.col(score_col), np.float64)
+        return t.with_column(pred_col, scores, AlinkTypes.DOUBLE)
+
+    def _out_schema(self, in_schema):
+        return TableSchema(
+            list(in_schema.names) + [self.get(self.PREDICTION_COL)],
+            list(in_schema.types) + [AlinkTypes.DOUBLE])

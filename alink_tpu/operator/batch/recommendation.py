@@ -22,7 +22,8 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-from ...common.exceptions import AkIllegalDataException
+from ...common.exceptions import (AkIllegalArgumentException,
+                                  AkIllegalDataException)
 from ...common.model import model_to_table, table_to_model
 from ...common.mtable import AlinkTypes, MTable, TableSchema
 from ...common.params import MinValidator, ParamInfo
@@ -506,3 +507,191 @@ class UserCfRateRecommBatchOp(_RecommOpBase):
 
 class SwingSimilarItemsRecommBatchOp(_RecommOpBase):
     mapper_cls = _SimilarItemsMapper
+
+
+# ---------------------------------------------------------------------------
+# FM recommender (reference: FmRecommTrainBatchOp.java + FmRecommBinary...)
+# ---------------------------------------------------------------------------
+
+class FmRecommTrainBatchOp(ModelTrainOpMixin, BatchOperator,
+                           HasRecommTripleCols):
+    """Factorization-machine recommender on (user, item, rate) triples
+    (reference: recommendation/FmRecommTrainBatchOp.java — FM over the
+    one-hot user++item design matrix; for that design the FM collapses to
+    biased matrix factorization: score = w0 + bu + bi + <Vu, Vi>).
+
+    TPU re-design: one jitted adam loop over the embedding tables. The
+    learned biases are FOLDED into augmented factors (U' = [Vu, bu+w0/2, 1],
+    V' = [Vi, 1, bi+w0/2]) so every ALS serving kernel — rate/top-K/similar
+    — serves FM models unchanged: <U', V'> reproduces the FM score
+    exactly."""
+
+    RANK = ParamInfo("rank", int, default=10, validator=MinValidator(1))
+    NUM_EPOCHS = ParamInfo("numEpochs", int, default=30,
+                           aliases=("numIter",))
+    LEARN_RATE = ParamInfo("learnRate", float, default=0.05)
+    LAMBDA = ParamInfo("lambda", float, default=0.01, aliases=("lambda_",))
+    RANDOM_SEED = ParamInfo("randomSeed", int, default=0, aliases=("seed",))
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    def _static_meta_keys(self, in_schema):
+        return {
+            "modelName": "FmRecommModel",
+            "userCol": self.get(self.USER_COL),
+            "itemCol": self.get(self.ITEM_COL),
+        }
+
+    def _execute_impl(self, t: MTable) -> MTable:
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        user_col = self.get(self.USER_COL)
+        item_col = self.get(self.ITEM_COL)
+        rate_col = self.get(self.RATE_COL)
+        users = np.asarray(t.col(user_col))
+        items = np.asarray(t.col(item_col))
+        rates = (np.asarray(t.col(rate_col), np.float32) if rate_col
+                 else np.ones(t.num_rows, np.float32))
+        user_ids, u_idx = np.unique(users.astype(str), return_inverse=True)
+        item_ids, i_idx = np.unique(items.astype(str), return_inverse=True)
+        nu, ni = len(user_ids), len(item_ids)
+        rank = self.get(self.RANK)
+        lam = float(self.get(self.LAMBDA))
+        lr = float(self.get(self.LEARN_RATE))
+        epochs = int(self.get(self.NUM_EPOCHS))
+
+        rng = np.random.default_rng(self.get(self.RANDOM_SEED))
+        params = {
+            "w0": jnp.asarray(float(rates.mean())),
+            "bu": jnp.zeros(nu, jnp.float32),
+            "bi": jnp.zeros(ni, jnp.float32),
+            "U": jnp.asarray(rng.normal(0, 0.05, (nu, rank)), jnp.float32),
+            "V": jnp.asarray(rng.normal(0, 0.05, (ni, rank)), jnp.float32),
+        }
+        u_j = jnp.asarray(u_idx, jnp.int32)
+        i_j = jnp.asarray(i_idx, jnp.int32)
+        r_j = jnp.asarray(rates)
+        tx = optax.adam(lr)
+
+        def loss(p):
+            score = (p["w0"] + p["bu"][u_j] + p["bi"][i_j]
+                     + (p["U"][u_j] * p["V"][i_j]).sum(-1))
+            reg = sum(jnp.sum(x * x) for x in
+                      (p["bu"], p["bi"], p["U"], p["V"]))
+            return jnp.mean((score - r_j) ** 2) + lam * reg / len(rates)
+
+        @jax.jit
+        def fit(params):
+            state = tx.init(params)
+
+            def body(_, carry):
+                p, st = carry
+                g = jax.grad(loss)(p)
+                up, st = tx.update(g, st)
+                return optax.apply_updates(p, up), st
+
+            p, _ = jax.lax.fori_loop(0, epochs, body, (params, state))
+            return p
+
+        p = jax.device_get(fit(params))
+        w0 = float(p["w0"])
+        U_aug = np.concatenate(
+            [p["U"], (p["bu"] + w0 / 2)[:, None], np.ones((nu, 1))],
+            axis=1).astype(np.float32)
+        V_aug = np.concatenate(
+            [p["V"], np.ones((ni, 1)), (p["bi"] + w0 / 2)[:, None]],
+            axis=1).astype(np.float32)
+        meta = {
+            "modelName": "FmRecommModel",
+            "userCol": user_col, "itemCol": item_col, "rateCol": rate_col,
+            "rank": rank, "implicitPrefs": False,
+        }
+        return model_to_table(meta, {
+            "userIds": user_ids.astype(object),
+            "itemIds": item_ids.astype(object),
+            "userFactors": U_aug,
+            "itemFactors": V_aug,
+        })
+
+
+# FM serving = the ALS kernels over the augmented factors (see the train
+# op's docstring): new public op names, shared mappers.
+class FmRateRecommBatchOp(_RecommOpBase):
+    """(reference: FmRateRecommBatchOp.java)"""
+
+    mapper_cls = AlsRateRecommMapper
+
+
+class FmItemsPerUserRecommBatchOp(_RecommOpBase):
+    """(reference: FmItemsPerUserRecommBatchOp.java)"""
+
+    mapper_cls = AlsItemsPerUserRecommMapper
+
+
+class FmUsersPerItemRecommBatchOp(_RecommOpBase):
+    """(reference: FmUsersPerItemRecommBatchOp.java)"""
+
+    mapper_cls = AlsUsersPerItemRecommMapper
+
+
+# ---------------------------------------------------------------------------
+# Leave-K-out splitters (reference: dataproc/LeaveKObjectOutBatchOp.java,
+# LeaveTopKObjectOutBatchOp.java — recsys train/test protocol)
+# ---------------------------------------------------------------------------
+
+class LeaveKObjectOutBatchOp(BatchOperator, HasRecommTripleCols):
+    """Per group (user), leave K objects out: MAIN output = the left-out
+    test rows, SIDE output 0 = the remaining train rows (reference:
+    LeaveKObjectOutBatchOp.java — fraction/k params; we keep k +
+    minimum-rows semantics)."""
+
+    K = ParamInfo("k", int, default=1, validator=MinValidator(1))
+    MIN_ROWS = ParamInfo("minRows", int, default=2, validator=MinValidator(1),
+                         desc="groups smaller than this stay whole in train")
+    RANDOM_SEED = ParamInfo("randomSeed", int, default=0, aliases=("seed",))
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    def _pick(self, idx: np.ndarray, rates: Optional[np.ndarray],
+              k: int, rng) -> np.ndarray:
+        return rng.choice(idx, size=k, replace=False)
+
+    def _execute_impl(self, t: MTable):
+        user_col = self.get(self.USER_COL)
+        k = int(self.get(self.K))
+        min_rows = int(self.get(self.MIN_ROWS))
+        rate_col = self.get(self.RATE_COL)
+        rng = np.random.default_rng(self.get(self.RANDOM_SEED))
+        users = np.asarray(t.col(user_col), object).astype(str)
+        rates = (np.asarray(t.col(rate_col), np.float64)
+                 if rate_col else None)
+        test_mask = np.zeros(t.num_rows, bool)
+        _, inv = np.unique(users, return_inverse=True)
+        order = np.argsort(inv, kind="stable")
+        bounds = np.flatnonzero(np.diff(inv[order])) + 1
+        for idx in np.split(order, bounds):
+            if len(idx) < min_rows or len(idx) <= k:
+                continue
+            take = self._pick(idx, rates, k, rng)
+            test_mask[take] = True
+        return t.filter_mask(test_mask), [t.filter_mask(~test_mask)]
+
+    def _out_schema(self, in_schema):
+        return in_schema
+
+
+class LeaveTopKObjectOutBatchOp(LeaveKObjectOutBatchOp):
+    """Leave out the TOP-K rated objects per group (reference:
+    LeaveTopKObjectOutBatchOp.java — rateThreshold ordering)."""
+
+    def _pick(self, idx: np.ndarray, rates: Optional[np.ndarray],
+              k: int, rng) -> np.ndarray:
+        if rates is None:
+            raise AkIllegalArgumentException(
+                "LeaveTopKObjectOut needs rateCol")
+        order = idx[np.argsort(-rates[idx], kind="stable")]
+        return order[:k]

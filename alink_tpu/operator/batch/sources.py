@@ -290,3 +290,40 @@ class TsvSinkBatchOp(BatchOperator):
 
     def _out_schema(self, in_schema):
         return in_schema
+
+
+class XlsSourceBatchOp(BatchOperator):
+    """Excel sheet source, plugin-gated on openpyxl (reference:
+    XlsSourceBatchOp.java via connectors/connector-xls)."""
+
+    FILE_PATH = ParamInfo("filePath", str, optional=False)
+    SCHEMA_STR = ParamInfo("schemaStr", str, optional=False,
+                           aliases=("schema",))
+    SHEET_NAME = ParamInfo("sheetName", str, default=None)
+    IGNORE_FIRST_LINE = ParamInfo("ignoreFirstLine", bool, default=False)
+
+    _max_inputs = 0
+
+    def _execute_impl(self) -> MTable:
+        import pandas as pd
+
+        from ...common.exceptions import AkPluginNotExistException
+        from ...common.mtable import TableSchema as _TS
+
+        schema = _TS.parse(self.get(self.SCHEMA_STR))
+        try:
+            with file_open(self.get(self.FILE_PATH), "rb") as f:
+                df = pd.read_excel(
+                    f,
+                    sheet_name=self.get(self.SHEET_NAME) or 0,
+                    header=0 if self.get(self.IGNORE_FIRST_LINE) else None,
+                    names=schema.names,
+                )
+        except ImportError as e:
+            raise AkPluginNotExistException(
+                "XlsSource needs the 'openpyxl' package (the connector-xls "
+                "plugin analog): pip install openpyxl") from e
+        return MTable({n: df[n].to_numpy() for n in schema.names}, schema)
+
+    def _out_schema(self) -> TableSchema:
+        return TableSchema.parse(self.get(self.SCHEMA_STR))

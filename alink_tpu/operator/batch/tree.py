@@ -25,6 +25,7 @@ from ...mapper import (
     HasPredictionDetailCol,
     HasReservedCols,
     HasVectorCol,
+    ModelMapper,
     RichModelMapper,
     detail_json,
     get_feature_block,
@@ -258,3 +259,69 @@ class DecisionTreePredictBatchOp(_TreePredictBatchOp):
 
 class DecisionTreeRegPredictBatchOp(_TreePredictBatchOp):
     pass
+
+
+class GbdtEncoderMapper(ModelMapper, HasReservedCols):
+    """Rows → per-tree leaf indices as a sparse one-hot vector (reference:
+    operator/common/tree/TreeModelEncoderModelMapper.java — GBDT leaves as
+    categorical features feeding a downstream linear model)."""
+
+    ENCODE_OUTPUT_COL = ParamInfo("encodeOutputCol", str,
+                                  default="gbdt_encode",
+                                  aliases=("outputCol", "predictionCol"))
+
+    def load_model(self, model: MTable):
+        from ...tree.grow import TreeEnsemble
+
+        meta, arrays = table_to_model(model)
+        self.meta = meta
+        self.ens = TreeEnsemble.from_arrays(meta, arrays)
+        return self
+
+    def output_schema(self, input_schema):
+        out = self.get(self.ENCODE_OUTPUT_COL)
+        return self._append_result_schema(
+            input_schema, [out], [AlinkTypes.SPARSE_VECTOR])
+
+    def map_table(self, t: MTable) -> MTable:
+        from ...common.linalg import SparseVector
+
+        p = merge_feature_params(self.get_params(), self.meta)
+        X = get_feature_block(
+            t, p, vector_size=self.meta["dim"]).astype(np.float32)
+        ens = self.ens
+        T = ens.feats.shape[0]
+        leaf_count = ens.leaves.shape[-1]
+        # per-tree leaf id via the shared traversal: replicate raw_predict's
+        # routing but keep the leaf index instead of the value
+        n = X.shape[0]
+        leaf_ids = np.zeros((n, T), np.int64)
+        for ti in range(T):
+            node = np.zeros(n, np.int64)
+            pos = np.zeros(n, np.int64)
+            f, thr = ens.feats[ti], ens.thrs[ti]
+            for _ in range(ens.depth):
+                fs = f[pos]
+                ts = thr[pos]
+                x = X[np.arange(n), np.maximum(fs, 0)]
+                left = (fs < 0) | (x <= ts)
+                node = node * 2 + (1 - left.astype(np.int64))
+                pos = 2 * pos + 1 + (1 - left.astype(np.int64))
+            leaf_ids[:, ti] = node
+        dim = T * leaf_count
+        vecs = np.empty(n, object)
+        offsets = np.arange(T) * leaf_count
+        for i in range(n):
+            idx = offsets + leaf_ids[i]
+            vecs[i] = SparseVector(dim, idx, np.ones(T, np.float64))
+        out = self.get(self.ENCODE_OUTPUT_COL)
+        return self._append_result(
+            t, {out: vecs}, {out: AlinkTypes.SPARSE_VECTOR})
+
+
+class GbdtEncoderBatchOp(ModelMapBatchOp, HasReservedCols):
+    """link_from(gbdt_model, data) → leaf-index one-hot features
+    (reference: GbdtEncoderBatchOp.java)."""
+
+    mapper_cls = GbdtEncoderMapper
+    ENCODE_OUTPUT_COL = GbdtEncoderMapper.ENCODE_OUTPUT_COL

@@ -154,7 +154,9 @@ class GenerateFeatureOfWindowBatchOp(BatchOperator):
         gsets = {tuple(d["groupCols"]) for d in defs}
         wspecs = {(d.get("windowType", "TUMBLE").upper(),
                    float(d.get("windowTime", 0)),
-                   float(d.get("hopTime", d.get("windowTime", 0)) or 0))
+                   float(d.get("hopTime", d.get("windowTime", 0)) or 0),
+                   float(d.get("sessionGapTime",
+                               d.get("windowTime", 0)) or 0))
                   for d in defs}
         if len(gsets) > 1 or len(wspecs) > 1:
             raise AkIllegalArgumentException(

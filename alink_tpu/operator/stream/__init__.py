@@ -31,6 +31,18 @@ from .onlinelearning import (
     OnlineFmTrainStreamOp,
     OnlineLearningStreamOp,
 )
+from .sources import (
+    AkSinkStreamOp,
+    AkSourceStreamOp,
+    CsvSinkStreamOp,
+    Export2FileSinkStreamOp,
+    LibSvmSourceStreamOp,
+    ParquetSourceStreamOp,
+    TextSourceStreamOp,
+    TFRecordSourceStreamOp,
+    TsvSinkStreamOp,
+    TsvSourceStreamOp,
+)
 from .connectors import (
     GenerateFeatureOfWindowStreamOp,
     KafkaSinkStreamOp,
@@ -60,6 +72,16 @@ __all__ = [
     "OnlineLearningStreamOp",
     "FtrlPredictStreamOp",
     "FtrlTrainStreamOp",
+    "AkSinkStreamOp",
+    "AkSourceStreamOp",
+    "CsvSinkStreamOp",
+    "Export2FileSinkStreamOp",
+    "LibSvmSourceStreamOp",
+    "ParquetSourceStreamOp",
+    "TextSourceStreamOp",
+    "TFRecordSourceStreamOp",
+    "TsvSinkStreamOp",
+    "TsvSourceStreamOp",
     "GenerateFeatureOfWindowStreamOp",
     "KafkaSinkStreamOp",
     "KafkaSourceStreamOp",

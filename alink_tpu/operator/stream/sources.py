@@ -1,0 +1,190 @@
+"""Stream source/sink breadth: file formats as micro-batch streams.
+
+Capability parity with the reference's stream IO ops (reference:
+operator/stream/source/TextSourceStreamOp.java, TsvSourceStreamOp.java,
+LibSvmSourceStreamOp.java, AkSourceStreamOp.java and the sink family
+operator/stream/sink/CsvSinkStreamOp.java, AkSinkStreamOp.java,
+TsvSinkStreamOp.java, Export2FileSinkStreamOp.java — each wraps the batch
+reader/writer behind Flink's streaming runtime).
+
+Re-design: each source delegates to its batch twin's reader and yields
+fixed-size chunks; sinks append per chunk. Export2FileSinkStreamOp writes
+each micro-batch as its own timestamped part file (the reference's
+per-checkpoint file rolling)."""
+
+from __future__ import annotations
+
+import time
+from typing import Iterator
+
+from ...common.mtable import MTable, TableSchema
+from ...common.params import InValidator, ParamInfo
+from .base import StreamOperator
+
+
+def _chunked(table: MTable, chunk: int) -> Iterator[MTable]:
+    for s in range(0, table.num_rows, chunk):
+        yield table.slice(s, min(s + chunk, table.num_rows))
+
+
+class _BatchReaderSource(StreamOperator):
+    """Read via the batch twin once, emit micro-batches."""
+
+    CHUNK_SIZE = ParamInfo("chunkSize", int, default=1024)
+
+    _max_inputs = 0
+    _batch_cls: type = None
+
+    def _stream_impl(self) -> Iterator[MTable]:
+        inner = self._batch_cls(self.get_params().clone())
+        yield from _chunked(inner._execute_impl(),
+                            max(1, self.get(self.CHUNK_SIZE)))
+
+    def _out_schema(self) -> TableSchema:
+        return self._batch_cls(self.get_params().clone())._out_schema()
+
+
+def _source(name: str, batch_cls: type, doc: str) -> type:
+    ns = {"_batch_cls": batch_cls, "__doc__": doc}
+    for pname in dir(batch_cls):
+        p = getattr(batch_cls, pname)
+        if pname.isupper() and hasattr(p, "name"):
+            ns[pname] = p
+    return type(name, (_BatchReaderSource,), ns)
+
+
+from ..batch.base import AkSourceBatchOp, CsvSourceBatchOp  # noqa: E402
+from ..batch.sources import (  # noqa: E402
+    LibSvmSourceBatchOp,
+    ParquetSourceBatchOp,
+    TextSourceBatchOp,
+    TFRecordSourceBatchOp,
+    TsvSourceBatchOp,
+)
+
+TextSourceStreamOp = _source(
+    "TextSourceStreamOp", TextSourceBatchOp,
+    "(reference: TextSourceStreamOp.java)")
+TsvSourceStreamOp = _source(
+    "TsvSourceStreamOp", TsvSourceBatchOp,
+    "(reference: TsvSourceStreamOp.java)")
+LibSvmSourceStreamOp = _source(
+    "LibSvmSourceStreamOp", LibSvmSourceBatchOp,
+    "(reference: LibSvmSourceStreamOp.java)")
+AkSourceStreamOp = _source(
+    "AkSourceStreamOp", AkSourceBatchOp,
+    "(reference: AkSourceStreamOp.java)")
+ParquetSourceStreamOp = _source(
+    "ParquetSourceStreamOp", ParquetSourceBatchOp,
+    "(reference: ParquetSourceStreamOp.java)")
+TFRecordSourceStreamOp = _source(
+    "TFRecordSourceStreamOp", TFRecordSourceBatchOp,
+    "(reference: TFRecordDatasetSourceStreamOp.java)")
+
+
+class CsvSinkStreamOp(StreamOperator):
+    """Append every chunk to one CSV file (reference:
+    CsvSinkStreamOp.java)."""
+
+    FILE_PATH = ParamInfo("filePath", str, optional=False)
+    FIELD_DELIMITER = ParamInfo("fieldDelimiter", str, default=",")
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    def _stream_impl(self, it: Iterator[MTable]) -> Iterator[MTable]:
+        from ...io.filesystem import file_open
+
+        path = self.get(self.FILE_PATH)
+        delim = self.get(self.FIELD_DELIMITER)
+        with file_open(path, "w") as f:
+            for chunk in it:
+                chunk.to_dataframe().to_csv(f, sep=delim, index=False,
+                                            header=False)
+                yield chunk
+
+    def _out_schema(self, in_schema: TableSchema) -> TableSchema:
+        return in_schema
+
+
+class AkSinkStreamOp(StreamOperator):
+    """Collect the stream and land ONE .ak file at the end (reference:
+    AkSinkStreamOp.java — the bounded-stream sink)."""
+
+    FILE_PATH = ParamInfo("filePath", str, optional=False)
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    def _stream_impl(self, it: Iterator[MTable]) -> Iterator[MTable]:
+        from ...io.ak import write_ak
+
+        chunks = []
+        for chunk in it:
+            chunks.append(chunk)
+            yield chunk
+        if chunks:
+            write_ak(self.get(self.FILE_PATH), MTable.concat(chunks))
+
+    def _out_schema(self, in_schema: TableSchema) -> TableSchema:
+        return in_schema
+
+
+class Export2FileSinkStreamOp(StreamOperator):
+    """Each micro-batch rolls into its OWN timestamped part file under a
+    directory (reference: Export2FileSinkStreamOp.java — time-rolling file
+    export; format ak or csv)."""
+
+    FILE_PATH = ParamInfo("filePath", str, optional=False,
+                          desc="output DIRECTORY")
+    FORMAT = ParamInfo("format", str, default="AK",
+                       validator=InValidator("AK", "CSV"))
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    def _stream_impl(self, it: Iterator[MTable]) -> Iterator[MTable]:
+        from ...io.ak import write_ak
+        from ...io.filesystem import file_open, get_file_system
+
+        root = self.get(self.FILE_PATH)
+        fs = get_file_system(root)
+        fs.makedirs(root)
+        fmt = self.get(self.FORMAT)
+        part = 0
+        for chunk in it:
+            ts = int(time.time() * 1000)
+            if fmt == "AK":
+                fname = fs.join(root, f"part-{ts}-{part:05d}.ak")
+                write_ak(fname, chunk)
+            else:
+                fname = fs.join(root, f"part-{ts}-{part:05d}.csv")
+                with file_open(fname, "w") as f:
+                    chunk.to_dataframe().to_csv(f, index=False, header=False)
+            part += 1
+            yield chunk
+
+    def _out_schema(self, in_schema: TableSchema) -> TableSchema:
+        return in_schema
+
+
+class TsvSinkStreamOp(StreamOperator):
+    """(reference: TsvSinkStreamOp.java)"""
+
+    FILE_PATH = ParamInfo("filePath", str, optional=False)
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    def _stream_impl(self, it: Iterator[MTable]) -> Iterator[MTable]:
+        from ...io.filesystem import file_open
+
+        with file_open(self.get(self.FILE_PATH), "w") as f:
+            for chunk in it:
+                for row in chunk.rows():
+                    f.write("\t".join("" if v is None else str(v)
+                                      for v in row) + "\n")
+                yield chunk
+
+    def _out_schema(self, in_schema: TableSchema) -> TableSchema:
+        return in_schema
