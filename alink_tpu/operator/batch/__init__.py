@@ -396,6 +396,18 @@ from .finance import (
     GroupScorecardPredictBatchOp,
     GroupScorecardTrainBatchOp,
 )
+from .vector import (
+    VectorImputerPredictBatchOp,
+    VectorImputerTrainBatchOp,
+    VectorMaxAbsScalerPredictBatchOp,
+    VectorMaxAbsScalerTrainBatchOp,
+    VectorMinMaxScalerPredictBatchOp,
+    VectorMinMaxScalerTrainBatchOp,
+    VectorStandardScalerPredictBatchOp,
+    VectorStandardScalerTrainBatchOp,
+)
+from . import modelinfo as _modelinfo
+from .modelinfo import *  # noqa: F401,F403 — ModelInfo family
 from . import format as _format
 from .format import *  # noqa: F401,F403 — format conversion family
 from .windowfe import (

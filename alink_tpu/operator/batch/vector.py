@@ -19,6 +19,7 @@ from ...common.exceptions import AkIllegalArgumentException
 from ...common.linalg import DenseVector, parse_vector
 from ...common.mtable import AlinkTypes, MTable, TableSchema
 from ...common.params import InValidator, ParamInfo
+from ...common.model import model_to_table, table_to_model
 from ...mapper import (
     HasOutputCol,
     HasOutputCols,
@@ -26,10 +27,11 @@ from ...mapper import (
     HasSelectedCol,
     HasSelectedCols,
     Mapper,
+    ModelMapper,
     SISOMapper,
 )
 from .base import BatchOperator
-from .utils import MapBatchOp
+from .utils import MapBatchOp, ModelMapBatchOp, ModelTrainOpMixin
 
 
 def _dense_rows(col) -> List[np.ndarray]:
@@ -268,3 +270,195 @@ class UdtfBatchOp(BatchOperator, HasSelectedCols, HasOutputCols,
                       [AlinkTypes.STRING] * len(outs))
         return TableSchema(list(in_schema.names) + outs,
                            list(in_schema.types) + rtypes)
+
+
+# ---------------------------------------------------------------------------
+# vector-column scaler/imputer model family (reference:
+# operator/batch/dataproc/vector/VectorStandardScalerTrainBatchOp.java,
+# VectorMinMaxScalerTrainBatchOp.java, VectorMaxAbsScalerTrainBatchOp.java,
+# VectorImputerTrainBatchOp.java + their Predict twins)
+# ---------------------------------------------------------------------------
+
+
+def _vector_block(t: MTable, col: str) -> np.ndarray:
+    return np.stack([parse_vector(v).to_dense().data
+                     for v in t.col(col)]).astype(np.float64)
+
+
+class _VectorStatModelMapper(ModelMapper, HasSelectedCol, HasOutputCol,
+                             HasReservedCols):
+    """Shared vector-transform serving: load stats, map vectors in one
+    vectorized pass."""
+
+    def load_model(self, model: MTable):
+        self.meta, self.arrays = table_to_model(model)
+        return self
+
+    def output_schema(self, input_schema):
+        # mirror map_table exactly: selectedCol overrides the model's,
+        # outputCol defaults to in-place
+        col = (self.get(HasSelectedCol.SELECTED_COL)
+               or self.meta["selectedCol"])
+        out = self.get(HasOutputCol.OUTPUT_COL) or col
+        return self._append_result_schema(
+            input_schema, [out], [AlinkTypes.DENSE_VECTOR])
+
+    def _transform(self, X: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def map_table(self, t: MTable) -> MTable:
+        col = (self.get(HasSelectedCol.SELECTED_COL)
+               or self.meta["selectedCol"])
+        out = self.get(HasOutputCol.OUTPUT_COL) or col
+        X = _vector_block(t, col)
+        Y = self._transform(X)
+        vecs = np.empty(len(Y), object)
+        for i, row in enumerate(Y):
+            vecs[i] = DenseVector(row)
+        return self._append_result(
+            t, {out: vecs}, {out: AlinkTypes.DENSE_VECTOR})
+
+
+class _VectorStatTrainBase(ModelTrainOpMixin, BatchOperator, HasSelectedCol):
+    _min_inputs = 1
+    _max_inputs = 1
+    _model_name = ""
+
+    def _stats(self, X: np.ndarray) -> dict:
+        raise NotImplementedError
+
+    def _meta_extra(self) -> dict:
+        return {}
+
+    def _execute_impl(self, t: MTable) -> MTable:
+        col = self.get(HasSelectedCol.SELECTED_COL)
+        X = _vector_block(t, col)
+        meta = {"modelName": self._model_name, "selectedCol": col,
+                **self._meta_extra()}
+        return model_to_table(meta, self._stats(X))
+
+    def _static_meta_keys(self, in_schema):
+        return {"modelName": self._model_name,
+                "selectedCol": self.get(HasSelectedCol.SELECTED_COL)}
+
+
+class VectorStandardScalerTrainBatchOp(_VectorStatTrainBase):
+    """(reference: VectorStandardScalerTrainBatchOp.java)"""
+
+    WITH_MEAN = ParamInfo("withMean", bool, default=True)
+    WITH_STD = ParamInfo("withStd", bool, default=True)
+
+    _model_name = "VectorStandardScalerModel"
+
+    def _meta_extra(self):
+        return {"withMean": self.get(self.WITH_MEAN),
+                "withStd": self.get(self.WITH_STD)}
+
+    def _stats(self, X):
+        return {"mean": X.mean(axis=0), "std": X.std(axis=0, ddof=0)}
+
+
+class VectorStandardScalerModelMapper(_VectorStatModelMapper):
+    def _transform(self, X):
+        mean = self.arrays["mean"]
+        std = np.where(self.arrays["std"] > 0, self.arrays["std"], 1.0)
+        if self.meta.get("withMean", True):
+            X = X - mean
+        if self.meta.get("withStd", True):
+            X = X / std
+        return X
+
+
+class VectorStandardScalerPredictBatchOp(ModelMapBatchOp, HasSelectedCol,
+                                         HasOutputCol, HasReservedCols):
+    mapper_cls = VectorStandardScalerModelMapper
+
+
+class VectorMinMaxScalerTrainBatchOp(_VectorStatTrainBase):
+    """(reference: VectorMinMaxScalerTrainBatchOp.java)"""
+
+    MIN_VALUE = ParamInfo("min", float, default=0.0)
+    MAX_VALUE = ParamInfo("max", float, default=1.0)
+
+    _model_name = "VectorMinMaxScalerModel"
+
+    def _meta_extra(self):
+        return {"min": self.get(self.MIN_VALUE),
+                "max": self.get(self.MAX_VALUE)}
+
+    def _stats(self, X):
+        return {"dataMin": X.min(axis=0), "dataMax": X.max(axis=0)}
+
+
+class VectorMinMaxScalerModelMapper(_VectorStatModelMapper):
+    def _transform(self, X):
+        lo, hi = self.arrays["dataMin"], self.arrays["dataMax"]
+        span = np.where(hi > lo, hi - lo, 1.0)
+        out_lo = self.meta.get("min", 0.0)
+        out_hi = self.meta.get("max", 1.0)
+        return (X - lo) / span * (out_hi - out_lo) + out_lo
+
+
+class VectorMinMaxScalerPredictBatchOp(ModelMapBatchOp, HasSelectedCol,
+                                       HasOutputCol, HasReservedCols):
+    mapper_cls = VectorMinMaxScalerModelMapper
+
+
+class VectorMaxAbsScalerTrainBatchOp(_VectorStatTrainBase):
+    """(reference: VectorMaxAbsScalerTrainBatchOp.java)"""
+
+    _model_name = "VectorMaxAbsScalerModel"
+
+    def _stats(self, X):
+        return {"maxAbs": np.abs(X).max(axis=0)}
+
+
+class VectorMaxAbsScalerModelMapper(_VectorStatModelMapper):
+    def _transform(self, X):
+        m = np.where(self.arrays["maxAbs"] > 0, self.arrays["maxAbs"], 1.0)
+        return X / m
+
+
+class VectorMaxAbsScalerPredictBatchOp(ModelMapBatchOp, HasSelectedCol,
+                                       HasOutputCol, HasReservedCols):
+    mapper_cls = VectorMaxAbsScalerModelMapper
+
+
+class VectorImputerTrainBatchOp(_VectorStatTrainBase):
+    """NaN filling for vector columns (reference:
+    VectorImputerTrainBatchOp.java — MEAN/MIN/MAX/VALUE strategies)."""
+
+    STRATEGY = ParamInfo("strategy", str, default="MEAN",
+                         validator=InValidator("MEAN", "MIN", "MAX",
+                                               "VALUE"))
+    FILL_VALUE = ParamInfo("fillValue", float, default=0.0)
+
+    _model_name = "VectorImputerModel"
+
+    def _meta_extra(self):
+        return {"strategy": self.get(self.STRATEGY)}
+
+    def _stats(self, X):
+        strat = self.get(self.STRATEGY)
+        with np.errstate(all="ignore"):
+            if strat == "MEAN":
+                fill = np.nanmean(X, axis=0)
+            elif strat == "MIN":
+                fill = np.nanmin(X, axis=0)
+            elif strat == "MAX":
+                fill = np.nanmax(X, axis=0)
+            else:
+                fill = np.full(X.shape[1], self.get(self.FILL_VALUE))
+        return {"fill": np.nan_to_num(fill,
+                                      nan=self.get(self.FILL_VALUE))}
+
+
+class VectorImputerModelMapper(_VectorStatModelMapper):
+    def _transform(self, X):
+        fill = self.arrays["fill"]
+        return np.where(np.isnan(X), fill[None, :], X)
+
+
+class VectorImputerPredictBatchOp(ModelMapBatchOp, HasSelectedCol,
+                                  HasOutputCol, HasReservedCols):
+    mapper_cls = VectorImputerModelMapper

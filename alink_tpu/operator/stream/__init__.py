@@ -31,6 +31,11 @@ from .onlinelearning import (
     OnlineFmTrainStreamOp,
     OnlineLearningStreamOp,
 )
+from .checkpoint import (
+    AckCheckpointStreamOp,
+    CheckpointedSourceStreamOp,
+    StreamCheckpoint,
+)
 from .sources import (
     AkSinkStreamOp,
     AkSourceStreamOp,
@@ -72,6 +77,9 @@ __all__ = [
     "OnlineLearningStreamOp",
     "FtrlPredictStreamOp",
     "FtrlTrainStreamOp",
+    "AckCheckpointStreamOp",
+    "CheckpointedSourceStreamOp",
+    "StreamCheckpoint",
     "AkSinkStreamOp",
     "AkSourceStreamOp",
     "CsvSinkStreamOp",

@@ -251,3 +251,24 @@ def test_xls_source_plugin_gated(tmp_path):
         (tmp_path / "x.xlsx").write_bytes(b"PK\x03\x04 not really")
         with pytest.raises(Exception):
             op.collect()
+
+
+def test_model_info_generic_and_named():
+    from alink_tpu.operator.batch import (
+        GbdtModelInfoBatchOp,
+        KMeansTrainBatchOp,
+        ModelInfoBatchOp,
+    )
+
+    rng = np.random.default_rng(0)
+    cols = {f"f{i}": rng.standard_normal(60) for i in range(3)}
+    t = MTable(cols)
+    model = KMeansTrainBatchOp(
+        k=2, featureCols=["f0", "f1", "f2"]).link_from(
+        TableSourceBatchOp(t))
+    info = ModelInfoBatchOp().link_from(model).collect()
+    keys = list(info.col("key"))
+    assert any(k.startswith("meta.") for k in keys)
+    assert any(k.startswith("array.") for k in keys)
+    # named variants share the inspector
+    assert issubclass(GbdtModelInfoBatchOp, ModelInfoBatchOp)

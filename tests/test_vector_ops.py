@@ -73,3 +73,49 @@ def test_udtf_explodes_rows():
     assert out.num_rows == 3
     assert list(out.col("word")) == ["a", "b", "c"]
     assert list(out.col("id")) == [1, 1, 2]
+
+
+def test_vector_scaler_family():
+    from alink_tpu.common.linalg import DenseVector
+    from alink_tpu.common.mtable import MTable
+    from alink_tpu.operator.batch import (
+        VectorImputerPredictBatchOp,
+        VectorImputerTrainBatchOp,
+        VectorMaxAbsScalerPredictBatchOp,
+        VectorMaxAbsScalerTrainBatchOp,
+        VectorMinMaxScalerPredictBatchOp,
+        VectorMinMaxScalerTrainBatchOp,
+        VectorStandardScalerPredictBatchOp,
+        VectorStandardScalerTrainBatchOp,
+    )
+    from alink_tpu.operator.batch.base import TableSourceBatchOp
+
+    rows = [(DenseVector([1.0, 10.0]),), (DenseVector([3.0, 30.0]),),
+            (DenseVector([5.0, 50.0]),)]
+    t = MTable.from_rows(rows, "v DENSE_VECTOR")
+    src = TableSourceBatchOp(t)
+
+    m = VectorStandardScalerTrainBatchOp(selectedCol="v").link_from(src)
+    out = VectorStandardScalerPredictBatchOp().link_from(m, src).collect()
+    X = np.stack([np.asarray(v.data) for v in out.col("v")])
+    np.testing.assert_allclose(X.mean(axis=0), 0.0, atol=1e-12)
+    np.testing.assert_allclose(X.std(axis=0), 1.0, atol=1e-12)
+
+    m2 = VectorMinMaxScalerTrainBatchOp(selectedCol="v").link_from(src)
+    out2 = VectorMinMaxScalerPredictBatchOp().link_from(m2, src).collect()
+    X2 = np.stack([np.asarray(v.data) for v in out2.col("v")])
+    assert X2.min() == 0.0 and X2.max() == 1.0
+
+    m3 = VectorMaxAbsScalerTrainBatchOp(selectedCol="v").link_from(src)
+    out3 = VectorMaxAbsScalerPredictBatchOp().link_from(m3, src).collect()
+    X3 = np.stack([np.asarray(v.data) for v in out3.col("v")])
+    assert abs(X3).max() == 1.0
+
+    rows_nan = [(DenseVector([1.0, np.nan]),), (DenseVector([3.0, 6.0]),)]
+    tn = MTable.from_rows(rows_nan, "v DENSE_VECTOR")
+    srcn = TableSourceBatchOp(tn)
+    m4 = VectorImputerTrainBatchOp(selectedCol="v",
+                                   strategy="MEAN").link_from(srcn)
+    out4 = VectorImputerPredictBatchOp().link_from(m4, srcn).collect()
+    X4 = np.stack([np.asarray(v.data) for v in out4.col("v")])
+    assert not np.isnan(X4).any() and X4[0, 1] == 6.0
