@@ -152,6 +152,13 @@ from .classification import (
     OneVsRestTrainBatchOp,
 )
 from .outlier import (
+    CopodOutlier4GroupedDataBatchOp,
+    EcodOutlier4GroupedDataBatchOp,
+    HbosOutlier4GroupedDataBatchOp,
+    KdeOutlier4GroupedDataBatchOp,
+    LofOutlier4GroupedDataBatchOp,
+    OcsvmOutlier4GroupedDataBatchOp,
+    SosOutlier4GroupedDataBatchOp,
     BoxPlotOutlier4GroupedDataBatchOp,
     BoxPlotOutlierBatchOp,
     CopodOutlierBatchOp,
@@ -287,6 +294,9 @@ from .timeseries import (
     ShiftBatchOp,
 )
 from .graph import (
+    MultiSourceShortestPathBatchOp,
+    TreeDepthBatchOp,
+    VertexNeighborSearchBatchOp,
     CommonNeighborsBatchOp,
     CommunityDetectionClusterBatchOp,
     ConnectedComponentsBatchOp,
@@ -405,6 +415,14 @@ from .vector import (
     VectorMinMaxScalerTrainBatchOp,
     VectorStandardScalerPredictBatchOp,
     VectorStandardScalerTrainBatchOp,
+)
+from .utils2 import (
+    AppendIdBatchOp,
+    AppendModelStreamFileSinkBatchOp,
+    DummySinkBatchOp,
+    FlattenMTableBatchOp,
+    GroupDataToMTableBatchOp,
+    TextSinkBatchOp,
 )
 from . import modelinfo as _modelinfo
 from .modelinfo import *  # noqa: F401,F403 — ModelInfo family

@@ -307,3 +307,126 @@ class CommonNeighborsBatchOp(BatchOperator, _HasGraphCols):
             ["source", "target", "neighbors", "cnt"],
             [AlinkTypes.STRING, AlinkTypes.STRING, AlinkTypes.STRING,
              AlinkTypes.DOUBLE])
+
+
+class MultiSourceShortestPathBatchOp(BatchOperator, _HasGraphCols):
+    """Distance to the NEAREST of several sources, plus which root won
+    (reference: MultiSourceShortestPathBatchOp.java). Implementation: one
+    SSSP run per root with a host-side min-merge — O(|roots|) superstep
+    runs; fine for the handful of roots the op is used with."""
+
+    SOURCE_POINTS = ParamInfo("sourcePoints", list, optional=False,
+                              aliases=("sourcePoint",))
+
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    _SCHEMA = TableSchema(["vertex", "value", "root"],
+                          [AlinkTypes.STRING, AlinkTypes.DOUBLE,
+                           AlinkTypes.STRING])
+
+    def _execute_impl(self, t: MTable) -> MTable:
+        g = self._graph(t)
+        label_list = g.labels.astype(str).tolist()
+        srcs = [label_list.index(str(s))
+                for s in self.get(self.SOURCE_POINTS)]
+        n = len(g.labels)
+        dist = np.full(n, np.inf)
+        root = np.full(n, -1, np.int64)
+        for s in srcs:
+            d = sssp(g, s)
+            better = d < dist
+            dist[better] = d[better]
+            root[better] = s
+        root_labels = np.asarray(
+            [g.labels[r] if r >= 0 else None for r in root], object)
+        return MTable({"vertex": g.labels.astype(str),
+                       "value": dist.astype(np.float64),
+                       "root": root_labels}, self._SCHEMA)
+
+    def _out_schema(self, in_schema):
+        return self._SCHEMA
+
+
+class TreeDepthBatchOp(BatchOperator, _HasGraphCols):
+    """Depth of every vertex in a forest of rooted trees (reference:
+    TreeDepthBatchOp.java — roots are vertices with no incoming edge;
+    depth 0 at the root)."""
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    _SCHEMA = TableSchema(["vertex", "root", "value"],
+                          [AlinkTypes.STRING, AlinkTypes.STRING,
+                           AlinkTypes.LONG])
+
+    def _execute_impl(self, t: MTable) -> MTable:
+        g = self._graph(t, directed=True)
+        n = len(g.labels)
+        parents = np.bincount(g.dst, minlength=n)
+        from ...common.exceptions import AkIllegalDataException
+
+        if (parents > 1).any():
+            bad = g.labels[int(np.argmax(parents))]
+            raise AkIllegalDataException(
+                f"vertex {bad!r} has {int(parents.max())} parents — "
+                "TreeDepth needs a forest")
+        has_parent = parents > 0
+        depth = np.full(n, -1, np.int64)
+        root = np.arange(n)
+        depth[~has_parent] = 0
+        # BFS supersteps over the edge list (vectorized frontier expand)
+        for _ in range(n):
+            src_known = depth[g.src] >= 0
+            cand = g.dst[src_known]
+            new = depth[cand] < 0
+            if not new.any():
+                break
+            depth[cand[new]] = depth[g.src[src_known]][new] + 1
+            root[cand[new]] = root[g.src[src_known]][new]
+        if (depth < 0).any():
+            raise AkIllegalDataException(
+                "graph contains a cycle or unreachable vertex — TreeDepth "
+                "needs a forest")
+        return MTable({"vertex": g.labels.astype(str),
+                       "root": g.labels[root].astype(str),
+                       "value": depth}, self._SCHEMA)
+
+    def _out_schema(self, in_schema):
+        return self._SCHEMA
+
+
+class VertexNeighborSearchBatchOp(BatchOperator, _HasGraphCols):
+    """Subgraph within K hops of the given vertices (reference:
+    VertexNeighborSearchBatchOp.java — emits the induced edge list)."""
+
+    SOURCES = ParamInfo("sources", list, optional=False,
+                        aliases=("vertices",))
+    DEPTH = ParamInfo("depth", int, default=1, validator=MinValidator(1))
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    _SCHEMA = TableSchema(["source", "target"],
+                          [AlinkTypes.STRING, AlinkTypes.STRING])
+
+    def _execute_impl(self, t: MTable) -> MTable:
+        g = self._graph(t)
+        label_list = g.labels.astype(str).tolist()
+        n = len(g.labels)
+        seen = np.zeros(n, bool)
+        for s in self.get(self.SOURCES):
+            seen[label_list.index(str(s))] = True
+        for _ in range(int(self.get(self.DEPTH))):
+            frontier = seen[g.src]
+            seen[g.dst[frontier]] = True
+        half = len(g.src) // 2
+        src, dst = g.src[:half], g.dst[:half]
+        keep = seen[src] & seen[dst]
+        return MTable({"source": g.labels[src[keep]].astype(str),
+                       "target": g.labels[dst[keep]].astype(str)},
+                      self._SCHEMA)
+
+    def _out_schema(self, in_schema):
+        return self._SCHEMA

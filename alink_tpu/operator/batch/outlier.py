@@ -262,15 +262,10 @@ class _Grouped4DataMixin:
     GROUP_COLS = ParamInfo("groupCols", list, optional=False)
 
     def _execute_impl(self, t: MTable):
-        group_cols = [c.strip() for c in (
-            self.get(self.GROUP_COLS) if isinstance(
-                self.get(self.GROUP_COLS), (list, tuple))
-            else str(self.get(self.GROUP_COLS)).split(",")
-        )]
-        keys = list(zip(*[t.col(c) for c in group_cols]))
-        index: Dict = {}
-        for r, k in enumerate(keys):
-            index.setdefault(k, []).append(r)
+        from .utils2 import coerce_group_cols, group_row_indices
+
+        group_cols = coerce_group_cols(self.get(self.GROUP_COLS))
+        index, _ = group_row_indices(t, group_cols)
         n = t.num_rows
         scores = np.zeros(n)
         flags = np.zeros(n, bool)
@@ -303,6 +298,20 @@ ShEsdOutlier4GroupedDataBatchOp = _grouped(
     "ShEsdOutlier4GroupedDataBatchOp", ShEsdOutlierBatchOp)
 IForestOutlier4GroupedDataBatchOp = _grouped(
     "IForestOutlier4GroupedDataBatchOp", IForestOutlierBatchOp)
+HbosOutlier4GroupedDataBatchOp = _grouped(
+    "HbosOutlier4GroupedDataBatchOp", HbosOutlierBatchOp)
+KdeOutlier4GroupedDataBatchOp = _grouped(
+    "KdeOutlier4GroupedDataBatchOp", KdeOutlierBatchOp)
+LofOutlier4GroupedDataBatchOp = _grouped(
+    "LofOutlier4GroupedDataBatchOp", LofOutlierBatchOp)
+SosOutlier4GroupedDataBatchOp = _grouped(
+    "SosOutlier4GroupedDataBatchOp", SosOutlierBatchOp)
+OcsvmOutlier4GroupedDataBatchOp = _grouped(
+    "OcsvmOutlier4GroupedDataBatchOp", OcsvmOutlierBatchOp)
+EcodOutlier4GroupedDataBatchOp = _grouped(
+    "EcodOutlier4GroupedDataBatchOp", EcodOutlierBatchOp)
+CopodOutlier4GroupedDataBatchOp = _grouped(
+    "CopodOutlier4GroupedDataBatchOp", CopodOutlierBatchOp)
 
 
 # -- evaluation --------------------------------------------------------------

@@ -7,6 +7,9 @@ ConnectedComponentsBatchOpTest.java, KCoreBatchOpTest.java, ...)."""
 import numpy as np
 import pytest
 
+from alink_tpu.common.mtable import MTable
+from alink_tpu.operator.batch.base import TableSourceBatchOp
+
 from alink_tpu.operator.batch import (
     CommonNeighborsBatchOp,
     CommunityDetectionClusterBatchOp,
@@ -126,3 +129,39 @@ def test_common_neighbors():
     row = {(r[0], r[1]): r for r in out.rows()}
     assert row[("u", "v")][3] == 2.0
     assert set(row[("u", "v")][2].split()) == {"x", "y"}
+
+
+def test_multi_source_shortest_path():
+    from alink_tpu.operator.batch import MultiSourceShortestPathBatchOp
+
+    edges = [("a", "b"), ("b", "c"), ("c", "d"), ("x", "y"), ("y", "d")]
+    t = MTable.from_rows(edges, "source string, target string")
+    out = MultiSourceShortestPathBatchOp(
+        sourcePoints=["a", "x"]).link_from(TableSourceBatchOp(t)).collect()
+    d = {r[0]: (r[1], r[2]) for r in out.rows()}
+    assert d["b"][0] == 1.0 and d["b"][1] == "a"
+    assert d["y"][0] == 1.0 and d["y"][1] == "x"
+    assert d["d"][0] == 2.0  # via x->y->d, closer than a->b->c->d
+
+
+def test_tree_depth():
+    from alink_tpu.operator.batch import TreeDepthBatchOp
+
+    edges = [("r", "c1"), ("r", "c2"), ("c1", "g1"), ("r2", "z")]
+    t = MTable.from_rows(edges, "source string, target string")
+    out = TreeDepthBatchOp().link_from(TableSourceBatchOp(t)).collect()
+    d = {r[0]: (r[1], r[2]) for r in out.rows()}
+    assert d["r"] == ("r", 0) and d["g1"] == ("r", 2)
+    assert d["z"] == ("r2", 1)
+
+
+def test_vertex_neighbor_search():
+    from alink_tpu.operator.batch import VertexNeighborSearchBatchOp
+
+    edges = [("a", "b"), ("b", "c"), ("c", "d")]
+    t = MTable.from_rows(edges, "source string, target string")
+    out = VertexNeighborSearchBatchOp(
+        sources=["a"], depth=2).link_from(TableSourceBatchOp(t)).collect()
+    got = {(r[0], r[1]) for r in out.rows()}
+    # within 2 hops of a: vertices {a,b,c}; induced edges a-b, b-c
+    assert got == {("a", "b"), ("b", "c")}

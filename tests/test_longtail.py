@@ -272,3 +272,70 @@ def test_model_info_generic_and_named():
     assert any(k.startswith("array.") for k in keys)
     # named variants share the inspector
     assert issubclass(GbdtModelInfoBatchOp, ModelInfoBatchOp)
+
+
+def test_mtable_nesting_roundtrip(tmp_path):
+    from alink_tpu.operator.batch import (
+        AppendIdBatchOp,
+        FlattenMTableBatchOp,
+        GroupDataToMTableBatchOp,
+        TextSinkBatchOp,
+    )
+
+    t = MTable.from_rows(
+        [("a", 1, 1.0), ("a", 2, 2.0), ("b", 3, 3.0)],
+        "g string, i long, x double")
+    src = TableSourceBatchOp(t)
+    nested = GroupDataToMTableBatchOp(
+        groupCols=["g"], outputCol="mt").link_from(src).collect()
+    assert nested.num_rows == 2
+    assert nested.col("mt")[0].num_rows == 2
+    flat = FlattenMTableBatchOp(
+        selectedCol="mt", schemaStr="i long, x double").link_from(
+        TableSourceBatchOp(nested)).collect()
+    assert flat.num_rows == 3
+    assert sorted(flat.col("i")) == [1, 2, 3]
+
+    withid = AppendIdBatchOp().link_from(src).collect()
+    assert list(withid.col("append_id")) == [0, 1, 2]
+
+    p = str(tmp_path / "out.txt")
+    TextSinkBatchOp(filePath=p).link_from(
+        TableSourceBatchOp(t.select(["g"]))).collect()
+    assert open(p).read().splitlines() == ["a", "a", "b"]
+
+
+def test_append_model_stream_sink(tmp_path):
+    from alink_tpu.common.model import model_to_table
+    from alink_tpu.operator.batch import AppendModelStreamFileSinkBatchOp
+    from alink_tpu.operator.stream import scan_model_dir
+
+    model = model_to_table({"modelName": "M"},
+                           {"w": np.ones(2, np.float32)})
+    d = str(tmp_path / "ms")
+    AppendModelStreamFileSinkBatchOp(filePath=d).link_from(
+        TableSourceBatchOp(model)).collect()
+    assert len(scan_model_dir(d)) == 1
+
+
+def test_grouped_outlier_new_variants():
+    from alink_tpu.operator.batch import (
+        CopodOutlier4GroupedDataBatchOp,
+        LofOutlier4GroupedDataBatchOp,
+    )
+
+    rng = np.random.default_rng(0)
+    rows = []
+    for g in ("a", "b"):
+        base = rng.standard_normal((40, 2))
+        base[0] = [8.0, 8.0]  # one obvious outlier per group
+        for r in base:
+            rows.append((g, float(r[0]), float(r[1])))
+    t = MTable.from_rows(rows, "g string, x double, y double")
+    for op_cls in (CopodOutlier4GroupedDataBatchOp,
+                   LofOutlier4GroupedDataBatchOp):
+        out = op_cls(groupCols=["g"], featureCols=["x", "y"],
+                     predictionCol="flag").link_from(
+            TableSourceBatchOp(t)).collect()
+        flags = np.asarray(out.col("flag"))
+        assert flags[0] and flags[40]  # BOTH groups' planted outliers
