@@ -287,6 +287,7 @@ from .timeseries import (
     DeepARBatchOp,
     LSTNetBatchOp,
     ProphetBatchOp,
+    TFTBatchOp,
     DifferenceBatchOp,
     EvalTimeSeriesBatchOp,
     GarchBatchOp,
@@ -385,6 +386,9 @@ from .connectors import (
     LookupKvBatchOp,
 )
 from .recommendation import (
+    DeepFmItemsPerUserRecommBatchOp,
+    DeepFmRateRecommBatchOp,
+    DeepFmRecommTrainBatchOp,
     FmItemsPerUserRecommBatchOp,
     FmRateRecommBatchOp,
     FmRecommTrainBatchOp,

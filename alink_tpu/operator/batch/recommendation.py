@@ -695,3 +695,203 @@ class LeaveTopKObjectOutBatchOp(LeaveKObjectOutBatchOp):
                 "LeaveTopKObjectOut needs rateCol")
         order = idx[np.argsort(-rates[idx], kind="stable")]
         return order[:k]
+
+
+# ---------------------------------------------------------------------------
+# DeepFM recommender (reference: the easyrec model family in akdl —
+# core/src/main/python/akdl/akdl/models/tf/easyrec/; DeepFM = FM scoring +
+# an MLP over the concatenated user/item embeddings)
+# ---------------------------------------------------------------------------
+
+class DeepFmRecommTrainBatchOp(ModelTrainOpMixin, BatchOperator,
+                               HasRecommTripleCols):
+    """DeepFM on (user, item, rate) triples: score = w0 + bu + bi +
+    <Vu, Vi> + MLP([Vu, Vi]). The nonlinear head means serving has its own
+    mapper (unlike the pure-FM op, whose biases fold into ALS kernels)."""
+
+    RANK = ParamInfo("rank", int, default=8, validator=MinValidator(1))
+    HIDDEN = ParamInfo("hiddenSize", int, default=32)
+    NUM_EPOCHS = ParamInfo("numEpochs", int, default=60, aliases=("numIter",))
+    LEARN_RATE = ParamInfo("learnRate", float, default=0.02)
+    LAMBDA = ParamInfo("lambda", float, default=0.01, aliases=("lambda_",))
+    RANDOM_SEED = ParamInfo("randomSeed", int, default=0, aliases=("seed",))
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    def _static_meta_keys(self, in_schema):
+        return {
+            "modelName": "DeepFmRecommModel",
+            "userCol": self.get(self.USER_COL),
+            "itemCol": self.get(self.ITEM_COL),
+        }
+
+    def _execute_impl(self, t: MTable) -> MTable:
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        user_col = self.get(self.USER_COL)
+        item_col = self.get(self.ITEM_COL)
+        rate_col = self.get(self.RATE_COL)
+        users = np.asarray(t.col(user_col)).astype(str)
+        items = np.asarray(t.col(item_col)).astype(str)
+        rates = (np.asarray(t.col(rate_col), np.float32) if rate_col
+                 else np.ones(t.num_rows, np.float32))
+        user_ids, u_idx = np.unique(users, return_inverse=True)
+        item_ids, i_idx = np.unique(items, return_inverse=True)
+        nu, ni = len(user_ids), len(item_ids)
+        rank = self.get(self.RANK)
+        hidden = self.get(self.HIDDEN)
+        lam = float(self.get(self.LAMBDA))
+
+        rng = np.random.default_rng(self.get(self.RANDOM_SEED))
+        params = {
+            "w0": jnp.asarray(float(rates.mean())),
+            "bu": jnp.zeros(nu, jnp.float32),
+            "bi": jnp.zeros(ni, jnp.float32),
+            "U": jnp.asarray(rng.normal(0, 0.05, (nu, rank)), jnp.float32),
+            "V": jnp.asarray(rng.normal(0, 0.05, (ni, rank)), jnp.float32),
+            "W1": jnp.asarray(rng.normal(0, 0.1, (2 * rank, hidden)),
+                              jnp.float32),
+            "b1": jnp.zeros(hidden, jnp.float32),
+            "W2": jnp.asarray(rng.normal(0, 0.1, (hidden, 1)), jnp.float32),
+            "b2": jnp.zeros(1, jnp.float32),
+        }
+        u_j = jnp.asarray(u_idx, jnp.int32)
+        i_j = jnp.asarray(i_idx, jnp.int32)
+        r_j = jnp.asarray(rates)
+        tx = optax.adam(float(self.get(self.LEARN_RATE)))
+        epochs = int(self.get(self.NUM_EPOCHS))
+
+        def score_fn(p, uu, ii):
+            eu, ei = p["U"][uu], p["V"][ii]
+            fm = p["w0"] + p["bu"][uu] + p["bi"][ii] + (eu * ei).sum(-1)
+            h = jnp.tanh(jnp.concatenate([eu, ei], -1) @ p["W1"] + p["b1"])
+            return fm + (h @ p["W2"])[:, 0] + p["b2"][0]
+
+        def loss(p):
+            reg = sum(jnp.sum(x * x) for x in
+                      (p["bu"], p["bi"], p["U"], p["V"]))
+            return (jnp.mean((score_fn(p, u_j, i_j) - r_j) ** 2)
+                    + lam * reg / len(rates))
+
+        @jax.jit
+        def fit(params):
+            state = tx.init(params)
+
+            def body(_, carry):
+                p, st = carry
+                g = jax.grad(loss)(p)
+                up, st = tx.update(g, st)
+                return optax.apply_updates(p, up), st
+
+            p, _ = jax.lax.fori_loop(0, epochs, body, (params, state))
+            return p
+
+        p = jax.device_get(fit(params))
+        meta = {
+            "modelName": "DeepFmRecommModel",
+            "userCol": user_col, "itemCol": item_col, "rateCol": rate_col,
+            "rank": rank, "hiddenSize": hidden,
+        }
+        arrays = {"userIds": user_ids.astype(object),
+                  "itemIds": item_ids.astype(object)}
+        arrays.update({k: np.asarray(v) for k, v in p.items()})
+        return model_to_table(meta, arrays)
+
+
+class DeepFmRecommMapper(ModelMapper, HasPredictionCol, HasReservedCols):
+    """DeepFM serving: one jitted score over (user, item) index pairs."""
+
+    USER_COL = ParamInfo("userCol", str)
+    ITEM_COL = ParamInfo("itemCol", str)
+    K = ParamInfo("k", int, default=10)
+
+    def load_model(self, model: MTable):
+        import jax
+        import jax.numpy as jnp
+
+        self.meta, arrays = table_to_model(model)
+        self.user_ids = arrays["userIds"]
+        self.item_ids = arrays["itemIds"]
+        self.u_index = {v: i for i, v in enumerate(self.user_ids.tolist())}
+        self.i_index = {v: i for i, v in enumerate(self.item_ids.tolist())}
+        p = {k: jnp.asarray(arrays[k]) for k in
+             ("w0", "bu", "bi", "U", "V", "W1", "b1", "W2", "b2")}
+
+        def score(uu, ii):
+            eu, ei = p["U"][uu], p["V"][ii]
+            fm = p["w0"] + p["bu"][uu] + p["bi"][ii] + (eu * ei).sum(-1)
+            h = jnp.tanh(jnp.concatenate([eu, ei], -1) @ p["W1"] + p["b1"])
+            return fm + (h @ p["W2"])[:, 0] + p["b2"][0]
+
+        self._score_jit = jax.jit(score)
+        # all-items scoring for one user (top-K serving)
+        self._score_all_jit = jax.jit(
+            lambda uu: score(
+                jnp.full(len(self.item_ids), uu, jnp.int32),
+                jnp.arange(len(self.item_ids), dtype=jnp.int32)))
+        return self
+
+    def _out_col(self):
+        return self.get(HasPredictionCol.PREDICTION_COL) or "recomm"
+
+    def output_schema(self, input_schema):
+        return self._append_result_schema(
+            input_schema, [self._out_col()], [AlinkTypes.DOUBLE])
+
+    def _user_col(self) -> str:
+        return self.get(self.USER_COL) or self.meta["userCol"]
+
+    def _item_col(self) -> str:
+        return self.get(self.ITEM_COL) or self.meta["itemCol"]
+
+    def map_table(self, t: MTable) -> MTable:
+        u = np.asarray([self.u_index.get(str(v), -1)
+                        for v in t.col(self._user_col())], np.int64)
+        i = np.asarray([self.i_index.get(str(v), -1)
+                        for v in t.col(self._item_col())], np.int64)
+        ok = (u >= 0) & (i >= 0)
+        scores = np.full(t.num_rows, np.nan)
+        if ok.any():
+            s = np.asarray(self._score_jit(
+                np.maximum(u, 0).astype(np.int32),
+                np.maximum(i, 0).astype(np.int32)))
+            scores[ok] = s[ok]
+        oc = self._out_col()
+        return self._append_result(t, {oc: scores},
+                                   {oc: AlinkTypes.DOUBLE})
+
+
+class DeepFmItemsPerUserRecommMapper(DeepFmRecommMapper):
+    def output_schema(self, input_schema):
+        return self._append_result_schema(
+            input_schema, [self._out_col()], [AlinkTypes.STRING])
+
+    def map_table(self, t: MTable) -> MTable:
+        k = int(self.get(self.K))
+        rows = []
+        for v in t.col(self._user_col()):
+            ui = self.u_index.get(str(v), -1)
+            if ui < 0:
+                rows.append(_recomm_json(np.empty(0), np.empty(0), False))
+                continue
+            s = np.asarray(self._score_all_jit(np.int32(ui)))
+            top = np.argsort(-s)[:k]
+            rows.append(_recomm_json(self.item_ids[top], s[top], True))
+        oc = self._out_col()
+        return self._append_result(t, {oc: np.asarray(rows, object)},
+                                   {oc: AlinkTypes.STRING})
+
+
+class DeepFmRateRecommBatchOp(_RecommOpBase):
+    """(reference: easyrec deepfm serving — rate a (user, item) pair)"""
+
+    mapper_cls = DeepFmRecommMapper
+
+
+class DeepFmItemsPerUserRecommBatchOp(_RecommOpBase):
+    """(reference: easyrec deepfm serving — top-K items per user)"""
+
+    mapper_cls = DeepFmItemsPerUserRecommMapper

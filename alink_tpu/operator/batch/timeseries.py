@@ -749,3 +749,91 @@ class ProphetBatchOp(_BaseForecastOp):
                                          freq=self.get(self.FREQ))
         fc = m.predict(future)["yhat"].to_numpy()
         return np.asarray(fc[-horizon:], np.float64)
+
+
+class TFTBatchOp(_BaseForecastOp):
+    """Attention-based forecaster in the Temporal-Fusion-Transformer family
+    (reference: akdl tft model — core/src/main/python/akdl/akdl/models/tf/
+    tft/; this is the single-series core of that design: LSTM encoding +
+    multi-head self-attention over the lookback + gated residual head,
+    without the multi-covariate variable-selection networks the reference
+    wires for exogenous inputs)."""
+
+    LOOKBACK = ParamInfo("lookback", int, default=24,
+                         validator=MinValidator(4))
+    HIDDEN = ParamInfo("hiddenSize", int, default=32)
+    NUM_HEADS = ParamInfo("numHeads", int, default=4)
+    NUM_EPOCHS = ParamInfo("numEpochs", int, default=60)
+    BATCH_SIZE = ParamInfo("batchSize", int, default=64)
+    LEARNING_RATE = ParamInfo("learningRate", float, default=5e-3)
+    RANDOM_SEED = ParamInfo("randomSeed", int, default=0, aliases=("seed",))
+
+    def _forecast(self, y: np.ndarray, horizon: int) -> np.ndarray:
+        import flax.linen as nn
+        import jax
+        import jax.numpy as jnp
+
+        from ...dl.train import TrainConfig, train_model
+
+        if len(y) < 12:
+            raise AkIllegalArgumentException(
+                f"TFT needs at least 12 observations, got {len(y)}")
+        L = min(self.get(self.LOOKBACK), max(len(y) - 1, 4))
+        mu_y, sd_y = float(np.mean(y)), float(np.std(y) + 1e-9)
+        z32 = ((np.asarray(y, np.float64) - mu_y) / sd_y).astype(np.float32)
+        X = np.stack([z32[s:s + L] for s in range(len(z32) - L)])[..., None]
+        t = z32[L:]
+
+        hidden = self.get(self.HIDDEN)
+        heads = max(1, min(self.get(self.NUM_HEADS), hidden))
+        while hidden % heads:  # flax SelfAttention needs heads | qkv dims
+            heads -= 1
+
+        class GRN(nn.Module):
+            """Gated residual network — the TFT building block."""
+
+            units: int
+
+            @nn.compact
+            def __call__(self, x):
+                h = nn.elu(nn.Dense(self.units)(x))
+                h = nn.Dense(self.units)(h)
+                gate = nn.sigmoid(nn.Dense(self.units)(x))
+                skip = (x if x.shape[-1] == self.units
+                        else nn.Dense(self.units)(x))
+                return nn.LayerNorm()(skip + gate * h)
+
+        class Net(nn.Module):
+            @nn.compact
+            def __call__(self, x, deterministic=True):  # (b, L, 1)
+                h = nn.Dense(hidden)(x)
+                h = nn.RNN(nn.OptimizedLSTMCell(hidden))(h)  # (b, L, h)
+                attn = nn.SelfAttention(
+                    num_heads=heads, qkv_features=hidden,
+                    deterministic=True)(h)
+                h = nn.LayerNorm()(h + attn)       # post-attention residual
+                h = GRN(hidden)(h)[:, -1, :]       # gated head on last step
+                return nn.Dense(1)(h)              # (b, 1) — mse squeezes
+
+        cfg = TrainConfig(num_epochs=self.get(self.NUM_EPOCHS),
+                          batch_size=self.get(self.BATCH_SIZE),
+                          learning_rate=self.get(self.LEARNING_RATE),
+                          loss="mse", seed=self.get(self.RANDOM_SEED))
+        net = Net()
+        params, _ = train_model(net, {"x": X}, t, cfg, regression=True,
+                                seq_axis=None)
+
+        @jax.jit
+        def predict(params, window):
+            return net.apply(params, window[None],
+                             deterministic=True)[0, 0]
+
+        window = z32[-L:].copy()
+        preds = []
+        for _ in range(horizon):
+            nxt = float(jax.device_get(predict(
+                params, jnp.asarray(window[..., None]))))
+            preds.append(nxt)
+            window = np.roll(window, -1)
+            window[-1] = nxt
+        return np.asarray(preds, np.float64) * sd_y + mu_y

@@ -339,3 +339,53 @@ def test_grouped_outlier_new_variants():
             TableSourceBatchOp(t)).collect()
         flags = np.asarray(out.col("flag"))
         assert flags[0] and flags[40]  # BOTH groups' planted outliers
+
+
+def test_deepfm_recommender():
+    from alink_tpu.operator.batch import (
+        DeepFmItemsPerUserRecommBatchOp,
+        DeepFmRateRecommBatchOp,
+        DeepFmRecommTrainBatchOp,
+    )
+
+    rng = np.random.default_rng(0)
+    rows = []
+    for u in range(16):
+        for i in range(16):
+            same = (u < 8) == (i < 8)
+            r = (4.0 if same else 1.0) + 0.2 * rng.standard_normal()
+            if rng.random() < 0.8:
+                rows.append((f"u{u}", f"i{i}", float(r)))
+    t = MTable.from_rows(rows, "user string, item string, rate double")
+    model = DeepFmRecommTrainBatchOp(
+        userCol="user", itemCol="item", rateCol="rate", rank=4,
+        numEpochs=400).link_from(TableSourceBatchOp(t))
+    test = MTable.from_rows([("u1", "i2"), ("u1", "i12"), ("zz", "i1")],
+                            "user string, item string")
+    out = DeepFmRateRecommBatchOp(predictionCol="score").link_from(
+        model, TableSourceBatchOp(test)).collect()
+    s = np.asarray(out.col("score"), float)
+    assert s[0] > s[1] + 1.0      # same-block scores higher
+    assert np.isnan(s[2])         # unknown user -> NaN
+    topk = DeepFmItemsPerUserRecommBatchOp(
+        k=4, predictionCol="rec").link_from(
+        model, TableSourceBatchOp(test)).collect()
+    recs = json.loads(topk.col("rec")[0])
+    assert all(int(o[1:]) < 8 for o in recs["object"][:2])
+
+
+def test_tft_forecaster_learns_seasonality():
+    from alink_tpu.operator.batch import TFTBatchOp
+
+    rng = np.random.default_rng(3)
+    n, period, horizon = 144, 6, 6
+    tg = np.arange(n + horizon)
+    series = 5 + 2 * np.sin(2 * np.pi * tg / period) \
+        + 0.05 * rng.standard_normal(n + horizon)
+    t = MTable({"y": series[:n]})
+    fc = TFTBatchOp(valueCol="y", predictNum=horizon, lookback=18,
+                    numEpochs=80, seed=0).link_from(
+        TableSourceBatchOp(t)).collect()
+    pred = np.asarray(fc.col("forecast")[0].data)
+    mae = np.abs(pred - series[n:]).mean()
+    assert mae < 0.8, mae  # tracks the oscillation, not the mean
