@@ -145,11 +145,12 @@ class MLEnvironment:
     # -- device mesh -------------------------------------------------------
     @property
     def mesh(self):
-        if self._mesh is None:
-            from ..parallel.mesh import default_mesh
+        with self._lock:  # lazy init must be single-shot across threads
+            if self._mesh is None:
+                from ..parallel.mesh import default_mesh
 
-            self._mesh = default_mesh()
-        return self._mesh
+                self._mesh = default_mesh()
+            return self._mesh
 
     def set_mesh(self, mesh):
         self._mesh = mesh

@@ -234,15 +234,22 @@ class GroupScorecardTrainBatchOp(BatchOperator, HasSelectedCols):
         group_col = self.get(self.GROUP_COL)
         groups = np.asarray(t.col(group_col), object).astype(str)
         sub_params = self.get_params().clone()
-        parts = []
-        for g in np.unique(groups):
+
+        def one(g):
             sub = t.filter_mask(groups == g).drop([group_col])
             inner = ScorecardTrainBatchOp(sub_params.clone())
             model = inner._execute_impl(sub)
-            parts.append(model.with_column(
+            return model.with_column(
                 "group_value", np.asarray([g] * model.num_rows, object),
-                AlinkTypes.STRING))
-        return MTable.concat(parts)
+                AlinkTypes.STRING)
+
+        from ..local import parallel_apply
+
+        # one scorecard fit per group on the session pool (touch the mesh
+        # first so its lazy init happens before threads fan out)
+        _ = self.env.mesh
+        return MTable.concat(parallel_apply(one, list(np.unique(groups)),
+                                            env=self.env))
 
     def _out_schema(self, in_schema):
         from ...common.model import MODEL_SCHEMA

@@ -269,10 +269,18 @@ class _Grouped4DataMixin:
         n = t.num_rows
         scores = np.zeros(n)
         flags = np.zeros(n, bool)
-        for rows in index.values():
+
+        def one(rows):
             rows = np.asarray(rows)
-            sub = t.take(rows)
-            s, f = self._score(self._matrix(sub))
+            s, f = self._score(self._matrix(t.take(rows)))
+            return rows, s, f
+
+        from ..local import parallel_apply
+
+        # per-group task parallelism on the session pool (the
+        # AlinkLocalSession work-splitting role; SURVEY §2.2 pattern #4)
+        for rows, s, f in parallel_apply(one, list(index.values()),
+                                         env=self.env, min_items=4):
             scores[rows] = s
             flags[rows] = f
         return _append_outlier(t, self, scores, flags)

@@ -72,3 +72,47 @@ def test_xgboost_gated_or_works():
         return
     model = op.collect()
     assert model.num_rows > 0
+
+
+def test_split_work_distributed_info():
+    from alink_tpu.operator.local import split_work
+
+    assert split_work(10, 3) == [(0, 4), (4, 3), (7, 3)]
+    assert split_work(2, 4) == [(0, 1), (1, 1), (2, 0), (2, 0)]
+    assert sum(n for _, n in split_work(1000, 7)) == 1000
+
+
+def test_parallel_apply_order_and_errors():
+    from alink_tpu.operator.local import parallel_apply
+
+    out = parallel_apply(lambda x: x * x, list(range(20)))
+    assert out == [x * x for x in range(20)]
+    with pytest.raises(ValueError, match="boom"):
+        def bad(x):
+            if x == 3:
+                raise ValueError("boom")
+            return x
+        parallel_apply(bad, list(range(6)))
+
+
+def test_grouped_outlier_uses_pool():
+    # many groups route through parallel_apply; results identical to serial
+    import numpy as np
+
+    from alink_tpu.common.mtable import MTable
+    from alink_tpu.operator.batch import KSigmaOutlier4GroupedDataBatchOp
+    from alink_tpu.operator.batch.base import TableSourceBatchOp
+
+    rng = np.random.default_rng(0)
+    rows = []
+    for g in range(12):
+        vals = rng.standard_normal(30)
+        vals[0] = 30.0
+        for v in vals:
+            rows.append((f"g{g}", float(v)))
+    t = MTable.from_rows(rows, "g string, x double")
+    out = KSigmaOutlier4GroupedDataBatchOp(
+        groupCols=["g"], selectedCol="x",
+        predictionCol="flag").link_from(TableSourceBatchOp(t)).collect()
+    flags = np.asarray(out.col("flag")).reshape(12, 30)
+    assert flags[:, 0].all() and not flags[:, 1:].any()
