@@ -145,7 +145,12 @@ class MLEnvironment:
     # -- device mesh -------------------------------------------------------
     @property
     def mesh(self):
-        with self._lock:  # lazy init must be single-shot across threads
+        # double-checked: lock-free once initialized (every op execution
+        # reads this, including pool workers), single-shot lazy init
+        m = self._mesh
+        if m is not None:
+            return m
+        with self._lock:
             if self._mesh is None:
                 from ..parallel.mesh import default_mesh
 
@@ -153,7 +158,8 @@ class MLEnvironment:
             return self._mesh
 
     def set_mesh(self, mesh):
-        self._mesh = mesh
+        with self._lock:  # must not race the lazy init in `mesh`
+            self._mesh = mesh
         return self
 
     def close(self):

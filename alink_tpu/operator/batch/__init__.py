@@ -255,21 +255,38 @@ from .dl import (
     KerasSequentialRegressorTrainBatchOp,
 )
 from .tree import (
+    C45EncoderTrainBatchOp,
+    C45PredictBatchOp,
     C45TrainBatchOp,
+    CartEncoderTrainBatchOp,
+    CartPredictBatchOp,
+    CartRegEncoderTrainBatchOp,
+    CartRegPredictBatchOp,
+    CartRegTrainBatchOp,
     CartTrainBatchOp,
+    DecisionTreeEncoderTrainBatchOp,
     DecisionTreePredictBatchOp,
+    DecisionTreeRegEncoderTrainBatchOp,
     DecisionTreeRegPredictBatchOp,
     DecisionTreeRegTrainBatchOp,
     DecisionTreeTrainBatchOp,
+    GbdtEncoderPredictBatchOp,
+    GbdtEncoderTrainBatchOp,
     GbdtPredictBatchOp,
+    GbdtRegEncoderTrainBatchOp,
     GbdtRegPredictBatchOp,
     GbdtRegTrainBatchOp,
     GbdtTrainBatchOp,
+    Id3EncoderTrainBatchOp,
+    Id3PredictBatchOp,
     Id3TrainBatchOp,
+    RandomForestEncoderTrainBatchOp,
     RandomForestPredictBatchOp,
+    RandomForestRegEncoderTrainBatchOp,
     RandomForestRegPredictBatchOp,
     RandomForestRegTrainBatchOp,
     RandomForestTrainBatchOp,
+    TreeModelEncoderBatchOp,
 )
 from .statistics import (
     ChiSquareTestBatchOp,

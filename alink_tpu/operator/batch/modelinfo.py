@@ -67,6 +67,13 @@ _NAMES = [
     "OneHot", "Pca", "QuantileDiscretizer", "StandardScaler",
     "MinMaxScaler", "MaxAbsScaler", "Imputer", "StringIndexer",
     "Word2Vec", "Scorecard",
+    # tree-family variants (reference: C45ModelInfoBatchOp.java etc.)
+    "C45", "Cart", "CartReg", "Id3", "DecisionTreeReg", "RandomForestReg",
+    # long-tail per-model inspectors (reference: same-named .java files)
+    "AftSurvivalReg", "ChisqSelector", "EqualWidthDiscretizer", "MultiHot",
+    "NaiveBayesText", "VectorImputer", "VectorMaxAbsScaler",
+    "VectorMinMaxScaler", "VectorStandardScaler", "ExclusiveFeatureBundle",
+    "MultiStringIndexer", "TargetEncoder",
 ]
 
 __all__ = ["ModelInfoBatchOp"]
@@ -80,3 +87,18 @@ for _name in _NAMES:
                    "model inspector over the uniform model-table format)",
     })
     __all__.append(_cls_name)
+
+
+class ExtractModelInfoBatchOp(ModelInfoBatchOp):
+    """Base of the per-model inspector family — extract a structured summary
+    from any linked model table (reference: operator/batch/utils/
+    ExtractModelInfoBatchOp.java, the shared base of every *ModelInfoBatchOp)."""
+
+
+class WithModelInfoBatchOp(ModelInfoBatchOp):
+    """Mixin-style entry: gives any trainer a ``lazyPrintModelInfo``-style
+    inspector over its model output (reference: operator/batch/utils/
+    WithModelInfoBatchOp.java)."""
+
+
+__all__ += ["ExtractModelInfoBatchOp", "WithModelInfoBatchOp"]

@@ -1,5 +1,6 @@
-"""Tree ensemble operators: GBDT, RandomForest, DecisionTree (+Cart/C45/Id3
-aliases).
+"""Tree ensemble operators: GBDT, RandomForest, DecisionTree, and the
+impurity-criterion single trees (Cart=gini, C45=infoGainRatio, Id3=infoGain)
+plus the tree-model encoder family.
 
 Capability parity (reference: operator/batch/classification/
 GbdtTrainBatchOp.java, RandomForestTrainBatchOp.java,
@@ -18,7 +19,7 @@ import numpy as np
 from ...common.exceptions import AkIllegalDataException
 from ...common.model import model_to_table, table_to_model
 from ...common.mtable import AlinkTypes, MTable
-from ...common.params import MinValidator, ParamInfo
+from ...common.params import InValidator, MinValidator, ParamInfo
 from ...mapper import (
     HasFeatureCols,
     HasPredictionCol,
@@ -71,7 +72,9 @@ class _BaseTreeTrainBatchOp(ModelTrainOpMixin, BatchOperator, HasTreeTrainParams
 
     LEARNING_RATE = ParamInfo("learningRate", float, default=0.1)
 
-    def _execute_impl(self, t: MTable) -> MTable:
+    def _prep_data(self, t: MTable):
+        """Shared label-encoding + feature-block extraction for every tree
+        trainer (gbdt / forest / impurity variants)."""
         label_col = self.get(self.LABEL_COL)
         vec_col = self.get(HasVectorCol.VECTOR_COL)
         feature_cols = (
@@ -91,7 +94,29 @@ class _BaseTreeTrainBatchOp(ModelTrainOpMixin, BatchOperator, HasTreeTrainParams
             if K < 2:
                 raise AkIllegalDataException("need >= 2 label values")
             task = "binary" if K == 2 else "multiclass"
+        return X, y, labels, K, task, feature_cols, vec_col, label_col
 
+    def _model_meta(self, t, ens, task, labels, feature_cols, vec_col,
+                    label_col, num_trees, dim, **extra):
+        meta = {
+            "modelName": "TreeEnsembleModel",
+            "algo": self._algo,
+            "task": task,
+            "depth": int(ens.depth),
+            "vectorCol": vec_col,
+            "featureCols": feature_cols,
+            "labelCol": label_col,
+            "labelType": t.schema.type_of(label_col),
+            "labels": labels,
+            "dim": dim,
+            "numTrees": int(num_trees),
+        }
+        meta.update(extra)
+        return meta
+
+    def _execute_impl(self, t: MTable) -> MTable:
+        (X, y, labels, K, task, feature_cols, vec_col,
+         label_col) = self._prep_data(t)
         num_trees = self._force_num_trees or self.get(self.NUM_TREES)
         common = dict(
             task=task,
@@ -128,19 +153,8 @@ class _BaseTreeTrainBatchOp(ModelTrainOpMixin, BatchOperator, HasTreeTrainParams
                 **common,
             )
 
-        meta = {
-            "modelName": "TreeEnsembleModel",
-            "algo": self._algo,
-            "task": task,
-            "depth": int(ens.depth),
-            "vectorCol": vec_col,
-            "featureCols": feature_cols,
-            "labelCol": label_col,
-            "labelType": t.schema.type_of(label_col),
-            "labels": labels,
-            "dim": int(X.shape[1]),
-            "numTrees": int(num_trees),
-        }
+        meta = self._model_meta(t, ens, task, labels, feature_cols, vec_col,
+                                label_col, num_trees, int(X.shape[1]))
         return model_to_table(meta, ens.to_arrays())
 
 
@@ -171,8 +185,9 @@ class RandomForestRegTrainBatchOp(_BaseTreeTrainBatchOp):
 
 
 class DecisionTreeTrainBatchOp(_BaseTreeTrainBatchOp):
-    """Single tree (reference: DecisionTreeTrainBatchOp.java; C45/Cart/Id3
-    variants share this impl — binning makes them equivalent here)."""
+    """Single tree via the variance/Newton-gain histogram trainer
+    (reference: DecisionTreeTrainBatchOp.java; the named Cart/C45/Id3
+    variants below use true impurity criteria instead)."""
 
     _algo = "forest"
     _regression = False
@@ -185,9 +200,70 @@ class DecisionTreeRegTrainBatchOp(_BaseTreeTrainBatchOp):
     _force_num_trees = 1
 
 
-CartTrainBatchOp = DecisionTreeTrainBatchOp
-C45TrainBatchOp = DecisionTreeTrainBatchOp
-Id3TrainBatchOp = DecisionTreeTrainBatchOp
+class _ImpurityTreeTrainBatchOp(_BaseTreeTrainBatchOp):
+    """Single tree with a classic impurity criterion — per-class count
+    histograms on the MXU + gini/entropy/gain-ratio split search
+    (:func:`alink_tpu.tree.train_tree_impurity`)."""
+
+    _algo = "forest"
+    _regression = False
+    _force_num_trees = 1
+    _criterion: str = "gini"
+
+    TREE_TYPE = ParamInfo(
+        "treeType", str, default=None,
+        validator=InValidator(None, "gini", "infoGain", "infoGainRatio"))
+
+    def _execute_impl(self, t: MTable) -> MTable:
+        from ...tree import train_tree_impurity
+
+        (X, y, labels, K, _task, feature_cols, vec_col,
+         label_col) = self._prep_data(t)
+        criterion = self.get(self.TREE_TYPE) or self._criterion
+        ens = train_tree_impurity(
+            X, np.asarray(y, np.int64),
+            criterion=criterion,
+            num_classes=K,
+            depth=self.get(self.MAX_DEPTH),
+            num_bins=self.get(self.MAX_BINS),
+            min_samples=float(self.get(self.MIN_SAMPLES_PER_LEAF)),
+            min_gain=self.get(self.MIN_INFO_GAIN),
+            subsample=self.get(self.SUBSAMPLING_RATIO),
+            feature_fraction=self.get(self.FEATURE_SUBSAMPLING_RATIO),
+            seed=self.get(self.RANDOM_SEED),
+            mesh=self.env.mesh,
+        )
+        meta = self._model_meta(t, ens, ens.task, labels, feature_cols,
+                                vec_col, label_col, 1, int(X.shape[1]),
+                                criterion=criterion)
+        return model_to_table(meta, ens.to_arrays())
+
+
+class CartTrainBatchOp(_ImpurityTreeTrainBatchOp):
+    """CART: Gini-impurity splits (reference: operator/batch/classification/
+    CartTrainBatchOp.java)."""
+
+    _criterion = "gini"
+
+
+class C45TrainBatchOp(_ImpurityTreeTrainBatchOp):
+    """C4.5: information-gain-ratio splits (reference: operator/batch/
+    classification/C45TrainBatchOp.java)."""
+
+    _criterion = "infoGainRatio"
+
+
+class Id3TrainBatchOp(_ImpurityTreeTrainBatchOp):
+    """ID3: information-gain splits (reference: operator/batch/
+    classification/Id3TrainBatchOp.java)."""
+
+    _criterion = "infoGain"
+
+
+class CartRegTrainBatchOp(DecisionTreeRegTrainBatchOp):
+    """CART regression tree: variance-reduction splits — the shared
+    histogram trainer's single-tree regression path IS the CART criterion
+    (reference: operator/batch/regression/CartRegTrainBatchOp.java)."""
 
 
 class TreeModelMapper(RichModelMapper):
@@ -325,3 +401,75 @@ class GbdtEncoderBatchOp(ModelMapBatchOp, HasReservedCols):
 
     mapper_cls = GbdtEncoderMapper
     ENCODE_OUTPUT_COL = GbdtEncoderMapper.ENCODE_OUTPUT_COL
+
+
+class C45PredictBatchOp(_TreePredictBatchOp):
+    """(reference: operator/batch/classification/C45PredictBatchOp.java)"""
+
+
+class CartPredictBatchOp(_TreePredictBatchOp):
+    """(reference: operator/batch/classification/CartPredictBatchOp.java)"""
+
+
+class CartRegPredictBatchOp(_TreePredictBatchOp):
+    """(reference: operator/batch/regression/CartRegPredictBatchOp.java)"""
+
+
+class Id3PredictBatchOp(_TreePredictBatchOp):
+    """(reference: operator/batch/classification/Id3PredictBatchOp.java)"""
+
+
+class TreeModelEncoderBatchOp(GbdtEncoderBatchOp):
+    """Generic tree-model → leaf-index-one-hot encoder: works on ANY model
+    produced by the tree family (GBDT / forest / single trees)
+    (reference: operator/batch/feature/TreeModelEncoderBatchOp.java)."""
+
+
+class GbdtEncoderPredictBatchOp(TreeModelEncoderBatchOp):
+    """(reference: operator/batch/feature/GbdtEncoderPredictBatchOp.java)"""
+
+
+# Encoder trainers: train the underlying tree model whose leaves become
+# categorical features — each is the corresponding trainer with encoder
+# defaults (reference: operator/batch/feature/GbdtEncoderTrainBatchOp.java
+# and siblings; the model feeds TreeModelEncoderBatchOp).
+class GbdtEncoderTrainBatchOp(GbdtTrainBatchOp):
+    """(reference: operator/batch/feature/GbdtEncoderTrainBatchOp.java)"""
+
+
+class GbdtRegEncoderTrainBatchOp(GbdtRegTrainBatchOp):
+    """(reference: operator/batch/feature/GbdtRegEncoderTrainBatchOp.java)"""
+
+
+class RandomForestEncoderTrainBatchOp(RandomForestTrainBatchOp):
+    """(reference: operator/batch/feature/RandomForestEncoderTrainBatchOp.java)"""
+
+
+class RandomForestRegEncoderTrainBatchOp(RandomForestRegTrainBatchOp):
+    """(reference: operator/batch/feature/
+    RandomForestRegEncoderTrainBatchOp.java)"""
+
+
+class DecisionTreeEncoderTrainBatchOp(DecisionTreeTrainBatchOp):
+    """(reference: operator/batch/feature/DecisionTreeEncoderTrainBatchOp.java)"""
+
+
+class DecisionTreeRegEncoderTrainBatchOp(DecisionTreeRegTrainBatchOp):
+    """(reference: operator/batch/feature/
+    DecisionTreeRegEncoderTrainBatchOp.java)"""
+
+
+class C45EncoderTrainBatchOp(C45TrainBatchOp):
+    """(reference: operator/batch/feature/C45EncoderTrainBatchOp.java)"""
+
+
+class CartEncoderTrainBatchOp(CartTrainBatchOp):
+    """(reference: operator/batch/feature/CartEncoderTrainBatchOp.java)"""
+
+
+class CartRegEncoderTrainBatchOp(CartRegTrainBatchOp):
+    """(reference: operator/batch/feature/CartRegEncoderTrainBatchOp.java)"""
+
+
+class Id3EncoderTrainBatchOp(Id3TrainBatchOp):
+    """(reference: operator/batch/feature/Id3EncoderTrainBatchOp.java)"""

@@ -21,7 +21,7 @@ TPU-first re-design (SURVEY.md §7 flags this as the riskiest parity item):
 """
 
 from .binning import quantile_bins, apply_bins
-from .grow import TreeEnsemble, train_gbdt, train_forest
+from .grow import TreeEnsemble, train_gbdt, train_forest, train_tree_impurity
 
 __all__ = [
     "quantile_bins",
@@ -29,4 +29,5 @@ __all__ = [
     "TreeEnsemble",
     "train_gbdt",
     "train_forest",
+    "train_tree_impurity",
 ]

@@ -631,6 +631,235 @@ def train_gbdt(
 
 
 # ---------------------------------------------------------------------------
+# impurity-criterion single trees (C45 / Cart / Id3)
+# ---------------------------------------------------------------------------
+
+
+def _split_search_impurity(hk, fmask, min_samples, min_gain, criterion):
+    """Per-class count histograms (L, d, B, K) -> (feat (L,), thr (L,)).
+
+    Classic impurity split criteria over the SAME binned layout the
+    gradient kernels use (reference: the Gini / InfoGain / InfoGainRatio
+    arms of operator/common/tree/seriescalc — Cart=gini, Id3=infoGain,
+    C45=infoGainRatio):
+
+    - ``gini``: parent Gini minus weighted child Gini
+    - ``infoGain``: parent entropy minus weighted child entropy
+    - ``infoGainRatio``: infoGain / split-entropy (C4.5's normalization)
+    """
+    import jax.numpy as jnp
+
+    L, d, B, K = hk.shape
+    CLk = jnp.cumsum(hk, axis=2)                # left class counts
+    Ck = CLk[:, :, -1:, :]                      # node class totals
+    CRk = Ck - CLk
+    nL = CLk.sum(-1)                            # (L, d, B)
+    nR = CRk.sum(-1)
+    ntot = Ck.sum(-1)                           # (L, d, 1)
+
+    def impurity(counts, total):
+        p = counts / jnp.maximum(total[..., None], 1.0)
+        if criterion == "gini":
+            return 1.0 - (p * p).sum(-1)
+        return -(p * jnp.where(p > 0, jnp.log2(jnp.maximum(p, 1e-12)),
+                               0.0)).sum(-1)
+
+    imp_parent = impurity(Ck, ntot)             # (L, d, 1)
+    imp_L = impurity(CLk, nL)
+    imp_R = impurity(CRk, nR)
+    n_safe = jnp.maximum(ntot, 1.0)
+    gain = imp_parent - (nL / n_safe) * imp_L - (nR / n_safe) * imp_R
+    if criterion == "infoGainRatio":
+        pL = nL / n_safe
+        pR = nR / n_safe
+        split_info = -(
+            jnp.where(pL > 0, pL * jnp.log2(jnp.maximum(pL, 1e-12)), 0.0)
+            + jnp.where(pR > 0, pR * jnp.log2(jnp.maximum(pR, 1e-12)), 0.0))
+        gain = gain / jnp.maximum(split_info, 1e-6)
+
+    ok = (nL >= min_samples) & (nR >= min_samples)
+    ok = ok & (jnp.arange(B)[None, None, :] < B - 1)
+    gain = jnp.where(ok & (fmask[None, :, None] > 0), gain, -jnp.inf)
+    flat = gain.reshape(L, d * B)
+    best = jnp.argmax(flat, axis=1)
+    best_gain = jnp.take_along_axis(flat, best[:, None], 1)[:, 0]
+    feat = jnp.where(best_gain > min_gain, best // B, -1).astype(jnp.int32)
+    thr = jnp.where(best_gain > min_gain, best % B, B - 1).astype(jnp.int32)
+    return feat, thr
+
+
+@functools.lru_cache(maxsize=32)
+def _impurity_tree_fn(mesh_key, depth: int, num_bins: int, K: int, d: int,
+                      criterion: str, num_chunks: int):
+    """ONE compiled program growing a whole impurity-criterion tree:
+    per-class count histograms as MXU matmuls (one-hot node x one-hot class
+    against the bins one-hot), psum across the data axis, impurity split
+    search, routing — every level unrolled inside one shard_map. Like the
+    fused GBDT program, row chunks stream through the matmul under
+    ``lax.scan`` when the full one-hot would blow the HBM budget."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    mesh = _MESHES[mesh_key]
+    axis = AXIS_DATA
+    B = num_bins
+    HEAP = 2 ** depth - 1
+    LEAF = 2 ** depth
+
+    def _onehot_bins(b):
+        return (b[:, :, None] == jnp.arange(B, dtype=b.dtype)
+                ).astype(jnp.bfloat16).reshape(b.shape[0], d * B)
+
+    def body(bins, W, fmask, hp):
+        # W: (n, K) per-class row weights (one-hot label x sample weight)
+        min_samples, min_gain = hp
+        n_local = bins.shape[0]
+        Wb = W.astype(jnp.bfloat16)
+
+        def _vm(node_c, W_c, L):
+            N = (node_c[:, None]
+                 == jnp.arange(L, dtype=node_c.dtype)[None, :]
+                 ).astype(jnp.bfloat16)          # (chunk, L)
+            return (N[:, :, None] * W_c[:, None, :]
+                    ).reshape(node_c.shape[0], L * K)
+
+        if num_chunks == 1:
+            O = _onehot_bins(bins)
+
+            def class_hists(node, L):
+                return jax.lax.dot_general(
+                    _vm(node, Wb, L), O, (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)  # (L*K, d*B)
+        else:
+            chunk = n_local // num_chunks
+            bins_c = bins.reshape(num_chunks, chunk, d)
+            Wb_c = Wb.reshape(num_chunks, chunk, K)
+
+            def class_hists(node, L):
+                def step(acc, xs):
+                    nc, wc, bc = xs
+                    part = jax.lax.dot_general(
+                        _vm(nc, wc, L), _onehot_bins(bc),
+                        (((0,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32)
+                    return acc + part, None
+
+                hist0 = jnp.zeros((L * K, d * B), jnp.float32)
+                hist, _ = jax.lax.scan(
+                    step, hist0,
+                    (node.reshape(num_chunks, chunk), Wb_c, bins_c))
+                return hist
+
+        feats_acc = jnp.full((HEAP,), -1, jnp.int32)
+        thrs_acc = jnp.full((HEAP,), B - 1, jnp.int32)
+        node = jnp.zeros(n_local, jnp.int32)
+        for level in range(depth):
+            L = 2 ** level
+            hist = class_hists(node, L)
+            hk = jax.lax.psum(
+                hist.reshape(L, K, d, B).transpose(0, 2, 3, 1), axis)
+            feat, thr = _split_search_impurity(
+                hk, fmask, min_samples, min_gain, criterion)
+            hbase = 2 ** level - 1
+            feats_acc = jax.lax.dynamic_update_slice(feats_acc, feat,
+                                                     (hbase,))
+            thrs_acc = jax.lax.dynamic_update_slice(thrs_acc, thr, (hbase,))
+            node = _route(bins, node, feat, thr)
+
+        NL = (node[:, None] == jnp.arange(LEAF, dtype=node.dtype)[None, :]
+              ).astype(jnp.bfloat16)
+        counts = jax.lax.psum(
+            jax.lax.dot_general(
+                NL, Wb, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32), axis)  # (LEAF, K)
+        probs = counts / jnp.maximum(counts.sum(-1, keepdims=True), 1.0)
+        return feats_acc, thrs_acc, probs
+
+    return jax.jit(
+        jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(P(AXIS_DATA), P(AXIS_DATA), P(), P()),
+            out_specs=(P(), P(), P()),
+            check_vma=False,
+        )
+    )
+
+
+def train_tree_impurity(
+    X: np.ndarray,
+    y: np.ndarray,
+    *,
+    criterion: str,  # gini | infoGain | infoGainRatio
+    num_classes: int,
+    depth: int = 5,
+    num_bins: int = 64,
+    min_samples: float = 2.0,
+    min_gain: float = 0.0,
+    subsample: float = 1.0,
+    feature_fraction: float = 1.0,
+    seed: int = 0,
+    mesh=None,
+) -> TreeEnsemble:
+    """Single classification tree with a classic impurity criterion
+    (reference: C45TrainBatchOp.java / CartTrainBatchOp.java /
+    Id3TrainBatchOp.java — the three named tree types). Leaves hold class
+    probabilities; for K=2 they collapse to one p(positive) channel so the
+    shared forest predict contract applies unchanged."""
+    if criterion not in ("gini", "infoGain", "infoGainRatio"):
+        from ..common.exceptions import AkIllegalArgumentException
+
+        raise AkIllegalArgumentException(
+            f"criterion must be gini|infoGain|infoGainRatio, got {criterion}")
+    _check_depth(depth)
+    import jax.numpy as jnp
+
+    mesh = mesh or default_mesh()
+    dp = mesh.shape[AXIS_DATA]
+    n, d = X.shape
+    K = int(num_classes)
+    rng = np.random.default_rng(seed)
+    X32 = np.asarray(X, np.float32)
+    edges = quantile_bins(X32, num_bins)
+    bins = apply_bins(X32, edges)
+
+    per_shard = -(-n // dp)
+    num_chunks = max(1, -(-(per_shard * d * num_bins)
+                          // _HIST_ONEHOT_BUDGET_ELEMS))
+    bins_pad = _pad_rows(bins, dp * num_chunks)
+    w = np.ones(n, np.float32)
+    if subsample < 1.0:
+        w *= (rng.random(n) < subsample).astype(np.float32)
+    w_pad = _pad_rows(w, dp * num_chunks)  # padded rows get weight 0
+    fmask = np.ones(d, np.float32)
+    if feature_fraction < 1.0:
+        fmask = (rng.random(d) < feature_fraction).astype(np.float32)
+        if fmask.sum() == 0:
+            fmask[rng.integers(d)] = 1.0
+    W = (_pad_rows(np.eye(K, dtype=np.float32)[np.asarray(y, int)],
+                   dp * num_chunks) * w_pad[:, None])
+
+    fn = _impurity_tree_fn(_mesh_key(mesh), int(depth), int(num_bins), K, d,
+                           criterion, int(num_chunks))
+    hp = jnp.asarray([min_samples, min_gain], jnp.float32)
+    fh, th, probs = fn(_shard(mesh, bins_pad), _shard(mesh, W),
+                       jnp.asarray(fmask), hp)
+    fh = np.asarray(fh)
+    thrs = _bins_to_thresholds(edges, fh, np.asarray(th))
+    probs = np.asarray(probs)  # (LEAF, K)
+
+    leaf_count = 2 ** depth
+    if K == 2:
+        leaves = probs[:, 1].reshape(1, 1, leaf_count).astype(np.float32)
+        task = "binary"
+    else:
+        leaves = probs.T.reshape(1, K, leaf_count).astype(np.float32)
+        task = "multiclass"
+    return TreeEnsemble(depth, fh.reshape(1, -1), thrs.reshape(1, -1),
+                        leaves, np.zeros(leaves.shape[1], np.float32), task)
+
+
+# ---------------------------------------------------------------------------
 # RandomForest / DecisionTree
 # ---------------------------------------------------------------------------
 

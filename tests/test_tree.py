@@ -114,3 +114,107 @@ def test_tree_model_roundtrip(tmp_path):
     p2 = GbdtPredictBatchOp(predictionCol="p").link_from(
         TableSourceBatchOp(m2), src).collect()
     np.testing.assert_array_equal(p1.col("p"), p2.col("p"))
+
+
+def test_impurity_criterion_trees():
+    """C45/Cart/Id3 are REAL criterion variants (per-class count histograms
+    + gini/entropy/gain-ratio split search), not aliases."""
+    from alink_tpu.operator.batch import (
+        C45PredictBatchOp,
+        C45TrainBatchOp,
+        CartTrainBatchOp,
+        Id3TrainBatchOp,
+    )
+
+    t = _cls_table()
+    src = TableSourceBatchOp(t)
+    y = np.asarray(t.col("label"))
+    for cls, crit in ((C45TrainBatchOp, "infoGainRatio"),
+                      (CartTrainBatchOp, "gini"),
+                      (Id3TrainBatchOp, "infoGain")):
+        train = cls(labelCol="label", maxDepth=5).link_from(src)
+        from alink_tpu.common.model import table_to_model
+
+        meta, _ = table_to_model(train.collect())
+        assert meta["criterion"] == crit, (cls.__name__, meta["criterion"])
+        pred = C45PredictBatchOp(predictionCol="p").link_from(
+            train, src).collect()
+        acc = np.mean(np.asarray(pred.col("p")) == y)
+        assert acc > 0.9, (cls.__name__, acc)
+
+
+def test_impurity_tree_multiclass_detail():
+    from alink_tpu.operator.batch import CartPredictBatchOp, CartTrainBatchOp
+
+    rng = np.random.RandomState(3)
+    X = rng.rand(300, 3)
+    y = np.digitize(X[:, 0], [0.33, 0.66]).astype(np.int64)
+    t = MTable({"a": X[:, 0], "b": X[:, 1], "c": X[:, 2], "label": y})
+    src = TableSourceBatchOp(t)
+    train = CartTrainBatchOp(labelCol="label", maxDepth=4).link_from(src)
+    pred = CartPredictBatchOp(
+        predictionCol="p", predictionDetailCol="pd").link_from(
+        train, src).collect()
+    acc = np.mean(np.asarray(pred.col("p")) == y)
+    assert acc > 0.9, acc
+    import json
+
+    d = json.loads(list(pred.rows())[0][-1])
+    assert len(d) == 3
+    s = sum(float(v) for v in d.values())
+    assert abs(s - 1.0) < 1e-3
+
+
+def test_tree_model_encoder_family():
+    """Encoder trainers + generic TreeModelEncoderBatchOp -> leaf one-hots."""
+    from alink_tpu.common.linalg import SparseVector
+    from alink_tpu.operator.batch import (
+        DecisionTreeEncoderTrainBatchOp,
+        GbdtEncoderTrainBatchOp,
+        TreeModelEncoderBatchOp,
+    )
+
+    t = _cls_table(200)
+    src = TableSourceBatchOp(t)
+    for trainer in (
+        GbdtEncoderTrainBatchOp(labelCol="label", numTrees=5, maxDepth=3),
+        DecisionTreeEncoderTrainBatchOp(labelCol="label", maxDepth=3),
+    ):
+        model = trainer.link_from(src)
+        enc = TreeModelEncoderBatchOp(encodeOutputCol="leaf").link_from(
+            model, src).collect()
+        v = enc.col("leaf")[0]
+        sv = SparseVector.parse(v) if isinstance(v, str) else v
+        assert sv.size() > 0
+
+
+def test_impurity_tree_params_and_chunking(monkeypatch):
+    """treeType override, subsample/featureSubsample accepted, and the
+    chunked-histogram path produces the same tree as the unchunked one."""
+    import alink_tpu.tree.grow as grow
+    from alink_tpu.operator.batch import CartPredictBatchOp, CartTrainBatchOp
+    from alink_tpu.tree import train_tree_impurity
+
+    t = _cls_table(256)
+    src = TableSourceBatchOp(t)
+    y = np.asarray(t.col("label"))
+    train = CartTrainBatchOp(
+        labelCol="label", maxDepth=4, treeType="infoGain",
+        subsamplingRatio=0.9, featureSubsamplingRatio=0.9, randomSeed=7,
+    ).link_from(src)
+    pred = CartPredictBatchOp(predictionCol="p").link_from(train, src).collect()
+    acc = np.mean(np.asarray(pred.col("p")) == y)
+    assert acc > 0.85, acc
+
+    rng = np.random.RandomState(5)
+    X = rng.rand(64, 3).astype(np.float32)
+    yy = (X[:, 0] > 0.5).astype(np.int64)
+    full = train_tree_impurity(X, yy, criterion="gini", num_classes=2,
+                               depth=3, num_bins=8)
+    monkeypatch.setattr(grow, "_HIST_ONEHOT_BUDGET_ELEMS", 16)
+    grow._impurity_tree_fn.cache_clear()
+    chunked = train_tree_impurity(X, yy, criterion="gini", num_classes=2,
+                                  depth=3, num_bins=8)
+    grow._impurity_tree_fn.cache_clear()
+    np.testing.assert_array_equal(full.feats, chunked.feats)
+    np.testing.assert_allclose(full.leaves, chunked.leaves, atol=1e-5)
