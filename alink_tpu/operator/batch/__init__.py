@@ -466,3 +466,73 @@ from .huge import (
     Word2VecPredictBatchOp,
     Word2VecTrainBatchOp,
 )
+from .vector2 import (
+    VectorBiFunctionBatchOp,
+    VectorChiSqSelectorBatchOp,
+    VectorFunctionBatchOp,
+    VectorPolynomialExpandBatchOp,
+    VectorSizeHintBatchOp,
+)
+from .tensorops import (
+    MTableSerializeBatchOp,
+    TensorReshapeBatchOp,
+    TensorSerializeBatchOp,
+    TensorToVectorBatchOp,
+    ToMTableBatchOp,
+    ToTensorBatchOp,
+    ToVectorBatchOp,
+    VectorSerializeBatchOp,
+    VectorToTensorBatchOp,
+)
+from .feature3 import (
+    BinarizerBatchOp,
+    BucketizerBatchOp,
+    ExclusiveFeatureBundlePredictBatchOp,
+    ExclusiveFeatureBundleTrainBatchOp,
+    IndexToStringPredictBatchOp,
+    MultiHotPredictBatchOp,
+    MultiHotTrainBatchOp,
+    MultiStringIndexerPredictBatchOp,
+    MultiStringIndexerTrainBatchOp,
+    TargetEncoderPredictBatchOp,
+    TargetEncoderTrainBatchOp,
+)
+from .relational2 import (
+    AsBatchOp,
+    DataSetWrapperBatchOp,
+    FullOuterJoinBatchOp,
+    IntersectAllBatchOp,
+    LeftOuterJoinBatchOp,
+    MinusAllBatchOp,
+    PrintBatchOp,
+    RandomVectorSourceBatchOp,
+    RightOuterJoinBatchOp,
+    SampleWithSizeBatchOp,
+    StratifiedSampleWithSizeBatchOp,
+)
+from .udf2 import (
+    BaseGroupPandasUdfBatchOp,
+    BasePandasUdfBatchOp,
+    BasePyScalarFnBatchOp,
+    BasePyTableFnBatchOp,
+    FlatMapBatchOp,
+    FlatModelMapBatchOp,
+    FlattenKObjectBatchOp,
+    GroupPandasFileUdfBatchOp,
+    GroupPandasUdfBatchOp,
+    GroupRBatchOp,
+    PandasUdfBatchOp,
+    PandasUdfFileBatchOp,
+    PyFileScalarFnBatchOp,
+    PyFileTableFnBatchOp,
+    PyScalarFnBatchOp,
+    PyTableFnBatchOp,
+    RUdfBatchOp,
+    UDFBatchOp,
+    UDTFBatchOp,
+)
+from .nlp import (
+    RegexTokenizerBatchOp,
+    TokenizerBatchOp,
+)
+from .huge import RandomWalkBatchOp

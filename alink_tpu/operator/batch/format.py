@@ -400,3 +400,74 @@ class TripleToAnyBatchOp(BatchOperator, HasFormatParams):
 
 class TripleToColumnsBatchOp(TripleToAnyBatchOp):
     """(reference: TripleToColumnsBatchOp.java)"""
+
+
+class CsvToTripleBatchOp(AnyToTripleBatchOp):
+    """(reference: dataproc/format/CsvToTripleBatchOp.java)"""
+
+    def __init__(self, params=None, **kw):
+        kw.setdefault("fromFormat", "Csv")
+        super().__init__(params, **kw)
+
+
+class JsonToTripleBatchOp(AnyToTripleBatchOp):
+    """(reference: dataproc/format/JsonToTripleBatchOp.java)"""
+
+    def __init__(self, params=None, **kw):
+        kw.setdefault("fromFormat", "Json")
+        super().__init__(params, **kw)
+
+
+class KvToTripleBatchOp(AnyToTripleBatchOp):
+    """(reference: dataproc/format/KvToTripleBatchOp.java)"""
+
+    def __init__(self, params=None, **kw):
+        kw.setdefault("fromFormat", "Kv")
+        super().__init__(params, **kw)
+
+
+class VectorToTripleBatchOp(AnyToTripleBatchOp):
+    """(reference: dataproc/format/VectorToTripleBatchOp.java)"""
+
+    def __init__(self, params=None, **kw):
+        kw.setdefault("fromFormat", "Vector")
+        super().__init__(params, **kw)
+
+
+class TripleToCsvBatchOp(TripleToAnyBatchOp):
+    """(reference: dataproc/format/TripleToCsvBatchOp.java)"""
+
+    def __init__(self, params=None, **kw):
+        kw.setdefault("toFormat", "Csv")
+        super().__init__(params, **kw)
+
+
+class TripleToJsonBatchOp(TripleToAnyBatchOp):
+    """(reference: dataproc/format/TripleToJsonBatchOp.java)"""
+
+    def __init__(self, params=None, **kw):
+        kw.setdefault("toFormat", "Json")
+        super().__init__(params, **kw)
+
+
+class TripleToKvBatchOp(TripleToAnyBatchOp):
+    """(reference: dataproc/format/TripleToKvBatchOp.java)"""
+
+    def __init__(self, params=None, **kw):
+        kw.setdefault("toFormat", "Kv")
+        super().__init__(params, **kw)
+
+
+class TripleToVectorBatchOp(TripleToAnyBatchOp):
+    """(reference: dataproc/format/TripleToVectorBatchOp.java)"""
+
+    def __init__(self, params=None, **kw):
+        kw.setdefault("toFormat", "Vector")
+        super().__init__(params, **kw)
+
+
+__all__ += [
+    "CsvToTripleBatchOp", "JsonToTripleBatchOp", "KvToTripleBatchOp",
+    "VectorToTripleBatchOp", "TripleToCsvBatchOp", "TripleToJsonBatchOp",
+    "TripleToKvBatchOp", "TripleToVectorBatchOp",
+]

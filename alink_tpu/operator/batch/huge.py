@@ -206,7 +206,9 @@ class DeepWalkBatchOp(BatchOperator, HasWalkParams):
         return _walks_table(walks, nodes, self.get(self.DELIMITER))
 
 
-RandomWalkBatchOp = DeepWalkBatchOp
+class RandomWalkBatchOp(DeepWalkBatchOp):
+    """Uniform random walks op under its graph-family name
+    (reference: operator/batch/graph/RandomWalkBatchOp.java)."""
 
 
 class Node2VecWalkBatchOp(BatchOperator, HasWalkParams):

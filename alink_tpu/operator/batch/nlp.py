@@ -507,3 +507,60 @@ class DocHashCountVectorizerPredictBatchOp(ModelMapBatchOp, HasSelectedCol,
                                            HasOutputCol, HasReservedCols):
     mapper_cls = DocHashCountVectorizerModelMapper
     FEATURE_TYPE = DocHashCountVectorizerModelMapper.FEATURE_TYPE
+
+
+class TokenizerMapper(SISOMapper):
+    """Lowercase whitespace tokenizer, space-joined output (reference:
+    common/nlp/TokenizerMapper.java)."""
+
+    def map_column(self, values, type_tag):
+        out = []
+        for v in values:
+            out.append(None if v is None
+                       else " ".join(str(v).lower().split()))
+        return np.asarray(out, object), AlinkTypes.STRING
+
+
+class TokenizerBatchOp(MapBatchOp, HasSelectedCol, HasOutputCol,
+                       HasReservedCols):
+    """(reference: operator/batch/nlp/TokenizerBatchOp.java)"""
+
+    mapper_cls = TokenizerMapper
+
+
+class RegexTokenizerMapper(SISOMapper):
+    """Regex split (gaps=True) or regex match (gaps=False) tokenizer
+    (reference: common/nlp/RegexTokenizerMapper.java)."""
+
+    PATTERN = ParamInfo("pattern", str, default=r"\s+")
+    GAPS = ParamInfo("gaps", bool, default=True)
+    MIN_TOKEN_LENGTH = ParamInfo("minTokenLength", int, default=1)
+    TO_LOWER_CASE = ParamInfo("toLowerCase", bool, default=True)
+
+    def map_column(self, values, type_tag):
+        import re as _re
+
+        pat = _re.compile(self.get(self.PATTERN))
+        gaps = self.get(self.GAPS)
+        min_len = self.get(self.MIN_TOKEN_LENGTH)
+        lower = self.get(self.TO_LOWER_CASE)
+        out = []
+        for v in values:
+            if v is None:
+                out.append(None)
+                continue
+            s = str(v).lower() if lower else str(v)
+            toks = pat.split(s) if gaps else pat.findall(s)
+            out.append(" ".join(t for t in toks if len(t) >= min_len))
+        return np.asarray(out, object), AlinkTypes.STRING
+
+
+class RegexTokenizerBatchOp(MapBatchOp, HasSelectedCol, HasOutputCol,
+                            HasReservedCols):
+    """(reference: operator/batch/nlp/RegexTokenizerBatchOp.java)"""
+
+    mapper_cls = RegexTokenizerMapper
+    PATTERN = RegexTokenizerMapper.PATTERN
+    GAPS = RegexTokenizerMapper.GAPS
+    MIN_TOKEN_LENGTH = RegexTokenizerMapper.MIN_TOKEN_LENGTH
+    TO_LOWER_CASE = RegexTokenizerMapper.TO_LOWER_CASE

@@ -96,3 +96,29 @@ __all__ = [
     "KvSinkStreamOp",
     "LookupKvStreamOp",
 ] + list(_generated.__all__) + list(_outlier_stream.__all__)
+from .relational import (
+    AppendIdStreamOp,
+    AsStreamOp,
+    FilterStreamOp,
+    MemSourceStreamOp,
+    NumSeqSourceStreamOp,
+    PrintStreamOp,
+    RandomTableSourceStreamOp,
+    RandomVectorSourceStreamOp,
+    RebalanceStreamOp,
+    SampleStreamOp,
+    SelectStreamOp,
+    SpeedControlStreamOp,
+    SplitStreamOp,
+    StratifiedSampleStreamOp,
+    UnionAllStreamOp,
+    WhereStreamOp,
+)
+
+__all__ += [
+    "AppendIdStreamOp", "AsStreamOp", "FilterStreamOp", "MemSourceStreamOp",
+    "NumSeqSourceStreamOp", "PrintStreamOp", "RandomTableSourceStreamOp",
+    "RandomVectorSourceStreamOp", "RebalanceStreamOp", "SampleStreamOp",
+    "SelectStreamOp", "SpeedControlStreamOp", "SplitStreamOp",
+    "StratifiedSampleStreamOp", "UnionAllStreamOp", "WhereStreamOp",
+]

@@ -1,0 +1,388 @@
+"""Sweep tests for the round-3 op-surface completion: vector functions,
+tensor ops, feature transforms, relational long-tail, stream relational,
+UDF variants, tokenizers (reference test model: the corresponding
+*BatchOpTest.java / *StreamOpTest.java smoke tests)."""
+
+import numpy as np
+import pytest
+
+from alink_tpu.common.linalg import SparseVector, parse_vector
+from alink_tpu.common.mtable import AlinkTypes, MTable, TableSchema
+from alink_tpu.operator.batch.base import TableSourceBatchOp
+
+
+def _tab(**cols):
+    return TableSourceBatchOp(MTable(cols))
+
+
+# -- vector function family -------------------------------------------------
+
+
+def test_vector_function_ops():
+    from alink_tpu.operator.batch import (
+        VectorBiFunctionBatchOp,
+        VectorFunctionBatchOp,
+        VectorPolynomialExpandBatchOp,
+        VectorSizeHintBatchOp,
+    )
+
+    t = MTable({"v": np.asarray(["1 2 3", "4 5 6"], object),
+                "w": np.asarray(["1 0 1", "0 1 0"], object)},
+               TableSchema(["v", "w"], [AlinkTypes.DENSE_VECTOR,
+                                        AlinkTypes.DENSE_VECTOR]))
+    src = TableSourceBatchOp(t)
+    r = VectorFunctionBatchOp(selectedCol="v", outputCol="m",
+                              funcName="NormL2Square").link_from(src).collect()
+    np.testing.assert_allclose(r.col("m"), [14.0, 77.0])
+    r = VectorBiFunctionBatchOp(selectedCols=["v", "w"], outputCol="d",
+                                biFuncName="Plus").link_from(src).collect()
+    assert parse_vector(r.col("d")[0]).to_dense().data.tolist() == [2, 2, 4]
+    r = VectorPolynomialExpandBatchOp(selectedCol="v", outputCol="p",
+                                      degree=2).link_from(src).collect()
+    assert parse_vector(r.col("p")[0]).size() == 9
+    with pytest.raises(Exception):
+        VectorSizeHintBatchOp(selectedCol="v", outputCol="h",
+                              size=4).link_from(src).collect()
+
+
+def test_vector_chisq_selector():
+    from alink_tpu.operator.batch import (
+        ChiSqSelectorPredictBatchOp,
+        VectorChiSqSelectorBatchOp,
+    )
+
+    rng = np.random.RandomState(0)
+    n = 120
+    informative = rng.randint(0, 2, n)
+    noise = rng.randint(0, 2, n)
+    vecs = np.asarray([f"{informative[i]} {noise[i]}" for i in range(n)],
+                      object)
+    t = MTable({"vec": vecs, "y": informative.astype(np.int64)},
+               TableSchema(["vec", "y"],
+                           [AlinkTypes.DENSE_VECTOR, AlinkTypes.LONG]))
+    m = VectorChiSqSelectorBatchOp(
+        selectedCol="vec", labelCol="y",
+        numTopFeatures=1).link_from(TableSourceBatchOp(t))
+    from alink_tpu.common.model import table_to_model
+
+    meta, _ = table_to_model(m.collect())
+    assert meta["siftOutCols"] == ["v_0"]
+
+
+# -- tensor family ----------------------------------------------------------
+
+
+def test_tensor_roundtrip_ops():
+    from alink_tpu.operator.batch import (
+        TensorReshapeBatchOp,
+        TensorSerializeBatchOp,
+        TensorToVectorBatchOp,
+        ToTensorBatchOp,
+        VectorToTensorBatchOp,
+    )
+
+    t = MTable({"v": np.asarray(["1 2 3 4", "5 6 7 8"], object)},
+               TableSchema(["v"], [AlinkTypes.DENSE_VECTOR]))
+    src = TableSourceBatchOp(t)
+    tens = VectorToTensorBatchOp(selectedCol="v", outputCol="t",
+                                 tensorShape=[2, 2]).link_from(src).collect()
+    assert tens.col("t")[0].shape == (2, 2)
+    ser = TensorSerializeBatchOp(selectedCol="t", outputCol="s").link_from(
+        TableSourceBatchOp(tens)).collect()
+    assert ser.col("s")[0].startswith("FLOAT#2,2#")
+    back = ToTensorBatchOp(selectedCol="s", outputCol="t2").link_from(
+        TableSourceBatchOp(ser)).collect()
+    np.testing.assert_allclose(back.col("t2")[0],
+                               np.asarray([[1, 2], [3, 4]], np.float32))
+    re = TensorReshapeBatchOp(selectedCol="t", outputCol="r",
+                              newShape=[4]).link_from(
+        TableSourceBatchOp(tens)).collect()
+    assert re.col("r")[0].shape == (4,)
+    vec = TensorToVectorBatchOp(selectedCol="t", outputCol="tv",
+                                convertMethod="MEAN").link_from(
+        TableSourceBatchOp(tens)).collect()
+    np.testing.assert_allclose(
+        parse_vector(vec.col("tv")[0]).to_dense().data, [2.0, 3.0])
+
+
+def test_serialize_ops_stream_twins_exist():
+    import alink_tpu.operator.stream as stream_mod
+
+    for name in ("ToTensorStreamOp", "TensorToVectorStreamOp",
+                 "VectorToTensorStreamOp", "TensorSerializeStreamOp",
+                 "VectorSerializeStreamOp", "MTableSerializeStreamOp",
+                 "ToVectorStreamOp", "ToMTableStreamOp",
+                 "TokenizerStreamOp", "RegexTokenizerStreamOp",
+                 "BinarizerStreamOp", "BucketizerStreamOp",
+                 "MultiHotPredictStreamOp", "TargetEncoderPredictStreamOp",
+                 "IndexToStringPredictStreamOp",
+                 "VectorFunctionStreamOp", "VectorBiFunctionStreamOp",
+                 "VectorPolynomialExpandStreamOp", "VectorSizeHintStreamOp"):
+        assert hasattr(stream_mod, name), name
+
+
+# -- feature transforms -----------------------------------------------------
+
+
+def test_binarizer_bucketizer():
+    from alink_tpu.operator.batch import BinarizerBatchOp, BucketizerBatchOp
+
+    src = _tab(x=np.asarray([-1.0, 0.4, 2.5]))
+    r = BinarizerBatchOp(selectedCol="x", threshold=0.3).link_from(
+        src).collect()
+    assert r.col("x").tolist() == [0.0, 1.0, 1.0]
+    r = BucketizerBatchOp(selectedCols=["x"], outputCols=["b"],
+                          cutsArray=[[0.0, 1.0]]).link_from(src).collect()
+    assert r.col("b").tolist() == [0, 1, 2]
+
+
+def test_multihot():
+    from alink_tpu.operator.batch import (
+        MultiHotPredictBatchOp,
+        MultiHotTrainBatchOp,
+    )
+
+    src = _tab(tags=np.asarray(["a,b", "b,c", "c"], object))
+    m = MultiHotTrainBatchOp(selectedCols=["tags"]).link_from(src)
+    r = MultiHotPredictBatchOp(outputCol="mh").link_from(m, src).collect()
+    sv = parse_vector(r.col("mh")[0])
+    assert isinstance(sv, SparseVector)
+    assert sv.indices.tolist() == [0, 1]  # a, b of vocab [a, b, c]
+
+
+def test_target_encoder():
+    from alink_tpu.operator.batch import (
+        TargetEncoderPredictBatchOp,
+        TargetEncoderTrainBatchOp,
+    )
+
+    src = _tab(cat=np.asarray(["p", "q", "p", "q"], object),
+               y=np.asarray([1.0, 0.0, 1.0, 1.0]))
+    m = TargetEncoderTrainBatchOp(selectedCols=["cat"],
+                                  labelCol="y").link_from(src)
+    r = TargetEncoderPredictBatchOp().link_from(m, src).collect()
+    np.testing.assert_allclose(r.col("cat_te"), [1.0, 0.5, 1.0, 0.5])
+
+
+def test_exclusive_feature_bundle():
+    from alink_tpu.operator.batch import (
+        ExclusiveFeatureBundlePredictBatchOp,
+        ExclusiveFeatureBundleTrainBatchOp,
+    )
+
+    t = MTable({"v": np.asarray(["$4$0:1", "$4$1:2", "$4$2:1 3:1"], object)},
+               TableSchema(["v"], [AlinkTypes.SPARSE_VECTOR]))
+    src = TableSourceBatchOp(t)
+    m = ExclusiveFeatureBundleTrainBatchOp(selectedCol="v").link_from(src)
+    r = ExclusiveFeatureBundlePredictBatchOp(outputCol="e").link_from(
+        m, src).collect()
+    # dims 0,1 are exclusive (rows 0,1) and bundle together; 2,3 co-occur
+    dense = [parse_vector(x).to_dense().data for x in r.col("e")]
+    assert all(d.size < 4 for d in dense)
+
+
+def test_multi_string_indexer_and_inverse():
+    from alink_tpu.operator.batch import (
+        IndexToStringPredictBatchOp,
+        MultiStringIndexerPredictBatchOp,
+        MultiStringIndexerTrainBatchOp,
+    )
+
+    src = _tab(cat=np.asarray(["x", "y", "x", "z"], object))
+    m = MultiStringIndexerTrainBatchOp(selectedCols=["cat"]).link_from(src)
+    p = MultiStringIndexerPredictBatchOp(outputCols=["cid"]).link_from(
+        m, src)
+    back = IndexToStringPredictBatchOp(
+        selectedCol="cid", outputCol="cat2").link_from(m, p).collect()
+    assert back.col("cat2").tolist() == ["x", "y", "x", "z"]
+
+
+# -- relational long-tail ---------------------------------------------------
+
+
+def test_outer_joins_and_multiset_ops():
+    from alink_tpu.operator.batch import (
+        FullOuterJoinBatchOp,
+        IntersectAllBatchOp,
+        LeftOuterJoinBatchOp,
+        MinusAllBatchOp,
+        RightOuterJoinBatchOp,
+    )
+
+    a = _tab(k=np.asarray([1, 2, 2], np.int64), x=np.asarray([1., 2., 2.]))
+    b = _tab(k=np.asarray([2, 3], np.int64), y=np.asarray([20., 30.]))
+    assert LeftOuterJoinBatchOp("k = k").link_from(a, b).collect(
+        ).num_rows == 3
+    assert RightOuterJoinBatchOp("k = k").link_from(a, b).collect(
+        ).num_rows == 3
+    assert FullOuterJoinBatchOp("k = k").link_from(a, b).collect(
+        ).num_rows == 4
+    dup = _tab(k=np.asarray([1, 1, 2], np.int64))
+    one = _tab(k=np.asarray([1, 2], np.int64))
+    assert IntersectAllBatchOp().link_from(dup, one).collect().num_rows == 2
+    assert MinusAllBatchOp().link_from(dup, one).collect().num_rows == 1
+
+
+def test_exact_size_samples():
+    from alink_tpu.operator.batch import (
+        SampleWithSizeBatchOp,
+        StratifiedSampleWithSizeBatchOp,
+    )
+
+    src = _tab(g=np.asarray(["a"] * 5 + ["b"] * 5, object),
+               v=np.arange(10.0))
+    assert SampleWithSizeBatchOp(size=4).link_from(src).collect(
+        ).num_rows == 4
+    r = StratifiedSampleWithSizeBatchOp(
+        strataCol="g", strataSizes="a:1,b:3").link_from(src).collect()
+    g = r.col("g").tolist()
+    assert g.count("a") == 1 and g.count("b") == 3
+
+
+def test_flatten_k_object():
+    from alink_tpu.operator.batch import FlattenKObjectBatchOp
+
+    inner = MTable({"item": np.asarray(["i1", "i2"], object),
+                    "score": np.asarray([0.9, 0.8])},
+                   TableSchema(["item", "score"],
+                               [AlinkTypes.STRING, AlinkTypes.DOUBLE]))
+    t = MTable({"user": np.asarray(["u1"], object),
+                "recs": np.asarray([inner], object)},
+               TableSchema(["user", "recs"],
+                           [AlinkTypes.STRING, AlinkTypes.MTABLE]))
+    r = FlattenKObjectBatchOp(
+        selectedCol="recs",
+        schemaStr="item STRING, score DOUBLE").link_from(
+        TableSourceBatchOp(t)).collect()
+    assert r.num_rows == 2 and r.names == ["user", "item", "score"]
+
+
+# -- UDF variants -----------------------------------------------------------
+
+
+def test_udf_aliases_and_pandas(tmp_path):
+    from alink_tpu.operator.batch import (
+        GroupPandasUdfBatchOp,
+        PandasUdfBatchOp,
+        PyFileScalarFnBatchOp,
+        UDFBatchOp,
+    )
+
+    src = _tab(g=np.asarray(["a", "a", "b"], object),
+               x=np.asarray([1.0, 2.0, 3.0]))
+    r = UDFBatchOp(func=lambda x: x + 1, selectedCols=["x"],
+                   outputCol="y").link_from(src).collect()
+    assert r.col("y").tolist() == [2.0, 3.0, 4.0]
+    r = PandasUdfBatchOp(func=lambda df: df.assign(z=df.x * 2)).link_from(
+        src).collect()
+    assert r.col("z").tolist() == [2.0, 4.0, 6.0]
+    r = GroupPandasUdfBatchOp(func=lambda g: g.tail(1),
+                              groupCols=["g"]).link_from(src).collect()
+    assert r.num_rows == 2
+    f = tmp_path / "fn.py"
+    f.write_text("def udf(x):\n    return x * 10\n")
+    r = PyFileScalarFnBatchOp(str(f), selectedCols=["x"],
+                              outputCol="t").link_from(src).collect()
+    assert r.col("t").tolist() == [10.0, 20.0, 30.0]
+
+
+def test_r_udf_gated():
+    from alink_tpu.common.exceptions import AkUnsupportedOperationException
+    from alink_tpu.operator.batch import RUdfBatchOp
+
+    with pytest.raises(AkUnsupportedOperationException):
+        RUdfBatchOp()
+
+
+# -- stream relational ------------------------------------------------------
+
+
+def test_stream_relational_pipeline():
+    from alink_tpu.operator.stream import (
+        AppendIdStreamOp,
+        FilterStreamOp,
+        MemSourceStreamOp,
+        RebalanceStreamOp,
+        SelectStreamOp,
+        UnionAllStreamOp,
+    )
+
+    src = MemSourceStreamOp(
+        [[i, float(i)] for i in range(10)], "k BIGINT, x DOUBLE",
+        numChunks=3)
+    sel = SelectStreamOp("k, x*2 as x2").link_from(src)
+    fil = FilterStreamOp("x2 >= 10").link_from(sel)
+    out = AppendIdStreamOp().link_from(fil).collect()
+    assert out.names == ["k", "x2", "append_id"]
+    assert out.num_rows == 5
+    assert out.col("append_id").tolist() == list(range(5))
+    u = UnionAllStreamOp().link_from(
+        MemSourceStreamOp([[1]], "a BIGINT"),
+        MemSourceStreamOp([[2]], "a BIGINT")).collect()
+    assert sorted(u.col("a").tolist()) == [1, 2]
+    rb = RebalanceStreamOp(chunkSize=4).link_from(src)
+    chunks = list(rb._stream())
+    assert [c.num_rows for c in chunks] == [4, 4, 2]
+
+
+def test_stream_sources_and_split():
+    from alink_tpu.operator.stream import (
+        NumSeqSourceStreamOp,
+        RandomTableSourceStreamOp,
+        RandomVectorSourceStreamOp,
+        SplitStreamOp,
+        StratifiedSampleStreamOp,
+    )
+
+    ns = NumSeqSourceStreamOp(**{"from": 1, "to": 100, "chunkSize": 17})
+    assert ns.collect().num_rows == 100
+    rt = RandomTableSourceStreamOp(numCols=3, maxRows=50).collect()
+    assert rt.num_rows == 50 and len(rt.names) == 3
+    rv = RandomVectorSourceStreamOp(numRows=9).collect()
+    assert rv.num_rows == 9
+    sp = SplitStreamOp(fraction=0.5, randomSeed=1).link_from(
+        NumSeqSourceStreamOp(fromIndex=1, to=100))
+    comp = sp.complement()  # must be requested before the stream runs
+    main = sp.collect()
+    rest = comp.collect()
+    assert main.num_rows + rest.num_rows == 100
+    st = StratifiedSampleStreamOp(
+        strataCol="g", strataRatios="a:1.0,b:0.0").link_from(
+        _stream_tab(g=np.asarray(["a", "b", "a"], object)))
+    assert st.collect().col("g").tolist() == ["a", "a"]
+
+
+def _stream_tab(**cols):
+    from alink_tpu.operator.stream import TableSourceStreamOp
+
+    return TableSourceStreamOp(MTable(cols))
+
+
+def test_triple_named_ops():
+    from alink_tpu.operator.batch import (
+        KvToTripleBatchOp,
+        TripleToJsonBatchOp,
+    )
+
+    src = _tab(kv=np.asarray(["a:1,b:2", "a:3,b:4"], object))
+    tri = KvToTripleBatchOp(selectedCols=["kv"]).link_from(src).collect()
+    assert tri.num_rows == 4
+    assert tri.names == ["row", "column", "value"]
+    js = TripleToJsonBatchOp().link_from(
+        TableSourceBatchOp(tri)).collect()
+    assert js.num_rows == 2
+
+
+def test_tokenizers():
+    from alink_tpu.operator.batch import (
+        RegexTokenizerBatchOp,
+        TokenizerBatchOp,
+    )
+
+    src = _tab(s=np.asarray(["Hello  World", "A b-c D"], object))
+    r = TokenizerBatchOp(selectedCol="s", outputCol="t").link_from(
+        src).collect()
+    assert r.col("t").tolist() == ["hello world", "a b-c d"]
+    r = RegexTokenizerBatchOp(selectedCol="s", outputCol="t",
+                              pattern=r"\W+").link_from(src).collect()
+    assert r.col("t").tolist() == ["hello world", "a b c d"]
