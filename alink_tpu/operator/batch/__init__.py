@@ -536,3 +536,22 @@ from .nlp import (
     TokenizerBatchOp,
 )
 from .huge import RandomWalkBatchOp
+from .recommendation2 import (
+    AlsForHotPointTrainBatchOp,
+    AlsImplicitForHotPointTrainBatchOp,
+    AlsImplicitTrainBatchOp,
+    AlsSimilarUsersRecommBatchOp,
+    FmRecommBinaryImplicitTrainBatchOp,
+    ItemCfUsersPerItemRecommBatchOp,
+    MfAlsBatchOp,
+    MfAlsForHotPointBatchOp,
+    NegativeItemSamplingBatchOp,
+    RankingListBatchOp,
+    RecommendationRankingBatchOp,
+    SwingRecommBatchOp,
+    UserCfItemsPerUserRecommBatchOp,
+    UserCfSimilarUsersRecommBatchOp,
+    UserCfUsersPerItemRecommBatchOp,
+    VecDotItemsPerUserRecommBatchOp,
+    VecDotModelGeneratorBatchOp,
+)

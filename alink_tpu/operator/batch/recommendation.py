@@ -67,6 +67,14 @@ class AlsTrainBatchOp(ModelTrainOpMixin, BatchOperator, HasRecommTripleCols):
             "itemCol": self.get(self.ITEM_COL),
         }
 
+    def _max_neighbors(self) -> int:
+        """Per-entity neighbor-list cap; 0 = uncapped. The ForHotPoint
+        variants override this (recommendation2._HotPointMixin)."""
+        return 0
+
+    def _extra_meta(self) -> dict:
+        return {}
+
     def _execute_impl(self, t: MTable) -> MTable:
         user_col = self.get(self.USER_COL)
         item_col = self.get(self.ITEM_COL)
@@ -79,6 +87,7 @@ class AlsTrainBatchOp(ModelTrainOpMixin, BatchOperator, HasRecommTripleCols):
             lam=self.get(self.LAMBDA),
             implicit=self.get(self.IMPLICIT_PREFS),
             alpha=self.get(self.ALPHA), seed=self.get(self.RANDOM_SEED),
+            max_neighbors=self._max_neighbors(),
             mesh=self.env.mesh,
         )
         meta = {
@@ -88,6 +97,7 @@ class AlsTrainBatchOp(ModelTrainOpMixin, BatchOperator, HasRecommTripleCols):
             "rateCol": rate_col,
             "rank": self.get(self.RANK),
             "implicitPrefs": self.get(self.IMPLICIT_PREFS),
+            **self._extra_meta(),
         }
         return model_to_table(meta, {
             "userIds": model.user_ids,
@@ -120,7 +130,11 @@ class _AlsRecommMapper(ModelMapper, HasPredictionCol, HasReservedCols):
         return self
 
     def _lookup(self, col_vals, index) -> np.ndarray:
-        return np.asarray([index.get(v, -1) for v in col_vals], np.int64)
+        # FM trainers store ids as strings (np.unique over astype(str));
+        # ALS keeps native dtypes — accept either at serving time
+        return np.asarray(
+            [index.get(v, index.get(str(v), -1)) for v in col_vals],
+            np.int64)
 
     def _out_col(self) -> str:
         return self.get(HasPredictionCol.PREDICTION_COL) or "recomm"

@@ -96,10 +96,17 @@ def train_als(
     implicit: bool = False,
     alpha: float = 40.0,
     seed: int = 0,
+    max_neighbors: int = 0,
     mesh=None,
 ) -> AlsModelData:
     """Factorize sparse (user, item, rating) triples. λ is scaled by each
-    entity's rating count (ALS-WR weighting, matching the reference)."""
+    entity's rating count (ALS-WR weighting, matching the reference).
+
+    ``max_neighbors > 0`` caps each entity's padded neighbor list by random
+    subsampling — the hot-point strategy: one viral item/user otherwise sets
+    the rectangle width D for EVERY row of the sweep (reference:
+    AlsForHotPointTrainBatchOp.java / MfAlsForHotPointBatchOp.java handle
+    the same skew with a dedicated hub-block path)."""
     mesh = mesh or default_mesh()
     dp = mesh.shape[AXIS_DATA]
 
@@ -113,6 +120,15 @@ def train_als(
     for u, i, v in zip(u_inv, i_inv, r):
         by_user[u].append((i, v))
         by_item[i].append((u, v))
+
+    if max_neighbors and max_neighbors > 0:
+        cap_rng = np.random.default_rng(seed + 1)
+        for table in (by_user, by_item):
+            for e, pairs in table.items():
+                if len(pairs) > max_neighbors:
+                    pick = cap_rng.choice(len(pairs), max_neighbors,
+                                          replace=False)
+                    table[e] = [pairs[j] for j in pick]
 
     uids, urts, umask = _pad_lists(by_user, n_u)
     iids, irts, imask = _pad_lists(by_item, n_i)

@@ -1,0 +1,208 @@
+"""Recommendation long-tail tests (reference test model:
+AlsImplicitTrainBatchOpTest.java, UserCfRecommKernelTest.java,
+NegativeItemSamplingBatchOpTest.java styles)."""
+
+import json
+
+import numpy as np
+
+from alink_tpu.common.mtable import MTable
+from alink_tpu.operator.batch.base import TableSourceBatchOp
+
+
+def _triples(n=300, seed=0):
+    rng = np.random.default_rng(seed)
+    users = rng.integers(0, 20, n).astype(np.int64)
+    items = rng.integers(0, 30, n).astype(np.int64)
+    rates = (5 - np.abs(users % 5 - items % 5)).astype(np.float64)
+    return TableSourceBatchOp(MTable({"u": users, "i": items, "r": rates}))
+
+
+def test_als_variants_and_hot_point():
+    from alink_tpu.common.model import table_to_model
+    from alink_tpu.operator.batch import (
+        AlsForHotPointTrainBatchOp,
+        AlsImplicitTrainBatchOp,
+        AlsRateRecommBatchOp,
+        MfAlsBatchOp,
+    )
+
+    src = _triples()
+    m = AlsImplicitTrainBatchOp(userCol="u", itemCol="i", rateCol="r",
+                                numIter=3, rank=4).link_from(src)
+    meta, _ = table_to_model(m.collect())
+    assert meta["implicitPrefs"] is True
+    hp = AlsForHotPointTrainBatchOp(
+        userCol="u", itemCol="i", rateCol="r", numIter=2,
+        maxNeighborNumber=8).link_from(src)
+    meta, arrays = table_to_model(hp.collect())
+    assert meta["maxNeighborNumber"] == 8
+    pred = AlsRateRecommBatchOp(userCol="u", itemCol="i",
+                                predictionCol="p").link_from(
+        MfAlsBatchOp(userCol="u", itemCol="i", rateCol="r",
+                     numIter=4).link_from(src), src).collect()
+    # MF predictions correlate with the structured ratings
+    r = np.asarray(src.collect().col("r"))
+    p = np.asarray(pred.col("p"))
+    ok = np.isfinite(p)
+    assert np.corrcoef(r[ok], p[ok])[0, 1] > 0.5
+
+
+def test_als_similar_users():
+    from alink_tpu.operator.batch import (
+        AlsSimilarUsersRecommBatchOp,
+        AlsTrainBatchOp,
+    )
+
+    src = _triples()
+    m = AlsTrainBatchOp(userCol="u", itemCol="i", rateCol="r",
+                        numIter=3).link_from(src)
+    r = AlsSimilarUsersRecommBatchOp(userCol="u", predictionCol="rec",
+                                     k=3).link_from(m, src).collect()
+    obj = json.loads(r.col("rec")[0])
+    assert len(obj["object"]) == 3
+    # the query user itself is excluded
+    assert int(np.asarray(src.collect().col("u"))[0]) not in obj["object"]
+
+
+def test_usercf_cross_role_kernels():
+    from alink_tpu.operator.batch import (
+        ItemCfTrainBatchOp,
+        ItemCfUsersPerItemRecommBatchOp,
+        UserCfItemsPerUserRecommBatchOp,
+        UserCfSimilarUsersRecommBatchOp,
+        UserCfTrainBatchOp,
+        UserCfUsersPerItemRecommBatchOp,
+    )
+
+    src = _triples()
+    ucf = UserCfTrainBatchOp(userCol="u", itemCol="i",
+                             rateCol="r").link_from(src)
+    for op in (
+        UserCfItemsPerUserRecommBatchOp(userCol="u", predictionCol="rec",
+                                        k=3),
+        UserCfUsersPerItemRecommBatchOp(itemCol="i", predictionCol="rec",
+                                        k=3),
+        UserCfSimilarUsersRecommBatchOp(userCol="u", predictionCol="rec",
+                                        k=3),
+    ):
+        out = op.link_from(ucf, src).collect()
+        obj = json.loads(out.col("rec")[0])
+        assert 0 < len(obj["object"]) <= 3
+        assert all(b >= a for a, b in zip(obj["rate"][1:], obj["rate"]))
+    icf = ItemCfTrainBatchOp(userCol="u", itemCol="i",
+                             rateCol="r").link_from(src)
+    out = ItemCfUsersPerItemRecommBatchOp(
+        itemCol="i", predictionCol="rec", k=3).link_from(icf, src).collect()
+    assert len(json.loads(out.col("rec")[0])["object"]) > 0
+
+
+def test_negative_sampling_and_ranking_list():
+    from alink_tpu.operator.batch import (
+        NegativeItemSamplingBatchOp,
+        RankingListBatchOp,
+    )
+
+    src = _triples(100)
+    out = NegativeItemSamplingBatchOp(
+        userCol="u", itemCol="i", samplingFactor=2).link_from(src).collect()
+    assert out.names[-1] == "label"
+    y = np.asarray(out.col("label"))
+    assert (y == 1).sum() == 100 and (y == 0).sum() > 0
+    # negatives are genuinely unseen pairs
+    seen = set(zip(np.asarray(src.collect().col("u")),
+                   np.asarray(src.collect().col("i"))))
+    for u, i, lab in out.rows():
+        if lab == 0:
+            assert (u, i) not in seen
+    rl = RankingListBatchOp(objectCol="i", topN=5).link_from(src).collect()
+    assert rl.num_rows == 5
+    assert rl.col("rank").tolist() == [1, 2, 3, 4, 5]
+    s = rl.col("score")
+    assert all(b <= a for a, b in zip(s, s[1:]))
+    grouped = RankingListBatchOp(objectCol="i", groupCol="u",
+                                 topN=2).link_from(src).collect()
+    assert grouped.names == ["u", "i", "rank", "score"]
+
+
+def test_vecdot_model_and_serving():
+    from alink_tpu.operator.batch import (
+        VecDotItemsPerUserRecommBatchOp,
+        VecDotModelGeneratorBatchOp,
+    )
+
+    uvecs = TableSourceBatchOp(MTable({
+        "uid": np.arange(3, dtype=np.int64),
+        "vec": np.asarray(["1 0", "0 1", "1 1"], object)}))
+    ivecs = TableSourceBatchOp(MTable({
+        "iid": np.arange(3, dtype=np.int64),
+        "vec": np.asarray(["2 0", "0 2", "1 1"], object)}))
+    m = VecDotModelGeneratorBatchOp().link_from(uvecs, ivecs)
+    q = TableSourceBatchOp(MTable({"uid": np.asarray([0], np.int64)}))
+    out = VecDotItemsPerUserRecommBatchOp(
+        userCol="uid", predictionCol="rec", k=1).link_from(m, q).collect()
+    obj = json.loads(out.col("rec")[0])
+    assert obj["object"] == [0]  # item 0 has max dot with user 0
+    assert abs(obj["rate"][0] - 2.0) < 1e-5
+
+
+def test_recommendation_ranking():
+    from alink_tpu.operator.batch import (
+        ItemCfItemsPerUserRecommBatchOp,
+        ItemCfTrainBatchOp,
+        RecommendationRankingBatchOp,
+    )
+    from alink_tpu.pipeline import LinearRegression, Pipeline, StringIndexer
+
+    src = _triples()
+    icf = ItemCfTrainBatchOp(userCol="u", itemCol="i",
+                             rateCol="r").link_from(src)
+    recs = ItemCfItemsPerUserRecommBatchOp(
+        userCol="u", predictionCol="rec", k=5).link_from(icf, src)
+
+    # ranking model: item string -> indexed id -> linear score
+    train = TableSourceBatchOp(MTable({
+        "item": np.asarray([str(i) for i in range(30)], object),
+        "y": np.arange(30, dtype=np.float64)}))
+    pipe = Pipeline(
+        StringIndexer(selectedCols=["item"]),
+        LinearRegression(featureCols=["item"], labelCol="y",
+                         predictionCol="pred"),
+    ).fit(train)
+    model_table = TableSourceBatchOp(pipe._to_table())
+
+    ranked = RecommendationRankingBatchOp(
+        mTableCol="rec", objectColName="item", predictionScoreCol="pred",
+        topN=3).link_from(model_table, recs).collect()
+    obj = json.loads(ranked.col("rec")[0])
+    assert len(obj["object"]) <= 3
+    assert all(b <= a for a, b in zip(obj["rate"], obj["rate"][1:])) or \
+        all(b >= a for a, b in zip(obj["rate"][1:], obj["rate"]))
+
+
+def test_fm_binary_implicit():
+    from alink_tpu.operator.batch import (
+        FmItemsPerUserRecommBatchOp,
+        FmRecommBinaryImplicitTrainBatchOp,
+    )
+
+    src = _triples()
+    m = FmRecommBinaryImplicitTrainBatchOp(
+        userCol="u", itemCol="i", rateCol="r",
+        numEpochs=5).link_from(src)
+    out = FmItemsPerUserRecommBatchOp(
+        userCol="u", predictionCol="rec", k=3).link_from(m, src).collect()
+    assert len(json.loads(out.col("rec")[0])["object"]) > 0
+
+
+def test_recomm_stream_twins_exist():
+    import alink_tpu.operator.stream as stream_mod
+
+    for name in ("AlsSimilarUsersRecommStreamOp",
+                 "UserCfItemsPerUserRecommStreamOp",
+                 "UserCfUsersPerItemRecommStreamOp",
+                 "UserCfSimilarUsersRecommStreamOp",
+                 "ItemCfUsersPerItemRecommStreamOp",
+                 "SwingRecommStreamOp",
+                 "VecDotItemsPerUserRecommStreamOp"):
+        assert hasattr(stream_mod, name), name
