@@ -555,3 +555,17 @@ from .recommendation2 import (
     VecDotItemsPerUserRecommBatchOp,
     VecDotModelGeneratorBatchOp,
 )
+from .outlier import (
+    CooksDistanceOutlierBatchOp,
+    DbscanModelOutlierPredictBatchOp,
+    DbscanOutlier4GroupedDataBatchOp,
+    DbscanOutlierBatchOp,
+    DbscanPredictBatchOp,
+    DynamicTimeWarpOutlierBatchOp,
+    GroupDbscanModelBatchOp,
+    IForestModelOutlierPredictBatchOp,
+    IForestModelOutlierTrainBatchOp,
+    OcsvmModelOutlierPredictBatchOp,
+    OcsvmModelOutlierTrainBatchOp,
+    SHEsdOutlierBatchOp,
+)

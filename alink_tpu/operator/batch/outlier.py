@@ -386,3 +386,417 @@ class EvalOutlierBatchOp(BatchOperator):
 
         t = self.collect()
         return Metrics(json.loads(t.col("Data")[0]))
+
+
+# -- Cook's distance / DBSCAN / DTW -----------------------------------------
+
+class CooksDistanceOutlierBatchOp(_BaseOutlierBatchOp, HasFeatureCols,
+                                  HasVectorCol):
+    """Linear-model leverage outliers: Cook's distance of every row under
+    OLS of labelCol on featureCols, flagged above F(0.95, p, n-p)
+    (reference: operator/batch/outlier/CooksDistanceOutlierBatchOp.java,
+    common/outlier/CooksDistanceDetector.java)."""
+
+    LABEL_COL = ParamInfo("labelCol", str, optional=False)
+
+    def _execute_impl(self, t: MTable) -> MTable:
+        from ...outlier.detectors import cooks_distance
+
+        label_col = self.get(self.LABEL_COL)
+        y = np.asarray(t.col(label_col), np.float64)
+        X = get_feature_block(t, self, dtype=np.float64,
+                              exclude=[label_col])
+        scores, flags, _thr = cooks_distance(X, y)
+        return _append_outlier(t, self, scores, flags)
+
+
+class DbscanOutlierBatchOp(_MultivariateOutlierOp):
+    """Density outliers: points whose k-th neighbor lies beyond the
+    (auto-tuned) eps (reference: operator/batch/outlier/
+    DbscanOutlierBatchOp.java, common/outlier/DbscanDetector.java)."""
+
+    MIN_POINTS = ParamInfo("minPoints", int, default=4)
+    EPSILON = ParamInfo("epsilon", float, default=None)
+
+    def _score(self, X):
+        from ...outlier.detectors import dbscan_outlier
+
+        return dbscan_outlier(X, min_points=self.get(self.MIN_POINTS),
+                              eps=self.get(self.EPSILON))
+
+
+DbscanOutlier4GroupedDataBatchOp = _grouped(
+    "DbscanOutlier4GroupedDataBatchOp", DbscanOutlierBatchOp)
+
+
+class SHEsdOutlierBatchOp(ShEsdOutlierBatchOp):
+    """Reference-capitalization name for the S-H-ESD detector
+    (reference: operator/batch/outlier/SHEsdOutlierBatchOp.java)."""
+
+
+class DynamicTimeWarpOutlierBatchOp(_BaseOutlierBatchOp):
+    """DTW novelty detection over fixed-length windows of a univariate
+    series (reference: operator/stream/outlier/
+    DynamicTimeWarpOutlierStreamOp.java, common/outlier/
+    DynamicTimeWarpingDetector.java)."""
+
+    _univariate = True
+
+    SERIES_LENGTH = ParamInfo("seriesLength", int, default=10)
+    SEARCH_WINDOW = ParamInfo("searchWindow", int, default=-1)
+    K = ParamInfo("k", float, default=3.0, desc="k-sigma novelty threshold")
+
+    def _score(self, x):
+        from ...outlier.detectors import dtw_outlier
+
+        return dtw_outlier(x, self.get(self.SERIES_LENGTH),
+                           search_window=self.get(self.SEARCH_WINDOW),
+                           k_sigma=self.get(self.K))
+
+
+# -- model outlier train/predict families ------------------------------------
+
+from ...common.model import model_to_table, table_to_model  # noqa: E402
+from ...mapper import HasReservedCols, ModelMapper  # noqa: E402
+from .utils import MapBatchOp, ModelMapBatchOp, ModelTrainOpMixin  # noqa: E402
+
+
+class IForestModelOutlierTrainBatchOp(ModelTrainOpMixin, BatchOperator,
+                                      HasFeatureCols, HasVectorCol):
+    """Train a REUSABLE isolation forest (reference: operator/batch/outlier/
+    IForestModelOutlierTrainBatchOp.java — persisted trees served by
+    IForestModelDetector)."""
+
+    NUM_TREES = ParamInfo("numTrees", int, default=100)
+    SUBSAMPLING_SIZE = ParamInfo("subsamplingSize", int, default=256)
+    RANDOM_SEED = ParamInfo("randomSeed", int, default=0, aliases=("seed",))
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    def _static_meta_keys(self, in_schema):
+        return {"modelName": "IForestModel"}
+
+    def _execute_impl(self, t: MTable) -> MTable:
+        from ...outlier.detectors import iforest_fit
+
+        from ...mapper import resolve_feature_cols
+
+        vec_col = self.get(HasVectorCol.VECTOR_COL)
+        feature_cols = None if vec_col else resolve_feature_cols(t, self)
+        X = get_feature_block(t, self, dtype=np.float64)
+        arrays = iforest_fit(X, num_trees=self.get(self.NUM_TREES),
+                             subsample=self.get(self.SUBSAMPLING_SIZE),
+                             seed=self.get(self.RANDOM_SEED))
+        meta = {"modelName": "IForestModel",
+                "featureCols": feature_cols,
+                "vectorCol": vec_col,
+                "dim": int(X.shape[1])}
+        return model_to_table(meta, arrays)
+
+
+class _ModelOutlierMapper(ModelMapper, HasPredictionCol,
+                          HasPredictionDetailCol, HasReservedCols,
+                          HasFeatureCols, HasVectorCol):
+    """Shared serving harness for trained outlier models (reference:
+    common/outlier/ModelOutlierDetector.java)."""
+
+    def load_model(self, model: MTable):
+        self.meta, self.arrays = table_to_model(model)
+        return self
+
+    def output_schema(self, input_schema):
+        names = [self.get(HasPredictionCol.PREDICTION_COL)]
+        types = [AlinkTypes.BOOLEAN]
+        if self.get(HasPredictionDetailCol.PREDICTION_DETAIL_COL):
+            names.append(
+                self.get(HasPredictionDetailCol.PREDICTION_DETAIL_COL))
+            types.append(AlinkTypes.STRING)
+        return self._append_result_schema(input_schema, names, types)
+
+    def _features(self, t: MTable) -> np.ndarray:
+        from ...mapper import merge_feature_params
+
+        p = merge_feature_params(self.get_params(), self.meta)
+        return get_feature_block(t, p, dtype=np.float64,
+                                 vector_size=self.meta.get("dim"))
+
+    def _score(self, X):
+        raise NotImplementedError
+
+    def map_table(self, t: MTable) -> MTable:
+        scores, flags = self._score(self._features(t))
+        add = {self.get(HasPredictionCol.PREDICTION_COL):
+               np.asarray(flags, bool)}
+        types = {self.get(HasPredictionCol.PREDICTION_COL):
+                 AlinkTypes.BOOLEAN}
+        detail_col = self.get(HasPredictionDetailCol.PREDICTION_DETAIL_COL)
+        if detail_col:
+            add[detail_col] = np.asarray(
+                [json.dumps({"outlier_score": round(float(s), 6)
+                             if np.isfinite(s) else None})
+                 for s in scores], object)
+            types[detail_col] = AlinkTypes.STRING
+        return self._append_result(t, add, types)
+
+
+class IForestModelOutlierPredictMapper(_ModelOutlierMapper):
+    def _score(self, X):
+        from ...outlier.detectors import iforest_score
+
+        return iforest_score(self.arrays, X)
+
+
+class IForestModelOutlierPredictBatchOp(ModelMapBatchOp, HasPredictionCol,
+                                        HasPredictionDetailCol,
+                                        HasReservedCols, HasFeatureCols,
+                                        HasVectorCol):
+    """(reference: operator/batch/outlier/
+    IForestModelOutlierPredictBatchOp.java)"""
+
+    mapper_cls = IForestModelOutlierPredictMapper
+
+
+class OcsvmModelOutlierTrainBatchOp(ModelTrainOpMixin, BatchOperator,
+                                    HasFeatureCols, HasVectorCol):
+    """Train a reusable one-class SVM (reference: operator/batch/outlier/
+    OcsvmModelOutlierTrainBatchOp.java — OcsvmModelData support vectors)."""
+
+    NU = ParamInfo("nu", float, default=0.1)
+    GAMMA = ParamInfo("gamma", float, default=None)
+    RANDOM_SEED = ParamInfo("randomSeed", int, default=0, aliases=("seed",))
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    def _static_meta_keys(self, in_schema):
+        return {"modelName": "OcsvmModel"}
+
+    def _execute_impl(self, t: MTable) -> MTable:
+        from ...outlier.detectors import ocsvm_fit
+
+        from ...mapper import resolve_feature_cols
+
+        vec_col = self.get(HasVectorCol.VECTOR_COL)
+        feature_cols = None if vec_col else resolve_feature_cols(t, self)
+        X = get_feature_block(t, self, dtype=np.float64)
+        arrays = ocsvm_fit(X, nu=self.get(self.NU),
+                           gamma=self.get(self.GAMMA),
+                           seed=self.get(self.RANDOM_SEED))
+        meta = {"modelName": "OcsvmModel",
+                "featureCols": feature_cols,
+                "vectorCol": vec_col,
+                "dim": int(X.shape[1])}
+        return model_to_table(meta, arrays)
+
+
+class OcsvmModelOutlierPredictMapper(_ModelOutlierMapper):
+    def _score(self, X):
+        from ...outlier.detectors import ocsvm_score
+
+        return ocsvm_score(self.arrays, X)
+
+
+class OcsvmModelOutlierPredictBatchOp(ModelMapBatchOp, HasPredictionCol,
+                                      HasPredictionDetailCol,
+                                      HasReservedCols, HasFeatureCols,
+                                      HasVectorCol):
+    """(reference: operator/batch/outlier/
+    OcsvmModelOutlierPredictBatchOp.java)"""
+
+    mapper_cls = OcsvmModelOutlierPredictMapper
+
+
+class DbscanModelOutlierPredictMapper(_ModelOutlierMapper):
+    """New points with no model point within eps are outliers; score =
+    min-distance / eps. With a grouped model, each row only matches ITS
+    group's points — cluster structure never leaks across groups
+    (reference: common/outlier/DbscanModelDetector.java over the
+    GroupDbscanModel points)."""
+
+    EPSILON = ParamInfo("epsilon", float, default=None)
+
+    def _min_dist(self, t: MTable, X) -> np.ndarray:
+        """Per-row distance to the nearest eligible model point (inf when
+        the row's group has no clustered points)."""
+        pts = self.arrays["points"]
+        X = np.asarray(X)
+        mind = np.full(len(X), np.inf)
+        nearest = np.full(len(X), -1, np.int64)
+        for rows, pidx in _group_point_index(self.meta, self.arrays, t, X):
+            if pidx.size == 0 or rows.size == 0:
+                continue
+            d2 = ((X[rows][:, None, :] - pts[pidx][None, :, :]) ** 2).sum(-1)
+            j = d2.argmin(axis=1)
+            mind[rows] = np.sqrt(d2[np.arange(len(rows)), j])
+            nearest[rows] = pidx[j]
+        return mind, nearest
+
+    def _score(self, X):  # ungrouped fast path (kept for _BaseOutlier API)
+        raise NotImplementedError
+
+    def map_table(self, t: MTable) -> MTable:
+        X = self._features(t)
+        eps = self.get(self.EPSILON)
+        if eps is None:
+            eps = float(self.meta.get("epsilon", 0.5))
+        mind, _ = self._min_dist(t, X)
+        score = mind / max(eps, 1e-12)
+        flags = score > 1.0
+        add = {self.get(HasPredictionCol.PREDICTION_COL):
+               np.asarray(flags, bool)}
+        types = {self.get(HasPredictionCol.PREDICTION_COL):
+                 AlinkTypes.BOOLEAN}
+        detail_col = self.get(HasPredictionDetailCol.PREDICTION_DETAIL_COL)
+        if detail_col:
+            add[detail_col] = np.asarray(
+                [json.dumps({"outlier_score": round(float(s), 6)
+                             if np.isfinite(s) else None})
+                 for s in score], object)
+            types[detail_col] = AlinkTypes.STRING
+        return self._append_result(t, add, types)
+
+
+def _group_point_index(meta, arrays, t: MTable, X):
+    """Yield (row_indices, model_point_indices) pairs: one pair per group
+    for grouped models (matched via the persisted group keys), or a single
+    all-rows/all-points pair otherwise."""
+    group_cols = meta.get("groupCols")
+    gids = arrays.get("groups")
+    keys = meta.get("groupKeys")
+    all_pts = np.arange(arrays["points"].shape[0])
+    if not group_cols or gids is None or not keys:
+        yield np.arange(len(X)), all_pts
+        return
+    key_to_gid = {k: i for i, k in enumerate(keys)}
+    cols = [np.asarray(t.col(c), object) for c in group_cols]
+    row_keys = ["\x01".join(str(c[i]) for c in cols)
+                for i in range(len(X))]
+    by_gid = {}
+    for i, k in enumerate(row_keys):
+        by_gid.setdefault(key_to_gid.get(k, -1), []).append(i)
+    for gid, rows in by_gid.items():
+        rows = np.asarray(rows)
+        if gid < 0:
+            yield rows, np.asarray([], np.int64)  # unseen group: outliers
+        else:
+            yield rows, np.nonzero(gids == gid)[0]
+
+
+class DbscanModelOutlierPredictBatchOp(ModelMapBatchOp, HasPredictionCol,
+                                       HasPredictionDetailCol,
+                                       HasReservedCols, HasFeatureCols,
+                                       HasVectorCol):
+    """(reference: operator/stream/outlier/
+    DbscanModelOutlierPredictStreamOp.java — batch twin of the model-based
+    DBSCAN detector)."""
+
+    mapper_cls = DbscanModelOutlierPredictMapper
+    EPSILON = DbscanModelOutlierPredictMapper.EPSILON
+
+
+class GroupDbscanModelBatchOp(ModelTrainOpMixin, BatchOperator,
+                              HasFeatureCols, HasVectorCol):
+    """Per-group DBSCAN models: core points + cluster ids (+ group keys)
+    persisted for model-based serving (reference: operator/batch/clustering/
+    GroupDbscanModelBatchOp.java; served by DbscanModelDetector)."""
+
+    GROUP_COLS = ParamInfo("groupCols", list, default=None)
+    EPSILON = ParamInfo("epsilon", float, optional=False)
+    MIN_POINTS = ParamInfo("minPoints", int, default=4)
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    def _static_meta_keys(self, in_schema):
+        return {"modelName": "DbscanModel"}
+
+    def _execute_impl(self, t: MTable) -> MTable:
+        from ..batch.clustering2 import DbscanBatchOp
+
+        from ...mapper import resolve_feature_cols
+
+        eps = float(self.get(self.EPSILON))
+        min_pts = int(self.get(self.MIN_POINTS))
+        group_cols = self.get(self.GROUP_COLS)
+        pts_out, labels_out, gid_out = [], [], []
+        group_keys: List[str] = []
+        if group_cols:
+            from .utils2 import coerce_group_cols, group_row_indices
+
+            group_cols = coerce_group_cols(group_cols)
+            index, order = group_row_indices(t, group_cols)
+            groups = [(gi, np.asarray(index[k]))
+                      for gi, k in enumerate(order)]
+            group_keys = ["\x01".join(str(v) for v in k) for k in order]
+        else:
+            groups = [(0, np.arange(t.num_rows))]
+        vec_col = self.get(HasVectorCol.VECTOR_COL)
+        # resolve NOW (group columns excluded) so numeric group cols never
+        # leak into the feature block and serving binds the same columns
+        feature_cols = (None if vec_col else resolve_feature_cols(
+            t, self, exclude=list(group_cols) if group_cols else []))
+        for gid, rows in groups:
+            sub = t.take(rows)
+            clustered = DbscanBatchOp(
+                featureCols=feature_cols,
+                vectorCol=vec_col,
+                epsilon=eps, minPoints=min_pts,
+                predictionCol="cluster_id")._execute_impl(sub)
+            labels = np.asarray(clustered.col("cluster_id"))
+            X = (sub.to_numeric_block(feature_cols, dtype=np.float64)
+                 if feature_cols
+                 else get_feature_block(sub, self, dtype=np.float64))
+            keep = labels >= 0  # persist clustered (non-noise) points
+            pts_out.append(np.asarray(X, np.float64)[keep])
+            labels_out.append(labels[keep])
+            gid_out.append(np.full(int(keep.sum()), gid, np.int64))
+        pts = (np.concatenate(pts_out) if pts_out
+               else np.zeros((0, 1)))
+        meta = {"modelName": "DbscanModel", "epsilon": eps,
+                "minPoints": min_pts,
+                "featureCols": feature_cols,
+                "vectorCol": vec_col,
+                "dim": int(pts.shape[1]) if pts.size else 0,
+                "groupCols": group_cols, "groupKeys": group_keys}
+        return model_to_table(meta, {
+            "points": pts,
+            "labels": (np.concatenate(labels_out) if labels_out
+                       else np.zeros(0, np.int64)),
+            "groups": (np.concatenate(gid_out) if gid_out
+                       else np.zeros(0, np.int64)),
+        })
+
+
+class DbscanPredictMapper(_ModelOutlierMapper):
+    """Assign each row the cluster id of its nearest model point within eps,
+    else -1 (noise) (reference: operator/batch/clustering/
+    DbscanPredictBatchOp.java semantics over the persisted model)."""
+
+    def output_schema(self, input_schema):
+        return self._append_result_schema(
+            input_schema, [self.get(HasPredictionCol.PREDICTION_COL)],
+            [AlinkTypes.LONG])
+
+    def map_table(self, t: MTable) -> MTable:
+        labels = self.arrays["labels"]
+        eps = float(self.meta["epsilon"])
+        X = self._features(t)
+        pts = self.arrays["points"]
+        out = np.full(t.num_rows, -1, np.int64)
+        for rows, pidx in _group_point_index(self.meta, self.arrays, t, X):
+            if pidx.size == 0 or rows.size == 0:
+                continue
+            d2 = ((X[rows][:, None, :] - pts[pidx][None, :, :]) ** 2).sum(-1)
+            j = d2.argmin(axis=1)
+            mind = np.sqrt(d2[np.arange(len(rows)), j])
+            out[rows] = np.where(mind <= eps, labels[pidx[j]], -1)
+        oc = self.get(HasPredictionCol.PREDICTION_COL)
+        return self._append_result(t, {oc: out}, {oc: AlinkTypes.LONG})
+
+
+class DbscanPredictBatchOp(ModelMapBatchOp, HasPredictionCol,
+                           HasReservedCols, HasFeatureCols, HasVectorCol):
+    """(reference: operator/batch/clustering/DbscanPredictBatchOp.java)"""
+
+    mapper_cls = DbscanPredictMapper

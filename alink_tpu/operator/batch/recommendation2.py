@@ -538,7 +538,9 @@ class RecommendationRankingBatchOp(BatchOperator):
                 f"ranking model emitted no {score_col!r} column "
                 f"(have {scored.names})")
         scores = np.asarray(scored.col(score_col), np.float64)
-        objs_arr = np.asarray(scored.col(obj_col), object)
+        # rank the ORIGINAL candidate ids — pipeline stages (StringIndexer
+        # etc.) may have rewritten the object column in place
+        objs_arr = np.asarray([r[-1] for r in cand_rows], object)
         owners = np.asarray(owners)
         ranked = np.full(t.num_rows, empty, object)
         # one group-by over the candidate table instead of a per-row scan

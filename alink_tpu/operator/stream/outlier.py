@@ -47,10 +47,16 @@ def _generate():
     from ..batch import outlier as batch_outlier
 
     for attr in dir(batch_outlier):
-        if (attr.endswith("OutlierBatchOp") and not attr.startswith("_")
-                and not attr.startswith("Eval")):  # Eval* are metrics ops,
-                # not detectors — a per-chunk twin would mis-aggregate
-            name, cls = _make_twin(getattr(batch_outlier, attr))
+        if attr.startswith(("_", "Eval")):  # Eval* are metrics ops, not
+            continue  # detectors — a per-chunk twin would mis-aggregate
+        # plain detectors AND the *Outlier4GroupedData grouped variants
+        # (reference: the matching operator/stream/outlier wrappers)
+        if (attr.endswith("OutlierBatchOp")
+                or attr.endswith("Outlier4GroupedDataBatchOp")):
+            obj = getattr(batch_outlier, attr)
+            if obj.__name__ != attr:  # skip aliases; twin the real class
+                continue
+            name, cls = _make_twin(obj)
             globals()[name] = cls
             __all__.append(name)
 

@@ -19,7 +19,7 @@ more anomalous.
 from __future__ import annotations
 
 import math
-from typing import Optional, Tuple
+from typing import Dict, Optional, Tuple
 
 import numpy as np
 
@@ -247,62 +247,11 @@ def _avg_path_vec(ns: Arr) -> Arr:
 
 def iforest(X: Arr, num_trees: int = 100, subsample: int = 256,
             seed: int = 0) -> Tuple[Arr, Arr]:
-    """Isolation forest (reference: IForestDetector). Trees are grown on
-    subsamples host-side in heap layout; scoring descends all rows through
-    each tree fully vectorized."""
-    rng = np.random.default_rng(seed)
-    n, d = X.shape
-    psi = min(subsample, n)
-    depth = max(1, int(np.ceil(np.log2(max(psi, 2)))))
-    n_nodes = 2 ** (depth + 1) - 1
-    path = np.zeros(n)
-
-    for _ in range(num_trees):
-        idx = rng.choice(n, psi, replace=False)
-        feat = np.zeros(n_nodes, np.int64)
-        thr = np.zeros(n_nodes, np.float32)
-        is_leaf = np.ones(n_nodes, bool)
-        leaf_size = np.zeros(n_nodes, np.float64)
-        # grow: queue of (node, row indices)
-        queue = [(0, idx)]
-        while queue:
-            node, rows = queue.pop()
-            node_depth = int(np.floor(np.log2(node + 1)))
-            if len(rows) <= 1 or node_depth >= depth:
-                leaf_size[node] = len(rows)
-                continue
-            j = rng.integers(d)
-            lo, hi = X[rows, j].min(), X[rows, j].max()
-            if hi <= lo:
-                leaf_size[node] = len(rows)
-                continue
-            t = rng.uniform(lo, hi)
-            feat[node] = j
-            thr[node] = t
-            is_leaf[node] = False
-            mask = X[rows, j] < t
-            queue.append((2 * node + 1, rows[mask]))
-            queue.append((2 * node + 2, rows[~mask]))
-
-        # vectorized descent of ALL rows
-        cur = np.zeros(n, np.int64)
-        depth_at = np.zeros(n, np.float64)
-        done = is_leaf[cur]
-        for _level in range(depth):
-            go = ~done
-            if not go.any():
-                break
-            f = feat[cur[go]]
-            t = thr[cur[go]]
-            left = X[go, f] < t
-            cur[go] = np.where(left, 2 * cur[go] + 1, 2 * cur[go] + 2)
-            depth_at[go] += 1
-            done = is_leaf[cur]
-        path += depth_at + _avg_path_vec(leaf_size[cur])
-
-    e_path = path / num_trees
-    score = 2.0 ** (-e_path / max(_avg_path(psi), 1e-12))
-    return score, score > 0.6
+    """Isolation forest (reference: IForestDetector) — a thin wrapper over
+    the servable fit/score pair so the numeric kernel exists once."""
+    X = np.asarray(X, np.float64)
+    return iforest_score(iforest_fit(X, num_trees=num_trees,
+                                     subsample=subsample, seed=seed), X)
 
 
 def sos(X: Arr, perplexity: float = 4.5) -> Tuple[Arr, Arr]:
@@ -349,11 +298,201 @@ def ocsvm(X: Arr, nu: float = 0.1, gamma: Optional[float] = None,
           num_features: int = 256, num_steps: int = 400,
           seed: int = 0) -> Tuple[Arr, Arr]:
     """One-class SVM via Nyström RBF features (reference:
-    common/outlier/OcsvmDetector — the exact-kernel SMO solver; here the RBF
-    kernel is approximated with Nyström landmarks — unlike random Fourier
-    features these DECAY away from the data, so far outliers score outside —
-    and the primal one-class problem
-    min ½‖w‖² − ρ + 1/(νn)·Σ max(0, ρ − w·z(x)) solves on device)."""
+    common/outlier/OcsvmDetector) — wrapper over the servable fit/score
+    pair (ocsvm_fit keeps the Nyström landmarks, so far outliers decay
+    outside the boundary exactly as before)."""
+    model = ocsvm_fit(X, nu=nu, gamma=gamma, num_features=num_features,
+                      num_steps=num_steps, seed=seed)
+    return ocsvm_score(model, X)
+
+
+def cooks_distance(X: Arr, y: Arr, alpha: float = 0.95
+                   ) -> Tuple[Arr, Arr, float]:
+    """Cook's distance of each row under OLS with intercept (reference:
+    common/outlier/CooksDistanceDetector.java — D_i > F(0.95, p, n-p)
+    flags the row). Returns (distance, flags, f_threshold)."""
+    from ..stats.prob import IDF
+
+    X = np.asarray(X, np.float64)
+    y = np.asarray(y, np.float64).reshape(-1)
+    n = X.shape[0]
+    Xd = np.concatenate([X, np.ones((n, 1))], axis=1)
+    p = Xd.shape[1]
+    if n <= p:
+        raise ValueError("rowNum must be larger than colNum-1")
+    G = np.linalg.pinv(Xd.T @ Xd)
+    H_diag = np.einsum("ij,jk,ik->i", Xd, G, Xd)
+    beta = G @ (Xd.T @ y)
+    resid = y - Xd @ beta
+    dof = max(n - p, 1)
+    s2 = float(resid @ resid) / dof
+    h = np.clip(H_diag, 0.0, 1.0 - 1e-12)
+    d = (resid ** 2 / (p * max(s2, 1e-300))) * (h / (1.0 - h) ** 2)
+    f_thr = float(IDF.f(alpha, p, dof))
+    return d, d > f_thr, f_thr
+
+
+# ---------------------------------------------------------------------------
+# DBSCAN density outlier
+# ---------------------------------------------------------------------------
+
+
+def dbscan_outlier(X: Arr, min_points: int = 4,
+                   eps: Optional[float] = None,
+                   within_sd: float = 2.0) -> Tuple[Arr, Arr]:
+    """DBSCAN-based outlier detection (reference: common/outlier/
+    DbscanDetector.java): eps defaults to mean(k-th NN distance) +
+    within_sd·sd; points whose k-th neighbor is beyond eps (density too
+    low to be core-reachable) are outliers; score = k-th distance / eps."""
+    X = np.asarray(X, np.float64)
+    n = X.shape[0]
+    k = min(max(min_points, 1), max(n - 1, 1))
+    d2 = _pairwise_sq_dists(X)
+    np.fill_diagonal(d2, np.inf)
+    kth = np.sqrt(np.partition(d2, k - 1, axis=1)[:, k - 1])
+    if eps is None:
+        eps = float(kth.mean() + within_sd * kth.std())
+    eps = max(eps, 1e-12)
+    score = kth / eps
+    return score, score > 1.0
+
+
+# ---------------------------------------------------------------------------
+# Dynamic time warping
+# ---------------------------------------------------------------------------
+
+
+def dtw_distance(a: Arr, b: Arr, search_window: int = -1) -> float:
+    """Classic DP DTW with an optional Sakoe-Chiba band (reference:
+    common/outlier/DynamicTimeWarpingDetector.java dtw())."""
+    a = np.asarray(a, np.float64)
+    b = np.asarray(b, np.float64)
+    n, m = len(a), len(b)
+    w = max(search_window, abs(n - m)) if search_window >= 0 else max(n, m)
+    D = np.full((n + 1, m + 1), np.inf)
+    D[0, 0] = 0.0
+    for i in range(1, n + 1):
+        lo = max(1, i - w)
+        hi = min(m, i + w)
+        for j in range(lo, hi + 1):
+            cost = abs(a[i - 1] - b[j - 1])
+            D[i, j] = cost + min(D[i, j - 1], D[i - 1, j], D[i - 1, j - 1])
+    return float(D[n, m])
+
+
+def dtw_outlier(x: Arr, series_length: int,
+                search_window: int = -1,
+                k_sigma: float = 3.0) -> Tuple[Arr, Arr]:
+    """Per-window DTW novelty: each length-``series_length`` window's DTW
+    distance to its predecessor, flagged by k-sigma over the distance
+    series (reference: DynamicTimeWarpingDetector — the stream op detects
+    the LAST window against history; the batch scan scores every window,
+    broadcast back to its rows)."""
+    x = np.asarray(x, np.float64).reshape(-1)
+    n = len(x)
+    L = max(1, min(series_length, n))
+    n_win = n // L
+    if n_win < 3:
+        return np.zeros(n), np.zeros(n, bool)
+    wins = x[: n_win * L].reshape(n_win, L)
+    dists = np.zeros(n_win)
+    for i in range(1, n_win):
+        dists[i] = dtw_distance(wins[i], wins[i - 1], search_window)
+    base = dists[1:]
+    mu, sd = float(base.mean()), float(base.std())
+    flags_w = np.zeros(n_win, bool)
+    if sd > 0:
+        flags_w[1:] = np.abs(base - mu) > k_sigma * sd
+    scores = np.zeros(n)
+    flags = np.zeros(n, bool)
+    for i in range(n_win):
+        scores[i * L:(i + 1) * L] = dists[i]
+        flags[i * L:(i + 1) * L] = flags_w[i]
+    return scores, flags
+
+
+# ---------------------------------------------------------------------------
+# servable model variants (train once, score anywhere)
+# ---------------------------------------------------------------------------
+
+
+def iforest_fit(X: Arr, num_trees: int = 100, subsample: int = 256,
+                seed: int = 0) -> Dict[str, np.ndarray]:
+    """Isolation forest as serializable arrays: heap-layout trees
+    (feat/thr/is_leaf/leaf_size) (reference: IForestModelDetector's
+    persisted trees)."""
+    rng = np.random.default_rng(seed)
+    X = np.asarray(X, np.float64)
+    n, d = X.shape
+    psi = min(subsample, n)
+    depth = max(1, int(np.ceil(np.log2(max(psi, 2)))))
+    n_nodes = 2 ** (depth + 1) - 1
+    feats = np.zeros((num_trees, n_nodes), np.int64)
+    thrs = np.zeros((num_trees, n_nodes), np.float32)
+    leaf = np.ones((num_trees, n_nodes), bool)
+    sizes = np.zeros((num_trees, n_nodes), np.float64)
+    for ti in range(num_trees):
+        idx = rng.choice(n, psi, replace=False)
+        queue = [(0, idx)]
+        while queue:
+            node, rows = queue.pop()
+            node_depth = int(np.floor(np.log2(node + 1)))
+            if len(rows) <= 1 or node_depth >= depth:
+                sizes[ti, node] = len(rows)
+                continue
+            j = rng.integers(d)
+            lo, hi = X[rows, j].min(), X[rows, j].max()
+            if hi <= lo:
+                sizes[ti, node] = len(rows)
+                continue
+            thr = rng.uniform(lo, hi)
+            feats[ti, node] = j
+            thrs[ti, node] = thr
+            leaf[ti, node] = False
+            mask = X[rows, j] < thr
+            queue.append((2 * node + 1, rows[mask]))
+            queue.append((2 * node + 2, rows[~mask]))
+    return {"feats": feats, "thrs": thrs, "leaf": leaf.astype(np.int8),
+            "sizes": sizes, "psi": np.asarray([psi], np.int64),
+            "depth": np.asarray([depth], np.int64)}
+
+
+def iforest_score(model: Dict[str, np.ndarray], X: Arr,
+                  threshold: float = 0.6) -> Tuple[Arr, Arr]:
+    X = np.asarray(X, np.float64)
+    n = X.shape[0]
+    feats, thrs = model["feats"], model["thrs"]
+    leaf = model["leaf"].astype(bool)
+    sizes = model["sizes"]
+    psi = int(model["psi"][0])
+    depth = int(model["depth"][0])
+    num_trees = feats.shape[0]
+    path = np.zeros(n)
+    for ti in range(num_trees):
+        cur = np.zeros(n, np.int64)
+        depth_at = np.zeros(n, np.float64)
+        done = leaf[ti][cur]
+        for _level in range(depth):
+            go = ~done
+            if not go.any():
+                break
+            f = feats[ti][cur[go]]
+            t = thrs[ti][cur[go]]
+            left = X[go, f] < t
+            cur[go] = np.where(left, 2 * cur[go] + 1, 2 * cur[go] + 2)
+            depth_at[go] += 1
+            done = leaf[ti][cur]
+        path += depth_at + _avg_path_vec(sizes[ti][cur])
+    e_path = path / num_trees
+    score = 2.0 ** (-e_path / max(_avg_path(psi), 1e-12))
+    return score, score > threshold
+
+
+def ocsvm_fit(X: Arr, nu: float = 0.1, gamma: Optional[float] = None,
+              num_features: int = 256, num_steps: int = 400,
+              seed: int = 0) -> Dict[str, np.ndarray]:
+    """One-class SVM model as arrays: Nyström landmarks + whitening + primal
+    weights (reference: OcsvmModelData — persisted support vectors)."""
     import jax
     import jax.numpy as jnp
     import optax
@@ -373,13 +512,9 @@ def ocsvm(X: Arr, nu: float = 0.1, gamma: Optional[float] = None,
     K_mm = _rbf(landmarks, landmarks) + 1e-6 * np.eye(m)
     evals, evecs = np.linalg.eigh(K_mm)
     evals = np.maximum(evals, 1e-8)
-    whiten = (evecs / np.sqrt(evals)).astype(np.float32)   # K_mm^{-1/2}
+    whiten = (evecs / np.sqrt(evals)).astype(np.float32)
 
-    def featurize(x):
-        return (_rbf(np.asarray(x, np.float32), landmarks) @ whiten) \
-            .astype(np.float32)
-
-    F = featurize(X)
+    F = (_rbf(X, landmarks) @ whiten).astype(np.float32)
     Z = jnp.asarray(F)
 
     def loss(params):
@@ -405,6 +540,17 @@ def ocsvm(X: Arr, nu: float = 0.1, gamma: Optional[float] = None,
         return p
 
     p = jax.device_get(fit())
-    w, rho = np.asarray(p["w"]), float(p["rho"])
-    score = rho - F @ w                     # >0 = outside the boundary
+    return {"landmarks": landmarks, "whiten": whiten.astype(np.float32),
+            "w": np.asarray(p["w"], np.float32),
+            "rho": np.asarray([float(p["rho"])], np.float32),
+            "gamma": np.asarray([gamma], np.float32)}
+
+
+def ocsvm_score(model: Dict[str, np.ndarray], X: Arr) -> Tuple[Arr, Arr]:
+    X = np.asarray(X, np.float32)
+    landmarks = model["landmarks"]
+    gamma = float(model["gamma"][0])
+    d2 = ((X[:, None, :] - landmarks[None, :, :]) ** 2).sum(-1)
+    F = np.exp(-gamma * d2) @ model["whiten"]
+    score = float(model["rho"][0]) - F @ model["w"]
     return score, score > 0

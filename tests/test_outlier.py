@@ -220,3 +220,137 @@ def test_outlier_stream_twins():
     assert flags.sum() <= 4
     out2 = BoxPlotOutlierStreamOp(selectedCol="v").link_from(src).collect()
     assert np.asarray(out2.col("pred"))[10]
+
+
+def test_cooks_distance_outlier():
+    from alink_tpu.operator.batch import CooksDistanceOutlierBatchOp
+
+    rng = np.random.RandomState(0)
+    X = rng.normal(size=(80, 2))
+    y = X @ [1.0, 2.0] + rng.normal(0, 0.1, 80)
+    X[0] = [6, 6]
+    y[0] = -20  # high-leverage, high-residual point
+    t = MTable({"a": X[:, 0], "b": X[:, 1], "y": y})
+    out = CooksDistanceOutlierBatchOp(
+        featureCols=["a", "b"], labelCol="y",
+        predictionCol="o").link_from(TableSourceBatchOp(t)).collect()
+    assert out.col("o")[0]
+    assert out.col("o").sum() <= 5
+
+
+def test_dbscan_outlier_and_grouped():
+    from alink_tpu.operator.batch import (
+        DbscanOutlier4GroupedDataBatchOp,
+        DbscanOutlierBatchOp,
+    )
+
+    rng = np.random.RandomState(1)
+    X = rng.normal(size=(100, 2))
+    X[0] = [9, 9]
+    t = MTable({"a": X[:, 0], "b": X[:, 1]})
+    out = DbscanOutlierBatchOp(
+        featureCols=["a", "b"],
+        predictionCol="o").link_from(TableSourceBatchOp(t)).collect()
+    assert out.col("o")[0] and out.col("o").sum() <= 5
+    g = MTable({"g": np.repeat(["p", "q"], 50),
+                "a": X[:, 0], "b": X[:, 1]})
+    out = DbscanOutlier4GroupedDataBatchOp(
+        groupCols=["g"], featureCols=["a", "b"],
+        predictionCol="o").link_from(TableSourceBatchOp(g)).collect()
+    assert out.col("o")[0]
+
+
+def test_dtw_outlier():
+    from alink_tpu.operator.batch import DynamicTimeWarpOutlierBatchOp
+
+    x = np.sin(np.arange(200) * 0.3)
+    x[100:110] += 4.0
+    t = MTable({"v": x})
+    out = DynamicTimeWarpOutlierBatchOp(
+        selectedCol="v", seriesLength=10,
+        predictionCol="o").link_from(TableSourceBatchOp(t)).collect()
+    flagged = np.nonzero(out.col("o"))[0]
+    assert len(flagged) > 0
+    assert set(flagged).issubset(set(range(90, 130)))
+
+
+def test_model_outlier_train_predict_roundtrip(tmp_path):
+    """Train on clean data, flag a far point at serving time — the
+    capability the transient detectors can't provide."""
+    from alink_tpu.operator.batch import (
+        IForestModelOutlierPredictBatchOp,
+        IForestModelOutlierTrainBatchOp,
+        OcsvmModelOutlierPredictBatchOp,
+        OcsvmModelOutlierTrainBatchOp,
+    )
+
+    rng = np.random.RandomState(2)
+    train = MTable({"a": rng.normal(size=200), "b": rng.normal(size=200)})
+    test = MTable({"a": np.asarray([0.1, 12.0]),
+                   "b": np.asarray([0.0, 12.0])})
+    for train_op, pred_op in (
+        (IForestModelOutlierTrainBatchOp(featureCols=["a", "b"],
+                                         numTrees=50),
+         IForestModelOutlierPredictBatchOp(predictionCol="o",
+                                           predictionDetailCol="d")),
+        (OcsvmModelOutlierTrainBatchOp(featureCols=["a", "b"], nu=0.05),
+         OcsvmModelOutlierPredictBatchOp(predictionCol="o")),
+    ):
+        m = train_op.link_from(TableSourceBatchOp(train))
+        out = pred_op.link_from(m, TableSourceBatchOp(test)).collect()
+        assert not out.col("o")[0]  # inlier stays clean
+        assert out.col("o")[1]      # far point flagged
+
+
+def test_dbscan_model_family():
+    from alink_tpu.operator.batch import (
+        DbscanModelOutlierPredictBatchOp,
+        DbscanPredictBatchOp,
+        GroupDbscanModelBatchOp,
+    )
+
+    rng = np.random.RandomState(3)
+    a = rng.normal(0, 0.2, size=(40, 2))
+    b = rng.normal(5, 0.2, size=(40, 2))
+    train = MTable({"x": np.r_[a[:, 0], b[:, 0]],
+                    "y": np.r_[a[:, 1], b[:, 1]]})
+    m = GroupDbscanModelBatchOp(featureCols=["x", "y"], epsilon=1.0,
+                                minPoints=4).link_from(
+        TableSourceBatchOp(train))
+    test = MTable({"x": np.asarray([0.0, 5.0, 50.0]),
+                   "y": np.asarray([0.0, 5.0, 50.0])})
+    pred = DbscanPredictBatchOp(predictionCol="c").link_from(
+        m, TableSourceBatchOp(test)).collect()
+    c = pred.col("c")
+    assert c[0] != c[1] and c[0] >= 0 and c[1] >= 0 and c[2] == -1
+    out = DbscanModelOutlierPredictBatchOp(predictionCol="o").link_from(
+        m, TableSourceBatchOp(test)).collect()
+    assert out.col("o").tolist() == [False, False, True]
+
+
+def test_grouped_stream_twins_generated():
+    import alink_tpu.operator.stream as sm
+
+    for n in ("KSigmaOutlier4GroupedDataStreamOp",
+              "BoxPlotOutlier4GroupedDataStreamOp",
+              "CopodOutlier4GroupedDataStreamOp",
+              "EcodOutlier4GroupedDataStreamOp",
+              "EsdOutlier4GroupedDataStreamOp",
+              "HbosOutlier4GroupedDataStreamOp",
+              "IForestOutlier4GroupedDataStreamOp",
+              "OcsvmOutlier4GroupedDataStreamOp",
+              "DbscanOutlier4GroupedDataStreamOp",
+              "DynamicTimeWarpOutlierStreamOp",
+              "SHEsdOutlierStreamOp"):
+        assert hasattr(sm, n), n
+    # a grouped twin actually runs per micro-batch
+    from alink_tpu.operator.stream import TableSourceStreamOp
+
+    rng = np.random.RandomState(4)
+    t = MTable({"g": np.repeat(["p", "q"], 30),
+                "v": np.r_[rng.normal(size=30), rng.normal(10, 1, 30)]})
+    op = sm.KSigmaOutlier4GroupedDataStreamOp(
+        groupCols=["g"], selectedCol="v", predictionCol="o").link_from(
+        TableSourceStreamOp(t, numChunks=2))
+    out = op.collect()
+    assert out.num_rows == 60 and "o" in out.names
