@@ -511,6 +511,8 @@ def _cast_column(col: np.ndarray, type_tag: str) -> np.ndarray:
     if type_tag == AlinkTypes.STRING and col.dtype.kind not in ("U", "S", "O"):
         return col.astype(str)
     if AlinkTypes.is_vector(type_tag) and col.dtype != object:
+        if col.dtype.kind in ("U", "S"):  # string cells (e.g. from_rows
+            return col.astype(object)     # literals) parse lazily
         raise AkIllegalDataException("vector column must be object-dtype")
     if type_tag in _NP_OF_TYPE and col.dtype == object:
         return np.asarray([v for v in col], dtype=_NP_OF_TYPE[type_tag])

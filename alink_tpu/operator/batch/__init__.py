@@ -569,3 +569,15 @@ from .outlier import (
     OcsvmModelOutlierTrainBatchOp,
     SHEsdOutlierBatchOp,
 )
+from .timeseries2 import (
+    AutoGarchBatchOp,
+    DeepARPredictBatchOp,
+    DeepARTrainBatchOp,
+    LSTNetPredictBatchOp,
+    LSTNetTrainBatchOp,
+    LookupRecentDaysBatchOp,
+    LookupValueInTimeSeriesBatchOp,
+    LookupVectorInTimeSeriesBatchOp,
+    ProphetPredictBatchOp,
+    ProphetTrainBatchOp,
+)

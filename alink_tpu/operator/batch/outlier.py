@@ -524,8 +524,13 @@ class _ModelOutlierMapper(ModelMapper, HasPredictionCol,
     def _score(self, X):
         raise NotImplementedError
 
+    def _score_table(self, t: MTable, X):
+        """Hook for mappers that need the table (e.g. group columns);
+        default delegates to the feature-only scorer."""
+        return self._score(X)
+
     def map_table(self, t: MTable) -> MTable:
-        scores, flags = self._score(self._features(t))
+        scores, flags = self._score_table(t, self._features(t))
         add = {self.get(HasPredictionCol.PREDICTION_COL):
                np.asarray(flags, bool)}
         types = {self.get(HasPredictionCol.PREDICTION_COL):
@@ -616,45 +621,30 @@ class DbscanModelOutlierPredictMapper(_ModelOutlierMapper):
 
     EPSILON = ParamInfo("epsilon", float, default=None)
 
+    _CHUNK = 4096
+
     def _min_dist(self, t: MTable, X) -> np.ndarray:
         """Per-row distance to the nearest eligible model point (inf when
         the row's group has no clustered points)."""
         pts = self.arrays["points"]
         X = np.asarray(X)
         mind = np.full(len(X), np.inf)
-        nearest = np.full(len(X), -1, np.int64)
         for rows, pidx in _group_point_index(self.meta, self.arrays, t, X):
             if pidx.size == 0 or rows.size == 0:
                 continue
-            d2 = ((X[rows][:, None, :] - pts[pidx][None, :, :]) ** 2).sum(-1)
-            j = d2.argmin(axis=1)
-            mind[rows] = np.sqrt(d2[np.arange(len(rows)), j])
-            nearest[rows] = pidx[j]
-        return mind, nearest
+            P = pts[pidx]
+            for s0 in range(0, len(rows), self._CHUNK):
+                blk = rows[s0:s0 + self._CHUNK]
+                d2 = ((X[blk][:, None, :] - P[None, :, :]) ** 2).sum(-1)
+                mind[blk] = np.sqrt(d2.min(axis=1))
+        return mind
 
-    def _score(self, X):  # ungrouped fast path (kept for _BaseOutlier API)
-        raise NotImplementedError
-
-    def map_table(self, t: MTable) -> MTable:
-        X = self._features(t)
+    def _score_table(self, t: MTable, X):
         eps = self.get(self.EPSILON)
         if eps is None:
             eps = float(self.meta.get("epsilon", 0.5))
-        mind, _ = self._min_dist(t, X)
-        score = mind / max(eps, 1e-12)
-        flags = score > 1.0
-        add = {self.get(HasPredictionCol.PREDICTION_COL):
-               np.asarray(flags, bool)}
-        types = {self.get(HasPredictionCol.PREDICTION_COL):
-                 AlinkTypes.BOOLEAN}
-        detail_col = self.get(HasPredictionDetailCol.PREDICTION_DETAIL_COL)
-        if detail_col:
-            add[detail_col] = np.asarray(
-                [json.dumps({"outlier_score": round(float(s), 6)
-                             if np.isfinite(s) else None})
-                 for s in score], object)
-            types[detail_col] = AlinkTypes.STRING
-        return self._append_result(t, add, types)
+        score = self._min_dist(t, X) / max(eps, 1e-12)
+        return score, score > 1.0
 
 
 def _group_point_index(meta, arrays, t: MTable, X):
@@ -778,6 +768,8 @@ class DbscanPredictMapper(_ModelOutlierMapper):
             input_schema, [self.get(HasPredictionCol.PREDICTION_COL)],
             [AlinkTypes.LONG])
 
+    _CHUNK = 4096
+
     def map_table(self, t: MTable) -> MTable:
         labels = self.arrays["labels"]
         eps = float(self.meta["epsilon"])
@@ -787,10 +779,14 @@ class DbscanPredictMapper(_ModelOutlierMapper):
         for rows, pidx in _group_point_index(self.meta, self.arrays, t, X):
             if pidx.size == 0 or rows.size == 0:
                 continue
-            d2 = ((X[rows][:, None, :] - pts[pidx][None, :, :]) ** 2).sum(-1)
-            j = d2.argmin(axis=1)
-            mind = np.sqrt(d2[np.arange(len(rows)), j])
-            out[rows] = np.where(mind <= eps, labels[pidx[j]], -1)
+            P = pts[pidx]
+            lab = labels[pidx]
+            for s0 in range(0, len(rows), self._CHUNK):
+                blk = rows[s0:s0 + self._CHUNK]
+                d2 = ((X[blk][:, None, :] - P[None, :, :]) ** 2).sum(-1)
+                j = d2.argmin(axis=1)
+                mind = np.sqrt(d2[np.arange(len(blk)), j])
+                out[blk] = np.where(mind <= eps, lab[j], -1)
         oc = self.get(HasPredictionCol.PREDICTION_COL)
         return self._append_result(t, {oc: out}, {oc: AlinkTypes.LONG})
 

@@ -577,56 +577,16 @@ class DeepARBatchOp(_BaseForecastOp):
         return ["sigma"]
 
     def _fit_forecast(self, y: np.ndarray, horizon: int):
-        import flax.linen as nn
-        import jax
-        import jax.numpy as jnp
+        from .timeseries2 import deepar_train, net_forecast
 
-        from ...dl.train import TrainConfig, train_model
-
-        if len(y) < 8:
-            raise AkIllegalArgumentException(
-                f"DeepAR needs at least 8 observations per series, got "
-                f"{len(y)}")
-        L = min(self.get(self.LOOKBACK), max(len(y) - 1, 2))
-        mu_y, sd_y = float(np.mean(y)), float(np.std(y) + 1e-9)
-        z = (np.asarray(y, np.float32) - mu_y) / sd_y
-        windows, targets = [], []
-        for s in range(len(z) - L):
-            windows.append(z[s:s + L])
-            targets.append(z[s + L])
-        X = np.asarray(windows, np.float32)[..., None]   # (n, L, 1)
-        t = np.asarray(targets, np.float32)
-
-        hidden = self.get(self.HIDDEN)
-
-        class Net(nn.Module):
-            @nn.compact
-            def __call__(self, x, deterministic=True):
-                h = nn.RNN(nn.OptimizedLSTMCell(hidden))(x)[:, -1, :]
-                return nn.Dense(2)(h)
-
-        cfg = TrainConfig(num_epochs=self.get(self.NUM_EPOCHS),
-                          batch_size=self.get(self.BATCH_SIZE),
-                          learning_rate=self.get(self.LEARNING_RATE),
-                          loss="gaussian_nll", seed=self.get(self.RANDOM_SEED))
-        net = Net()
-        params, _ = train_model(net, {"x": X}, t, cfg, regression=True,
-                                seq_axis=None)
-
-        @jax.jit
-        def predict(params, window):
-            return net.apply(params, window[None], deterministic=True)[0]
-
-        window = z[-L:].copy()
-        means, sigmas = [], []
-        for _ in range(horizon):
-            out = np.asarray(jax.device_get(
-                predict(params, jnp.asarray(window[..., None]))))
-            mu, log_sigma = float(out[0]), float(out[1])
-            means.append(mu * sd_y + mu_y)
-            sigmas.append(float(np.exp(log_sigma)) * sd_y)
-            window = np.concatenate([window[1:], [mu]])
-        return np.asarray(means), sigmas[0]
+        model = deepar_train(
+            y, lookback=self.get(self.LOOKBACK),
+            hidden=self.get(self.HIDDEN),
+            num_epochs=self.get(self.NUM_EPOCHS),
+            batch_size=self.get(self.BATCH_SIZE),
+            learning_rate=self.get(self.LEARNING_RATE),
+            seed=self.get(self.RANDOM_SEED))
+        return net_forecast(model, y, horizon)
 
     def _forecast(self, y: np.ndarray, horizon: int) -> np.ndarray:
         # the base loop calls _forecast then _extra_outputs for each series:
@@ -660,61 +620,19 @@ class LSTNetBatchOp(_BaseForecastOp):
     RANDOM_SEED = ParamInfo("randomSeed", int, default=0, aliases=("seed",))
 
     def _forecast(self, y: np.ndarray, horizon: int) -> np.ndarray:
-        import flax.linen as nn
-        import jax
-        import jax.numpy as jnp
+        from .timeseries2 import lstnet_train, net_forecast
 
-        from ...dl.train import TrainConfig, train_model
-
-        if len(y) < 12:
-            raise AkIllegalArgumentException(
-                f"LSTNet needs at least 12 observations, got {len(y)}")
-        L = min(self.get(self.LOOKBACK), max(len(y) - 1, 4))
-        mu_y, sd_y = float(np.mean(y)), float(np.std(y) + 1e-9)
-        z = (np.asarray(y, np.float64) - mu_y) / sd_y
-        z32 = z.astype(np.float32)
-        X = np.stack([z32[s:s + L] for s in range(len(z) - L)])[..., None]
-        t = z32[L:]
-
-        hidden = self.get(self.HIDDEN)
-        kernel = self.get(self.KERNEL_SIZE)
-        skip = max(1, min(self.get(self.SKIP), L - 1))
-        ar_w = max(1, min(self.get(self.AR_WINDOW), L))
-
-        class Net(nn.Module):
-            @nn.compact
-            def __call__(self, x, deterministic=True):  # (b, L, 1)
-                c = nn.relu(nn.Conv(hidden, (kernel,))(x))   # (b, L, h)
-                r = nn.RNN(nn.GRUCell(hidden))(c)[:, -1, :]
-                # skip recurrence: last-aligned every-skip-th timestep
-                sk = c[:, (c.shape[1] - 1) % skip::skip, :]
-                sk = nn.RNN(nn.GRUCell(hidden // 2))(sk)[:, -1, :]
-                out = nn.Dense(1)(jnp.concatenate([r, sk], -1))
-                ar = nn.Dense(1)(x[:, -ar_w:, 0])   # highway AR
-                return out + ar                      # (b, 1) — mse squeezes
-
-        cfg = TrainConfig(num_epochs=self.get(self.NUM_EPOCHS),
-                          batch_size=self.get(self.BATCH_SIZE),
-                          learning_rate=self.get(self.LEARNING_RATE),
-                          loss="mse", seed=self.get(self.RANDOM_SEED))
-        net = Net()
-        params, _ = train_model(net, {"x": X}, t, cfg, regression=True,
-                                seq_axis=None)
-
-        @jax.jit
-        def predict(params, window):
-            return net.apply(params, window[None],
-                             deterministic=True)[0, 0]
-
-        window = z32[-L:].copy()
-        preds = []
-        for _ in range(horizon):
-            nxt = float(jax.device_get(predict(
-                params, jnp.asarray(window[..., None]))))
-            preds.append(nxt)
-            window = np.roll(window, -1)
-            window[-1] = nxt
-        return np.asarray(preds, np.float64) * sd_y + mu_y
+        model = lstnet_train(
+            y, lookback=self.get(self.LOOKBACK),
+            hidden=self.get(self.HIDDEN),
+            kernel=self.get(self.KERNEL_SIZE), skip=self.get(self.SKIP),
+            ar_window=self.get(self.AR_WINDOW),
+            num_epochs=self.get(self.NUM_EPOCHS),
+            batch_size=self.get(self.BATCH_SIZE),
+            learning_rate=self.get(self.LEARNING_RATE),
+            seed=self.get(self.RANDOM_SEED))
+        means, _ = net_forecast(model, y, horizon)
+        return means
 
 
 class ProphetBatchOp(_BaseForecastOp):

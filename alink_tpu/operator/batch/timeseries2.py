@@ -1,0 +1,747 @@
+"""Timeseries long-tail: DeepAR/LSTNet/Prophet train+predict pairs,
+AutoGarch order search, and in-series lookup ops.
+
+Capability parity (reference: operator/batch/timeseries/
+DeepARTrainBatchOp.java / DeepARPredictBatchOp.java,
+LSTNetTrainBatchOp.java / LSTNetPredictBatchOp.java,
+ProphetTrainBatchOp.java / ProphetPredictBatchOp.java,
+AutoGarchBatchOp.java, dataproc/LookupValueInTimeSeriesBatchOp.java,
+LookupVectorInTimeSeriesBatchOp.java, LookupRecentDaysBatchOp.java; the
+stream twins live in operator/stream/timeseries of the reference).
+
+The reference trains these nets through the akdl DLLauncher subprocess and
+persists TF checkpoints; here the SAME flax modules the direct forecast ops
+use are trained in-process and the parameter pytree is persisted with flax
+serialization inside the standard model table, so predict mappers (and
+their auto-generated stream twins) serve them anywhere.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ...common.exceptions import (
+    AkIllegalArgumentException,
+    AkIllegalDataException,
+)
+from ...common.linalg import DenseVector, parse_vector
+from ...common.model import model_to_table, table_to_model
+from ...common.mtable import AlinkTypes, MTable
+from ...common.params import MinValidator, ParamInfo
+from ...mapper import (
+    HasOutputCol,
+    HasReservedCols,
+    HasSelectedCol,
+    ModelMapper,
+    SISOMapper,
+)
+from .base import BatchOperator
+from .utils import MapBatchOp, ModelMapBatchOp, ModelTrainOpMixin
+from .timeseries import _BaseForecastOp
+
+
+# ---------------------------------------------------------------------------
+# shared flax-net cores (used by the direct ops AND the train/predict pairs)
+# ---------------------------------------------------------------------------
+
+
+def _deepar_net(hidden: int):
+    import flax.linen as nn
+
+    class Net(nn.Module):
+        @nn.compact
+        def __call__(self, x, deterministic=True):
+            h = nn.RNN(nn.OptimizedLSTMCell(hidden))(x)[:, -1, :]
+            return nn.Dense(2)(h)
+
+    return Net()
+
+
+def _lstnet_net(hidden: int, kernel: int, skip: int, ar_w: int):
+    import flax.linen as nn
+    import jax.numpy as jnp
+
+    class Net(nn.Module):
+        @nn.compact
+        def __call__(self, x, deterministic=True):  # (b, L, 1)
+            c = nn.relu(nn.Conv(hidden, (kernel,))(x))
+            r = nn.RNN(nn.GRUCell(hidden))(c)[:, -1, :]
+            sk = c[:, (c.shape[1] - 1) % skip::skip, :]
+            sk = nn.RNN(nn.GRUCell(hidden // 2))(sk)[:, -1, :]
+            out = nn.Dense(1)(jnp.concatenate([r, sk], -1))
+            ar = nn.Dense(1)(x[:, -ar_w:, 0])
+            return out + ar
+
+    return Net()
+
+
+def _train_windows(z: np.ndarray, L: int):
+    X = np.stack([z[s:s + L] for s in range(len(z) - L)])[..., None]
+    return X.astype(np.float32), z[L:].astype(np.float32)
+
+
+def deepar_train(y: np.ndarray, *, lookback: int, hidden: int,
+                 num_epochs: int, batch_size: int, learning_rate: float,
+                 seed: int) -> Dict:
+    """Fit the DeepAR net; returns the serializable model dict."""
+    from flax import serialization
+
+    from ...dl.train import TrainConfig, train_model
+
+    if len(y) < 8:
+        raise AkIllegalArgumentException(
+            f"DeepAR needs at least 8 observations, got {len(y)}")
+    L = min(lookback, max(len(y) - 1, 2))
+    mu_y, sd_y = float(np.mean(y)), float(np.std(y) + 1e-9)
+    z = (np.asarray(y, np.float64) - mu_y) / sd_y
+    X, t = _train_windows(z, L)
+    net = _deepar_net(hidden)
+    cfg = TrainConfig(num_epochs=num_epochs, batch_size=batch_size,
+                      learning_rate=learning_rate, loss="gaussian_nll",
+                      seed=seed)
+    params, _ = train_model(net, {"x": X}, t, cfg, regression=True,
+                            seq_axis=None)
+    return {"kind": "deepar", "L": L, "hidden": hidden,
+            "mu": mu_y, "sd": sd_y,
+            "params_bytes": np.frombuffer(
+                serialization.to_bytes(params), np.uint8).copy()}
+
+
+def lstnet_train(y: np.ndarray, *, lookback: int, hidden: int,
+                 kernel: int, skip: int, ar_window: int, num_epochs: int,
+                 batch_size: int, learning_rate: float, seed: int) -> Dict:
+    from flax import serialization
+
+    from ...dl.train import TrainConfig, train_model
+
+    if len(y) < 12:
+        raise AkIllegalArgumentException(
+            f"LSTNet needs at least 12 observations, got {len(y)}")
+    L = min(lookback, max(len(y) - 1, 4))
+    mu_y, sd_y = float(np.mean(y)), float(np.std(y) + 1e-9)
+    z = (np.asarray(y, np.float64) - mu_y) / sd_y
+    X, t = _train_windows(z, L)
+    skip = max(1, min(skip, L - 1))
+    ar_w = max(1, min(ar_window, L))
+    net = _lstnet_net(hidden, kernel, skip, ar_w)
+    cfg = TrainConfig(num_epochs=num_epochs, batch_size=batch_size,
+                      learning_rate=learning_rate, loss="mse", seed=seed)
+    params, _ = train_model(net, {"x": X}, t, cfg, regression=True,
+                            seq_axis=None)
+    return {"kind": "lstnet", "L": L, "hidden": hidden, "kernel": kernel,
+            "skip": skip, "arWindow": ar_w, "mu": mu_y, "sd": sd_y,
+            "params_bytes": np.frombuffer(
+                serialization.to_bytes(params), np.uint8).copy()}
+
+
+def _restore_net(model: Dict):
+    import jax
+    import jax.numpy as jnp
+    from flax import serialization
+
+    L = int(model["L"])
+    if model["kind"] == "deepar":
+        net = _deepar_net(int(model["hidden"]))
+    else:
+        net = _lstnet_net(int(model["hidden"]), int(model["kernel"]),
+                          int(model["skip"]), int(model["arWindow"]))
+    template = net.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, L, 1), jnp.float32))
+    params = serialization.from_bytes(
+        template, bytes(np.asarray(model["params_bytes"], np.uint8)))
+    return net, params
+
+
+def net_forecast(model: Dict, y_hist: np.ndarray, horizon: int
+                 ) -> Tuple[np.ndarray, Optional[float]]:
+    """Roll the restored net forward ``horizon`` steps from the end of
+    ``y_hist``. Returns (mean path, sigma of the first step for deepar)."""
+    import jax
+    import jax.numpy as jnp
+
+    net, params = _restore_net(model)
+    L = int(model["L"])
+    mu_y, sd_y = float(model["mu"]), float(model["sd"])
+    z = ((np.asarray(y_hist, np.float64) - mu_y) / sd_y).astype(np.float32)
+    if len(z) < L:
+        z = np.concatenate([np.zeros(L - len(z), np.float32), z])
+    window = z[-L:].copy()
+
+    @jax.jit
+    def predict(p, w):
+        return net.apply(p, w[None], deterministic=True)[0]
+
+    means: List[float] = []
+    sigma0: Optional[float] = None
+    for step in range(horizon):
+        out = np.asarray(jax.device_get(
+            predict(params, jnp.asarray(window[..., None]))))
+        if model["kind"] == "deepar":
+            mu, log_sigma = float(out[0]), float(out[1])
+            if step == 0:
+                sigma0 = float(np.exp(log_sigma)) * sd_y
+        else:
+            mu = float(np.asarray(out).reshape(-1)[0])
+        means.append(mu * sd_y + mu_y)
+        window = np.roll(window, -1)
+        window[-1] = mu
+    return np.asarray(means, np.float64), sigma0
+
+
+# ---------------------------------------------------------------------------
+# train ops
+# ---------------------------------------------------------------------------
+
+
+class _NetForecastTrainOp(ModelTrainOpMixin, BatchOperator):
+    VALUE_COL = ParamInfo("valueCol", str, optional=False,
+                          aliases=("selectedCol",))
+    LOOKBACK = ParamInfo("lookback", int, default=24,
+                         validator=MinValidator(2))
+    HIDDEN = ParamInfo("hiddenSize", int, default=32)
+    NUM_EPOCHS = ParamInfo("numEpochs", int, default=40)
+    BATCH_SIZE = ParamInfo("batchSize", int, default=64)
+    LEARNING_RATE = ParamInfo("learningRate", float, default=5e-3)
+    RANDOM_SEED = ParamInfo("randomSeed", int, default=0, aliases=("seed",))
+
+    _min_inputs = 1
+    _max_inputs = 1
+    _model_name = None
+
+    def _static_meta_keys(self, in_schema):
+        return {"modelName": self._model_name}
+
+    def _train(self, y: np.ndarray) -> Dict:
+        raise NotImplementedError
+
+    def _execute_impl(self, t: MTable) -> MTable:
+        y = np.asarray(t.col(self.get(self.VALUE_COL)), np.float64)
+        model = self._train(y)
+        arrays = {"params_bytes": model.pop("params_bytes")}
+        meta = {"modelName": self._model_name, **model}
+        return model_to_table(meta, arrays)
+
+
+class DeepARTrainBatchOp(_NetForecastTrainOp):
+    """(reference: operator/batch/timeseries/DeepARTrainBatchOp.java — the
+    akdl deepar estimator behind DLLauncher)."""
+
+    _model_name = "DeepARModel"
+
+    def _train(self, y):
+        return deepar_train(
+            y, lookback=self.get(self.LOOKBACK),
+            hidden=self.get(self.HIDDEN),
+            num_epochs=self.get(self.NUM_EPOCHS),
+            batch_size=self.get(self.BATCH_SIZE),
+            learning_rate=self.get(self.LEARNING_RATE),
+            seed=self.get(self.RANDOM_SEED))
+
+
+class LSTNetTrainBatchOp(_NetForecastTrainOp):
+    """(reference: operator/batch/timeseries/LSTNetTrainBatchOp.java)."""
+
+    _model_name = "LSTNetModel"
+
+    KERNEL_SIZE = ParamInfo("kernelSize", int, default=3)
+    SKIP = ParamInfo("skip", int, default=4)
+    AR_WINDOW = ParamInfo("arWindow", int, default=8)
+
+    def _train(self, y):
+        return lstnet_train(
+            y, lookback=self.get(self.LOOKBACK),
+            hidden=self.get(self.HIDDEN),
+            kernel=self.get(self.KERNEL_SIZE), skip=self.get(self.SKIP),
+            ar_window=self.get(self.AR_WINDOW),
+            num_epochs=self.get(self.NUM_EPOCHS),
+            batch_size=self.get(self.BATCH_SIZE),
+            learning_rate=self.get(self.LEARNING_RATE),
+            seed=self.get(self.RANDOM_SEED))
+
+
+# ---------------------------------------------------------------------------
+# predict mappers/ops
+# ---------------------------------------------------------------------------
+
+
+class _NetForecastPredictMapper(ModelMapper, HasSelectedCol, HasOutputCol,
+                                HasReservedCols):
+    """Each row's history (vector or MTable series cell) → forecast vector
+    (reference: DeepARPredictBatchOp.java over the persisted checkpoint)."""
+
+    PREDICT_NUM = ParamInfo("predictNum", int, default=12,
+                            validator=MinValidator(1))
+
+    def load_model(self, model: MTable):
+        self.meta, arrays = table_to_model(model)
+        self.model = dict(self.meta)
+        self.model["params_bytes"] = arrays["params_bytes"]
+        return self
+
+    def output_schema(self, input_schema):
+        out = self.get(HasOutputCol.OUTPUT_COL) or "forecast"
+        return self._append_result_schema(
+            input_schema, [out], [AlinkTypes.DENSE_VECTOR])
+
+    @staticmethod
+    def _history(cell) -> np.ndarray:
+        if isinstance(cell, MTable):
+            # last numeric column is the value series
+            for name, tp in zip(reversed(cell.names),
+                                reversed(list(cell.schema.types))):
+                if AlinkTypes.is_numeric(tp):
+                    return np.asarray(cell.col(name), np.float64)
+            raise AkIllegalDataException("series MTable has no numeric col")
+        return parse_vector(cell).to_dense().data
+
+    def map_table(self, t: MTable) -> MTable:
+        sel = self.get(HasSelectedCol.SELECTED_COL)
+        out = self.get(HasOutputCol.OUTPUT_COL) or "forecast"
+        horizon = self.get(self.PREDICT_NUM)
+        vecs = np.empty(t.num_rows, object)
+        for i, cell in enumerate(t.col(sel)):
+            if cell is None:
+                vecs[i] = None
+                continue
+            means, _sigma = net_forecast(self.model, self._history(cell),
+                                         horizon)
+            vecs[i] = DenseVector(means)
+        return self._append_result(
+            t, {out: vecs}, {out: AlinkTypes.DENSE_VECTOR})
+
+
+class DeepARPredictBatchOp(ModelMapBatchOp, HasSelectedCol, HasOutputCol,
+                           HasReservedCols):
+    """(reference: operator/batch/timeseries/DeepARPredictBatchOp.java)"""
+
+    mapper_cls = _NetForecastPredictMapper
+    PREDICT_NUM = _NetForecastPredictMapper.PREDICT_NUM
+
+
+class LSTNetPredictBatchOp(DeepARPredictBatchOp):
+    """(reference: operator/batch/timeseries/LSTNetPredictBatchOp.java)"""
+
+
+# ---------------------------------------------------------------------------
+# Prophet train/predict (plugin-gated like the direct op)
+# ---------------------------------------------------------------------------
+
+
+class ProphetTrainBatchOp(ModelTrainOpMixin, BatchOperator):
+    """Fit prophet once and persist its JSON model (reference:
+    operator/batch/timeseries/ProphetTrainBatchOp.java — the python
+    subprocess plugin collapses to an in-process fit)."""
+
+    VALUE_COL = ParamInfo("valueCol", str, optional=False,
+                          aliases=("selectedCol",))
+    FREQ = ParamInfo("freq", str, default="D")
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    def _static_meta_keys(self, in_schema):
+        return {"modelName": "ProphetModel"}
+
+    def _execute_impl(self, t: MTable) -> MTable:
+        try:
+            from prophet import Prophet
+            from prophet.serialize import model_to_json
+        except ImportError as e:
+            from ...common.exceptions import AkPluginNotExistException
+
+            raise AkPluginNotExistException(
+                "ProphetTrainBatchOp needs the 'prophet' package: "
+                "pip install prophet. Built-in alternatives: "
+                "AutoArimaBatchOp, DeepARTrainBatchOp, "
+                "LSTNetTrainBatchOp.") from e
+        import pandas as pd
+
+        y = np.asarray(t.col(self.get(self.VALUE_COL)), np.float64)
+        freq = self.get(self.FREQ)
+        ds = pd.date_range("2000-01-01", periods=len(y), freq=freq)
+        m = Prophet()
+        m.fit(pd.DataFrame({"ds": ds, "y": y}))
+        payload = model_to_json(m).encode()
+        meta = {"modelName": "ProphetModel", "freq": freq,
+                "numObservations": int(len(y))}
+        return model_to_table(
+            meta, {"model_json": np.frombuffer(payload, np.uint8).copy()})
+
+
+class ProphetPredictMapper(ModelMapper, HasSelectedCol, HasOutputCol,
+                           HasReservedCols):
+    PREDICT_NUM = ParamInfo("predictNum", int, default=12,
+                            validator=MinValidator(1))
+
+    def load_model(self, model: MTable):
+        self.meta, arrays = table_to_model(model)
+        self._json = bytes(np.asarray(arrays["model_json"],
+                                      np.uint8)).decode()
+        return self
+
+    def output_schema(self, input_schema):
+        out = self.get(HasOutputCol.OUTPUT_COL) or "forecast"
+        return self._append_result_schema(
+            input_schema, [out], [AlinkTypes.DENSE_VECTOR])
+
+    @staticmethod
+    def _row_series(cell) -> "np.ndarray | None":
+        if cell is None:
+            return None
+        if isinstance(cell, MTable):
+            for name, tp in zip(reversed(cell.names),
+                                reversed(list(cell.schema.types))):
+                if AlinkTypes.is_numeric(tp):
+                    return np.asarray(cell.col(name), np.float64)
+            return None
+        return parse_vector(cell).to_dense().data
+
+    def map_table(self, t: MTable) -> MTable:
+        try:
+            from prophet import Prophet
+            from prophet.serialize import model_from_json
+        except ImportError as e:
+            from ...common.exceptions import AkPluginNotExistException
+
+            raise AkPluginNotExistException(
+                "ProphetPredictBatchOp needs the 'prophet' package") from e
+        import pandas as pd
+
+        horizon = self.get(self.PREDICT_NUM)
+        freq = self.meta["freq"]
+        out = self.get(HasOutputCol.OUTPUT_COL) or "forecast"
+        sel = self.get(HasSelectedCol.SELECTED_COL)
+        cells = t.col(sel) if sel else [None] * t.num_rows
+        trained_fc = None
+        vecs = np.empty(t.num_rows, object)
+        for i in range(t.num_rows):
+            y = self._row_series(cells[i]) if sel else None
+            if y is not None and len(y) >= 2:
+                # per-row refit on the row's own series — the reference
+                # runs prophet per mapper row
+                ds = pd.date_range("2000-01-01", periods=len(y), freq=freq)
+                m = Prophet()
+                m.fit(pd.DataFrame({"ds": ds, "y": y}))
+                future = m.make_future_dataframe(periods=horizon, freq=freq)
+                fc = m.predict(future)["yhat"].to_numpy()[-horizon:]
+            else:
+                # no per-row series: continue the TRAINING series
+                if trained_fc is None:
+                    m = model_from_json(self._json)
+                    future = m.make_future_dataframe(periods=horizon,
+                                                     freq=freq)
+                    trained_fc = m.predict(
+                        future)["yhat"].to_numpy()[-horizon:]
+                fc = trained_fc
+            vecs[i] = DenseVector(np.asarray(fc, np.float64))
+        return self._append_result(
+            t, {out: vecs}, {out: AlinkTypes.DENSE_VECTOR})
+
+
+class ProphetPredictBatchOp(ModelMapBatchOp, HasSelectedCol, HasOutputCol,
+                            HasReservedCols):
+    """(reference: operator/batch/timeseries/ProphetPredictBatchOp.java)"""
+
+    mapper_cls = ProphetPredictMapper
+    PREDICT_NUM = ProphetPredictMapper.PREDICT_NUM
+
+
+# ---------------------------------------------------------------------------
+# AutoGarch: (p, q) order search by AIC
+# ---------------------------------------------------------------------------
+
+
+def _garch_fit_pq(r: np.ndarray, p: int, q: int
+                  ) -> Tuple[float, np.ndarray, np.ndarray, float]:
+    """CSS fit of GARCH(p, q): h_t = ω + Σ α_i r²_{t-i} + Σ β_j h_{t-j}.
+    Returns (nll, alphas, betas, omega). p, q are static (compile per
+    order), the lag recursions ride one lax.scan."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    rj = jnp.asarray(r, jnp.float32)
+    var0 = float(np.var(r)) + 1e-8
+    m = max(p, q, 1)
+
+    def unpack(params):
+        omega = jax.nn.softplus(params[0]) * var0 * 0.1
+        alphas = jax.nn.sigmoid(params[1:1 + p]) * (0.5 / max(p, 1))
+        betas = jax.nn.sigmoid(params[1 + p:1 + p + q]) / max(q, 1)
+        return omega, alphas, betas
+
+    def nll(params):
+        omega, alphas, betas = unpack(params)
+
+        def step(carry, t):
+            h_hist, r2_hist = carry  # (m,), (m,) most-recent-first
+            h_new = omega
+            for i in range(p):
+                h_new = h_new + alphas[i] * r2_hist[i]
+            for j in range(q):
+                h_new = h_new + betas[j] * h_hist[j]
+            loss = 0.5 * (jnp.log(h_new) + rj[t] ** 2 / h_new)
+            h_hist = jnp.concatenate([h_new[None], h_hist[:-1]])
+            r2_hist = jnp.concatenate([rj[t][None] ** 2, r2_hist[:-1]])
+            return (h_hist, r2_hist), loss
+
+        h0 = jnp.full((m,), var0, jnp.float32)
+        r20 = jnp.full((m,), var0, jnp.float32)
+        _, losses = jax.lax.scan(step, (h0, r20),
+                                 jnp.arange(m, len(r)))
+        return losses.sum()
+
+    opt = optax.adam(0.05)
+
+    @jax.jit
+    def fit(p0):
+        s0 = opt.init(p0)
+
+        def body(_, carry):
+            pp, ss = carry
+            g = jax.grad(nll)(pp)
+            upd, ss = opt.update(g, ss)
+            return optax.apply_updates(pp, upd), ss
+
+        return jax.lax.fori_loop(0, 300, body, (p0, s0))[0]
+
+    import jax.numpy as jnp2
+
+    params = np.asarray(jax.device_get(
+        fit(jnp2.zeros(1 + p + q, jnp2.float32))))
+    final_nll = float(nll(jnp2.asarray(params)))
+    import jax as _jax
+
+    omega, alphas, betas = (np.asarray(_jax.device_get(x))
+                            for x in unpack(jnp2.asarray(params)))
+    return final_nll, np.atleast_1d(alphas), np.atleast_1d(betas), float(omega)
+
+
+class AutoGarchBatchOp(_BaseForecastOp):
+    """GARCH with (p, q) order search by AIC over a small grid — the
+    reference's headline auto-order op (reference: operator/batch/
+    timeseries/AutoGarchBatchOp.java)."""
+
+    MAX_ORDER = ParamInfo("maxOrder", int, default=2,
+                          validator=MinValidator(1))
+
+    def _extra_schema_keys(self):
+        return ["p", "q", "aic"]
+
+    def _fit(self, y: np.ndarray):
+        key = (y.tobytes(), y.shape[0])
+        cached = getattr(self, "_fit_cache", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        r = y - y.mean()
+        best = None
+        mo = int(self.get(self.MAX_ORDER))
+        for p in range(1, mo + 1):
+            for q in range(0, mo + 1):
+                nll, alphas, betas, omega = _garch_fit_pq(r, p, q)
+                k = 1 + p + q
+                aic = 2 * k + 2 * nll
+                if best is None or aic < best["aic"]:
+                    best = {"p": p, "q": q, "aic": aic, "nll": nll,
+                            "alphas": alphas, "betas": betas,
+                            "omega": omega, "r": r}
+        self._fit_cache = (key, best)
+        return best
+
+    def _forecast(self, y: np.ndarray, horizon: int) -> np.ndarray:
+        fit = self._fit(y)
+        r = fit["r"]
+        p, q = fit["p"], fit["q"]
+        omega, alphas, betas = fit["omega"], fit["alphas"], fit["betas"]
+        m = max(p, q, 1)
+        # reconstruct conditional variances to seed the forecast recursion
+        var0 = float(np.var(r)) + 1e-8
+        h_hist = [var0] * m
+        r2_hist = [var0] * m
+        for t in range(m, len(r)):
+            h_new = omega
+            for i in range(p):
+                h_new += alphas[i] * r2_hist[i]
+            for j in range(q):
+                h_new += betas[j] * h_hist[j]
+            h_hist = [h_new] + h_hist[:-1]
+            r2_hist = [float(r[t] ** 2)] + r2_hist[:-1]
+        out = []
+        for _ in range(horizon):
+            h_new = omega
+            for i in range(p):
+                h_new += alphas[i] * r2_hist[i]
+            for j in range(q):
+                h_new += betas[j] * h_hist[j]
+            out.append(h_new)
+            h_hist = [h_new] + h_hist[:-1]
+            r2_hist = [h_new] + r2_hist[:-1]  # E[r²] = h
+        return np.sqrt(np.asarray(out, np.float64))
+
+    def _extra_outputs(self, y: np.ndarray):
+        fit = self._fit(y)
+        return {"p": float(fit["p"]), "q": float(fit["q"]),
+                "aic": float(fit["aic"])}
+
+
+# ---------------------------------------------------------------------------
+# lookup in timeseries
+# ---------------------------------------------------------------------------
+
+
+def _series_cell(cell) -> Tuple[np.ndarray, MTable]:
+    if not isinstance(cell, MTable):
+        raise AkIllegalDataException(
+            "timeseries lookup expects an MTable series cell "
+            "(time column + value column)")
+    times = np.asarray(cell.col(cell.names[0]))
+    return times, cell
+
+
+def _parse_time(v):
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return np.datetime64(str(v)).astype("datetime64[s]").astype(float)
+
+
+class LookupValueInTimeSeriesMapper(SISOMapper):
+    """Row time → value at (or latest before) that time in the row's series
+    cell (reference: operator/common/timeseries/
+    LookupValueInTimeSeriesMapper.java)."""
+
+    TIME_COL = ParamInfo("timeCol", str, optional=False)
+
+    def map_table(self, t: MTable) -> MTable:
+        sel = self.get(HasSelectedCol.SELECTED_COL)
+        out = self.get(HasOutputCol.OUTPUT_COL) or "lookup_value"
+        time_col = self.get(self.TIME_COL)
+        res = np.full(t.num_rows, np.nan)
+        for i in range(t.num_rows):
+            cell = t.col(sel)[i]
+            if cell is None:
+                continue
+            times, series = _series_cell(cell)
+            tv = _parse_time(t.col(time_col)[i])
+            ts = np.asarray([_parse_time(x) for x in times])
+            value_col = series.names[-1]
+            mask = ts <= tv
+            if mask.any():
+                res[i] = float(np.asarray(
+                    series.col(value_col))[mask][np.argmax(ts[mask])])
+        return self._append_result(
+            t, {out: res}, {out: AlinkTypes.DOUBLE})
+
+    def output_schema(self, input_schema):
+        out = self.get(HasOutputCol.OUTPUT_COL) or "lookup_value"
+        return self._append_result_schema(input_schema, [out],
+                                          [AlinkTypes.DOUBLE])
+
+    def map_column(self, values, type_tag):  # SISOMapper API unused
+        raise NotImplementedError
+
+
+class LookupValueInTimeSeriesBatchOp(MapBatchOp, HasSelectedCol,
+                                     HasOutputCol, HasReservedCols):
+    """(reference: operator/batch/dataproc/
+    LookupValueInTimeSeriesBatchOp.java)"""
+
+    mapper_cls = LookupValueInTimeSeriesMapper
+    TIME_COL = LookupValueInTimeSeriesMapper.TIME_COL
+
+
+class LookupVectorInTimeSeriesMapper(LookupValueInTimeSeriesMapper):
+    """Same lookup, vector-valued series (reference: operator/common/
+    timeseries/LookupVectorInTimeSeriesMapper.java)."""
+
+    def map_table(self, t: MTable) -> MTable:
+        sel = self.get(HasSelectedCol.SELECTED_COL)
+        out = self.get(HasOutputCol.OUTPUT_COL) or "lookup_vector"
+        time_col = self.get(self.TIME_COL)
+        res = np.empty(t.num_rows, object)
+        for i in range(t.num_rows):
+            cell = t.col(sel)[i]
+            if cell is None:
+                res[i] = None
+                continue
+            times, series = _series_cell(cell)
+            tv = _parse_time(t.col(time_col)[i])
+            ts = np.asarray([_parse_time(x) for x in times])
+            value_col = series.names[-1]
+            mask = ts <= tv
+            if mask.any():
+                v = np.asarray(
+                    series.col(value_col), object)[mask][np.argmax(ts[mask])]
+                res[i] = parse_vector(v)
+            else:
+                res[i] = None
+        return self._append_result(
+            t, {out: res}, {out: AlinkTypes.DENSE_VECTOR})
+
+    def output_schema(self, input_schema):
+        out = self.get(HasOutputCol.OUTPUT_COL) or "lookup_vector"
+        return self._append_result_schema(input_schema, [out],
+                                          [AlinkTypes.DENSE_VECTOR])
+
+
+class LookupVectorInTimeSeriesBatchOp(MapBatchOp, HasSelectedCol,
+                                      HasOutputCol, HasReservedCols):
+    """(reference: operator/batch/dataproc/
+    LookupVectorInTimeSeriesBatchOp.java)"""
+
+    mapper_cls = LookupVectorInTimeSeriesMapper
+    TIME_COL = LookupVectorInTimeSeriesMapper.TIME_COL
+
+
+class LookupRecentDaysMapper(SISOMapper):
+    """Aggregate the last N days of the row's series before the row time:
+    count/sum/mean/min/max as a stat vector (reference: operator/batch/
+    dataproc/LookupRecentDaysBatchOp.java)."""
+
+    TIME_COL = ParamInfo("timeCol", str, optional=False)
+    NUM_DAYS = ParamInfo("numDays", int, default=7,
+                         validator=MinValidator(1))
+
+    def map_table(self, t: MTable) -> MTable:
+        sel = self.get(HasSelectedCol.SELECTED_COL)
+        out = self.get(HasOutputCol.OUTPUT_COL) or "recent_stats"
+        time_col = self.get(self.TIME_COL)
+        span = float(self.get(self.NUM_DAYS)) * 86400.0
+        res = np.empty(t.num_rows, object)
+        for i in range(t.num_rows):
+            cell = t.col(sel)[i]
+            if cell is None:
+                res[i] = None
+                continue
+            times, series = _series_cell(cell)
+            tv = _parse_time(t.col(time_col)[i])
+            ts = np.asarray([_parse_time(x) for x in times])
+            vals = np.asarray(series.col(series.names[-1]), np.float64)
+            mask = (ts <= tv) & (ts > tv - span)
+            w = vals[mask]
+            if w.size:
+                res[i] = DenseVector(np.asarray(
+                    [float(w.size), w.sum(), w.mean(), w.min(), w.max()]))
+            else:
+                res[i] = DenseVector(np.asarray([0.0, 0, 0, 0, 0]))
+        return self._append_result(
+            t, {out: res}, {out: AlinkTypes.DENSE_VECTOR})
+
+    def output_schema(self, input_schema):
+        out = self.get(HasOutputCol.OUTPUT_COL) or "recent_stats"
+        return self._append_result_schema(input_schema, [out],
+                                          [AlinkTypes.DENSE_VECTOR])
+
+    def map_column(self, values, type_tag):
+        raise NotImplementedError
+
+
+class LookupRecentDaysBatchOp(MapBatchOp, HasSelectedCol, HasOutputCol,
+                              HasReservedCols):
+    """(reference: operator/batch/dataproc/LookupRecentDaysBatchOp.java)"""
+
+    mapper_cls = LookupRecentDaysMapper
+    TIME_COL = LookupRecentDaysMapper.TIME_COL
+    NUM_DAYS = LookupRecentDaysMapper.NUM_DAYS

@@ -122,3 +122,7 @@ __all__ += [
     "SelectStreamOp", "SpeedControlStreamOp", "SplitStreamOp",
     "StratifiedSampleStreamOp", "UnionAllStreamOp", "WhereStreamOp",
 ]
+from . import timeseries as _timeseries_stream
+from .timeseries import *  # noqa: F401,F403 — forecast stream twins
+
+__all__ += list(_timeseries_stream.__all__)

@@ -18,29 +18,13 @@ __all__ = []
 
 
 def _make_twin(batch_cls):
-    def _stream_impl(self, it: Iterator[MTable]) -> Iterator[MTable]:
-        for chunk in it:
-            op = batch_cls(self.get_params().clone())
-            yield op._execute_impl(chunk)
+    from .base import make_per_chunk_twin
 
-    attrs = {
-        "_min_inputs": 1,
-        "_max_inputs": 1,
-        "_stream_impl": _stream_impl,
-        "__doc__": (f"Stream twin of {batch_cls.__name__}: each micro-batch "
-                    f"is the detection window (reference: the matching "
-                    f"operator/stream/outlier wrapper)."),
-        "__module__": __name__,
-    }
-    for attr, v in vars(batch_cls).items():
-        if isinstance(v, ParamInfo):
-            attrs[attr] = v
-    for base in batch_cls.__mro__[1:]:
-        for attr, v in vars(base).items():
-            if isinstance(v, ParamInfo) and attr not in attrs:
-                attrs[attr] = v
     name = batch_cls.__name__.replace("BatchOp", "StreamOp")
-    return name, type(name, (StreamOperator,), attrs)
+    doc = (f"Stream twin of {batch_cls.__name__}: each micro-batch is the "
+           f"detection window (reference: the matching "
+           f"operator/stream/outlier wrapper).")
+    return name, make_per_chunk_twin(batch_cls, name, doc)
 
 
 def _generate():

@@ -1,0 +1,53 @@
+"""Stream twins of the direct forecasting ops: each micro-batch is the
+series window, re-fit per chunk.
+
+Capability parity (reference: operator/stream/timeseries/ArimaStreamOp.java,
+AutoArimaStreamOp.java, AutoGarchStreamOp.java, HoltWintersStreamOp.java,
+ProphetStreamOp.java, ShiftStreamOp.java, DeepARPredictStreamOp.java /
+LSTNetPredictStreamOp.java / ProphetPredictStreamOp.java — the predict
+twins generate automatically from the mapper registry; this module covers
+the fit-per-window direct ops)."""
+
+from __future__ import annotations
+
+from typing import Iterator, List
+
+from ...common.mtable import MTable
+from ...common.params import ParamInfo
+from .base import StreamOperator
+
+__all__: List[str] = []
+
+
+def _make_twin(batch_cls, name: str):
+    from .base import make_per_chunk_twin
+
+    doc = (f"Stream twin of {batch_cls.__name__}: each micro-batch is the "
+           f"series window the model re-fits on (reference: "
+           f"operator/stream/timeseries/{name}.java).")
+    return make_per_chunk_twin(batch_cls, name, doc)
+
+
+def _generate():
+    from ..batch import timeseries as ts
+    from ..batch import timeseries2 as ts2
+
+    pairs = [
+        (ts.ArimaBatchOp, "ArimaStreamOp"),
+        (ts.AutoArimaBatchOp, "AutoArimaStreamOp"),
+        (ts.HoltWintersBatchOp, "HoltWintersStreamOp"),
+        (ts.GarchBatchOp, "GarchStreamOp"),
+        (ts2.AutoGarchBatchOp, "AutoGarchStreamOp"),
+        (ts.ShiftBatchOp, "ShiftStreamOp"),
+        (ts.DifferenceBatchOp, "DifferenceStreamOp"),
+        (ts.ProphetBatchOp, "ProphetStreamOp"),
+        (ts.DeepARBatchOp, "DeepARStreamOp"),
+        (ts.LSTNetBatchOp, "LSTNetStreamOp"),
+        (ts.TFTBatchOp, "TFTStreamOp"),
+    ]
+    for batch_cls, name in pairs:
+        globals()[name] = _make_twin(batch_cls, name)
+        __all__.append(name)
+
+
+_generate()

@@ -546,11 +546,17 @@ def ocsvm_fit(X: Arr, nu: float = 0.1, gamma: Optional[float] = None,
             "gamma": np.asarray([gamma], np.float32)}
 
 
-def ocsvm_score(model: Dict[str, np.ndarray], X: Arr) -> Tuple[Arr, Arr]:
+def ocsvm_score(model: Dict[str, np.ndarray], X: Arr,
+                chunk: int = 4096) -> Tuple[Arr, Arr]:
     X = np.asarray(X, np.float32)
     landmarks = model["landmarks"]
     gamma = float(model["gamma"][0])
-    d2 = ((X[:, None, :] - landmarks[None, :, :]) ** 2).sum(-1)
-    F = np.exp(-gamma * d2) @ model["whiten"]
-    score = float(model["rho"][0]) - F @ model["w"]
+    rho = float(model["rho"][0])
+    score = np.empty(X.shape[0])
+    # row chunks: the (n, m, d) broadcast would otherwise materialize whole
+    for s0 in range(0, X.shape[0], chunk):
+        blk = X[s0:s0 + chunk]
+        d2 = ((blk[:, None, :] - landmarks[None, :, :]) ** 2).sum(-1)
+        F = np.exp(-gamma * d2) @ model["whiten"]
+        score[s0:s0 + chunk] = rho - F @ model["w"]
     return score, score > 0

@@ -1,0 +1,134 @@
+"""Timeseries train/predict pairs, AutoGarch, and in-series lookups
+(reference test model: DeepARTrainBatchOpTest.java /
+AutoGarchBatchOpTest.java styles)."""
+
+import numpy as np
+import pytest
+
+from alink_tpu.common.mtable import AlinkTypes, MTable, TableSchema
+from alink_tpu.operator.batch.base import TableSourceBatchOp
+
+
+def _seasonal(n=120, seed=0):
+    rng = np.random.default_rng(seed)
+    return (10 + 3 * np.sin(np.arange(n) * 2 * np.pi / 12)
+            + rng.normal(0, 0.2, n))
+
+
+def test_deepar_train_predict_roundtrip(tmp_path):
+    from alink_tpu.io.ak import read_ak, write_ak
+    from alink_tpu.operator.batch import (
+        DeepARPredictBatchOp,
+        DeepARTrainBatchOp,
+    )
+
+    y = _seasonal()
+    src = TableSourceBatchOp(MTable({"v": y}))
+    model = DeepARTrainBatchOp(valueCol="v", numEpochs=15,
+                               lookback=12).link_from(src)
+    # model survives .ak persistence
+    path = str(tmp_path / "deepar.ak")
+    write_ak(path, model.collect())
+    restored = TableSourceBatchOp(read_ak(path))
+    hist = MTable(
+        {"h": np.asarray([" ".join(map(str, y[-24:]))], object)},
+        TableSchema(["h"], [AlinkTypes.DENSE_VECTOR]))
+    out = DeepARPredictBatchOp(
+        selectedCol="h", outputCol="fc", predictNum=6).link_from(
+        restored, TableSourceBatchOp(hist)).collect()
+    fc = out.col("fc")[0].data
+    assert fc.shape == (6,)
+    assert np.all(np.abs(fc - 10.0) < 6.0)  # stays in the series range
+
+
+def test_lstnet_train_predict():
+    from alink_tpu.operator.batch import (
+        LSTNetPredictBatchOp,
+        LSTNetTrainBatchOp,
+    )
+
+    y = _seasonal()
+    src = TableSourceBatchOp(MTable({"v": y}))
+    model = LSTNetTrainBatchOp(valueCol="v", numEpochs=15,
+                               lookback=12).link_from(src)
+    hist = MTable(
+        {"h": np.asarray([" ".join(map(str, y[-24:]))], object)},
+        TableSchema(["h"], [AlinkTypes.DENSE_VECTOR]))
+    out = LSTNetPredictBatchOp(
+        selectedCol="h", outputCol="fc", predictNum=4).link_from(
+        model, TableSourceBatchOp(hist)).collect()
+    assert out.col("fc")[0].data.shape == (4,)
+
+
+def test_autogarch_picks_order():
+    from alink_tpu.operator.batch import AutoGarchBatchOp
+
+    rng = np.random.default_rng(1)
+    # volatility-clustered returns
+    h = 1.0
+    r = []
+    for _ in range(400):
+        h = 0.1 + 0.3 * (r[-1] ** 2 if r else 1.0) + 0.5 * h
+        r.append(rng.normal(0, np.sqrt(h)))
+    out = AutoGarchBatchOp(valueCol="v", predictNum=4).link_from(
+        TableSourceBatchOp(MTable({"v": np.asarray(r)}))).collect()
+    row = list(out.rows())[0]
+    names = out.names
+    assert "p" in names and "q" in names and "aic" in names
+    fc = out.col("forecast")[0].data
+    assert fc.shape == (4,) and np.all(fc > 0)  # volatility is positive
+
+
+def test_timeseries_lookups():
+    from alink_tpu.operator.batch import (
+        LookupRecentDaysBatchOp,
+        LookupValueInTimeSeriesBatchOp,
+        LookupVectorInTimeSeriesBatchOp,
+    )
+
+    day = 86400.0
+    series = MTable({"ts": np.asarray([0.0, day, 2 * day, 3 * day]),
+                     "val": np.asarray([1.0, 2.0, 3.0, 4.0])})
+    vec_series = MTable(
+        {"ts": np.asarray([0.0, day]),
+         "vec": np.asarray(["1 0", "0 1"], object)},
+        TableSchema(["ts", "vec"],
+                    [AlinkTypes.DOUBLE, AlinkTypes.DENSE_VECTOR]))
+    t = MTable(
+        {"s": np.asarray([series], object),
+         "sv": np.asarray([vec_series], object),
+         "when": np.asarray([2.5 * day])},
+        TableSchema(["s", "sv", "when"],
+                    [AlinkTypes.MTABLE, AlinkTypes.MTABLE,
+                     AlinkTypes.DOUBLE]))
+    src = TableSourceBatchOp(t)
+    v = LookupValueInTimeSeriesBatchOp(
+        selectedCol="s", timeCol="when",
+        outputCol="v").link_from(src).collect()
+    assert v.col("v")[0] == 3.0  # latest value at or before t
+    vv = LookupVectorInTimeSeriesBatchOp(
+        selectedCol="sv", timeCol="when",
+        outputCol="vec").link_from(src).collect()
+    assert vv.col("vec")[0].data.tolist() == [0.0, 1.0]
+    rd = LookupRecentDaysBatchOp(
+        selectedCol="s", timeCol="when", numDays=2,
+        outputCol="st").link_from(src).collect()
+    stats = rd.col("st")[0].data
+    assert stats[0] == 2.0  # count: days 2 and 3 fall in the window
+    assert stats[1] == 5.0  # sum 2 + 3
+
+
+def test_forecast_stream_twins():
+    from alink_tpu.operator.stream import (
+        ArimaStreamOp,
+        AutoGarchStreamOp,
+        HoltWintersStreamOp,
+        TableSourceStreamOp,
+    )
+
+    y = _seasonal(72)
+    src = TableSourceStreamOp(MTable({"v": y}), numChunks=2)
+    out = HoltWintersStreamOp(valueCol="v", frequency=12,
+                              predictNum=3).link_from(src).collect()
+    assert out.num_rows == 2  # one forecast row per micro-batch window
+    assert out.col("forecast")[0].data.shape == (3,)
