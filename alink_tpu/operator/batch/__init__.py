@@ -581,3 +581,31 @@ from .timeseries2 import (
     ProphetPredictBatchOp,
     ProphetTrainBatchOp,
 )
+from .nlp2 import (
+    NaiveBayesTextPredictBatchOp,
+    NaiveBayesTextTrainBatchOp,
+    StringApproxNearestNeighborPredictBatchOp,
+    StringApproxNearestNeighborTrainBatchOp,
+    TextApproxNearestNeighborPredictBatchOp,
+    TextApproxNearestNeighborTrainBatchOp,
+    VectorApproxNearestNeighborPredictBatchOp,
+    VectorApproxNearestNeighborTrainBatchOp,
+)
+from .graph2 import (
+    CommunityDetectionClassifyBatchOp,
+    HugeDeepWalkTrainBatchOp,
+    HugeIndexerStringPredictBatchOp,
+    HugeLabeledWord2VecTrainBatchOp,
+    HugeLookupBatchOp,
+    HugeMetaPath2VecTrainBatchOp,
+    HugeMultiIndexerStringPredictBatchOp,
+    HugeNode2VecTrainBatchOp,
+    HugeWord2VecTrainBatchOp,
+    IndexToNodeBatchOp,
+    MdsBatchOp,
+    Node2VecBatchOp,
+    NodeIndexerTrainBatchOp,
+    NodeToIndexBatchOp,
+    RiskAlikeBuildGraphBatchOp,
+    SimrankBatchOp,
+)

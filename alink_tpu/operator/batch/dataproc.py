@@ -316,17 +316,24 @@ class LookupBatchOp(BatchOperator, HasSelectedCols, HasOutputCols,
     _min_inputs = 2
     _max_inputs = 2
 
-    def _execute_impl(self, model: MTable, t: MTable) -> MTable:
+    def _build_lut(self, model: MTable) -> dict:
+        """key tuple → value tuple; built ONCE per lookup (the Huge variant
+        reuses it across data blocks)."""
         key_cols = list(self.get(self.MAP_KEY_COLS))
         val_cols = list(self.get(self.MAP_VALUE_COLS))
-        sel = list(self.get(HasSelectedCols.SELECTED_COLS) or key_cols)
-        out_cols = list(self.get(HasOutputCols.OUTPUT_COLS) or val_cols)
         lut = {}
         key_arrays = [np.asarray(model.col(c), object) for c in key_cols]
         val_arrays = [np.asarray(model.col(c), object) for c in val_cols]
         for i in range(model.num_rows):
             k = tuple(str(a[i]) for a in key_arrays)
             lut[k] = tuple(a[i] for a in val_arrays)
+        return lut
+
+    def _probe(self, model_schema, t: MTable, lut: dict) -> MTable:
+        key_cols = list(self.get(self.MAP_KEY_COLS))
+        val_cols = list(self.get(self.MAP_VALUE_COLS))
+        sel = list(self.get(HasSelectedCols.SELECTED_COLS) or key_cols)
+        out_cols = list(self.get(HasOutputCols.OUTPUT_COLS) or val_cols)
         sel_arrays = [np.asarray(t.col(c), object) for c in sel]
         n = t.num_rows
         outs = {oc: [] for oc in out_cols}
@@ -340,9 +347,12 @@ class LookupBatchOp(BatchOperator, HasSelectedCols, HasOutputCols,
             cols[oc] = np.asarray(outs[oc], object)
         names = list(t.names) + [oc for oc in out_cols if oc not in t.names]
         types = [t.schema.type_of(n) if n in t.names
-                 else model.schema.type_of(val_cols[out_cols.index(n)])
+                 else model_schema.type_of(val_cols[out_cols.index(n)])
                  for n in names]
         return MTable(cols, TableSchema(names, types))
+
+    def _execute_impl(self, model: MTable, t: MTable) -> MTable:
+        return self._probe(model.schema, t, self._build_lut(model))
 
     def _out_schema(self, model_schema, data_schema):
         val_cols = list(self.get(self.MAP_VALUE_COLS))

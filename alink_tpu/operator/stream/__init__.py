@@ -126,3 +126,7 @@ from . import timeseries as _timeseries_stream
 from .timeseries import *  # noqa: F401,F403 — forecast stream twins
 
 __all__ += list(_timeseries_stream.__all__)
+from . import nlp as _nlp_stream
+from .nlp import *  # noqa: F401,F403 — NLP per-chunk twins
+
+__all__ += list(_nlp_stream.__all__)

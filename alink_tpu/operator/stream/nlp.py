@@ -1,0 +1,29 @@
+"""Stream twins of the non-mapper NLP batch ops (per-micro-batch corpus).
+
+Capability parity (reference: operator/stream/nlp/
+KeywordsExtractionStreamOp.java, DocWordCountStreamOp.java — each
+micro-batch is the corpus window)."""
+
+from __future__ import annotations
+
+from typing import List
+
+__all__: List[str] = []
+
+
+def _generate():
+    from ..batch import nlp as batch_nlp
+    from .base import make_per_chunk_twin
+
+    for batch_name, name in (
+        ("KeywordsExtractionBatchOp", "KeywordsExtractionStreamOp"),
+        ("DocWordCountBatchOp", "DocWordCountStreamOp"),
+    ):
+        cls = getattr(batch_nlp, batch_name)
+        doc = (f"Stream twin of {batch_name}: each micro-batch is the "
+               f"corpus window (reference: operator/stream/nlp/{name}.java).")
+        globals()[name] = make_per_chunk_twin(cls, name, doc)
+        __all__.append(name)
+
+
+_generate()

@@ -1,0 +1,180 @@
+"""NLP/similarity + graph long-tail tests (reference test model:
+NaiveBayesTextTrainBatchOpTest.java, SimrankBatchOpTest.java,
+MdsBatchOpTest.java, RiskAlikeBuildGraphBatchOpTest.java styles)."""
+
+import json
+
+import numpy as np
+
+from alink_tpu.common.mtable import AlinkTypes, MTable, TableSchema
+from alink_tpu.operator.batch.base import TableSourceBatchOp
+
+
+def test_naive_bayes_text():
+    from alink_tpu.operator.batch import (
+        NaiveBayesTextPredictBatchOp,
+        NaiveBayesTextTrainBatchOp,
+    )
+
+    vecs = ["$4$0:3 1:2", "$4$0:1 1:4", "$4$2:5 3:1", "$4$2:2 3:3"]
+    t = MTable({"v": np.asarray(vecs, object),
+                "y": np.asarray([0, 0, 1, 1], np.int64)},
+               TableSchema(["v", "y"],
+                           [AlinkTypes.SPARSE_VECTOR, AlinkTypes.LONG]))
+    src = TableSourceBatchOp(t)
+    for model_type in ("Multinomial", "Bernoulli"):
+        m = NaiveBayesTextTrainBatchOp(
+            vectorCol="v", labelCol="y",
+            modelType=model_type).link_from(src)
+        p = NaiveBayesTextPredictBatchOp(
+            predictionCol="p", predictionDetailCol="d").link_from(
+            m, src).collect()
+        assert p.col("p").tolist() == [0, 0, 1, 1], model_type
+        d = json.loads(p.col("d")[0])
+        assert abs(sum(float(v) for v in d.values()) - 1.0) < 1e-6
+
+
+def test_approx_nearest_neighbors():
+    from alink_tpu.operator.batch import (
+        StringApproxNearestNeighborPredictBatchOp,
+        StringApproxNearestNeighborTrainBatchOp,
+        TextApproxNearestNeighborPredictBatchOp,
+        TextApproxNearestNeighborTrainBatchOp,
+        VectorApproxNearestNeighborPredictBatchOp,
+        VectorApproxNearestNeighborTrainBatchOp,
+    )
+
+    corpus = TableSourceBatchOp(MTable({
+        "id": np.asarray(["a", "b", "c"], object),
+        "s": np.asarray(["hello world", "hello there",
+                         "completely different text"], object)}))
+    m = StringApproxNearestNeighborTrainBatchOp(
+        idCol="id", selectedCol="s").link_from(corpus)
+    q = TableSourceBatchOp(MTable(
+        {"s": np.asarray(["hello world!"], object)}))
+    r = StringApproxNearestNeighborPredictBatchOp(
+        selectedCol="s", outputCol="nn", topN=1).link_from(m, q).collect()
+    assert list(json.loads(r.col("nn")[0]))[0] == "a"
+    mt = TextApproxNearestNeighborTrainBatchOp(
+        idCol="id", selectedCol="s").link_from(corpus)
+    rt = TextApproxNearestNeighborPredictBatchOp(
+        selectedCol="s", outputCol="nn", topN=1).link_from(mt, q).collect()
+    assert list(json.loads(rt.col("nn")[0]))[0] == "a"
+
+    vc = TableSourceBatchOp(MTable(
+        {"id": np.asarray(["x", "y"], object),
+         "v": np.asarray(["1 0 0", "0 0 1"], object)},
+        TableSchema(["id", "v"],
+                    [AlinkTypes.STRING, AlinkTypes.DENSE_VECTOR])))
+    vm = VectorApproxNearestNeighborTrainBatchOp(
+        idCol="id", selectedCol="v").link_from(vc)
+    vq = TableSourceBatchOp(MTable(
+        {"v": np.asarray(["0.9 0 0.1"], object)},
+        TableSchema(["v"], [AlinkTypes.DENSE_VECTOR])))
+    vr = VectorApproxNearestNeighborPredictBatchOp(
+        selectedCol="v", outputCol="nn", topN=1).link_from(
+        vm, vq).collect()
+    assert list(json.loads(vr.col("nn")[0]))[0] == "x"
+
+
+def test_node_indexing_roundtrip():
+    from alink_tpu.operator.batch import (
+        IndexToNodeBatchOp,
+        NodeIndexerTrainBatchOp,
+        NodeToIndexBatchOp,
+    )
+
+    edges = MTable({"source": np.asarray(["a", "b", "c"], object),
+                    "target": np.asarray(["b", "c", "a"], object)})
+    esrc = TableSourceBatchOp(edges)
+    m = NodeIndexerTrainBatchOp().link_from(esrc)
+    idx = NodeToIndexBatchOp().link_from(m, esrc)
+    t = idx.collect()
+    assert t.schema.type_of("source") == AlinkTypes.LONG
+    back = IndexToNodeBatchOp().link_from(m, idx).collect()
+    assert back.col("source").tolist() == ["a", "b", "c"]
+    assert back.col("target").tolist() == ["b", "c", "a"]
+
+
+def test_simrank():
+    from alink_tpu.operator.batch import SimrankBatchOp
+
+    # u1/u2 rate the same two items; u3 rates a third — x and y must be
+    # mutually most similar, z similar to neither
+    tri = MTable({"u": np.asarray(["u1", "u1", "u2", "u2", "u3"], object),
+                  "i": np.asarray(["x", "y", "x", "y", "z"], object)})
+    out = SimrankBatchOp(userCol="u", itemCol="i", numIter=4,
+                         topN=2).link_from(TableSourceBatchOp(tri)).collect()
+    sims = {r[0]: json.loads(r[1]) for r in out.rows()}
+    assert "y" in sims["x"] and sims["x"]["y"] > 0.5
+    assert "z" not in sims["x"]
+
+
+def test_mds_recovers_structure():
+    from alink_tpu.operator.batch import MdsBatchOp
+
+    # three tight, well-separated clusters survive the 2-D embedding
+    rng = np.random.RandomState(0)
+    centers = np.asarray([[0, 0, 0, 0], [10, 0, 0, 0], [0, 10, 0, 0]])
+    X = np.concatenate([c + rng.normal(0, 0.1, (10, 4)) for c in centers])
+    t = MTable({f"f{i}": X[:, i] for i in range(4)})
+    out = MdsBatchOp(dim=2).link_from(TableSourceBatchOp(t)).collect()
+    Y = np.stack([out.col("mds_0"), out.col("mds_1")], axis=1)
+    within = max(np.linalg.norm(Y[g * 10:(g + 1) * 10] -
+                                Y[g * 10:(g + 1) * 10].mean(0),
+                                axis=1).max() for g in range(3))
+    between = min(
+        np.linalg.norm(Y[a * 10:(a + 1) * 10].mean(0)
+                       - Y[b * 10:(b + 1) * 10].mean(0))
+        for a in range(3) for b in range(a + 1, 3))
+    assert between > 5 * within
+
+
+def test_community_classify_and_risk_alike():
+    from alink_tpu.operator.batch import (
+        CommunityDetectionClassifyBatchOp,
+        RiskAlikeBuildGraphBatchOp,
+    )
+
+    edges = MTable(
+        {"source": np.asarray(["a", "a", "b", "d", "d", "e"], object),
+         "target": np.asarray(["b", "c", "c", "e", "f", "f"], object)})
+    esrc = TableSourceBatchOp(edges)
+    seeds = MTable({"vertex": np.asarray(["a", "f"], object),
+                    "label": np.asarray(["L", "R"], object)})
+    out = CommunityDetectionClassifyBatchOp().link_from(
+        esrc, TableSourceBatchOp(seeds)).collect()
+    got = dict(out.rows())
+    assert got["b"] == "L" and got["c"] == "L"
+    assert got["d"] == "R" and got["e"] == "R"
+    sub = RiskAlikeBuildGraphBatchOp(expandDegree=1).link_from(
+        TableSourceBatchOp(MTable(
+            {"vertex": np.asarray(["a"], object)})), esrc).collect()
+    # 1-hop around 'a': edges within {a, b, c}
+    assert sub.num_rows == 3
+
+
+def test_huge_variants_exist_and_serve():
+    from alink_tpu.operator.batch import (
+        HugeLookupBatchOp,
+        HugeIndexerStringPredictBatchOp,
+        MultiStringIndexerTrainBatchOp,
+        MultiStringIndexerPredictBatchOp,
+    )
+
+    src = TableSourceBatchOp(MTable(
+        {"cat": np.asarray(["x", "y", "z"], object)}))
+    m = MultiStringIndexerTrainBatchOp(selectedCols=["cat"]).link_from(src)
+    idx = MultiStringIndexerPredictBatchOp(
+        outputCols=["cid"]).link_from(m, src)
+    back = HugeIndexerStringPredictBatchOp(
+        selectedCol="cid", outputCol="cat2",
+        blockSize=2).link_from(m, idx).collect()
+    assert back.col("cat2").tolist() == ["x", "y", "z"]
+    mapping = TableSourceBatchOp(MTable(
+        {"k": np.asarray(["x", "y"], object),
+         "v": np.asarray([1.0, 2.0])}))
+    out = HugeLookupBatchOp(
+        mapKeyCols=["k"], mapValueCols=["v"], selectedCols=["cat"],
+        blockSize=1).link_from(mapping, src).collect()
+    assert out.num_rows == 3
