@@ -609,3 +609,32 @@ from .graph2 import (
     RiskAlikeBuildGraphBatchOp,
     SimrankBatchOp,
 )
+from .feature4 import (
+    ApplyAssociationRuleBatchOp,
+    ApplySequenceRuleBatchOp,
+    AutoCrossAlgoTrainBatchOp,
+    AutoCrossTrainBatchOp,
+    BaseCrossTrainBatchOp,
+    BinarySelectorPredictBatchOp,
+    BinarySelectorTrainBatchOp,
+    BinningTrainForScorecardBatchOp,
+    ConstrainedBinarySelectorPredictBatchOp,
+    ConstrainedBinarySelectorTrainBatchOp,
+    ConstrainedDivergenceTrainBatchOp,
+    ConstrainedLinearRegTrainBatchOp,
+    ConstrainedLogisticRegressionTrainBatchOp,
+    ConstrainedRegSelectorPredictBatchOp,
+    ConstrainedRegSelectorTrainBatchOp,
+    CrossCandidateSelectorPredictBatchOp,
+    CrossCandidateSelectorTrainBatchOp,
+    CrossFeaturePredictBatchOp,
+    CrossFeatureTrainBatchOp,
+    GlmEvaluationBatchOp,
+    GroupedFpGrowthBatchOp,
+    HashCrossFeatureBatchOp,
+    MultiCollinearityBatchOp,
+    RegressionSelectorPredictBatchOp,
+    RegressionSelectorTrainBatchOp,
+    WoePredictBatchOp,
+    WoeTrainBatchOp,
+)

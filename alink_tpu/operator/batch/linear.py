@@ -131,13 +131,7 @@ class BaseLinearModelTrainBatchOp(ModelTrainOpMixin, BatchOperator,
         sample_w = (np.asarray(t.col(weight_col), np.float32)
                     if weight_col else None)
         obj = self._objective(d, num_classes)
-        res = optimize(
-            obj, X, y, sample_weights=sample_w,
-            mesh=self.env.mesh,
-            method=self.get(self.OPTIM_METHOD),
-            max_iter=self.get(self.MAX_ITER),
-            l1=self._effective_l1(), l2=self._effective_l2(),
-            tol=self.get(self.EPSILON))
+        res = self._solve(obj, X, y, sample_w)
         if self.linear_model_type == "Softmax":
             W = res.weights.reshape(d, num_classes)
             arrays = {
@@ -165,6 +159,17 @@ class BaseLinearModelTrainBatchOp(ModelTrainOpMixin, BatchOperator,
             "numIters": res.num_iters,
         }
         return model_to_table(meta, arrays)
+
+    def _solve(self, obj, X, y, sample_w):
+        """Solver hook — the Constrained* variants override this to route
+        through the constrained optimizers (optim/constrained.py)."""
+        return optimize(
+            obj, X, y, sample_weights=sample_w,
+            mesh=self.env.mesh,
+            method=self.get(self.OPTIM_METHOD),
+            max_iter=self.get(self.MAX_ITER),
+            l1=self._effective_l1(), l2=self._effective_l2(),
+            tol=self.get(self.EPSILON))
 
     def _objective(self, dim: int, num_classes: int):
         t = self.linear_model_type
@@ -251,14 +256,7 @@ class BaseLinearModelTrainBatchOp(ModelTrainOpMixin, BatchOperator,
         d = Xn.shape[1]
 
         obj = self._objective(d, num_classes)
-        res = optimize(
-            obj, Xn, y, sample_weights=sample_w,
-            mesh=self.env.mesh,
-            method=self.get(self.OPTIM_METHOD),
-            max_iter=self.get(self.MAX_ITER),
-            l1=self._effective_l1(), l2=self._effective_l2(),
-            tol=self.get(self.EPSILON),
-        )
+        res = self._solve(obj, Xn, y, sample_w)
 
         # de-standardize: w_raw = w_std / std ; b_raw = b - sum(w_std * mean / std)
         if self.linear_model_type == "Softmax":

@@ -37,6 +37,7 @@ def constrained_optimize(
     rho: float = 1.0,
     tol: float = 1e-6,
     inner_max_iter: int = 60,
+    w0: Optional[np.ndarray] = None,
     **inner_kwargs,
 ) -> OptimResult:
     """Minimize the objective under linear constraints.
@@ -59,7 +60,8 @@ def constrained_optimize(
             raise ValueError("barrier method handles inequalities only")
         return _barrier(obj, X, y, A_ub_j, b_ub_j, mesh=mesh,
                         max_outer=max_outer, tol=tol,
-                        inner_max_iter=inner_max_iter, **inner_kwargs)
+                        inner_max_iter=inner_max_iter, w0=w0,
+                        **inner_kwargs)
     if method != "alm":
         raise ValueError(f"unknown constrained method {method!r}")
 
@@ -67,7 +69,7 @@ def constrained_optimize(
     n_ub = 0 if A_ub is None else A_ub.shape[0]
     lam = np.zeros(n_eq, np.float32)
     mu = np.zeros(n_ub, np.float32)
-    w = None
+    w = w0  # optional explicit start (objectives with a stationary origin)
     res = None
     prev_viol = np.inf
     cur_rho = float(rho)
@@ -109,13 +111,13 @@ def constrained_optimize(
 
 
 def _barrier(obj, X, y, A_ub_j, b_ub_j, *, mesh, max_outer, tol,
-             inner_max_iter, **inner_kwargs) -> OptimResult:
+             inner_max_iter, w0=None, **inner_kwargs) -> OptimResult:
     """Interior-point log barrier: t grows geometrically; infeasible iterates
     are pushed back by the +inf-free softplus barrier approximation near the
     boundary (reference: barrierIcq/LogBarrier.java)."""
     import jax.numpy as jnp
 
-    w = None
+    w = w0
     res = None
     t = 1.0
     for _ in range(max_outer):

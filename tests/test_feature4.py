@@ -1,0 +1,207 @@
+"""Selector / constrained / cross / WOE / VIF / rule-application tests
+(reference test model: BinarySelectorTrainBatchOpTest.java,
+ConstrainedLogisticRegressionTrainBatchOpTest.java styles)."""
+
+import json
+
+import numpy as np
+
+from alink_tpu.common.model import table_to_model
+from alink_tpu.common.mtable import MTable
+from alink_tpu.operator.batch.base import TableSourceBatchOp
+
+
+def _data(n=200, seed=0):
+    rng = np.random.default_rng(seed)
+    x1 = rng.normal(size=n)
+    x2 = rng.normal(size=n)
+    noise = rng.normal(size=n)
+    y = (x1 + 0.5 * x2 > 0).astype(np.int64)
+    return TableSourceBatchOp(
+        MTable({"x1": x1, "x2": x2, "noise": noise, "y": y}))
+
+
+def test_stepwise_selectors():
+    from alink_tpu.operator.batch import (
+        BinarySelectorPredictBatchOp,
+        BinarySelectorTrainBatchOp,
+        RegressionSelectorTrainBatchOp,
+    )
+
+    src = _data()
+    m = BinarySelectorTrainBatchOp(labelCol="y", maxSelected=2).link_from(src)
+    meta, _ = table_to_model(m.collect())
+    assert "x1" in meta["selectedCols"]
+    assert "noise" not in meta["selectedCols"]
+    assert meta["score"] > 0.8  # AUC of the selected model
+    p = BinarySelectorPredictBatchOp(predictionCol="s").link_from(
+        m, src).collect()
+    assert "s" in p.names
+
+    rng = np.random.default_rng(1)
+    x1 = rng.normal(size=150)
+    noise = rng.normal(size=150)
+    yr = 2 * x1 + 0.05 * rng.normal(size=150)
+    rsrc = TableSourceBatchOp(MTable({"x1": x1, "noise": noise, "y": yr}))
+    mr = RegressionSelectorTrainBatchOp(labelCol="y",
+                                        maxSelected=2).link_from(rsrc)
+    meta, _ = table_to_model(mr.collect())
+    assert meta["selectedCols"][0] == "x1"
+
+
+def test_constrained_linear_ops():
+    from alink_tpu.operator.batch import (
+        ConstrainedDivergenceTrainBatchOp,
+        ConstrainedLogisticRegressionTrainBatchOp,
+    )
+
+    src = _data()
+    # pin the 'noise' weight (index 2 of [x1, x2, noise, intercept]) to 0
+    cons = json.dumps({"A_eq": [[0.0, 0.0, 1.0, 0.0]], "b_eq": [0.0]})
+    m = ConstrainedLogisticRegressionTrainBatchOp(
+        labelCol="y", constraint=cons).link_from(src)
+    meta, arrays = table_to_model(m.collect())
+    assert abs(float(arrays["weights"][2])) < 1e-2
+    assert abs(float(arrays["weights"][0])) > 0.1  # real signal learned
+
+    dv = ConstrainedDivergenceTrainBatchOp(
+        labelCol="y", featureCols=["x1", "x2"]).link_from(src)
+    meta, arrays = table_to_model(dv.collect())
+    w = arrays["weights"]
+    # divergence direction aligns with the true separator (x1 + 0.5 x2)
+    cos = abs(w @ [1.0, 0.5]) / (np.linalg.norm(w) * np.linalg.norm([1, 0.5]))
+    assert cos > 0.9
+
+
+def test_cross_features():
+    from alink_tpu.common.linalg import parse_vector
+    from alink_tpu.operator.batch import (
+        CrossCandidateSelectorPredictBatchOp,
+        CrossCandidateSelectorTrainBatchOp,
+        CrossFeaturePredictBatchOp,
+        CrossFeatureTrainBatchOp,
+        HashCrossFeatureBatchOp,
+    )
+
+    t = MTable({"a": np.asarray(["p", "p", "q", "q"] * 10, object),
+                "b": np.asarray(["x", "y", "x", "y"] * 10, object),
+                "y": np.asarray([1, 0, 0, 1] * 10, np.int64)})
+    src = TableSourceBatchOp(t)
+    m = CrossFeatureTrainBatchOp(selectedCols=["a", "b"]).link_from(src)
+    out = CrossFeaturePredictBatchOp(outputCol="c").link_from(m, src).collect()
+    v0 = parse_vector(out.col("c")[0])
+    assert v0.size() == 5  # 4 combos + unseen slot
+    h = HashCrossFeatureBatchOp(selectedCols=["a", "b"], numFeatures=32,
+                                outputCol="c").link_from(src).collect()
+    assert parse_vector(h.col("c")[0]).size() == 32
+    # XOR label: the (a,b) cross beats 'a' alone on chi-square
+    cs = CrossCandidateSelectorTrainBatchOp(
+        featureCandidates=[["a", "b"], ["a"]], labelCol="y").link_from(src)
+    meta, _ = table_to_model(cs.collect())
+    assert meta["selectedCols"] == ["a", "b"]
+    out = CrossCandidateSelectorPredictBatchOp(outputCol="c").link_from(
+        cs, src).collect()
+    assert "c" in out.names
+
+
+def test_woe_and_vif():
+    from alink_tpu.operator.batch import (
+        MultiCollinearityBatchOp,
+        WoePredictBatchOp,
+        WoeTrainBatchOp,
+    )
+
+    # category 'p' is mostly positive, 'q' mostly negative
+    t = MTable({"cat": np.asarray(["p"] * 10 + ["q"] * 10, object),
+                "y": np.asarray([1] * 8 + [0] * 2 + [1] * 2 + [0] * 8,
+                                np.int64)})
+    src = TableSourceBatchOp(t)
+    m = WoeTrainBatchOp(selectedCols=["cat"], labelCol="y",
+                        positiveLabelValueString="1").link_from(src)
+    meta, _ = table_to_model(m.collect())
+    assert meta["woe"]["cat"]["p"] > 0 > meta["woe"]["cat"]["q"]
+    assert meta["iv"]["cat"] > 0.5
+    out = WoePredictBatchOp().link_from(m, src).collect()
+    assert out.col("cat")[0] > 0
+
+    rng = np.random.default_rng(2)
+    a = rng.normal(size=100)
+    b = a + 0.01 * rng.normal(size=100)  # nearly collinear
+    c = rng.normal(size=100)
+    v = MultiCollinearityBatchOp(selectedCols=["a", "b", "c"]).link_from(
+        TableSourceBatchOp(MTable({"a": a, "b": b, "c": c}))).collect()
+    vif = dict((r[0], r[1]) for r in v.rows())
+    assert vif["a"] > 100 and vif["b"] > 100 and vif["c"] < 2
+
+
+def test_grouped_fpgrowth_and_rule_application():
+    from alink_tpu.operator.batch import (
+        ApplyAssociationRuleBatchOp,
+        ApplySequenceRuleBatchOp,
+        GroupedFpGrowthBatchOp,
+    )
+
+    txn = MTable({"g": np.asarray(["A", "A", "B", "B"], object),
+                  "items": np.asarray(
+                      ["milk,bread", "milk,bread,eggs",
+                       "beer,chips", "beer,nuts"], object)})
+    out = GroupedFpGrowthBatchOp(
+        groupCol="g", selectedCol="items",
+        minSupportPercent=0.5).link_from(TableSourceBatchOp(txn)).collect()
+    assert "g" in out.names and out.num_rows > 0
+    groups = set(out.col("g").tolist())
+    assert groups == {"A", "B"}
+
+    rules = TableSourceBatchOp(MTable(
+        {"antecedent": np.asarray(["milk", "beer"], object),
+         "consequent": np.asarray(["bread", "chips"], object)}))
+    data = TableSourceBatchOp(MTable(
+        {"items": np.asarray(["milk,eggs", "wine"], object)}))
+    out = ApplyAssociationRuleBatchOp(
+        selectedCol="items", outputCol="rec").link_from(
+        rules, data).collect()
+    assert out.col("rec").tolist() == ["bread", ""]
+    seq = ApplySequenceRuleBatchOp(
+        selectedCol="items", outputCol="rec").link_from(
+        rules, data).collect()
+    assert seq.col("rec")[0] == "bread"
+
+
+def test_glm_evaluation():
+    from alink_tpu.operator.batch import (
+        GlmEvaluationBatchOp,
+        GlmTrainBatchOp,
+    )
+
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=150)
+    y = np.exp(0.4 * x) + 0.02 * np.abs(rng.normal(size=150))
+    src = TableSourceBatchOp(MTable({"x": x, "y": y}))
+    m = GlmTrainBatchOp(featureCols=["x"], labelCol="y", family="Gamma",
+                        link="Log").link_from(src)
+    out = GlmEvaluationBatchOp().link_from(m, src).collect()
+    metrics = dict(out.rows())
+    assert set(metrics) == {"deviance", "nullDeviance", "aic",
+                            "degreesOfFreedom"}
+    assert metrics["deviance"] < 1.0  # good fit
+
+
+def test_constrained_divergence_equality():
+    """Equality constraints on the scale-invariant divergence are solved
+    EXACTLY via null-space projection (penalty methods would shrink the
+    whole vector instead)."""
+    from alink_tpu.operator.batch import ConstrainedDivergenceTrainBatchOp
+
+    rng = np.random.default_rng(0)
+    x1 = rng.normal(size=150)
+    x2 = rng.normal(size=150)
+    y = (x1 + 0.5 * x2 > 0).astype(np.int64)
+    src = TableSourceBatchOp(MTable({"x1": x1, "x2": x2, "y": y}))
+    cons = json.dumps({"A_eq": [[0.0, 1.0, 0.0]], "b_eq": [0.0]})
+    m = ConstrainedDivergenceTrainBatchOp(
+        labelCol="y", featureCols=["x1", "x2"],
+        constraint=cons).link_from(src)
+    _, arrays = table_to_model(m.collect())
+    w = arrays["weights"]
+    assert abs(float(w[1])) < 1e-5   # pinned exactly
+    assert abs(float(w[0])) > 0.9    # unit-norm export, all mass on x1
