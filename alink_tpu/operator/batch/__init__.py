@@ -638,3 +638,8 @@ from .feature4 import (
     WoePredictBatchOp,
     WoeTrainBatchOp,
 )
+from .clustering2 import (
+    GroupEmBatchOp,
+    GroupGeoDbscanBatchOp,
+    GroupGeoDbscanModelBatchOp,
+)

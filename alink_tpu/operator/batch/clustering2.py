@@ -790,3 +790,195 @@ class SomPredictBatchOp(ModelMapBatchOp, HasPredictionCol, HasReservedCols):
             return d2.argmin(axis=1).astype(np.int64), AlinkTypes.LONG, None
 
     mapper_cls = _Mapper
+
+
+class GroupGeoDbscanBatchOp(BatchOperator, HasPredictionCol, HasReservedCols):
+    """Independent DBSCAN per group over (lat, lon) with great-circle
+    distances in kilometers (reference: operator/batch/clustering/
+    GroupGeoDbscanBatchOp.java)."""
+
+    GROUP_COL = ParamInfo("groupCols", list, aliases=("groupCol",),
+                          optional=False)
+    LATITUDE_COL = ParamInfo("latitudeCol", str, default="latitude")
+    LONGITUDE_COL = ParamInfo("longitudeCol", str, default="longitude")
+    EPSILON = ParamInfo("epsilon", float, optional=False,
+                        desc="neighborhood radius in kilometers")
+    MIN_POINTS = ParamInfo("minPoints", int, default=4,
+                           validator=MinValidator(1))
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    @staticmethod
+    def _geo_cluster(lat, lon, eps_km, min_pts):
+        import numpy as _np
+
+        from .clustering import _haversine_dists
+
+        X = _np.stack([lat, lon], axis=1)
+        D = _np.asarray(_haversine_dists(X, X))
+        n = len(lat)
+        neighbors = [set(_np.nonzero(D[i] <= eps_km)[0].tolist()) - {i}
+                     for i in range(n)]
+        labels = _np.full(n, -1, _np.int64)
+        core = _np.asarray([len(nb) + 1 >= min_pts for nb in neighbors])
+        cid = 0
+        for i in range(n):
+            if labels[i] != -1 or not core[i]:
+                continue
+            labels[i] = cid
+            frontier = list(neighbors[i])
+            while frontier:
+                j = frontier.pop()
+                if labels[j] == -1:
+                    labels[j] = cid
+                    if core[j]:
+                        frontier.extend(jj for jj in neighbors[j]
+                                        if labels[jj] == -1)
+            cid += 1
+        return labels
+
+    def _execute_impl(self, t: MTable) -> MTable:
+        from .utils2 import coerce_group_cols, group_row_indices
+
+        lat = np.asarray(t.col(self.get(self.LATITUDE_COL)), np.float64)
+        lon = np.asarray(t.col(self.get(self.LONGITUDE_COL)), np.float64)
+        eps = float(self.get(self.EPSILON))
+        min_pts = int(self.get(self.MIN_POINTS))
+        index, order = group_row_indices(
+            t, coerce_group_cols(self.get(self.GROUP_COL)))
+        labels = np.full(t.num_rows, -1, np.int64)
+        for key in order:
+            rows = np.asarray(index[key])
+            labels[rows] = self._geo_cluster(lat[rows], lon[rows], eps,
+                                             min_pts)
+        pred_col = self.get(HasPredictionCol.PREDICTION_COL)
+        return t.with_column(pred_col, labels, AlinkTypes.LONG)
+
+    def _out_schema(self, in_schema):
+        pred_col = self.get(HasPredictionCol.PREDICTION_COL)
+        return TableSchema(list(in_schema.names) + [pred_col],
+                           list(in_schema.types) + [AlinkTypes.LONG])
+
+
+class GroupGeoDbscanModelBatchOp(BatchOperator):
+    """Per-group geo-DBSCAN models: clustered (lat, lon) points with group
+    keys + cluster ids, persisted in the DbscanModel format so the model
+    outlier/predict mappers serve them (reference: operator/batch/
+    clustering/GroupGeoDbscanModelBatchOp.java)."""
+
+    GROUP_COL = GroupGeoDbscanBatchOp.GROUP_COL
+    LATITUDE_COL = GroupGeoDbscanBatchOp.LATITUDE_COL
+    LONGITUDE_COL = GroupGeoDbscanBatchOp.LONGITUDE_COL
+    EPSILON = GroupGeoDbscanBatchOp.EPSILON
+    MIN_POINTS = GroupGeoDbscanBatchOp.MIN_POINTS
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    def _out_schema(self, in_schema):
+        from ...common.model import MODEL_SCHEMA
+
+        return MODEL_SCHEMA
+
+    def _execute_impl(self, t: MTable) -> MTable:
+        from ...common.model import model_to_table
+        from .utils2 import coerce_group_cols, group_row_indices
+
+        lat_col = self.get(self.LATITUDE_COL)
+        lon_col = self.get(self.LONGITUDE_COL)
+        lat = np.asarray(t.col(lat_col), np.float64)
+        lon = np.asarray(t.col(lon_col), np.float64)
+        eps = float(self.get(self.EPSILON))
+        min_pts = int(self.get(self.MIN_POINTS))
+        group_cols = coerce_group_cols(self.get(self.GROUP_COL))
+        index, order = group_row_indices(t, group_cols)
+        pts, labs, gids = [], [], []
+        keys = []
+        for gid, key in enumerate(order):
+            rows = np.asarray(index[key])
+            lab = GroupGeoDbscanBatchOp._geo_cluster(
+                lat[rows], lon[rows], eps, min_pts)
+            keep = lab >= 0
+            pts.append(np.stack([lat[rows][keep], lon[rows][keep]], axis=1))
+            labs.append(lab[keep])
+            gids.append(np.full(int(keep.sum()), gid, np.int64))
+            keys.append("\x01".join(str(v) for v in key))
+        meta = {"modelName": "DbscanModel", "epsilon": eps,
+                "minPoints": min_pts, "geo": True,
+                "featureCols": [lat_col, lon_col], "vectorCol": None,
+                "dim": 2, "groupCols": group_cols, "groupKeys": keys}
+        return model_to_table(meta, {
+            "points": (np.concatenate(pts) if pts else np.zeros((0, 2))),
+            "labels": (np.concatenate(labs) if labs
+                       else np.zeros(0, np.int64)),
+            "groups": (np.concatenate(gids) if gids
+                       else np.zeros(0, np.int64)),
+        })
+
+
+class GroupEmBatchOp(BatchOperator, HasFeatureCols, HasPredictionCol,
+                     HasReservedCols):
+    """Independent Gaussian-mixture EM per group key — the grouped twin of
+    GmmTrainBatchOp's compiled EM (reference: operator/batch/clustering/
+    GroupEmBatchOp.java)."""
+
+    GROUP_COL = ParamInfo("groupCols", list, aliases=("groupCol",),
+                          optional=False)
+    K = ParamInfo("k", int, default=2, validator=MinValidator(1))
+    MAX_ITER = ParamInfo("maxIter", int, default=50,
+                         validator=MinValidator(1))
+    RANDOM_SEED = ParamInfo("randomSeed", int, default=0, aliases=("seed",))
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    def _execute_impl(self, t: MTable) -> MTable:
+        from .utils2 import coerce_group_cols, group_row_indices
+
+        group_cols = coerce_group_cols(self.get(self.GROUP_COL))
+        feature_cols = resolve_feature_cols(t, self, exclude=group_cols)
+        X = t.to_numeric_block(feature_cols, dtype=np.float64)
+        k = int(self.get(self.K))
+        index, order = group_row_indices(t, group_cols)
+        labels = np.zeros(t.num_rows, np.int64)
+        for key in order:
+            rows = np.asarray(index[key])
+            Xg = X[rows]
+            if len(rows) <= k:
+                labels[rows] = np.arange(len(rows)) % max(k, 1)
+                continue
+            labels[rows] = self._em(Xg, k)
+        pred_col = self.get(HasPredictionCol.PREDICTION_COL)
+        return t.with_column(pred_col, labels, AlinkTypes.LONG)
+
+    def _em(self, X: np.ndarray, k: int) -> np.ndarray:
+        rng = np.random.default_rng(self.get(self.RANDOM_SEED))
+        n, d = X.shape
+        mu = X[rng.choice(n, k, replace=False)]
+        var = np.full((k, d), X.var(0) + 1e-6)
+        pi = np.full(k, 1.0 / k)
+        resp = None
+        for _ in range(int(self.get(self.MAX_ITER))):
+            # diagonal-covariance E step
+            log_p = (-0.5 * (((X[:, None, :] - mu[None]) ** 2 / var[None])
+                             + np.log(2 * np.pi * var[None])).sum(-1)
+                     + np.log(pi)[None, :])
+            m = log_p.max(1, keepdims=True)
+            resp = np.exp(log_p - m)
+            resp /= resp.sum(1, keepdims=True)
+            nk = resp.sum(0) + 1e-9
+            mu_new = (resp.T @ X) / nk[:, None]
+            var = ((resp[:, :, None] * (X[:, None, :] - mu_new[None]) ** 2
+                    ).sum(0) / nk[:, None]) + 1e-6
+            pi = nk / n
+            if np.allclose(mu, mu_new, atol=1e-7):
+                mu = mu_new
+                break
+            mu = mu_new
+        return resp.argmax(1).astype(np.int64)
+
+    def _out_schema(self, in_schema):
+        pred_col = self.get(HasPredictionCol.PREDICTION_COL)
+        return TableSchema(list(in_schema.names) + [pred_col],
+                           list(in_schema.types) + [AlinkTypes.LONG])

@@ -191,25 +191,40 @@ class ConstrainedDivergenceTrainBatchOp(ModelTrainOpMixin, BatchOperator,
             w_part = np.linalg.lstsq(A, b, rcond=None)[0]
             _u, sv, vt = np.linalg.svd(A)
             null = vt[np.sum(sv > 1e-10):].T  # (d+1, k)
+            homogeneous = bool(np.allclose(b, 0.0))
             if null.shape[1] == 0:
                 w = w_part.astype(np.float32)
                 res = None
             else:
                 Xz = (Xb @ null).astype(np.float32)
                 shift = (Xb @ w_part).astype(np.float32)
-                # scores are linear in z plus a constant shift; absorb the
-                # shift by appending it as a fixed pseudo-feature
+                # scores = Xz z + shift with the shift coefficient FIXED at
+                # 1 (append it as a column of the data, not of the weights)
+                import jax.numpy as _jnp
+
+                from ...optim.objfunc import ObjFunc as _ObjFunc
+
+                def local_loss(z, Xj, yj, wt):
+                    s = Xj[:, :-1] @ z + Xj[:, -1]
+                    p = yj * wt
+                    q = (1.0 - yj) * wt
+                    mu_p = (s * p).sum() / _jnp.maximum(p.sum(), 1.0)
+                    mu_q = (s * q).sum() / _jnp.maximum(q.sum(), 1.0)
+                    var_p = ((s - mu_p) ** 2 * p).sum() / _jnp.maximum(
+                        p.sum(), 1.0)
+                    var_q = ((s - mu_q) ** 2 * q).sum() / _jnp.maximum(
+                        q.sum(), 1.0)
+                    div = (mu_p - mu_q) ** 2 / (
+                        0.5 * (var_p + var_q) + 1e-6)
+                    return (-div + 1e-4 * (z @ z)) * Xj.shape[0]
+
+                obj2 = _ObjFunc(local_loss, null.shape[1])
                 Xz2 = np.concatenate([Xz, shift[:, None]], axis=1)
-                obj2 = divergence_obj(null.shape[1] + 1)
-                z0 = np.concatenate(
-                    [null.T @ w0.astype(np.float64), [1.0]]).astype(
-                    np.float32)
+                z0 = (null.T @ w0.astype(np.float64)).astype(np.float32)
                 res = optimize(obj2, Xz2, pos, mesh=self.env.mesh, w0=z0,
                                max_iter=self.get(self.MAX_ITER))
                 z = np.asarray(res.weights, np.float64)
-                # the last coefficient scales the particular solution; for
-                # homogeneous constraints (b=0, w_part=0) it is irrelevant
-                w = (null @ z[:-1] + z[-1] * w_part).astype(np.float32)
+                w = (null @ z + w_part).astype(np.float32)
         else:
             obj = divergence_obj(d + 1)
             if cons:
@@ -220,11 +235,15 @@ class ConstrainedDivergenceTrainBatchOp(ModelTrainOpMixin, BatchOperator,
                 res = optimize(obj, Xb, pos, mesh=self.env.mesh, w0=w0,
                                max_iter=self.get(self.MAX_ITER))
             w = res.weights
-        # export at unit feature-weight norm (scale-invariant objective;
-        # normalization preserves homogeneous constraints)
-        norm = float(np.linalg.norm(w[:d]))
-        if norm > 1e-9:
-            w = np.asarray(w) / norm
+        # export at unit feature-weight norm when that cannot violate the
+        # declared constraints (any inhomogeneous system pins a scale)
+        rescalable = not cons or (
+            cons.get("A_ub") is None
+            and np.allclose(cons.get("b_eq", np.zeros(1)), 0.0))
+        if rescalable:
+            norm = float(np.linalg.norm(np.asarray(w)[:d]))
+            if norm > 1e-9:
+                w = np.asarray(w) / norm
         meta = {
             "modelName": "LinearModel",
             "linearModelType": "LinearReg",  # score = w·x + b serving
@@ -235,8 +254,9 @@ class ConstrainedDivergenceTrainBatchOp(ModelTrainOpMixin, BatchOperator,
             "labels": None,
             "hasIntercept": True,
             "dim": int(d),
-            "loss": res.loss,
+            "loss": None if res is None else res.loss,
         }
+        w = np.asarray(w)
         return model_to_table(meta, {
             "weights": w[:d].astype(np.float32),
             "intercept": np.asarray([w[d]], np.float32)})
@@ -272,6 +292,11 @@ class _SelectorTrainBase(ModelTrainOpMixin, BatchOperator, HasSelectedCols):
         Xb = np.concatenate([X, np.ones((len(X), 1))], axis=1)
         w, *_ = np.linalg.lstsq(Xb, y, rcond=None)
         return w
+
+    def _final_fit_weights(self, X: np.ndarray, y: np.ndarray):
+        """Fit of the CHOSEN columns for the exported model — constrained
+        variants override this (candidate scoring stays unconstrained)."""
+        return self._fit_weights(X, y)
 
     def _score(self, X: np.ndarray, y: np.ndarray) -> float:
         w = self._fit_weights(X, y)
@@ -329,7 +354,7 @@ class _SelectorTrainBase(ModelTrainOpMixin, BatchOperator, HasSelectedCols):
             history.append({"step": len(chosen), "col": cand,
                             "score": round(float(score), 6)})
         X = np.stack([X_all[k] for k in chosen], axis=1)
-        w = self._fit_weights(X, y)
+        w = self._final_fit_weights(X, y)
         meta = {
             "modelName": "SelectorModel",
             "binary": self._binary,
@@ -390,19 +415,29 @@ class RegressionSelectorPredictBatchOp(BinarySelectorPredictBatchOp):
 
 class ConstrainedBinarySelectorTrainBatchOp(BinarySelectorTrainBatchOp,
                                             _ConstrainedSolveMixin):
-    """Stepwise binary selection whose final refit honors linear weight
-    constraints (reference: operator/batch/feature/
+    """Stepwise binary selection whose FINAL refit honors linear weight
+    constraints; candidate scoring stays unconstrained. The constraint
+    matrix columns map to the chosen columns in selection order plus the
+    intercept slot (reference: operator/batch/feature/
     ConstrainedBinarySelectorTrainBatchOp.java)."""
 
-    def _fit_weights(self, X, y):
+    def _final_fit_weights(self, X, y):
         cons = self._constraints()
         if not cons:
-            return super()._fit_weights(X, y)
+            return super()._final_fit_weights(X, y)
         from ...optim import constrained_optimize, squared_obj
 
         Xb = np.concatenate([X, np.ones((len(X), 1))], axis=1)
+        width = Xb.shape[1]
+        for key in ("A_eq", "A_ub"):
+            if key in cons and np.atleast_2d(cons[key]).shape[1] != width:
+                raise AkIllegalArgumentException(
+                    f"constraint {key} has "
+                    f"{np.atleast_2d(cons[key]).shape[1]} columns but the "
+                    f"final model has {width} (selected cols in order + "
+                    f"intercept)")
         res = constrained_optimize(
-            squared_obj(Xb.shape[1]), Xb.astype(np.float32),
+            squared_obj(width), Xb.astype(np.float32),
             y.astype(np.float32), mesh=self.env.mesh,
             method=self.get(self.CONSTRAINED_METHOD), **cons)
         return np.asarray(res.weights, np.float64)
@@ -799,8 +834,8 @@ class GroupedFpGrowthBatchOp(BatchOperator, HasSelectedCol):
             list(base.types) + [in_schema.type_of(group_col)])
 
 
-class ApplyAssociationRuleBatchOp(MapBatchOp, HasSelectedCol, HasOutputCol,
-                                  HasReservedCols):
+class ApplyAssociationRuleBatchOp(ModelMapBatchOp, HasSelectedCol,
+                                  HasOutputCol, HasReservedCols):
     """Apply mined rules to transactions: emit the consequents whose
     antecedents are contained in the row's item set
     (reference: operator/batch/associationrule/
@@ -839,19 +874,6 @@ class ApplyAssociationRuleBatchOp(MapBatchOp, HasSelectedCol, HasOutputCol,
 
     mapper_cls = _Mapper
     ITEM_DELIMITER = _Mapper.ITEM_DELIMITER
-
-    _min_inputs = 2
-    _max_inputs = 2
-
-    def _execute_impl(self, rules: MTable, t: MTable) -> MTable:
-        mapper = self.mapper_cls(rules.schema, t.schema, self.get_params())
-        mapper.load_model(rules)
-        return mapper.map_table(t)
-
-    def _out_schema(self, rules_schema, in_schema):
-        out = self.get(HasOutputCol.OUTPUT_COL) or "recommendations"
-        return TableSchema(list(in_schema.names) + [out],
-                           list(in_schema.types) + [AlinkTypes.STRING])
 
 
 class ApplySequenceRuleBatchOp(ApplyAssociationRuleBatchOp):

@@ -130,3 +130,7 @@ from . import nlp as _nlp_stream
 from .nlp import *  # noqa: F401,F403 — NLP per-chunk twins
 
 __all__ += list(_nlp_stream.__all__)
+from . import windows as _windows_stream
+from .windows import *  # noqa: F401,F403 — window/streaming-cluster ops
+
+__all__ += list(_windows_stream.__all__)

@@ -1,0 +1,738 @@
+"""Time/count window stream ops + streaming clustering + traffic metrics +
+functional stream ops.
+
+Capability parity (reference: operator/stream/sql/TumbleTimeWindowStreamOp
+.java / HopTimeWindowStreamOp.java / SessionTimeWindowStreamOp.java /
+WindowGroupByStreamOp.java; dataproc/OverCountWindowStreamOp.java /
+OverTimeWindowStreamOp.java; statistics/QuantileStreamOp.java;
+evaluation/EvalMultiClassStreamOp.java / EvalRegressionStreamOp.java;
+recommendation/HotProductStreamOp.java; statistics/WebTrafficIndexStreamOp
+.java; clustering/StreamingKMeansStreamOp.java / OnePassClusterStreamOp
+.java; utils/UDFStreamOp.java / UDTFStreamOp.java / PyScalarFnStreamOp.java
+/ PyTableFnStreamOp.java / PandasUdfStreamOp.java / RUdfStreamOp.java /
+FlatMapStreamOp.java; dataproc/ExpandExtendedVarsStreamOp.java;
+onlinelearning/FtrlModelFilterStreamOp.java etc.).
+
+Windows re-cut the micro-batch stream by event time: rows buffer until the
+watermark (max time seen) passes a window's end, then the window's rows
+aggregate through the SAME GroupBy machinery the batch sql ops use.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, Iterator, List, Optional
+
+import numpy as np
+
+from ...common.exceptions import (
+    AkIllegalArgumentException,
+    AkUnsupportedOperationException,
+)
+from ...common.mtable import AlinkTypes, MTable, TableSchema
+from ...common.params import InValidator, MinValidator, ParamInfo
+from .base import StreamOperator
+from .onlinelearning import BinaryClassModelFilterStreamOp
+
+__all__ = [
+    "TumbleTimeWindowStreamOp", "HopTimeWindowStreamOp",
+    "SessionTimeWindowStreamOp", "WindowGroupByStreamOp",
+    "OverCountWindowStreamOp", "OverTimeWindowStreamOp",
+    "QuantileStreamOp", "EvalMultiClassStreamOp", "EvalRegressionStreamOp",
+    "BaseEvalClassStreamOp", "HotProductStreamOp",
+    "WebTrafficIndexStreamOp", "StreamingKMeansStreamOp",
+    "OnePassClusterStreamOp", "UDFStreamOp", "UDTFStreamOp",
+    "PyScalarFnStreamOp", "PyTableFnStreamOp", "PandasUdfStreamOp",
+    "BasePandasUdfStreamOp", "RUdfStreamOp", "FlatMapStreamOp",
+    "ExpandExtendedVarsStreamOp", "FtrlModelFilterStreamOp",
+    "OnlineFmModelFilterStreamOp",
+    "BinaryClassPipelineModelFilterStreamOp",
+    "GenerateFeatureOfLatestStreamOp",
+]
+
+
+def _parse_time(v) -> float:
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return np.datetime64(str(v)).astype("datetime64[s]").astype(float)
+
+
+class _TimeWindowBase(StreamOperator):
+    """Event-time windowing: buffer rows, close windows behind the
+    watermark, aggregate each closed window with the batch GroupBy."""
+
+    TIME_COL = ParamInfo("timeCol", str, optional=False)
+    CLAUSE = ParamInfo("clause", str, optional=False,
+                       desc="aggregate select clause, e.g. "
+                            "'sum(v) as s, count(*) as c'")
+    GROUP_COLS = ParamInfo("groupCols", list, default=None)
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    def _windows_of(self, ts: float) -> List[float]:
+        """Window START keys this timestamp belongs to."""
+        raise NotImplementedError
+
+    def _window_end(self, start: float) -> float:
+        raise NotImplementedError
+
+    def _aggregate(self, start: float, rows: List[tuple],
+                   schema: TableSchema) -> MTable:
+        from ..sql import GroupByOp
+
+        t = MTable.from_rows(rows, schema)
+        group_cols = self.get(self.GROUP_COLS) or []
+        clause = self.get(self.CLAUSE)
+        if group_cols:
+            sel = ", ".join(group_cols) + ", " + clause
+            out = GroupByOp(", ".join(group_cols), sel)._execute_impl(t)
+        else:
+            out = GroupByOp("__w", "__w, " + clause)._execute_impl(
+                t.with_column("__w", np.full(t.num_rows, start),
+                              AlinkTypes.DOUBLE))
+            out = MTable({n: out.col(n) for n in out.names if n != "__w"},
+                         TableSchema([n for n in out.names if n != "__w"],
+                                     [tp for n, tp in
+                                      zip(out.names, out.schema.types)
+                                      if n != "__w"]))
+        return out.with_column("window_start",
+                               np.full(out.num_rows, float(start)),
+                               AlinkTypes.DOUBLE)
+
+    def _stream_impl(self, it: Iterator[MTable]) -> Iterator[MTable]:
+        time_col = self.get(self.TIME_COL)
+        buffers: Dict[float, List[tuple]] = {}
+        schema: Optional[TableSchema] = None
+        watermark = -np.inf
+        for chunk in it:
+            schema = chunk.schema
+            times = [_parse_time(v) for v in chunk.col(time_col)]
+            for row, ts in zip(chunk.rows(), times):
+                for w in self._windows_of(ts):
+                    buffers.setdefault(w, []).append(tuple(row))
+            watermark = max(watermark, max(times, default=watermark))
+            closed = [w for w in buffers if self._window_end(w) <= watermark]
+            for w in sorted(closed):
+                yield self._aggregate(w, buffers.pop(w), schema)
+        for w in sorted(buffers):  # flush at end-of-stream
+            if buffers[w] and schema is not None:
+                yield self._aggregate(w, buffers[w], schema)
+
+
+class TumbleTimeWindowStreamOp(_TimeWindowBase):
+    """Fixed, non-overlapping event-time windows (reference:
+    operator/stream/sql/TumbleTimeWindowStreamOp.java)."""
+
+    WINDOW_TIME = ParamInfo("windowTime", float, optional=False,
+                            desc="window size in seconds")
+
+    def _windows_of(self, ts):
+        size = float(self.get(self.WINDOW_TIME))
+        return [np.floor(ts / size) * size]
+
+    def _window_end(self, start):
+        return start + float(self.get(self.WINDOW_TIME))
+
+
+class HopTimeWindowStreamOp(_TimeWindowBase):
+    """Sliding (hopping) event-time windows (reference:
+    operator/stream/sql/HopTimeWindowStreamOp.java)."""
+
+    WINDOW_TIME = ParamInfo("windowTime", float, optional=False)
+    HOP_TIME = ParamInfo("hopTime", float, optional=False)
+
+    def _windows_of(self, ts):
+        size = float(self.get(self.WINDOW_TIME))
+        hop = float(self.get(self.HOP_TIME))
+        first = (np.floor((ts - size) / hop) + 1) * hop
+        out = []
+        w = first
+        while w <= ts:
+            out.append(float(w))
+            w += hop
+        return out
+
+    def _window_end(self, start):
+        return start + float(self.get(self.WINDOW_TIME))
+
+
+class SessionTimeWindowStreamOp(StreamOperator):
+    """Session windows split by inactivity gaps (reference:
+    operator/stream/sql/SessionTimeWindowStreamOp.java). Sessions close
+    when the watermark passes last-event + gap."""
+
+    TIME_COL = _TimeWindowBase.TIME_COL
+    CLAUSE = _TimeWindowBase.CLAUSE
+    GROUP_COLS = _TimeWindowBase.GROUP_COLS
+    SESSION_GAP_TIME = ParamInfo("sessionGapTime", float, optional=False)
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    def _stream_impl(self, it: Iterator[MTable]) -> Iterator[MTable]:
+        gap = float(self.get(self.SESSION_GAP_TIME))
+        time_col = self.get(self.TIME_COL)
+        # one open session at a time per whole stream (grouped sessions
+        # aggregate inside the session via GROUP_COLS)
+        cur: List[tuple] = []
+        cur_start = None
+        cur_last = None
+        schema: Optional[TableSchema] = None
+        agg = _TimeWindowBase._aggregate
+
+        def flush():
+            if cur and schema is not None:
+                return agg(self, cur_start, list(cur), schema)
+            return None
+
+        for chunk in it:
+            schema = chunk.schema
+            order = np.argsort([_parse_time(v)
+                                for v in chunk.col(time_col)])
+            rows = list(chunk.rows())
+            for i in order:
+                ts = _parse_time(chunk.col(time_col)[i])
+                if cur_last is not None and ts - cur_last > gap:
+                    out = flush()
+                    if out is not None:
+                        yield out
+                    cur = []
+                    cur_start = None
+                cur.append(tuple(rows[i]))
+                cur_start = ts if cur_start is None else cur_start
+                cur_last = ts
+        out = flush()
+        if out is not None:
+            yield out
+
+
+class WindowGroupByStreamOp(StreamOperator):
+    """Unified windowed group-by: windowType TUMBLE/HOP/SESSION (reference:
+    operator/stream/sql/WindowGroupByStreamOp.java)."""
+
+    WINDOW_TYPE = ParamInfo("windowType", str, default="TUMBLE",
+                            validator=InValidator("TUMBLE", "HOP",
+                                                  "SESSION"))
+    TIME_COL = _TimeWindowBase.TIME_COL
+    CLAUSE = _TimeWindowBase.CLAUSE
+    GROUP_COLS = _TimeWindowBase.GROUP_COLS
+    WINDOW_TIME = ParamInfo("windowTime", float, default=60.0)
+    HOP_TIME = ParamInfo("hopTime", float, default=30.0)
+    SESSION_GAP_TIME = ParamInfo("sessionGapTime", float, default=60.0)
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    def _stream_impl(self, it):
+        kind = self.get(self.WINDOW_TYPE)
+        p = self.get_params().clone()
+        if kind == "TUMBLE":
+            inner = TumbleTimeWindowStreamOp(p)
+        elif kind == "HOP":
+            inner = HopTimeWindowStreamOp(p)
+        else:
+            inner = SessionTimeWindowStreamOp(p)
+        return inner._stream_impl(it)
+
+
+class OverCountWindowStreamOp(StreamOperator):
+    """Per-row aggregates over the preceding N rows (rolling buffer across
+    micro-batches) (reference: operator/stream/dataproc/
+    OverCountWindowStreamOp.java)."""
+
+    SELECTED_COL = ParamInfo("selectedCol", str, optional=False,
+                             aliases=("valueCol",))
+    WINDOW_SIZE = ParamInfo("windowSize", int, default=100,
+                            validator=MinValidator(1))
+    AGG = ParamInfo("agg", str, default="mean",
+                    validator=InValidator("mean", "sum", "min", "max",
+                                          "count"))
+    OUTPUT_COL = ParamInfo("outputCol", str, default=None)
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    def _agg(self, window: np.ndarray) -> float:
+        how = self.get(self.AGG)
+        if how == "count":
+            return float(len(window))
+        return float(getattr(np, how)(window)) if len(window) else np.nan
+
+    def _stream_impl(self, it: Iterator[MTable]) -> Iterator[MTable]:
+        col = self.get(self.SELECTED_COL)
+        size = int(self.get(self.WINDOW_SIZE))
+        out_col = self.get(self.OUTPUT_COL) or f"{col}_{self.get(self.AGG)}"
+        tail: List[float] = []
+        for chunk in it:
+            vals = np.asarray(chunk.col(col), np.float64)
+            buf = np.concatenate([np.asarray(tail), vals])
+            off = len(tail)
+            agg = np.asarray([
+                self._agg(buf[max(0, off + i + 1 - size): off + i + 1])
+                for i in range(len(vals))])
+            tail = list(buf[-(size - 1):]) if size > 1 else []
+            yield chunk.with_column(out_col, agg, AlinkTypes.DOUBLE)
+
+
+class OverTimeWindowStreamOp(StreamOperator):
+    """Per-row aggregates over the preceding time span (reference:
+    operator/stream/dataproc/OverTimeWindowStreamOp.java)."""
+
+    SELECTED_COL = ParamInfo("selectedCol", str, optional=False,
+                             aliases=("valueCol",))
+    TIME_COL = ParamInfo("timeCol", str, optional=False)
+    WINDOW_TIME = ParamInfo("windowTime", float, default=60.0)
+    AGG = OverCountWindowStreamOp.AGG
+    OUTPUT_COL = ParamInfo("outputCol", str, default=None)
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    def _stream_impl(self, it: Iterator[MTable]) -> Iterator[MTable]:
+        col = self.get(self.SELECTED_COL)
+        time_col = self.get(self.TIME_COL)
+        span = float(self.get(self.WINDOW_TIME))
+        out_col = self.get(self.OUTPUT_COL) or f"{col}_{self.get(self.AGG)}"
+        hist_t: List[float] = []
+        hist_v: List[float] = []
+        for chunk in it:
+            vals = np.asarray(chunk.col(col), np.float64)
+            times = [_parse_time(v) for v in chunk.col(time_col)]
+            agg = np.empty(len(vals))
+            for i, (ts, v) in enumerate(zip(times, vals)):
+                hist_t.append(ts)
+                hist_v.append(float(v))
+                # drop history beyond the span of the current row
+                while hist_t and hist_t[0] < ts - span:
+                    hist_t.pop(0)
+                    hist_v.pop(0)
+                w = np.asarray([hv for ht, hv in zip(hist_t, hist_v)
+                                if ht >= ts - span])
+                how = self.get(self.AGG)
+                agg[i] = (float(len(w)) if how == "count"
+                          else float(getattr(np, how)(w)))
+            yield chunk.with_column(out_col, agg, AlinkTypes.DOUBLE)
+
+
+# ---------------------------------------------------------------------------
+# cumulative evaluation / statistics streams
+# ---------------------------------------------------------------------------
+
+
+class EvalMultiClassStreamOp(StreamOperator):
+    """Per-window + cumulative multiclass accuracy/macro-F1 (reference:
+    operator/stream/evaluation/EvalMultiClassStreamOp.java)."""
+
+    LABEL_COL = ParamInfo("labelCol", str, optional=False)
+    PREDICTION_COL = ParamInfo("predictionCol", str, optional=False)
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    @staticmethod
+    def _metrics(y, p) -> str:
+        acc = float(np.mean(y == p))
+        f1s = []
+        for lab in sorted(set(y.tolist()) | set(p.tolist())):
+            tp = float(np.sum((p == lab) & (y == lab)))
+            fp = float(np.sum((p == lab) & (y != lab)))
+            fn = float(np.sum((p != lab) & (y == lab)))
+            prec = tp / (tp + fp) if tp + fp else 0.0
+            rec = tp / (tp + fn) if tp + fn else 0.0
+            f1s.append(2 * prec * rec / (prec + rec) if prec + rec else 0.0)
+        return json.dumps({"Accuracy": acc,
+                           "MacroF1": float(np.mean(f1s)),
+                           "Count": int(len(y))})
+
+    def _stream_impl(self, it):
+        schema = TableSchema(["Statistics", "WindowId", "Data"],
+                             [AlinkTypes.STRING, AlinkTypes.LONG,
+                              AlinkTypes.STRING])
+        all_y, all_p = [], []
+        for i, chunk in enumerate(it):
+            y = np.asarray([str(v) for v in
+                            chunk.col(self.get(self.LABEL_COL))])
+            p = np.asarray([str(v) for v in
+                            chunk.col(self.get(self.PREDICTION_COL))])
+            all_y.append(y)
+            all_p.append(p)
+            yield MTable.from_rows(
+                [("window", i, self._metrics(y, p))], schema)
+        if all_y:
+            yield MTable.from_rows(
+                [("all", -1, self._metrics(np.concatenate(all_y),
+                                           np.concatenate(all_p)))], schema)
+
+
+class BaseEvalClassStreamOp(EvalMultiClassStreamOp):
+    """(reference: operator/stream/evaluation/BaseEvalClassStreamOp.java)"""
+
+
+class EvalRegressionStreamOp(StreamOperator):
+    """Per-window + cumulative MAE/RMSE/R2 (reference:
+    operator/stream/evaluation/EvalRegressionStreamOp.java)."""
+
+    LABEL_COL = ParamInfo("labelCol", str, optional=False)
+    PREDICTION_COL = ParamInfo("predictionCol", str, optional=False)
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    @staticmethod
+    def _metrics(y, p) -> str:
+        err = y - p
+        mae = float(np.abs(err).mean())
+        rmse = float(np.sqrt((err ** 2).mean()))
+        ss_tot = float(((y - y.mean()) ** 2).sum()) + 1e-12
+        r2 = 1.0 - float((err ** 2).sum()) / ss_tot
+        return json.dumps({"MAE": mae, "RMSE": rmse, "R2": r2,
+                           "Count": int(len(y))})
+
+    def _stream_impl(self, it):
+        schema = TableSchema(["Statistics", "WindowId", "Data"],
+                             [AlinkTypes.STRING, AlinkTypes.LONG,
+                              AlinkTypes.STRING])
+        all_y, all_p = [], []
+        for i, chunk in enumerate(it):
+            y = np.asarray(chunk.col(self.get(self.LABEL_COL)), np.float64)
+            p = np.asarray(chunk.col(self.get(self.PREDICTION_COL)),
+                           np.float64)
+            all_y.append(y)
+            all_p.append(p)
+            yield MTable.from_rows(
+                [("window", i, self._metrics(y, p))], schema)
+        if all_y:
+            yield MTable.from_rows(
+                [("all", -1, self._metrics(np.concatenate(all_y),
+                                           np.concatenate(all_p)))], schema)
+
+
+class QuantileStreamOp(StreamOperator):
+    """Cumulative quantiles of a column, one row set per micro-batch
+    (reference: operator/stream/statistics/QuantileStreamOp.java)."""
+
+    SELECTED_COL = ParamInfo("selectedCol", str, optional=False)
+    QUANTILE_NUM = ParamInfo("quantileNum", int, default=4,
+                             validator=MinValidator(1))
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    def _stream_impl(self, it):
+        col = self.get(self.SELECTED_COL)
+        q = int(self.get(self.QUANTILE_NUM))
+        seen: List[np.ndarray] = []
+        schema = TableSchema(["quantile", "value"],
+                             [AlinkTypes.DOUBLE, AlinkTypes.DOUBLE])
+        for chunk in it:
+            seen.append(np.asarray(chunk.col(col), np.float64))
+            allv = np.concatenate(seen)
+            qs = np.linspace(0, 1, q + 1)
+            vals = np.quantile(allv, qs)
+            yield MTable.from_rows(
+                [(float(a), float(b)) for a, b in zip(qs, vals)], schema)
+
+
+class HotProductStreamOp(StreamOperator):
+    """Cumulative top-N hottest items, re-emitted per micro-batch
+    (reference: operator/stream/recommendation/HotProductStreamOp.java)."""
+
+    SELECTED_COL = ParamInfo("selectedCol", str, optional=False,
+                             aliases=("itemCol",))
+    TOP_N = ParamInfo("topN", int, default=10, validator=MinValidator(1))
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    def _stream_impl(self, it):
+        from collections import Counter
+
+        col = self.get(self.SELECTED_COL)
+        n = int(self.get(self.TOP_N))
+        counts: Counter = Counter()
+        schema = TableSchema(["item", "count"],
+                             [AlinkTypes.STRING, AlinkTypes.LONG])
+        for chunk in it:
+            counts.update(str(v) for v in chunk.col(col))
+            yield MTable.from_rows(
+                [(k, int(c)) for k, c in counts.most_common(n)], schema)
+
+
+class WebTrafficIndexStreamOp(StreamOperator):
+    """Cumulative PV/UV traffic indexes (reference:
+    operator/stream/statistics/WebTrafficIndexStreamOp.java — the
+    bitmap/sketch UV estimation collapses to an exact set here)."""
+
+    SELECTED_COL = ParamInfo("selectedCol", str, optional=False,
+                             aliases=("userCol",))
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    def _stream_impl(self, it):
+        pv = 0
+        uniques = set()
+        schema = TableSchema(["index", "value"],
+                             [AlinkTypes.STRING, AlinkTypes.LONG])
+        for chunk in it:
+            vals = [str(v) for v in chunk.col(self.get(self.SELECTED_COL))]
+            pv += len(vals)
+            uniques.update(vals)
+            yield MTable.from_rows(
+                [("PV", pv), ("UV", len(uniques))], schema)
+
+
+# ---------------------------------------------------------------------------
+# streaming clustering
+# ---------------------------------------------------------------------------
+
+
+class StreamingKMeansStreamOp(StreamOperator):
+    """Mini-batch k-means with decayed centroid updates: consumes a trained
+    KMeans model for the initial centroids, assigns each micro-batch, and
+    updates centroids with the decay factor (reference:
+    operator/stream/clustering/StreamingKMeansStreamOp.java)."""
+
+    PREDICTION_COL = ParamInfo("predictionCol", str, default="cluster_id")
+    HALF_LIFE = ParamInfo("halfLife", float, default=10.0,
+                          desc="micro-batches until an old centroid's "
+                               "weight halves")
+
+    _min_inputs = 1
+    _max_inputs = 2
+
+    def __init__(self, model: Optional[MTable] = None, params=None, **kw):
+        super().__init__(params, **kw)
+        self._model = model
+
+    def _stream_impl(self, *ins: Iterator[MTable]) -> Iterator[MTable]:
+        from ...common.model import table_to_model
+        from ...mapper import get_feature_block, merge_feature_params
+
+        data_it = ins[-1]
+        model = self._model
+        if model is None and len(ins) == 2:
+            # first input is a model stream: its first snapshot seeds the
+            # centroids (ModelMapStreamOp convention)
+            try:
+                model = next(ins[0])
+            except StopIteration:
+                model = None
+        if model is None:
+            raise AkIllegalArgumentException(
+                "StreamingKMeansStreamOp needs model= (a trained KMeans "
+                "model table) or a model-table first input")
+        meta, arrays = table_to_model(model)
+        centers = np.asarray(arrays["centroids"], np.float64).copy()
+        weights = np.ones(len(centers))
+        decay = 0.5 ** (1.0 / float(self.get(self.HALF_LIFE)))
+        pred_col = self.get(self.PREDICTION_COL)
+        p = merge_feature_params(self.get_params(), meta)
+        for chunk in data_it:
+            X = np.asarray(get_feature_block(chunk, p), np.float64)
+            d2 = ((X[:, None, :] - centers[None, :, :]) ** 2).sum(-1)
+            assign = d2.argmin(1)
+            yield chunk.with_column(pred_col, assign.astype(np.int64),
+                                    AlinkTypes.LONG)
+            # decayed mini-batch update
+            weights *= decay
+            for k in range(len(centers)):
+                rows = X[assign == k]
+                if len(rows):
+                    w_new = weights[k] + len(rows)
+                    centers[k] = (centers[k] * weights[k]
+                                  + rows.sum(0)) / w_new
+                    weights[k] = w_new
+
+
+class OnePassClusterStreamOp(StreamOperator):
+    """Single-pass threshold clustering: assign to the nearest existing
+    center within epsilon, else open a new cluster (reference:
+    operator/stream/clustering/OnePassClusterStreamOp.java)."""
+
+    FEATURE_COLS = ParamInfo("featureCols", list, default=None)
+    VECTOR_COL = ParamInfo("vectorCol", str, default=None)
+    EPSILON = ParamInfo("epsilon", float, optional=False)
+    MAX_CLUSTER_NUMBER = ParamInfo("maxClusterNumber", int, default=100)
+    PREDICTION_COL = ParamInfo("predictionCol", str, default="cluster_id")
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    def _stream_impl(self, it: Iterator[MTable]) -> Iterator[MTable]:
+        from ...mapper import get_feature_block
+
+        eps = float(self.get(self.EPSILON))
+        cap = int(self.get(self.MAX_CLUSTER_NUMBER))
+        pred_col = self.get(self.PREDICTION_COL)
+        centers: List[np.ndarray] = []
+        counts: List[int] = []
+        for chunk in it:
+            X = np.asarray(get_feature_block(chunk, self), np.float64)
+            assign = np.empty(len(X), np.int64)
+            for i, x in enumerate(X):
+                if centers:
+                    C = np.stack(centers)
+                    d = np.sqrt(((C - x) ** 2).sum(1))
+                    j = int(d.argmin())
+                else:
+                    d = np.asarray([np.inf])
+                    j = 0
+                if centers and d[j] <= eps:
+                    assign[i] = j
+                    counts[j] += 1  # running-mean center update
+                    centers[j] = centers[j] + (x - centers[j]) / counts[j]
+                elif len(centers) < cap:
+                    assign[i] = len(centers)
+                    centers.append(x.copy())
+                    counts.append(1)
+                else:
+                    assign[i] = j  # at capacity: nearest wins
+                    counts[j] += 1
+                    centers[j] = centers[j] + (x - centers[j]) / counts[j]
+            yield chunk.with_column(pred_col, assign, AlinkTypes.LONG)
+
+
+# ---------------------------------------------------------------------------
+# functional stream ops
+# ---------------------------------------------------------------------------
+
+
+class _FuncPerChunkStreamOp(StreamOperator):
+    """Apply a func-configured batch op per micro-batch."""
+
+    _min_inputs = 1
+    _max_inputs = 1
+    _batch_cls = None
+
+    def __init__(self, func=None, params=None, **kw):
+        super().__init__(params, **kw)
+        self._func = func
+
+    def _stream_impl(self, it: Iterator[MTable]) -> Iterator[MTable]:
+        for chunk in it:
+            op = self._batch_cls(func=self._func,
+                                 params=self.get_params().clone())
+            yield op._execute_impl(chunk)
+
+
+def _func_stream(name: str, batch_cls, ref: str):
+    cls = type(name, (_FuncPerChunkStreamOp,), {
+        "_batch_cls": batch_cls,
+        "__doc__": f"Per-micro-batch twin of {batch_cls.__name__} "
+                   f"(reference: {ref}).",
+        "__module__": __name__,
+    })
+    return cls
+
+
+def _make_func_streams():
+    from ..batch.udf2 import (
+        FlatMapBatchOp,
+        PandasUdfBatchOp,
+        PyScalarFnBatchOp,
+        PyTableFnBatchOp,
+        UDFBatchOp,
+        UDTFBatchOp,
+    )
+
+    return {
+        "UDFStreamOp": _func_stream(
+            "UDFStreamOp", UDFBatchOp, "operator/stream/utils/UDFStreamOp.java"),
+        "UDTFStreamOp": _func_stream(
+            "UDTFStreamOp", UDTFBatchOp,
+            "operator/stream/utils/UDTFStreamOp.java"),
+        "PyScalarFnStreamOp": _func_stream(
+            "PyScalarFnStreamOp", PyScalarFnBatchOp,
+            "operator/stream/utils/PyScalarFnStreamOp.java"),
+        "PyTableFnStreamOp": _func_stream(
+            "PyTableFnStreamOp", PyTableFnBatchOp,
+            "operator/stream/utils/PyTableFnStreamOp.java"),
+        "PandasUdfStreamOp": _func_stream(
+            "PandasUdfStreamOp", PandasUdfBatchOp,
+            "operator/stream/utils/PandasUdfStreamOp.java"),
+        "FlatMapStreamOp": _func_stream(
+            "FlatMapStreamOp", FlatMapBatchOp,
+            "operator/stream/utils/FlatMapStreamOp.java"),
+    }
+
+
+globals().update(_make_func_streams())
+
+
+class BasePandasUdfStreamOp(globals()["PandasUdfStreamOp"]):
+    """(reference: operator/stream/utils/BasePandasUdfStreamOp.java)"""
+
+
+class RUdfStreamOp(StreamOperator):
+    """Gated: R runtime absent (reference: operator/stream/utils/
+    RUdfStreamOp.java)."""
+
+    def __init__(self, *a, **kw):
+        raise AkUnsupportedOperationException(
+            "R is not available in this runtime; wrap an R bridge as a "
+            "python callable in UDFStreamOp/PandasUdfStreamOp instead.")
+
+
+class ExpandExtendedVarsStreamOp(StreamOperator):
+    """Expand a JSON extended-vars column into declared columns
+    (reference: operator/stream/dataproc/ExpandExtendedVarsStreamOp.java)."""
+
+    SELECTED_COL = ParamInfo("selectedCol", str, optional=False,
+                             aliases=("extendedVarsCol",))
+    EXTENDED_VARS = ParamInfo("extendedVars", str, optional=False,
+                              desc="comma-separated keys to expand")
+    RESERVED_COLS = ParamInfo("reservedCols", list, default=None)
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    def _stream_impl(self, it: Iterator[MTable]) -> Iterator[MTable]:
+        sel = self.get(self.SELECTED_COL)
+        keys = [k.strip() for k in self.get(self.EXTENDED_VARS).split(",")
+                if k.strip()]
+        for chunk in it:
+            out = chunk
+            cells = chunk.col(sel)
+            parsed = []
+            for v in cells:
+                try:
+                    parsed.append(json.loads(str(v)) if v is not None else {})
+                except json.JSONDecodeError:
+                    parsed.append({})
+            for k in keys:
+                vals = np.asarray(
+                    [None if p.get(k) is None else str(p.get(k))
+                     for p in parsed], object)
+                out = out.with_column(k, vals, AlinkTypes.STRING)
+            yield out
+
+
+class FtrlModelFilterStreamOp(BinaryClassModelFilterStreamOp):
+    """(reference: operator/stream/onlinelearning/
+    FtrlModelFilterStreamOp.java — the shared windowed-gate filter)."""
+
+
+class OnlineFmModelFilterStreamOp(BinaryClassModelFilterStreamOp):
+    """(reference: operator/stream/onlinelearning/
+    OnlineFmModelFilterStreamOp.java)"""
+
+
+class BinaryClassPipelineModelFilterStreamOp(BinaryClassModelFilterStreamOp):
+    """(reference: operator/stream/onlinelearning/
+    BinaryClassPipelineModelFilterStreamOp.java)"""
+
+
+def _latest_twin():
+    from ..batch.windowfe import GenerateFeatureOfLatestBatchOp
+    from .base import make_per_chunk_twin
+
+    return make_per_chunk_twin(
+        GenerateFeatureOfLatestBatchOp, "GenerateFeatureOfLatestStreamOp",
+        "Per-micro-batch twin of GenerateFeatureOfLatestBatchOp (reference: "
+        "operator/stream/feature/GenerateFeatureOfLatestStreamOp.java).")
+
+
+GenerateFeatureOfLatestStreamOp = _latest_twin()

@@ -1,0 +1,208 @@
+"""Window/stream long-tail tests (reference test model:
+TumbleTimeWindowStreamOpTest.java, StreamingKMeansStreamOpTest.java
+styles)."""
+
+import numpy as np
+import pytest
+
+from alink_tpu.common.mtable import MTable
+from alink_tpu.operator.stream import TableSourceStreamOp
+
+
+def _src(numChunks=4):
+    t = MTable({"ts": np.arange(20, dtype=np.float64),
+                "v": np.arange(20, dtype=np.float64),
+                "g": np.asarray(["a", "b"] * 10, object)})
+    return TableSourceStreamOp(t, numChunks=numChunks)
+
+
+def test_tumble_window():
+    from alink_tpu.operator.stream import TumbleTimeWindowStreamOp
+
+    out = TumbleTimeWindowStreamOp(
+        timeCol="ts", windowTime=5.0,
+        clause="sum(v) as s, count(*) as c").link_from(_src()).collect()
+    assert out.num_rows == 4
+    assert out.col("c").tolist() == [5, 5, 5, 5]
+    assert out.col("s").tolist() == [10.0, 35.0, 60.0, 85.0]
+    assert out.col("window_start").tolist() == [0.0, 5.0, 10.0, 15.0]
+
+
+def test_hop_and_session_windows():
+    from alink_tpu.operator.stream import (
+        HopTimeWindowStreamOp,
+        SessionTimeWindowStreamOp,
+    )
+
+    out = HopTimeWindowStreamOp(
+        timeCol="ts", windowTime=10.0, hopTime=5.0,
+        clause="count(*) as c").link_from(_src()).collect()
+    assert out.num_rows >= 4  # overlapping windows
+    gaps = MTable({"ts": np.asarray([0., 1., 2., 50., 51., 100.]),
+                   "v": np.ones(6)})
+    sess = SessionTimeWindowStreamOp(
+        timeCol="ts", sessionGapTime=10.0,
+        clause="count(*) as c").link_from(
+        TableSourceStreamOp(gaps, numChunks=2)).collect()
+    assert sess.col("c").tolist() == [3, 2, 1]
+
+
+def test_over_windows():
+    from alink_tpu.operator.stream import (
+        OverCountWindowStreamOp,
+        OverTimeWindowStreamOp,
+    )
+
+    out = OverCountWindowStreamOp(
+        selectedCol="v", windowSize=3, agg="mean").link_from(
+        _src()).collect()
+    # rolling mean crosses micro-batch boundaries seamlessly
+    assert out.col("v_mean")[0] == 0.0
+    assert out.col("v_mean")[5] == 4.0  # mean(3,4,5)
+    ot = OverTimeWindowStreamOp(
+        selectedCol="v", timeCol="ts", windowTime=2.0,
+        agg="sum").link_from(_src()).collect()
+    assert ot.col("v_sum")[10] == 27.0  # 8+9+10
+
+
+def test_eval_streams_and_quantile():
+    from alink_tpu.operator.stream import (
+        EvalMultiClassStreamOp,
+        EvalRegressionStreamOp,
+        QuantileStreamOp,
+    )
+    import json
+
+    ev = MTable({"y": np.asarray(["a", "b"] * 10, object),
+                 "p": np.asarray(["a", "b", "a", "a"] * 5, object)})
+    out = EvalMultiClassStreamOp(labelCol="y", predictionCol="p").link_from(
+        TableSourceStreamOp(ev, numChunks=2)).collect()
+    assert out.num_rows == 3  # 2 windows + cumulative
+    final = json.loads(list(out.rows())[-1][-1])
+    assert final["Count"] == 20 and 0 < final["Accuracy"] < 1
+    er = EvalRegressionStreamOp(labelCol="ts",
+                                predictionCol="v").link_from(
+        _src()).collect()
+    final = json.loads(list(er.rows())[-1][-1])
+    assert final["RMSE"] == 0.0 and final["R2"] == 1.0
+    q = QuantileStreamOp(selectedCol="v", quantileNum=2).link_from(
+        _src()).collect()
+    assert list(q.rows())[-1][-1] == 19.0  # cumulative max
+
+
+def test_hot_product_and_traffic():
+    from alink_tpu.operator.stream import (
+        HotProductStreamOp,
+        WebTrafficIndexStreamOp,
+    )
+
+    hot = HotProductStreamOp(selectedCol="g", topN=1).link_from(
+        _src()).collect()
+    assert list(hot.rows())[-1][1] == 10  # cumulative count
+    wt = WebTrafficIndexStreamOp(selectedCol="g").link_from(
+        _src()).collect()
+    rows = list(wt.rows())
+    assert rows[-2][1] == 20 and rows[-1][1] == 2  # PV, UV
+
+
+def test_streaming_clustering():
+    from alink_tpu.operator.batch import KMeansTrainBatchOp
+    from alink_tpu.operator.batch.base import TableSourceBatchOp
+    from alink_tpu.operator.stream import (
+        OnePassClusterStreamOp,
+        StreamingKMeansStreamOp,
+    )
+
+    rng = np.random.default_rng(0)
+    X = np.r_[rng.normal(0, 0.3, (30, 2)), rng.normal(5, 0.3, (30, 2))]
+    t = MTable({"f0": X[:, 0], "f1": X[:, 1]})
+    km = KMeansTrainBatchOp(k=2, featureCols=["f0", "f1"]).link_from(
+        TableSourceBatchOp(t)).collect()
+    out = StreamingKMeansStreamOp(
+        model=km, featureCols=["f0", "f1"]).link_from(
+        TableSourceStreamOp(t, numChunks=3)).collect()
+    c = np.asarray(out.col("cluster_id"))
+    assert len(set(c[:30])) == 1 and len(set(c[30:])) == 1
+    assert c[0] != c[-1]
+    op = OnePassClusterStreamOp(
+        featureCols=["f0", "f1"], epsilon=2.0).link_from(
+        TableSourceStreamOp(t, numChunks=3)).collect()
+    c = np.asarray(op.col("cluster_id"))
+    assert len(set(c.tolist())) == 2
+
+
+def test_functional_streams():
+    from alink_tpu.operator.stream import (
+        ExpandExtendedVarsStreamOp,
+        FlatMapStreamOp,
+        PandasUdfStreamOp,
+        UDFStreamOp,
+    )
+
+    out = UDFStreamOp(func=lambda v: v * 2, selectedCols=["v"],
+                      outputCol="v2").link_from(_src()).collect()
+    assert out.col("v2").tolist() == [v * 2.0 for v in range(20)]
+    fm = FlatMapStreamOp(
+        func=lambda ts, v, g: [(g, v), (g, -v)],
+        resultSchemaStr="g STRING, v DOUBLE").link_from(_src()).collect()
+    assert fm.num_rows == 40
+    pu = PandasUdfStreamOp(
+        func=lambda df: df.assign(z=df.v + 1)).link_from(_src()).collect()
+    assert pu.col("z")[0] == 1.0
+    ee = MTable({"vars": np.asarray(['{"a": 1, "b": "x"}'] * 4, object)})
+    out = ExpandExtendedVarsStreamOp(
+        selectedCol="vars", extendedVars="a,b").link_from(
+        TableSourceStreamOp(ee, numChunks=2)).collect()
+    assert out.col("a").tolist() == ["1"] * 4
+    assert out.col("b").tolist() == ["x"] * 4
+
+
+def test_model_filter_aliases_and_rudf_gate():
+    import alink_tpu.operator.stream as sm
+    from alink_tpu.common.exceptions import AkUnsupportedOperationException
+
+    for n in ("FtrlModelFilterStreamOp", "OnlineFmModelFilterStreamOp",
+              "BinaryClassPipelineModelFilterStreamOp",
+              "GenerateFeatureOfLatestStreamOp", "WindowGroupByStreamOp",
+              "BaseEvalClassStreamOp", "BasePandasUdfStreamOp"):
+        assert hasattr(sm, n), n
+    with pytest.raises(AkUnsupportedOperationException):
+        sm.RUdfStreamOp()
+
+
+def test_grouped_geo_and_em_clustering():
+    from alink_tpu.operator.batch import (
+        DbscanModelOutlierPredictBatchOp,
+        GroupEmBatchOp,
+        GroupGeoDbscanBatchOp,
+        GroupGeoDbscanModelBatchOp,
+    )
+    from alink_tpu.operator.batch.base import TableSourceBatchOp
+
+    rng = np.random.default_rng(0)
+    lat = np.r_[rng.normal(39.9, 0.01, 20), rng.normal(31.2, 0.01, 20)]
+    lon = np.r_[rng.normal(116.4, 0.01, 20), rng.normal(121.5, 0.01, 20)]
+    t = MTable({"g": np.repeat(["bj", "sh"], 20),
+                "latitude": lat, "longitude": lon})
+    src = TableSourceBatchOp(t)
+    r = GroupGeoDbscanBatchOp(groupCols=["g"], epsilon=5.0, minPoints=3,
+                              predictionCol="c").link_from(src).collect()
+    assert (np.asarray(r.col("c")) >= 0).all()
+    m = GroupGeoDbscanModelBatchOp(groupCols=["g"], epsilon=5.0,
+                                   minPoints=3).link_from(src)
+    test = MTable({"g": np.asarray(["bj", "bj"], object),
+                   "latitude": np.asarray([39.9, 10.0]),
+                   "longitude": np.asarray([116.4, 50.0])})
+    o = DbscanModelOutlierPredictBatchOp(predictionCol="o").link_from(
+        m, TableSourceBatchOp(test)).collect()
+    assert o.col("o").tolist() == [False, True]
+
+    X = np.r_[rng.normal(0, 0.3, (30, 2)), rng.normal(4, 0.3, (30, 2))]
+    t2 = MTable({"g": np.repeat(["a", "b"], 30),
+                 "f0": X[:, 0], "f1": X[:, 1]})
+    em = GroupEmBatchOp(groupCols=["g"], k=2, featureCols=["f0", "f1"],
+                        predictionCol="c").link_from(
+        TableSourceBatchOp(t2)).collect()
+    c = np.asarray(em.col("c"))
+    # within group 'a': the two gaussian halves separate
+    assert len(set(c[:30].tolist())) <= 2
