@@ -103,7 +103,7 @@ class TransformerEncoder(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
-                 *, deterministic: bool = True):
+                 *, deterministic: bool = True, return_pooled: bool = False):
         c = self.cfg
         b, s = input_ids.shape
         if attention_mask is None:
@@ -131,6 +131,8 @@ class TransformerEncoder(nn.Module):
         m = attention_mask.astype(x.dtype)[:, :, None]
         pooled = (x * m).sum(axis=1) / jnp.maximum(m.sum(axis=1), 1.0)
         pooled = jnp.tanh(nn.Dense(c.hidden_size, dtype=c.dtype, name="pooler")(pooled))
+        if return_pooled:  # embedding serving (BertTextEmbeddingBatchOp)
+            return pooled.astype(jnp.float32)
         out_dim = 1 if c.regression else c.num_labels
         logits = nn.Dense(out_dim, dtype=jnp.float32, name="head")(pooled)
         return logits

@@ -643,3 +643,36 @@ from .clustering2 import (
     GroupGeoDbscanBatchOp,
     GroupGeoDbscanModelBatchOp,
 )
+from .io2 import (
+    AggLookupBatchOp,
+    BertTextEmbeddingBatchOp,
+    BertTextPairClassifierPredictBatchOp,
+    BertTextPairRegressorPredictBatchOp,
+    BertTextPairRegressorTrainBatchOp,
+    CatalogSinkBatchOp,
+    CatalogSourceBatchOp,
+    HBaseSinkBatchOp,
+    InternalFullStatsBatchOp,
+    LinearRegStepwisePredictBatchOp,
+    LinearRegStepwiseTrainBatchOp,
+    LookupHBaseBatchOp,
+    LookupRedisRowBatchOp,
+    LookupRedisStringBatchOp,
+    RedisRowSinkBatchOp,
+    RedisStringSinkBatchOp,
+    TF2TableModelTrainBatchOp,
+    TFRecordDatasetSinkBatchOp,
+    TFRecordDatasetSourceBatchOp,
+    TFTableModelClassifierPredictBatchOp,
+    TFTableModelClassifierTrainBatchOp,
+    TFTableModelPredictBatchOp,
+    TFTableModelRegressorPredictBatchOp,
+    TFTableModelRegressorTrainBatchOp,
+    TFTableModelTrainBatchOp,
+    TensorFlow2BatchOp,
+    TensorFlowBatchOp,
+    WriteTensorToImageBatchOp,
+    XGBoostRegPredictBatchOp,
+    XGBoostRegTrainBatchOp,
+    XlsSinkBatchOp,
+)

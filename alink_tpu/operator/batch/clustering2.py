@@ -290,6 +290,29 @@ class BisectingKMeansPredictBatchOp(ModelMapBatchOp, HasPredictionCol,
 # DBSCAN
 # ---------------------------------------------------------------------------
 
+def _expand_clusters(neighbors, core):
+    """Shared DBSCAN cluster expansion: BFS from each unvisited core point
+    (used by the euclidean and haversine variants so the border-point
+    semantics cannot drift)."""
+    n = len(neighbors)
+    labels = np.full(n, -1, np.int64)
+    cid = 0
+    for i in range(n):
+        if labels[i] != -1 or not core[i]:
+            continue
+        labels[i] = cid
+        frontier = list(neighbors[i])
+        while frontier:
+            j = frontier.pop()
+            if labels[j] == -1:
+                labels[j] = cid
+                if core[j]:
+                    frontier.extend(jj for jj in neighbors[j]
+                                    if labels[jj] == -1)
+        cid += 1
+    return labels
+
+
 def _eps_neighbors(X: np.ndarray, eps: float, block: int = 2048):
     """Adjacency lists of the ε-graph, distances computed on device in
     (block × n) tiles."""
@@ -329,24 +352,8 @@ class DbscanBatchOp(BatchOperator, HasVectorCol, HasFeatureCols,
         eps = float(self.get(self.EPSILON))
         min_pts = int(self.get(self.MIN_POINTS))
         neighbors = _eps_neighbors(X, eps)
-        n = X.shape[0]
-        labels = np.full(n, -1, np.int64)
         core = np.asarray([len(nb) >= min_pts for nb in neighbors])
-        cid = 0
-        for i in range(n):
-            if labels[i] != -1 or not core[i]:
-                continue
-            # BFS over density-reachable points
-            labels[i] = cid
-            frontier = list(neighbors[i])
-            while frontier:
-                j = frontier.pop()
-                if labels[j] == -1:
-                    labels[j] = cid
-                    if core[j]:
-                        frontier.extend(
-                            jj for jj in neighbors[j] if labels[jj] == -1)
-            cid += 1
+        labels = _expand_clusters(neighbors, core)
         pred_col = self.get(HasPredictionCol.PREDICTION_COL)
         return t.with_column(pred_col, labels, AlinkTypes.LONG)
 
@@ -818,25 +825,10 @@ class GroupGeoDbscanBatchOp(BatchOperator, HasPredictionCol, HasReservedCols):
         X = _np.stack([lat, lon], axis=1)
         D = _np.asarray(_haversine_dists(X, X))
         n = len(lat)
-        neighbors = [set(_np.nonzero(D[i] <= eps_km)[0].tolist()) - {i}
-                     for i in range(n)]
-        labels = _np.full(n, -1, _np.int64)
+        neighbors = [list(set(_np.nonzero(D[i] <= eps_km)[0].tolist())
+                          - {i}) for i in range(n)]
         core = _np.asarray([len(nb) + 1 >= min_pts for nb in neighbors])
-        cid = 0
-        for i in range(n):
-            if labels[i] != -1 or not core[i]:
-                continue
-            labels[i] = cid
-            frontier = list(neighbors[i])
-            while frontier:
-                j = frontier.pop()
-                if labels[j] == -1:
-                    labels[j] = cid
-                    if core[j]:
-                        frontier.extend(jj for jj in neighbors[j]
-                                        if labels[jj] == -1)
-            cid += 1
-        return labels
+        return _expand_clusters(neighbors, core)
 
     def _execute_impl(self, t: MTable) -> MTable:
         from .utils2 import coerce_group_cols, group_row_indices

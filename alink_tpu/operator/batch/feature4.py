@@ -191,7 +191,6 @@ class ConstrainedDivergenceTrainBatchOp(ModelTrainOpMixin, BatchOperator,
             w_part = np.linalg.lstsq(A, b, rcond=None)[0]
             _u, sv, vt = np.linalg.svd(A)
             null = vt[np.sum(sv > 1e-10):].T  # (d+1, k)
-            homogeneous = bool(np.allclose(b, 0.0))
             if null.shape[1] == 0:
                 w = w_part.astype(np.float32)
                 res = None

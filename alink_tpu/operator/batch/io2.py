@@ -1,0 +1,523 @@
+"""IO / DL long-tail: dataset-named TFRecord ops, Xls sink, Redis/HBase
+named connectors, catalog source/sink, TF table-model family, XGBoost
+regression names, tensor-to-image, aggregated embedding lookup, BERT
+embeddings and text-pair serving, stepwise-regression names.
+
+Capability parity (reference: operator/batch/source/
+TFRecordDatasetSourceBatchOp.java / sink/TFRecordDatasetSinkBatchOp.java;
+sink/XlsSinkBatchOp.java; dataproc/LookupRedisRowBatchOp.java /
+LookupRedisStringBatchOp.java / LookupHBaseBatchOp.java,
+sink/RedisRowSinkBatchOp.java / RedisStringSinkBatchOp.java /
+HBaseSinkBatchOp.java; source/CatalogSourceBatchOp.java /
+sink/CatalogSinkBatchOp.java; dataproc/TensorFlowBatchOp.java /
+TensorFlow2BatchOp.java, classification/TFTableModelClassifierPredictBatchOp
+.java + regression twin + dataproc/TFTableModelPredictBatchOp.java /
+TF2TableModelTrainBatchOp.java; classification/XGBoostRegTrainBatchOp.java /
+XGBoostRegPredictBatchOp.java; image/WriteTensorToImageBatchOp.java;
+dataproc/AggLookupBatchOp.java; classification/BertTextEmbeddingBatchOp.java
++ pair predict twins; regression/LinearRegStepwiseTrainBatchOp.java /
+LinearRegStepwisePredictBatchOp.java; statistics/InternalFullStatsBatchOp
+.java).
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ...common.exceptions import (
+    AkIllegalArgumentException,
+    AkIllegalDataException,
+)
+from ...common.linalg import DenseVector, parse_vector
+from ...common.model import table_to_model
+from ...common.mtable import AlinkTypes, MTable, TableSchema
+from ...common.params import InValidator, MinValidator, ParamInfo
+from ...io.filesystem import file_open
+from ...mapper import (
+    HasOutputCol,
+    HasReservedCols,
+    HasSelectedCol,
+    HasSelectedCols,
+    ModelMapper,
+)
+from .base import BatchOperator
+from .connectors import KvSinkBatchOp, LookupKvBatchOp
+from .dl import (
+    BertTextClassifierPredictBatchOp,
+    BertTextModelMapper,
+    BertTextPairClassifierTrainBatchOp,
+    BertTextRegressorPredictBatchOp,
+    BertTextRegressorTrainBatchOp,
+    KerasSequentialClassifierPredictBatchOp,
+    KerasSequentialClassifierTrainBatchOp,
+    KerasSequentialRegressorPredictBatchOp,
+    KerasSequentialRegressorTrainBatchOp,
+)
+from .linear import LinearRegPredictBatchOp
+from .modelpredict import TFSavedModelPredictBatchOp
+from .regression import StepwiseLinearRegTrainBatchOp
+from .sources import TFRecordSinkBatchOp, TFRecordSourceBatchOp
+from .statistics import SummarizerBatchOp
+from .udf2 import PandasUdfBatchOp
+from .utils import MapBatchOp, ModelMapBatchOp
+from .xgboost import XGBoostPredictBatchOp, XGBoostTrainBatchOp
+
+
+# ---------------------------------------------------------------------------
+# sources / sinks
+# ---------------------------------------------------------------------------
+
+
+class TFRecordDatasetSourceBatchOp(TFRecordSourceBatchOp):
+    """(reference: operator/batch/source/TFRecordDatasetSourceBatchOp.java)"""
+
+
+class TFRecordDatasetSinkBatchOp(TFRecordSinkBatchOp):
+    """(reference: operator/batch/sink/TFRecordDatasetSinkBatchOp.java)"""
+
+
+class XlsSinkBatchOp(BatchOperator):
+    """Excel sheet sink, plugin-gated on openpyxl (reference:
+    operator/batch/sink/XlsSinkBatchOp.java via connectors/connector-xls)."""
+
+    FILE_PATH = ParamInfo("filePath", str, optional=False)
+    SHEET_NAME = ParamInfo("sheetName", str, default="Sheet1")
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    def _execute_impl(self, t: MTable) -> MTable:
+        try:
+            import openpyxl  # noqa: F401
+        except ImportError as e:
+            from ...common.exceptions import AkPluginNotExistException
+
+            raise AkPluginNotExistException(
+                "XlsSinkBatchOp needs the 'openpyxl' package (the reference "
+                "ships connector-xls as a plugin): pip install openpyxl. "
+                "CsvSinkBatchOp is the built-in alternative.") from e
+        import pandas as pd
+
+        df = pd.DataFrame({n: t.col(n) for n in t.names})
+        with file_open(self.get(self.FILE_PATH), "wb") as f:
+            df.to_excel(f, sheet_name=self.get(self.SHEET_NAME),
+                        index=False)
+        return t
+
+    def _out_schema(self, in_schema):
+        return in_schema
+
+
+# ---------------------------------------------------------------------------
+# named KV-store connectors
+# ---------------------------------------------------------------------------
+
+
+class LookupRedisRowBatchOp(LookupKvBatchOp):
+    """Row-structured Redis lookup — field values land in output columns
+    (reference: operator/batch/dataproc/LookupRedisRowBatchOp.java; the
+    Redis backend resolves from the redis:// storeUri, the in-memory
+    backend serves tests)."""
+
+
+class LookupRedisStringBatchOp(LookupKvBatchOp):
+    """Plain-string Redis lookup: the whole value lands in ONE output
+    column (reference: operator/batch/dataproc/
+    LookupRedisStringBatchOp.java)."""
+
+    def _decorate(self, t: MTable, store) -> MTable:
+        key_col, out_cols, _ = self._resolved_cols()
+        if len(out_cols) != 1:
+            raise AkIllegalArgumentException(
+                "LookupRedisString writes exactly one output column")
+        raw = store.mget_raw([str(v) for v in t.col(key_col)]) \
+            if hasattr(store, "mget_raw") else None
+        if raw is None:
+            import json as _json
+
+            hits = store.mget([str(v) for v in t.col(key_col)])
+            raw = []
+            for h in hits:
+                if h is None:
+                    raw.append(None)
+                elif isinstance(h, str):
+                    raw.append(h)
+                elif isinstance(h, dict) and len(h) == 1:
+                    v = next(iter(h.values()))
+                    raw.append(None if v is None else str(v))
+                else:
+                    raw.append(_json.dumps(h))
+        out = t.with_column(out_cols[0], np.asarray(raw, object),
+                            AlinkTypes.STRING)
+        return out
+
+    def _out_schema(self, in_schema):
+        _, out_cols, _ = self._resolved_cols()
+        names = list(in_schema.names)
+        types = list(in_schema.types)
+        if out_cols[0] in names:
+            types[names.index(out_cols[0])] = AlinkTypes.STRING
+        else:
+            names.append(out_cols[0])
+            types.append(AlinkTypes.STRING)
+        return TableSchema(names, types)
+
+
+class LookupHBaseBatchOp(LookupKvBatchOp):
+    """HBase rowkey lookup over the shared KV abstraction (reference:
+    operator/batch/dataproc/LookupHBaseBatchOp.java — the HBase thrift
+    client plugs in behind the same mget contract)."""
+
+
+class RedisRowSinkBatchOp(KvSinkBatchOp):
+    """(reference: operator/batch/sink/RedisRowSinkBatchOp.java)"""
+
+
+class RedisStringSinkBatchOp(KvSinkBatchOp):
+    """(reference: operator/batch/sink/RedisStringSinkBatchOp.java)"""
+
+
+class HBaseSinkBatchOp(KvSinkBatchOp):
+    """(reference: operator/batch/sink/HBaseSinkBatchOp.java)"""
+
+
+# ---------------------------------------------------------------------------
+# catalog source / sink (sqlite catalog plays the Hive/ODPS catalog role)
+# ---------------------------------------------------------------------------
+
+
+class CatalogSourceBatchOp(BatchOperator):
+    """Read a table registered in a database catalog (reference:
+    operator/batch/source/CatalogSourceBatchOp.java — Hive/ODPS/JDBC
+    catalogs; here the JDBC-sqlite catalog serves the role)."""
+
+    DB_PATH = ParamInfo("dbPath", str, optional=False,
+                        aliases=("catalogPath", "url"))
+    TABLE_NAME = ParamInfo("tableName", str, optional=False,
+                           aliases=("inputTableName",))
+
+    _max_inputs = 0
+
+    def _execute_impl(self) -> MTable:
+        from ..sqlengine import SqliteCatalog
+
+        cat = SqliteCatalog(self.get(self.DB_PATH))
+        return cat.read_table(self.get(self.TABLE_NAME))
+
+    def _out_schema(self):
+        from ..sqlengine import SqliteCatalog
+
+        cat = SqliteCatalog(self.get(self.DB_PATH))
+        return cat.get_table_schema(self.get(self.TABLE_NAME))
+
+
+class CatalogSinkBatchOp(BatchOperator):
+    """Write a table into a database catalog (reference:
+    operator/batch/sink/CatalogSinkBatchOp.java)."""
+
+    DB_PATH = ParamInfo("dbPath", str, optional=False,
+                        aliases=("catalogPath", "url"))
+    TABLE_NAME = ParamInfo("tableName", str, optional=False,
+                           aliases=("outputTableName",))
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    def _execute_impl(self, t: MTable) -> MTable:
+        from ..sqlengine import SqliteCatalog
+
+        cat = SqliteCatalog(self.get(self.DB_PATH))
+        cat.write_table(self.get(self.TABLE_NAME), t)
+        return t
+
+    def _out_schema(self, in_schema):
+        return in_schema
+
+
+class InternalFullStatsBatchOp(SummarizerBatchOp):
+    """Full per-column statistics under the reference's internal name
+    (reference: operator/batch/statistics/InternalFullStatsBatchOp.java —
+    the engine behind the stats visualizer)."""
+
+
+# ---------------------------------------------------------------------------
+# TF table-model family (python-first collapse onto the shared DL loop)
+# ---------------------------------------------------------------------------
+
+
+class TFTableModelTrainBatchOp(KerasSequentialRegressorTrainBatchOp):
+    """Train a user-declared network on table columns — the akdl
+    TFTableModelTrain role; the reference runs a user TF script through
+    DLLauncher, here the SAME layer-spec DSL trains via the shared flax
+    loop and persists in the standard model table (reference:
+    operator/batch/dataproc/TFTableModelTrainBatchOp.java)."""
+
+
+class TF2TableModelTrainBatchOp(TFTableModelTrainBatchOp):
+    """(reference: operator/batch/dataproc/TF2TableModelTrainBatchOp.java)"""
+
+
+class TFTableModelRegressorPredictBatchOp(
+        KerasSequentialRegressorPredictBatchOp):
+    """(reference: operator/batch/regression/
+    TFTableModelRegressorPredictBatchOp.java)"""
+
+
+class TFTableModelClassifierPredictBatchOp(
+        KerasSequentialClassifierPredictBatchOp):
+    """(reference: operator/batch/classification/
+    TFTableModelClassifierPredictBatchOp.java)"""
+
+
+class TFTableModelClassifierTrainBatchOp(
+        KerasSequentialClassifierTrainBatchOp):
+    """(reference: operator/batch/classification/
+    TFTableModelClassifierTrainBatchOp.java)"""
+
+
+class TFTableModelRegressorTrainBatchOp(TFTableModelTrainBatchOp):
+    """(reference: operator/batch/regression/
+    TFTableModelRegressorTrainBatchOp.java)"""
+
+
+class TFTableModelPredictBatchOp(TFSavedModelPredictBatchOp):
+    """Serve a foreign TF SavedModel on table columns (reference:
+    operator/batch/dataproc/TFTableModelPredictBatchOp.java — rides the
+    GraphDef→XLA ingest path)."""
+
+
+class TensorFlowBatchOp(PandasUdfBatchOp):
+    """Run an arbitrary user python function over the table — the
+    reference ships the table to a user TF1 script via DLLauncher; here
+    the callable runs in process (import tensorflow inside it if
+    installed) (reference: operator/batch/dataproc/TensorFlowBatchOp.java)."""
+
+
+class TensorFlow2BatchOp(TensorFlowBatchOp):
+    """(reference: operator/batch/dataproc/TensorFlow2BatchOp.java)"""
+
+
+# ---------------------------------------------------------------------------
+# XGBoost regression names (plugin-gated like the classifier)
+# ---------------------------------------------------------------------------
+
+
+class XGBoostRegTrainBatchOp(XGBoostTrainBatchOp):
+    """(reference: operator/batch/regression/XGBoostRegTrainBatchOp.java)"""
+
+    def __init__(self, params=None, **kw):
+        super().__init__(params, **kw)
+        # default the objective to regression ONLY when unset anywhere
+        # (params object or kwargs)
+        if not self._params.contains("objective"):
+            self._params.set("objective", "reg:squarederror")
+
+
+class XGBoostRegPredictBatchOp(XGBoostPredictBatchOp):
+    """(reference: operator/batch/regression/XGBoostRegPredictBatchOp.java)"""
+
+
+# ---------------------------------------------------------------------------
+# tensor → image (dependency-free PNG encoder)
+# ---------------------------------------------------------------------------
+
+
+def _png_bytes(a: np.ndarray) -> bytes:
+    """Minimal PNG writer: (h, w) grayscale or (h, w, 3) RGB uint8."""
+    a = np.asarray(a)
+    if a.dtype != np.uint8:
+        lo, hi = float(a.min()), float(a.max())
+        a = ((a - lo) / (hi - lo + 1e-12) * 255).astype(np.uint8)
+    if a.ndim == 2:
+        color_type, channels = 0, 1
+    elif a.ndim == 3 and a.shape[2] == 3:
+        color_type, channels = 2, 3
+    else:
+        raise AkIllegalDataException(
+            f"tensor shape {a.shape} is not (h, w) or (h, w, 3)")
+    h, w = a.shape[:2]
+    raw = b"".join(
+        b"\x00" + a[i].tobytes() for i in range(h))  # filter 0 per row
+
+    def chunk(tag: bytes, payload: bytes) -> bytes:
+        return (struct.pack(">I", len(payload)) + tag + payload
+                + struct.pack(">I", zlib.crc32(tag + payload)))
+
+    ihdr = struct.pack(">IIBBBBB", w, h, 8, color_type, 0, 0, 0)
+    return (b"\x89PNG\r\n\x1a\n" + chunk(b"IHDR", ihdr)
+            + chunk(b"IDAT", zlib.compress(raw))
+            + chunk(b"IEND", b""))
+
+
+class WriteTensorToImageBatchOp(BatchOperator, HasSelectedCol,
+                                HasReservedCols):
+    """Write tensor cells as PNG files; the written path lands in a column
+    (reference: operator/batch/image/WriteTensorToImageBatchOp.java — PNG
+    encoded here by a dependency-free writer)."""
+
+    ROOT_FILE_PATH = ParamInfo("rootFilePath", str, optional=False)
+    RELATIVE_FILE_PATH_COL = ParamInfo("relativeFilePathCol", str,
+                                       optional=False)
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    def _execute_impl(self, t: MTable) -> MTable:
+        root = self.get(self.ROOT_FILE_PATH).rstrip("/")
+        rel_col = self.get(self.RELATIVE_FILE_PATH_COL)
+        sel = self.get(HasSelectedCol.SELECTED_COL)
+        for cell, rel in zip(t.col(sel), t.col(rel_col)):
+            if cell is None:
+                continue
+            path = f"{root}/{rel}"
+            with file_open(path, "wb") as f:
+                f.write(_png_bytes(np.asarray(cell)))
+        return t
+
+    def _out_schema(self, in_schema):
+        return in_schema
+
+
+# ---------------------------------------------------------------------------
+# aggregated embedding lookup
+# ---------------------------------------------------------------------------
+
+
+class AggLookupMapper(ModelMapper, HasSelectedCol, HasOutputCol,
+                      HasReservedCols):
+    """Delimited keys → aggregate of their model vectors (reference:
+    operator/common/dataproc/AggLookupModelMapper.java — CONCAT/AVG/SUM/
+    MAX/MIN over embedding vectors)."""
+
+    HANDLE = ParamInfo("handle", str, default="AVG",
+                       validator=InValidator("AVG", "MEAN", "SUM", "MAX",
+                                             "MIN", "CONCAT"))
+    DELIMITER = ParamInfo("delimiter", str, default=",")
+
+    def load_model(self, model: MTable):
+        key_col, vec_col = model.names[0], model.names[-1]
+        self.lut = {str(k): parse_vector(v).to_dense().data
+                    for k, v in zip(model.col(key_col), model.col(vec_col))}
+        self.dim = (len(next(iter(self.lut.values())))
+                    if self.lut else 0)
+        return self
+
+    def output_schema(self, input_schema):
+        out = self.get(HasOutputCol.OUTPUT_COL) or "agg_vec"
+        return self._append_result_schema(
+            input_schema, [out], [AlinkTypes.DENSE_VECTOR])
+
+    def map_table(self, t: MTable) -> MTable:
+        sel = self.get(HasSelectedCol.SELECTED_COL)
+        how = self.get(self.HANDLE)
+        delim = self.get(self.DELIMITER)
+        out = self.get(HasOutputCol.OUTPUT_COL) or "agg_vec"
+        vecs = np.empty(t.num_rows, object)
+        for i, cell in enumerate(t.col(sel)):
+            keys = ([k.strip() for k in str(cell).split(delim) if k.strip()]
+                    if cell is not None else [])
+            hits = [self.lut[k] for k in keys if k in self.lut]
+            if not hits:
+                vecs[i] = None
+                continue
+            M = np.stack(hits)
+            if how == "CONCAT":
+                vecs[i] = DenseVector(M.reshape(-1))
+            elif how == "SUM":
+                vecs[i] = DenseVector(M.sum(0))
+            elif how == "MAX":
+                vecs[i] = DenseVector(M.max(0))
+            elif how == "MIN":
+                vecs[i] = DenseVector(M.min(0))
+            else:  # AVG / MEAN
+                vecs[i] = DenseVector(M.mean(0))
+        return self._append_result(
+            t, {out: vecs}, {out: AlinkTypes.DENSE_VECTOR})
+
+
+class AggLookupBatchOp(ModelMapBatchOp, HasSelectedCol, HasOutputCol,
+                       HasReservedCols):
+    """(reference: operator/batch/dataproc/AggLookupBatchOp.java)"""
+
+    mapper_cls = AggLookupMapper
+    HANDLE = AggLookupMapper.HANDLE
+    DELIMITER = AggLookupMapper.DELIMITER
+
+
+# ---------------------------------------------------------------------------
+# BERT embedding + text-pair serving names
+# ---------------------------------------------------------------------------
+
+
+class BertTextEmbeddingMapper(BertTextModelMapper):
+    """Pooled encoder output as the embedding vector (reference:
+    operator/batch/classification/BertTextEmbeddingBatchOp.java — the
+    reference embeds with a pretrained checkpoint; here any model trained
+    by the BertText trainers serves, pre-head pooled states)."""
+
+    def output_schema(self, input_schema):
+        return self._append_result_schema(
+            input_schema, ["embedding"], [AlinkTypes.DENSE_VECTOR])
+
+    def map_table(self, t: MTable) -> MTable:
+        import jax
+
+        meta = self.meta
+        text_col = self.get(self.TEXT_COL) or meta["textCol"]
+        texts = [str(v) for v in t.col(text_col)]
+        enc = self.tokenizer.encode_batch(
+            texts, None, max_len=int(meta["maxSeqLength"]))
+        pooled = np.asarray(jax.device_get(self.model.apply(
+            self.params, **{k: np.asarray(v) for k, v in enc.items()},
+            return_pooled=True)))
+        out = "embedding"
+        vecs = np.empty(t.num_rows, object)
+        for i in range(t.num_rows):
+            vecs[i] = DenseVector(pooled[i].astype(np.float64))
+        return self._append_result(
+            t, {out: vecs}, {out: AlinkTypes.DENSE_VECTOR})
+
+
+class BertTextEmbeddingBatchOp(ModelMapBatchOp, HasReservedCols):
+    """(reference: operator/batch/classification/
+    BertTextEmbeddingBatchOp.java)"""
+
+    mapper_cls = BertTextEmbeddingMapper
+
+
+class BertTextPairClassifierPredictBatchOp(BertTextClassifierPredictBatchOp):
+    """(reference: operator/batch/classification/
+    BertTextPairClassifierPredictBatchOp.java — the shared mapper reads
+    textPairCol from the model meta)."""
+
+
+class BertTextPairRegressorTrainBatchOp(BertTextRegressorTrainBatchOp):
+    """(reference: operator/batch/regression/
+    BertTextPairRegressorTrainBatchOp.java)"""
+
+    TEXT_PAIR_COL = BertTextPairClassifierTrainBatchOp.TEXT_PAIR_COL
+
+
+class BertTextPairRegressorPredictBatchOp(BertTextRegressorPredictBatchOp):
+    """(reference: operator/batch/regression/
+    BertTextPairRegressorPredictBatchOp.java)"""
+
+
+# ---------------------------------------------------------------------------
+# stepwise-regression reference names
+# ---------------------------------------------------------------------------
+
+
+class LinearRegStepwiseTrainBatchOp(StepwiseLinearRegTrainBatchOp):
+    """(reference: operator/batch/regression/
+    LinearRegStepwiseTrainBatchOp.java)"""
+
+
+class LinearRegStepwisePredictBatchOp(LinearRegPredictBatchOp):
+    """(reference: operator/batch/regression/
+    LinearRegStepwisePredictBatchOp.java — the stepwise model serves
+    through the standard linear predictor)."""

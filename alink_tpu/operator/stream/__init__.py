@@ -134,3 +134,7 @@ from . import windows as _windows_stream
 from .windows import *  # noqa: F401,F403 — window/streaming-cluster ops
 
 __all__ += list(_windows_stream.__all__)
+from . import io2 as _io2_stream
+from .io2 import *  # noqa: F401,F403 — IO/DL long-tail stream twins
+
+__all__ += list(_io2_stream.__all__)

@@ -1,0 +1,200 @@
+"""Stream twins for the IO/DL long-tail: named KV connectors, dataset
+TFRecord names, Xls, media ops, tensor-to-image, LibSvm/Text sinks.
+
+Capability parity (reference: operator/stream/dataproc/
+LookupRedisRowStreamOp.java / LookupRedisStringStreamOp.java /
+LookupHBaseStreamOp.java; sink/RedisRowSinkStreamOp.java /
+RedisStringSinkStreamOp.java / HBaseSinkStreamOp.java /
+LibSvmSinkStreamOp.java / TextSinkStreamOp.java / XlsSinkStreamOp.java /
+TFRecordDatasetSinkStreamOp.java; source/TFRecordDatasetSourceStreamOp.java
+/ XlsSourceStreamOp.java / CatalogSourceStreamOp.java; sink/
+CatalogSinkStreamOp.java; image/WriteTensorToImageStreamOp.java +
+ReadImageToTensorStreamOp.java / audio twins / ExtractMfccFeatureStreamOp
+.java)."""
+
+from __future__ import annotations
+
+from typing import Iterator, List
+
+import numpy as np
+
+from ...common.mtable import MTable
+from ...common.params import ParamInfo
+from .base import StreamOperator, make_per_chunk_twin
+from .connectors import KvSinkStreamOp, LookupKvStreamOp
+
+__all__: List[str] = [
+    "LookupRedisRowStreamOp", "LookupRedisStringStreamOp",
+    "LookupHBaseStreamOp", "RedisRowSinkStreamOp",
+    "RedisStringSinkStreamOp", "HBaseSinkStreamOp",
+    "TFRecordDatasetSourceStreamOp", "TFRecordDatasetSinkStreamOp",
+    "TFRecordSinkStreamOp", "XlsSourceStreamOp", "XlsSinkStreamOp",
+    "LibSvmSinkStreamOp", "TextSinkStreamOp", "CatalogSourceStreamOp",
+    "CatalogSinkStreamOp",
+]
+
+
+class LookupRedisRowStreamOp(LookupKvStreamOp):
+    """(reference: operator/stream/dataproc/LookupRedisRowStreamOp.java)"""
+
+
+class LookupRedisStringStreamOp(StreamOperator):
+    """Per-chunk twin of LookupRedisStringBatchOp — the store handle stays
+    open across chunks (reference: operator/stream/dataproc/
+    LookupRedisStringStreamOp.java)."""
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    def __init__(self, params=None, **kw):
+        super().__init__(params, **kw)
+
+    def _stream_impl(self, it: Iterator[MTable]) -> Iterator[MTable]:
+        from ...io.kv import open_kv_store
+        from ..batch.io2 import LookupRedisStringBatchOp
+
+        op = LookupRedisStringBatchOp(self.get_params().clone())
+        store = open_kv_store(op.get(op.STORE_URI))
+        try:
+            for chunk in it:
+                yield op._decorate(chunk, store)
+        finally:
+            store.close()
+
+
+class LookupHBaseStreamOp(LookupKvStreamOp):
+    """(reference: operator/stream/dataproc/LookupHBaseStreamOp.java)"""
+
+
+class RedisRowSinkStreamOp(KvSinkStreamOp):
+    """(reference: operator/stream/sink/RedisRowSinkStreamOp.java)"""
+
+
+class RedisStringSinkStreamOp(KvSinkStreamOp):
+    """(reference: operator/stream/sink/RedisStringSinkStreamOp.java)"""
+
+
+class HBaseSinkStreamOp(KvSinkStreamOp):
+    """(reference: operator/stream/sink/HBaseSinkStreamOp.java)"""
+
+
+def _sink_per_chunk(name: str, batch_cls_name: str, ref: str):
+    """Stream sink that re-runs the batch sink per chunk (append regime
+    for file formats that support it)."""
+
+    class _Sink(StreamOperator):
+        _min_inputs = 1
+        _max_inputs = 1
+
+        def _stream_impl(self, it: Iterator[MTable]) -> Iterator[MTable]:
+            from .. import batch as batch_mod
+
+            chunks = list(it)
+            if not chunks:
+                return
+            merged = MTable.concat(chunks)
+            op = getattr(batch_mod, batch_cls_name)(
+                self.get_params().clone())
+            op._execute_impl(merged)
+            yield merged
+
+    _Sink.__name__ = name
+    _Sink.__qualname__ = name
+    _Sink.__doc__ = (f"Stream sink twin of {batch_cls_name} — chunks "
+                     f"buffer and write once at stream end (reference: "
+                     f"{ref}).")
+    _Sink.__module__ = __name__
+    from .. import batch as batch_mod
+    from ...common.params import ParamInfo as _P
+
+    for klass in getattr(batch_mod, batch_cls_name).__mro__:
+        for attr, v in vars(klass).items():
+            if isinstance(v, _P) and not hasattr(_Sink, attr):
+                setattr(_Sink, attr, v)
+    return _Sink
+
+
+TFRecordSinkStreamOp = _sink_per_chunk(
+    "TFRecordSinkStreamOp", "TFRecordSinkBatchOp",
+    "operator/stream/sink/TFRecordDatasetSinkStreamOp.java")
+
+
+class TFRecordDatasetSinkStreamOp(TFRecordSinkStreamOp):
+    """(reference: operator/stream/sink/TFRecordDatasetSinkStreamOp.java)"""
+
+
+LibSvmSinkStreamOp = _sink_per_chunk(
+    "LibSvmSinkStreamOp", "LibSvmSinkBatchOp",
+    "operator/stream/sink/LibSvmSinkStreamOp.java")
+TextSinkStreamOp = _sink_per_chunk(
+    "TextSinkStreamOp", "TextSinkBatchOp",
+    "operator/stream/sink/TextSinkStreamOp.java")
+XlsSinkStreamOp = _sink_per_chunk(
+    "XlsSinkStreamOp", "XlsSinkBatchOp",
+    "operator/stream/sink/XlsSinkStreamOp.java")
+CatalogSinkStreamOp = _sink_per_chunk(
+    "CatalogSinkStreamOp", "CatalogSinkBatchOp",
+    "operator/stream/sink/CatalogSinkStreamOp.java")
+
+
+def _source_stream(name: str, batch_cls_name: str, ref: str):
+    class _Source(StreamOperator):
+        _max_inputs = 0
+
+        CHUNK_SIZE = ParamInfo("chunkSize", int, default=256)
+
+        def _stream_impl(self) -> Iterator[MTable]:
+            from .. import batch as batch_mod
+
+            t = getattr(batch_mod, batch_cls_name)(
+                self.get_params().clone())._execute_impl()
+            cs = max(1, int(self.get(self.CHUNK_SIZE)))
+            for s in range(0, t.num_rows, cs):
+                yield t.slice(s, min(s + cs, t.num_rows))
+
+    _Source.__name__ = name
+    _Source.__qualname__ = name
+    _Source.__doc__ = (f"Stream source twin of {batch_cls_name} "
+                       f"(reference: {ref}).")
+    _Source.__module__ = __name__
+    from .. import batch as batch_mod
+    from ...common.params import ParamInfo as _P
+
+    for klass in getattr(batch_mod, batch_cls_name).__mro__:
+        for attr, v in vars(klass).items():
+            if isinstance(v, _P) and not hasattr(_Source, attr):
+                setattr(_Source, attr, v)
+    return _Source
+
+
+TFRecordDatasetSourceStreamOp = _source_stream(
+    "TFRecordDatasetSourceStreamOp", "TFRecordSourceBatchOp",
+    "operator/stream/source/TFRecordDatasetSourceStreamOp.java")
+XlsSourceStreamOp = _source_stream(
+    "XlsSourceStreamOp", "XlsSourceBatchOp",
+    "operator/stream/source/XlsSourceStreamOp.java")
+CatalogSourceStreamOp = _source_stream(
+    "CatalogSourceStreamOp", "CatalogSourceBatchOp",
+    "operator/stream/source/CatalogSourceStreamOp.java")
+
+
+def _media_twins():
+    from .. import batch as batch_mod
+
+    for batch_name, name, ref in (
+        ("ReadImageToTensorBatchOp", "ReadImageToTensorStreamOp",
+         "operator/stream/image/ReadImageToTensorStreamOp.java"),
+        ("ReadAudioToTensorBatchOp", "ReadAudioToTensorStreamOp",
+         "operator/stream/audio/ReadAudioToTensorStreamOp.java"),
+        ("ExtractMfccFeatureBatchOp", "ExtractMfccFeatureStreamOp",
+         "operator/stream/audio/ExtractMfccFeatureStreamOp.java"),
+        ("WriteTensorToImageBatchOp", "WriteTensorToImageStreamOp",
+         "operator/stream/image/WriteTensorToImageStreamOp.java"),
+    ):
+        cls = getattr(batch_mod, batch_name)
+        doc = (f"Per-micro-batch twin of {batch_name} (reference: {ref}).")
+        globals()[name] = make_per_chunk_twin(cls, name, doc)
+        __all__.append(name)
+
+
+_media_twins()

@@ -228,6 +228,12 @@ class WindowGroupByStreamOp(StreamOperator):
     def _stream_impl(self, it):
         kind = self.get(self.WINDOW_TYPE)
         p = self.get_params().clone()
+        # materialize THIS op's defaults: the inner ops declare these
+        # params required-without-default
+        for info in (self.WINDOW_TIME, self.HOP_TIME,
+                     self.SESSION_GAP_TIME):
+            if not p.contains(info.name):
+                p.set(info.name, self.get(info))
         if kind == "TUMBLE":
             inner = TumbleTimeWindowStreamOp(p)
         elif kind == "HOP":

@@ -1,0 +1,204 @@
+"""IO/DL long-tail tests (reference test model: CatalogSourceBatchOpTest,
+LookupRedisRowBatchOpTest, WriteTensorToImageBatchOpTest styles)."""
+
+import numpy as np
+
+from alink_tpu.common.mtable import AlinkTypes, MTable, TableSchema
+from alink_tpu.operator.batch.base import TableSourceBatchOp
+
+
+def test_catalog_source_sink_roundtrip(tmp_path):
+    from alink_tpu.operator.batch import (
+        CatalogSinkBatchOp,
+        CatalogSourceBatchOp,
+    )
+
+    db = str(tmp_path / "cat.db")
+    t = MTable({"k": np.asarray(["a", "b"], object),
+                "v": np.asarray([1.0, 2.0])})
+    CatalogSinkBatchOp(dbPath=db, tableName="t1").link_from(
+        TableSourceBatchOp(t)).collect()
+    back = CatalogSourceBatchOp(dbPath=db, tableName="t1").collect()
+    assert back.num_rows == 2 and back.names == ["k", "v"]
+    assert back.col("v").tolist() == [1.0, 2.0]
+
+
+def test_named_kv_connectors():
+    from alink_tpu.operator.batch import (
+        LookupRedisRowBatchOp,
+        LookupRedisStringBatchOp,
+        RedisRowSinkBatchOp,
+    )
+
+    t = MTable({"k": np.asarray(["a", "b", "missing"], object),
+                "v": np.asarray([1.0, 2.0, 3.0])})
+    src = TableSourceBatchOp(t)
+    uri = "memory://t_named_kv"
+    RedisRowSinkBatchOp(storeUri=uri, keyCol="k",
+                        selectedCols=["v"]).link_from(
+        TableSourceBatchOp(t.head(2))).collect()
+    out = LookupRedisRowBatchOp(
+        storeUri=uri, selectedCols=["k"], outputCols=["v"],
+        outputTypes=["DOUBLE"]).link_from(src).collect()
+    got = out.col("v")
+    assert got[0] == 1.0 and got[1] == 2.0 and np.isnan(got[2])
+    s = LookupRedisStringBatchOp(
+        storeUri=uri, selectedCols=["k"],
+        outputCols=["raw"]).link_from(src).collect()
+    assert s.col("raw")[0] == "1.0" and s.col("raw")[2] is None
+
+
+def test_agg_lookup():
+    from alink_tpu.common.linalg import parse_vector
+    from alink_tpu.operator.batch import AggLookupBatchOp
+
+    emb = TableSourceBatchOp(MTable(
+        {"key": np.asarray(["x", "y"], object),
+         "vec": np.asarray(["1 0", "0 1"], object)},
+        TableSchema(["key", "vec"],
+                    [AlinkTypes.STRING, AlinkTypes.DENSE_VECTOR])))
+    data = TableSourceBatchOp(MTable(
+        {"keys": np.asarray(["x,y", "x", "nope"], object)}))
+    for how, expect in (("AVG", [0.5, 0.5]), ("SUM", [1.0, 1.0]),
+                        ("CONCAT", [1, 0, 0, 1])):
+        out = AggLookupBatchOp(selectedCol="keys",
+                               handle=how).link_from(emb, data).collect()
+        assert parse_vector(
+            out.col("agg_vec")[0]).to_dense().data.tolist() == expect
+        assert out.col("agg_vec")[2] is None  # all-miss row
+
+
+def test_write_tensor_to_image(tmp_path):
+    from alink_tpu.operator.batch import WriteTensorToImageBatchOp
+
+    gray = np.arange(64, dtype=np.uint8).reshape(8, 8)
+    rgb = np.random.default_rng(0).integers(
+        0, 255, (4, 4, 3)).astype(np.uint8)
+    t = MTable({"t": np.asarray([gray, rgb], object),
+                "p": np.asarray(["g.png", "c.png"], object)},
+               TableSchema(["t", "p"],
+                           [AlinkTypes.TENSOR, AlinkTypes.STRING]))
+    WriteTensorToImageBatchOp(
+        selectedCol="t", rootFilePath=str(tmp_path),
+        relativeFilePathCol="p").link_from(TableSourceBatchOp(t)).collect()
+    for name in ("g.png", "c.png"):
+        data = (tmp_path / name).read_bytes()
+        assert data[:8] == b"\x89PNG\r\n\x1a\n"
+        assert b"IHDR" in data and b"IEND" in data
+
+
+def test_tf_table_model_names_serve():
+    from alink_tpu.operator.batch import (
+        TFTableModelClassifierPredictBatchOp,
+        TFTableModelClassifierTrainBatchOp,
+    )
+
+    rng = np.random.RandomState(0)
+    X = rng.rand(120, 2)
+    y = (X[:, 0] > 0.5).astype(np.int64)
+    t = MTable({"a": X[:, 0], "b": X[:, 1], "y": y})
+    src = TableSourceBatchOp(t)
+    m = TFTableModelClassifierTrainBatchOp(
+        featureCols=["a", "b"], labelCol="y",
+        layers=["Dense(32, relu)", "Dense(2)"],
+        numEpochs=120, batchSize=32, learningRate=3e-3).link_from(src)
+    p = TFTableModelClassifierPredictBatchOp(
+        predictionCol="p").link_from(m, src).collect()
+    acc = float(np.mean(np.asarray(p.col("p")) == y))
+    assert acc > 0.85
+
+
+def test_stepwise_reference_names():
+    from alink_tpu.operator.batch import (
+        LinearRegStepwisePredictBatchOp,
+        LinearRegStepwiseTrainBatchOp,
+    )
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=100)
+    y = 2 * x + 0.01 * rng.normal(size=100)
+    src = TableSourceBatchOp(MTable(
+        {"x": x, "noise": rng.normal(size=100), "y": y}))
+    m = LinearRegStepwiseTrainBatchOp(labelCol="y").link_from(src)
+    p = LinearRegStepwisePredictBatchOp(
+        predictionCol="p").link_from(m, src).collect()
+    assert np.corrcoef(p.col("p"), y)[0, 1] > 0.99
+
+
+def test_bert_text_embedding():
+    from alink_tpu.common.linalg import parse_vector
+    from alink_tpu.operator.batch import (
+        BertTextClassifierTrainBatchOp,
+        BertTextEmbeddingBatchOp,
+    )
+
+    texts = ["good great nice"] * 8 + ["bad awful poor"] * 8
+    t = MTable({"text": np.asarray(texts, object),
+                "label": np.asarray([1] * 8 + [0] * 8, np.int64)})
+    src = TableSourceBatchOp(t)
+    model = BertTextClassifierTrainBatchOp(
+        textCol="text", labelCol="label", bertSize="tiny", maxSeqLength=8,
+        numEpochs=2, batchSize=8, vocabSize=64).link_from(src)
+    out = BertTextEmbeddingBatchOp().link_from(model, src).collect()
+    v = parse_vector(out.col("embedding")[0]).to_dense().data
+    assert v.ndim == 1 and v.size > 8  # hidden-size pooled embedding
+    # same text -> same embedding; different class text differs
+    v2 = parse_vector(out.col("embedding")[1]).to_dense().data
+    v3 = parse_vector(out.col("embedding")[-1]).to_dense().data
+    np.testing.assert_allclose(v, v2, atol=1e-5)
+    assert not np.allclose(v, v3, atol=1e-5)
+
+
+def test_stream_io_twins(tmp_path):
+    from alink_tpu.operator.stream import (
+        LookupRedisRowStreamOp,
+        MemSourceStreamOp,
+        RedisRowSinkStreamOp,
+        TFRecordDatasetSinkStreamOp,
+        TFRecordDatasetSourceStreamOp,
+        TextSinkStreamOp,
+    )
+
+    uri = "memory://t_stream_io"
+    src = lambda: MemSourceStreamOp(  # noqa: E731
+        [["a", 1.0], ["b", 2.0]], "k STRING, v DOUBLE", numChunks=2)
+    RedisRowSinkStreamOp(storeUri=uri, keyCol="k",
+                         selectedCols=["v"]).link_from(src()).collect()
+    out = LookupRedisRowStreamOp(
+        storeUri=uri, selectedCols=["k"], outputCols=["v"],
+        outputTypes=["DOUBLE"]).link_from(src()).collect()
+    assert out.col("v").tolist() == [1.0, 2.0]
+    TextSinkStreamOp(filePath=str(tmp_path / "t.txt")).link_from(
+        MemSourceStreamOp([["hello"], ["world"]], "line STRING",
+                          numChunks=2)).collect()
+    assert (tmp_path / "t.txt").read_text().split() == ["hello", "world"]
+    path = str(tmp_path / "d.tfrecord")
+    TFRecordDatasetSinkStreamOp(filePath=path).link_from(src()).collect()
+    back = TFRecordDatasetSourceStreamOp(
+        filePath=path, schemaStr="k STRING, v DOUBLE").collect()
+    assert back.num_rows == 2
+
+
+def test_all_sweepj_names_registered():
+    import alink_tpu.operator.batch as bm
+    import alink_tpu.operator.stream as sm
+
+    for n in ("TFRecordDatasetSourceBatchOp", "TFRecordDatasetSinkBatchOp",
+              "XlsSinkBatchOp", "LookupHBaseBatchOp", "HBaseSinkBatchOp",
+              "RedisStringSinkBatchOp", "TFTableModelPredictBatchOp",
+              "TF2TableModelTrainBatchOp", "TensorFlowBatchOp",
+              "TensorFlow2BatchOp", "XGBoostRegTrainBatchOp",
+              "XGBoostRegPredictBatchOp", "InternalFullStatsBatchOp",
+              "BertTextPairClassifierPredictBatchOp",
+              "BertTextPairRegressorTrainBatchOp",
+              "BertTextPairRegressorPredictBatchOp"):
+        assert hasattr(bm, n), n
+    for n in ("LookupRedisStringStreamOp", "LookupHBaseStreamOp",
+              "HBaseSinkStreamOp", "RedisStringSinkStreamOp",
+              "XlsSourceStreamOp", "XlsSinkStreamOp",
+              "CatalogSourceStreamOp", "CatalogSinkStreamOp",
+              "ReadImageToTensorStreamOp", "ReadAudioToTensorStreamOp",
+              "ExtractMfccFeatureStreamOp", "WriteTensorToImageStreamOp",
+              "AggLookupStreamOp", "BertTextEmbeddingStreamOp",
+              "XGBoostRegPredictStreamOp", "LibSvmSinkStreamOp"):
+        assert hasattr(sm, n), n
