@@ -339,3 +339,13 @@ class WithParams:
         if info is not None:
             return self._params.get(info)
         raise AttributeError(f"{type(self).__name__} has no attribute {attr!r}")
+
+
+def copy_param_infos(source_cls: type, target_cls: type) -> None:
+    """Surface every ParamInfo of ``source_cls``'s MRO on ``target_cls``
+    (shared by the stream-twin factories and alias ops so param-surfacing
+    semantics live in one place)."""
+    for klass in source_cls.__mro__:
+        for attr, v in vars(klass).items():
+            if isinstance(v, ParamInfo) and not hasattr(target_cls, attr):
+                setattr(target_cls, attr, v)

@@ -32,6 +32,21 @@ class KvStore:
     def mget(self, keys: Sequence[str]) -> List[Optional[dict]]:
         return [self.get(k) for k in keys]
 
+    def mget_raw(self, keys: Sequence[str]) -> List[Optional[str]]:
+        """The stored values as RAW strings (plain-string Redis semantics —
+        LookupRedisStringBatchOp). Default: the wire JSON, with single-field
+        rows collapsed to their value's string form."""
+        out: List[Optional[str]] = []
+        for h in self.mget(keys):
+            if h is None:
+                out.append(None)
+            elif isinstance(h, dict) and len(h) == 1:
+                v = next(iter(h.values()))
+                out.append(None if v is None else str(v))
+            else:
+                out.append(json.dumps(h))
+        return out
+
     def set(self, key: str, value: dict) -> None:
         raise NotImplementedError
 
@@ -75,6 +90,12 @@ class RedisKvStore(KvStore):
         for raw in self._client.mget(list(keys)):
             out.append(None if raw is None else json.loads(raw))
         return out
+
+    def mget_raw(self, keys: Sequence[str]) -> List[Optional[str]]:
+        # TRUE raw GET — plain-string values stored by other writers
+        return [None if raw is None else
+                (raw.decode() if isinstance(raw, bytes) else str(raw))
+                for raw in self._client.mget(list(keys))]
 
     def set(self, key: str, value: dict) -> None:
         self._client.set(key, json.dumps(value))

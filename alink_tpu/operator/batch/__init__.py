@@ -676,3 +676,18 @@ from .io2 import (
     XGBoostRegTrainBatchOp,
     XlsSinkBatchOp,
 )
+from .misc2 import (
+    AddressParserBatchOp,
+    PSIBatchOp,
+    SomBatchOp,
+    SparseFeatureIndexerPredictBatchOp,
+    SparseFeatureIndexerTrainBatchOp,
+)
+from .misc2 import (
+    BaseFormatTransBatchOp,
+    BaseNearestNeighborTrainBatchOp,
+    BaseRecommBatchOp,
+    BaseSinkBatchOp,
+    BaseSourceBatchOp,
+    BaseSqlApiBatchOp,
+)

@@ -24,8 +24,6 @@ from __future__ import annotations
 
 import struct
 import zlib
-from typing import Dict, List, Optional
-
 import numpy as np
 
 from ...common.exceptions import (
@@ -33,15 +31,13 @@ from ...common.exceptions import (
     AkIllegalDataException,
 )
 from ...common.linalg import DenseVector, parse_vector
-from ...common.model import table_to_model
 from ...common.mtable import AlinkTypes, MTable, TableSchema
-from ...common.params import InValidator, MinValidator, ParamInfo
+from ...common.params import InValidator, ParamInfo
 from ...io.filesystem import file_open
 from ...mapper import (
     HasOutputCol,
     HasReservedCols,
     HasSelectedCol,
-    HasSelectedCols,
     ModelMapper,
 )
 from .base import BatchOperator
@@ -63,7 +59,7 @@ from .regression import StepwiseLinearRegTrainBatchOp
 from .sources import TFRecordSinkBatchOp, TFRecordSourceBatchOp
 from .statistics import SummarizerBatchOp
 from .udf2 import PandasUdfBatchOp
-from .utils import MapBatchOp, ModelMapBatchOp
+from .utils import ModelMapBatchOp
 from .xgboost import XGBoostPredictBatchOp, XGBoostTrainBatchOp
 
 
@@ -134,37 +130,22 @@ class LookupRedisStringBatchOp(LookupKvBatchOp):
         if len(out_cols) != 1:
             raise AkIllegalArgumentException(
                 "LookupRedisString writes exactly one output column")
-        raw = store.mget_raw([str(v) for v in t.col(key_col)]) \
-            if hasattr(store, "mget_raw") else None
-        if raw is None:
-            import json as _json
-
-            hits = store.mget([str(v) for v in t.col(key_col)])
-            raw = []
-            for h in hits:
-                if h is None:
-                    raw.append(None)
-                elif isinstance(h, str):
-                    raw.append(h)
-                elif isinstance(h, dict) and len(h) == 1:
-                    v = next(iter(h.values()))
-                    raw.append(None if v is None else str(v))
-                else:
-                    raw.append(_json.dumps(h))
-        out = t.with_column(out_cols[0], np.asarray(raw, object),
-                            AlinkTypes.STRING)
-        return out
+        raw = store.mget_raw([str(v) for v in t.col(key_col)])
+        kept = self._kept_input_cols(t.names)
+        names = [n for n in kept if n != out_cols[0]]
+        cols = {n: t.col(n) for n in names}
+        types = [t.schema.type_of(n) for n in names]
+        cols[out_cols[0]] = np.asarray(raw, object)
+        return MTable(cols, TableSchema(names + [out_cols[0]],
+                                        types + [AlinkTypes.STRING]))
 
     def _out_schema(self, in_schema):
         _, out_cols, _ = self._resolved_cols()
-        names = list(in_schema.names)
-        types = list(in_schema.types)
-        if out_cols[0] in names:
-            types[names.index(out_cols[0])] = AlinkTypes.STRING
-        else:
-            names.append(out_cols[0])
-            types.append(AlinkTypes.STRING)
-        return TableSchema(names, types)
+        kept = self._kept_input_cols(in_schema.names)
+        names = [n for n in kept if n != out_cols[0]]
+        types = [in_schema.type_of(n) for n in names]
+        return TableSchema(names + [out_cols[0]],
+                           types + [AlinkTypes.STRING])
 
 
 class LookupHBaseBatchOp(LookupKvBatchOp):
@@ -284,10 +265,11 @@ class TFTableModelRegressorTrainBatchOp(TFTableModelTrainBatchOp):
     TFTableModelRegressorTrainBatchOp.java)"""
 
 
-class TFTableModelPredictBatchOp(TFSavedModelPredictBatchOp):
-    """Serve a foreign TF SavedModel on table columns (reference:
-    operator/batch/dataproc/TFTableModelPredictBatchOp.java — rides the
-    GraphDef→XLA ingest path)."""
+class TFTableModelPredictBatchOp(KerasSequentialRegressorPredictBatchOp):
+    """Serve a TFTableModel trainer's output on table columns — the
+    (model, data) contract the rest of the family uses; foreign SavedModel
+    artifacts serve through TFSavedModelPredictBatchOp instead (reference:
+    operator/batch/dataproc/TFTableModelPredictBatchOp.java)."""
 
 
 class TensorFlowBatchOp(PandasUdfBatchOp):
@@ -402,8 +384,6 @@ class AggLookupMapper(ModelMapper, HasSelectedCol, HasOutputCol,
         key_col, vec_col = model.names[0], model.names[-1]
         self.lut = {str(k): parse_vector(v).to_dense().data
                     for k, v in zip(model.col(key_col), model.col(vec_col))}
-        self.dim = (len(next(iter(self.lut.values())))
-                    if self.lut else 0)
         return self
 
     def output_schema(self, input_schema):

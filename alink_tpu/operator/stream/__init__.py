@@ -138,3 +138,7 @@ from . import io2 as _io2_stream
 from .io2 import *  # noqa: F401,F403 — IO/DL long-tail stream twins
 
 __all__ += list(_io2_stream.__all__)
+from . import misc2 as _misc2_stream
+from .misc2 import *  # noqa: F401,F403 — final stream-surface closure
+
+__all__ += list(_misc2_stream.__all__)

@@ -222,22 +222,19 @@ def make_per_chunk_twin(batch_cls, name: str, doc: str) -> type:
     """Factory for stream twins that re-run a batch op per micro-batch
     (shared by the outlier and timeseries twin registries so the
     param-copy / execution semantics cannot drift)."""
-    from ...common.params import ParamInfo as _ParamInfo
+    from ...common.params import copy_param_infos
 
     def _stream_impl(self, it: Iterator[MTable]) -> Iterator[MTable]:
         for chunk in it:
             op = batch_cls(self.get_params().clone())
             yield op._execute_impl(chunk)
 
-    attrs = {
+    cls = type(name, (StreamOperator,), {
         "_min_inputs": 1,
         "_max_inputs": 1,
         "_stream_impl": _stream_impl,
         "__doc__": doc,
         "__module__": batch_cls.__module__,
-    }
-    for klass in batch_cls.__mro__:
-        for attr, v in vars(klass).items():
-            if isinstance(v, _ParamInfo) and attr not in attrs:
-                attrs[attr] = v
-    return type(name, (StreamOperator,), attrs)
+    })
+    copy_param_infos(batch_cls, cls)
+    return cls

@@ -16,8 +16,6 @@ from __future__ import annotations
 
 from typing import Iterator, List
 
-import numpy as np
-
 from ...common.mtable import MTable
 from ...common.params import ParamInfo
 from .base import StreamOperator, make_per_chunk_twin
@@ -45,9 +43,6 @@ class LookupRedisStringStreamOp(StreamOperator):
 
     _min_inputs = 1
     _max_inputs = 1
-
-    def __init__(self, params=None, **kw):
-        super().__init__(params, **kw)
 
     def _stream_impl(self, it: Iterator[MTable]) -> Iterator[MTable]:
         from ...io.kv import open_kv_store
@@ -78,9 +73,10 @@ class HBaseSinkStreamOp(KvSinkStreamOp):
     """(reference: operator/stream/sink/HBaseSinkStreamOp.java)"""
 
 
-def _sink_per_chunk(name: str, batch_cls_name: str, ref: str):
-    """Stream sink that re-runs the batch sink per chunk (append regime
-    for file formats that support it)."""
+def _sink_at_stream_end(name: str, batch_cls_name: str, ref: str):
+    """Stream sink that BUFFERS all chunks and writes once when the stream
+    ends (these formats have no append regime; an empty stream writes
+    nothing since no schema ever materializes)."""
 
     class _Sink(StreamOperator):
         _min_inputs = 1
@@ -101,20 +97,17 @@ def _sink_per_chunk(name: str, batch_cls_name: str, ref: str):
     _Sink.__name__ = name
     _Sink.__qualname__ = name
     _Sink.__doc__ = (f"Stream sink twin of {batch_cls_name} — chunks "
-                     f"buffer and write once at stream end (reference: "
+                     f"buffer and write ONCE at stream end (reference: "
                      f"{ref}).")
     _Sink.__module__ = __name__
     from .. import batch as batch_mod
-    from ...common.params import ParamInfo as _P
+    from ...common.params import copy_param_infos
 
-    for klass in getattr(batch_mod, batch_cls_name).__mro__:
-        for attr, v in vars(klass).items():
-            if isinstance(v, _P) and not hasattr(_Sink, attr):
-                setattr(_Sink, attr, v)
+    copy_param_infos(getattr(batch_mod, batch_cls_name), _Sink)
     return _Sink
 
 
-TFRecordSinkStreamOp = _sink_per_chunk(
+TFRecordSinkStreamOp = _sink_at_stream_end(
     "TFRecordSinkStreamOp", "TFRecordSinkBatchOp",
     "operator/stream/sink/TFRecordDatasetSinkStreamOp.java")
 
@@ -123,16 +116,16 @@ class TFRecordDatasetSinkStreamOp(TFRecordSinkStreamOp):
     """(reference: operator/stream/sink/TFRecordDatasetSinkStreamOp.java)"""
 
 
-LibSvmSinkStreamOp = _sink_per_chunk(
+LibSvmSinkStreamOp = _sink_at_stream_end(
     "LibSvmSinkStreamOp", "LibSvmSinkBatchOp",
     "operator/stream/sink/LibSvmSinkStreamOp.java")
-TextSinkStreamOp = _sink_per_chunk(
+TextSinkStreamOp = _sink_at_stream_end(
     "TextSinkStreamOp", "TextSinkBatchOp",
     "operator/stream/sink/TextSinkStreamOp.java")
-XlsSinkStreamOp = _sink_per_chunk(
+XlsSinkStreamOp = _sink_at_stream_end(
     "XlsSinkStreamOp", "XlsSinkBatchOp",
     "operator/stream/sink/XlsSinkStreamOp.java")
-CatalogSinkStreamOp = _sink_per_chunk(
+CatalogSinkStreamOp = _sink_at_stream_end(
     "CatalogSinkStreamOp", "CatalogSinkBatchOp",
     "operator/stream/sink/CatalogSinkStreamOp.java")
 
@@ -158,12 +151,9 @@ def _source_stream(name: str, batch_cls_name: str, ref: str):
                        f"(reference: {ref}).")
     _Source.__module__ = __name__
     from .. import batch as batch_mod
-    from ...common.params import ParamInfo as _P
+    from ...common.params import copy_param_infos
 
-    for klass in getattr(batch_mod, batch_cls_name).__mro__:
-        for attr, v in vars(klass).items():
-            if isinstance(v, _P) and not hasattr(_Source, attr):
-                setattr(_Source, attr, v)
+    copy_param_infos(getattr(batch_mod, batch_cls_name), _Source)
     return _Source
 
 

@@ -1,0 +1,227 @@
+"""Final stream-surface closure: triple-format twins, flatten twins,
+lookup/ranking streams, model-stream sink op, func-op aliases, and the
+public Base* names.
+
+Capability parity (reference: operator/stream/dataproc/format/
+*ToTripleStreamOp.java; dataproc/FlattenKObjectStreamOp.java /
+FlattenMTableStreamOp.java / LookupStreamOp.java; recommendation/
+RecommendationRankingStreamOp.java; sink/ModelStreamFileSinkStreamOp.java;
+dataproc/TensorFlowStreamOp.java / TensorFlow2StreamOp.java; utils/
+BasePyScalarFnStreamOp.java / BasePyTableFnStreamOp.java /
+PandasUdfFilStreamOp.java [sic]; the public Base* classes)."""
+
+from __future__ import annotations
+
+from typing import Iterator, List
+
+from ...common.mtable import MTable
+from .base import (
+    ModelMapStreamOp,
+    StreamOperator,
+    make_per_chunk_twin,
+)
+
+__all__: List[str] = [
+    "AnyToTripleStreamOp", "ColumnsToTripleStreamOp", "CsvToTripleStreamOp",
+    "JsonToTripleStreamOp", "KvToTripleStreamOp", "VectorToTripleStreamOp",
+    "FlattenKObjectStreamOp", "FlattenMTableStreamOp", "LookupStreamOp",
+    "RecommendationRankingStreamOp", "ModelStreamFileSinkStreamOp",
+    "TensorFlowStreamOp", "TensorFlow2StreamOp",
+    "BasePyScalarFnStreamOp", "BasePyTableFnStreamOp",
+    "PandasUdfFilStreamOp", "BaseOnlinePredictStreamOp",
+    "BaseSourceStreamOp", "BaseSinkStreamOp", "BaseSqlApiStreamOp",
+    "BaseFormatTransStreamOp", "BaseRecommStreamOp",
+]
+
+
+def _triple_twins():
+    from ..batch import format as fmt
+
+    for bname, sname in (
+        ("AnyToTripleBatchOp", "AnyToTripleStreamOp"),
+        ("ColumnsToTripleBatchOp", "ColumnsToTripleStreamOp"),
+        ("CsvToTripleBatchOp", "CsvToTripleStreamOp"),
+        ("JsonToTripleBatchOp", "JsonToTripleStreamOp"),
+        ("KvToTripleBatchOp", "KvToTripleStreamOp"),
+        ("VectorToTripleBatchOp", "VectorToTripleStreamOp"),
+    ):
+        cls = getattr(fmt, bname)
+        doc = (f"Per-micro-batch twin of {bname} — row ids restart per "
+               f"chunk (reference: operator/stream/dataproc/format/"
+               f"{sname}.java).")
+        globals()[sname] = make_per_chunk_twin(cls, sname, doc)
+
+
+def _flatten_twins():
+    from ..batch.udf2 import FlattenKObjectBatchOp
+    from ..batch.utils2 import FlattenMTableBatchOp
+
+    globals()["FlattenKObjectStreamOp"] = make_per_chunk_twin(
+        FlattenKObjectBatchOp, "FlattenKObjectStreamOp",
+        "Per-micro-batch twin of FlattenKObjectBatchOp (reference: "
+        "operator/stream/recommendation/FlattenKObjectStreamOp.java).")
+    globals()["FlattenMTableStreamOp"] = make_per_chunk_twin(
+        FlattenMTableBatchOp, "FlattenMTableStreamOp",
+        "Per-micro-batch twin of FlattenMTableBatchOp (reference: "
+        "operator/stream/dataproc/FlattenMTableStreamOp.java).")
+
+
+_triple_twins()
+_flatten_twins()
+
+
+class LookupStreamOp(StreamOperator):
+    """Model-table lookup decoration per micro-batch: the dict builds once
+    from the first (model) input (reference: operator/stream/dataproc/
+    LookupStreamOp.java)."""
+
+    _min_inputs = 1
+    _max_inputs = 2
+
+    def __init__(self, model: MTable = None, params=None, **kw):
+        super().__init__(params, **kw)
+        self._model = model
+
+    def _stream_impl(self, *ins: Iterator[MTable]) -> Iterator[MTable]:
+        from ..batch.dataproc import LookupBatchOp
+
+        op = LookupBatchOp(self.get_params().clone())
+        model = self._model
+        if model is None and len(ins) == 2:
+            try:
+                model = next(ins[0])
+            except StopIteration:
+                model = None
+        if model is None:
+            from ...common.exceptions import AkIllegalArgumentException
+
+            raise AkIllegalArgumentException(
+                "LookupStreamOp needs model= (the mapping table) or a "
+                "model-table first input")
+        lut = op._build_lut(model)
+        for chunk in ins[-1]:
+            yield op._probe(model.schema, chunk, lut)
+
+
+class RecommendationRankingStreamOp(StreamOperator):
+    """Per-micro-batch twin of RecommendationRankingBatchOp — the pipeline
+    model loads once (reference: operator/stream/recommendation/
+    RecommendationRankingStreamOp.java)."""
+
+    _min_inputs = 2
+    _max_inputs = 2
+
+    def _stream_impl(self, *ins: Iterator[MTable]) -> Iterator[MTable]:
+        from ..batch.recommendation2 import RecommendationRankingBatchOp
+
+        try:
+            model = next(ins[0])
+        except StopIteration:
+            from ...common.exceptions import AkIllegalArgumentException
+
+            raise AkIllegalArgumentException(
+                "RecommendationRankingStreamOp needs a pipeline-model "
+                "first input")
+        op = RecommendationRankingBatchOp(self.get_params().clone())
+        for chunk in ins[1]:
+            yield op._execute_impl(model, chunk)
+
+
+class ModelStreamFileSinkStreamOp(StreamOperator):
+    """Append every model snapshot flowing through to a model-stream
+    directory (reference: operator/stream/sink/
+    ModelStreamFileSinkStreamOp.java)."""
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    from ...common.params import ParamInfo as _P
+
+    FILE_PATH = _P("filePath", str, optional=False,
+                   desc="model stream DIRECTORY")
+
+    def _stream_impl(self, it: Iterator[MTable]) -> Iterator[MTable]:
+        from .modelstream import FileModelStreamSink
+
+        sink = FileModelStreamSink(self.get(self.FILE_PATH))
+        for chunk in it:
+            sink.write(chunk)
+            yield chunk
+
+
+def _func_aliases():
+    from .windows import PandasUdfStreamOp, PyScalarFnStreamOp, \
+        PyTableFnStreamOp
+
+    class TensorFlowStreamOp(PandasUdfStreamOp):
+        """Run a user python function per micro-batch — the reference
+        ships chunks to a TF1 script (reference: operator/stream/dataproc/
+        TensorFlowStreamOp.java)."""
+
+    class TensorFlow2StreamOp(TensorFlowStreamOp):
+        """(reference: operator/stream/dataproc/TensorFlow2StreamOp.java)"""
+
+    class BasePyScalarFnStreamOp(PyScalarFnStreamOp):
+        """(reference: operator/stream/utils/BasePyScalarFnStreamOp.java)"""
+
+    class BasePyTableFnStreamOp(PyTableFnStreamOp):
+        """(reference: operator/stream/utils/BasePyTableFnStreamOp.java)"""
+
+    class PandasUdfFilStreamOp(PandasUdfStreamOp):
+        """File-loaded pandas UDF per micro-batch (reference:
+        operator/stream/utils/PandasUdfFilStreamOp.java — sic, the
+        reference's truncated class name)."""
+
+        def __init__(self, file_path: str = None, func_name: str = "udf",
+                     params=None, **kw):
+            from ..batch.udf2 import _load_callable
+
+            path = file_path or kw.pop("filePath", None)
+            name = kw.pop("funcName", func_name)
+            super().__init__(func=_load_callable(path, name),
+                             params=params, **kw)
+
+    for cls in (TensorFlowStreamOp, TensorFlow2StreamOp,
+                BasePyScalarFnStreamOp, BasePyTableFnStreamOp,
+                PandasUdfFilStreamOp):
+        cls.__module__ = __name__
+        globals()[cls.__name__] = cls
+
+
+_func_aliases()
+
+
+class BaseOnlinePredictStreamOp(ModelMapStreamOp):
+    """Public base of the model-serving stream ops (reference:
+    operator/stream/utils/BaseOnlinePredictStreamOp.java — the hot-swap
+    ModelMapStreamOp IS that base here)."""
+
+
+class BaseSourceStreamOp(StreamOperator):
+    """Public base of stream sources (reference: operator/stream/source/
+    BaseSourceStreamOp.java)."""
+
+    _max_inputs = 0
+
+
+class BaseSinkStreamOp(StreamOperator):
+    """Public base of stream sinks (reference: operator/stream/sink/
+    BaseSinkStreamOp.java)."""
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+
+class BaseSqlApiStreamOp(StreamOperator):
+    """Public base of the stream SQL-sugar ops (reference:
+    operator/stream/sql/BaseSqlApiStreamOp.java)."""
+
+
+class BaseFormatTransStreamOp(StreamOperator):
+    """Public base of the stream format-conversion twins (reference:
+    operator/stream/dataproc/format/BaseFormatTransStreamOp.java)."""
+
+
+class BaseRecommStreamOp(ModelMapStreamOp):
+    """Public base of the recommendation serving stream ops (reference:
+    operator/stream/recommendation/BaseRecommStreamOp.java)."""
