@@ -66,8 +66,21 @@ def parallel_apply(fn: Callable[[T], R], items: Sequence[T],
         env = MLEnvironmentFactory.get_default()
     if env.parallelism <= 1:
         return [fn(x) for x in items]
-    futures = [env.executor.submit(fn, x) for x in items]
-    return [f.result() for f in futures]
+    # one future PER SHARD, not per item: split_work balances the items
+    # across the pool (the DefaultDistributedInfo role) and a big grouped
+    # job submits parallelism futures instead of thousands
+    shards = [se for se in split_work(len(items), env.parallelism)
+              if se[1] > 0]
+
+    def run_shard(se):
+        start, length = se
+        return [fn(x) for x in items[start:start + length]]
+
+    futures = [env.executor.submit(run_shard, se) for se in shards]
+    out: List[R] = []
+    for f in futures:
+        out.extend(f.result())
+    return out
 
 
 class LocalOperator(_BatchOperator):

@@ -116,3 +116,61 @@ def test_grouped_outlier_uses_pool():
         predictionCol="flag").link_from(TableSourceBatchOp(t)).collect()
     flags = np.asarray(out.col("flag")).reshape(12, 30)
     assert flags[:, 0].all() and not flags[:, 1:].any()
+
+
+def test_parallel_apply_shards_via_split_work(monkeypatch):
+    """split_work is load-bearing: parallel_apply plans its shards with it
+    (one future per shard), so every grouped op consumes it."""
+    import alink_tpu.operator.local as local_mod
+
+    calls = []
+    real = local_mod.split_work
+
+    def spy(total, workers):
+        calls.append((total, workers))
+        return real(total, workers)
+
+    monkeypatch.setattr(local_mod, "split_work", spy)
+    from alink_tpu.common.env import MLEnvironment
+
+    env = MLEnvironment(parallelism=3)
+    try:
+        out = local_mod.parallel_apply(lambda x: x * 2, list(range(100)),
+                                      env=env, min_items=2)
+    finally:
+        env.close()
+    assert out == [x * 2 for x in range(100)]  # order preserved
+    assert calls == [(100, 3)]  # one planning call, one future per shard
+
+
+def test_csv_vector_roundtrip(tmp_path):
+    """Dense and sparse vector columns survive the CSV wire exactly."""
+    import numpy as np
+
+    from alink_tpu.common.linalg import DenseVector, SparseVector
+    from alink_tpu.common.mtable import AlinkTypes, MTable, TableSchema
+    from alink_tpu.operator.batch import CsvSinkBatchOp, CsvSourceBatchOp
+    from alink_tpu.operator.batch.base import TableSourceBatchOp
+
+    n = 50
+    dense = np.empty(n, object)
+    for i in range(n):
+        dense[i] = DenseVector(np.asarray([float(i), i + 0.5]))
+    t = MTable({"v": dense}, TableSchema(["v"], [AlinkTypes.DENSE_VECTOR]))
+    path = str(tmp_path / "d.csv")
+    CsvSinkBatchOp(filePath=path).link_from(TableSourceBatchOp(t)).collect()
+    out = CsvSourceBatchOp(filePath=path, schemaStr="v VECTOR").collect()
+    assert out.col("v")[7].data.tolist() == [7.0, 7.5]
+
+    sparse = np.empty(2, object)
+    sparse[0] = SparseVector(4, np.asarray([1]), np.asarray([2.0]))
+    sparse[1] = SparseVector(4, np.asarray([0, 3]), np.asarray([1.0, 5.0]))
+    t2 = MTable({"v": sparse},
+                TableSchema(["v"], [AlinkTypes.SPARSE_VECTOR]))
+    path2 = str(tmp_path / "s.csv")
+    CsvSinkBatchOp(filePath=path2).link_from(
+        TableSourceBatchOp(t2)).collect()
+    out2 = CsvSourceBatchOp(filePath=path2, schemaStr="v VECTOR").collect()
+    got = out2.col("v")[1]
+    assert isinstance(got, SparseVector)
+    assert got.get(3) == 5.0
