@@ -487,3 +487,194 @@ class Word2Vec(EstimatorBase):
     NUM_ITER = _huge.HasWord2VecParams.NUM_ITER
     MIN_COUNT = _huge.HasWord2VecParams.MIN_COUNT
     PREDICTION_COL = _huge.HasPredictionCol.PREDICTION_COL
+
+
+# -- round-3 feature/NLP/recommendation stages --------------------------------
+from ..operator.batch import feature3 as _feat3
+from ..operator.batch import feature4 as _feat4
+from ..operator.batch import misc2 as _misc2
+from ..operator.batch import nlp as _nlp
+from ..operator.batch import nlp2 as _nlp2
+
+
+class MultiHotEncoderModel(ModelBase):
+    _predict_op_cls = _feat3.MultiHotPredictBatchOp
+
+
+class MultiHotEncoder(EstimatorBase, _dp.HasSelectedCols):
+    """(reference: pipeline/feature/MultiHotEncoder.java)"""
+
+    _train_op_cls = _feat3.MultiHotTrainBatchOp
+    _model_cls = MultiHotEncoderModel
+    DELIMITER = _feat3.MultiHotTrainBatchOp.DELIMITER
+    OUTPUT_COL = _feat2.HasOutputCol.OUTPUT_COL
+
+
+class TargetEncoderModel(ModelBase):
+    _predict_op_cls = _feat3.TargetEncoderPredictBatchOp
+
+
+class TargetEncoder(EstimatorBase, _dp.HasSelectedCols):
+    """(reference: pipeline/feature/TargetEncoder.java)"""
+
+    _train_op_cls = _feat3.TargetEncoderTrainBatchOp
+    _model_cls = TargetEncoderModel
+    LABEL_COL = _feat3.TargetEncoderTrainBatchOp.LABEL_COL
+    POSITIVE_LABEL_VALUE_STRING = \
+        _feat3.TargetEncoderTrainBatchOp.POSITIVE_LABEL_VALUE_STRING
+    SMOOTHING = _feat3.TargetEncoderTrainBatchOp.SMOOTHING
+    OUTPUT_COLS = _dp.HasOutputCols.OUTPUT_COLS
+
+
+class MultiStringIndexerModel(ModelBase):
+    _predict_op_cls = _feat3.MultiStringIndexerPredictBatchOp
+
+
+class MultiStringIndexer(EstimatorBase, _dp.HasSelectedCols):
+    """(reference: pipeline/dataproc/MultiStringIndexer.java)"""
+
+    _train_op_cls = _feat3.MultiStringIndexerTrainBatchOp
+    _model_cls = MultiStringIndexerModel
+    STRING_ORDER_TYPE = \
+        _feat3.MultiStringIndexerTrainBatchOp.STRING_ORDER_TYPE
+    OUTPUT_COLS = _dp.HasOutputCols.OUTPUT_COLS
+
+
+class Binarizer(TransformerBase):
+    """(reference: pipeline/feature/Binarizer.java)"""
+
+    _map_op_cls = _feat3.BinarizerBatchOp
+    SELECTED_COL = _feat2.HasSelectedCol.SELECTED_COL
+    THRESHOLD = _feat3.BinarizerBatchOp.THRESHOLD
+    OUTPUT_COL = _feat2.HasOutputCol.OUTPUT_COL
+    RESERVED_COLS = _feat2.HasReservedCols.RESERVED_COLS
+
+
+class Bucketizer(TransformerBase):
+    """(reference: pipeline/feature/Bucketizer.java)"""
+
+    _map_op_cls = _feat3.BucketizerBatchOp
+    SELECTED_COLS = _dp.HasSelectedCols.SELECTED_COLS
+    CUTS_ARRAY = _feat3.BucketizerBatchOp.CUTS_ARRAY
+    OUTPUT_COLS = _dp.HasOutputCols.OUTPUT_COLS
+    RESERVED_COLS = _feat2.HasReservedCols.RESERVED_COLS
+
+
+class CrossFeatureModel(ModelBase):
+    _predict_op_cls = _feat4.CrossFeaturePredictBatchOp
+
+
+class CrossFeature(EstimatorBase, _dp.HasSelectedCols):
+    """(reference: pipeline/feature/CrossFeature.java)"""
+
+    _train_op_cls = _feat4.CrossFeatureTrainBatchOp
+    _model_cls = CrossFeatureModel
+    OUTPUT_COL = _feat2.HasOutputCol.OUTPUT_COL
+
+
+class WoeEncoderModel(ModelBase):
+    _predict_op_cls = _feat4.WoePredictBatchOp
+
+
+class WoeEncoder(EstimatorBase, _dp.HasSelectedCols):
+    """(reference: pipeline/finance/WoeEncoder.java)"""
+
+    _train_op_cls = _feat4.WoeTrainBatchOp
+    _model_cls = WoeEncoderModel
+    LABEL_COL = _feat4.WoeTrainBatchOp.LABEL_COL
+    POSITIVE_LABEL = _feat4.WoeTrainBatchOp.POSITIVE_LABEL
+
+
+class NaiveBayesTextClassifierModel(ModelBase):
+    _predict_op_cls = _nlp2.NaiveBayesTextPredictBatchOp
+
+
+class NaiveBayesTextClassifier(EstimatorBase, _RichPredictParams):
+    """(reference: pipeline/classification/NaiveBayesTextClassifier.java)"""
+
+    _train_op_cls = _nlp2.NaiveBayesTextTrainBatchOp
+    _model_cls = NaiveBayesTextClassifierModel
+    VECTOR_COL = _cls.HasVectorCol.VECTOR_COL
+    LABEL_COL = _nlp2.NaiveBayesTextTrainBatchOp.LABEL_COL
+    MODEL_TYPE = _nlp2.NaiveBayesTextTrainBatchOp.MODEL_TYPE
+
+
+class Tokenizer(TransformerBase):
+    """(reference: pipeline/nlp/Tokenizer.java)"""
+
+    _map_op_cls = _nlp.TokenizerBatchOp
+    SELECTED_COL = _feat2.HasSelectedCol.SELECTED_COL
+    OUTPUT_COL = _feat2.HasOutputCol.OUTPUT_COL
+    RESERVED_COLS = _feat2.HasReservedCols.RESERVED_COLS
+
+
+class RegexTokenizer(TransformerBase):
+    """(reference: pipeline/nlp/RegexTokenizer.java)"""
+
+    _map_op_cls = _nlp.RegexTokenizerBatchOp
+    SELECTED_COL = _feat2.HasSelectedCol.SELECTED_COL
+    OUTPUT_COL = _feat2.HasOutputCol.OUTPUT_COL
+    PATTERN = _nlp.RegexTokenizerBatchOp.PATTERN
+    GAPS = _nlp.RegexTokenizerBatchOp.GAPS
+    MIN_TOKEN_LENGTH = _nlp.RegexTokenizerBatchOp.MIN_TOKEN_LENGTH
+    TO_LOWER_CASE = _nlp.RegexTokenizerBatchOp.TO_LOWER_CASE
+    RESERVED_COLS = _feat2.HasReservedCols.RESERVED_COLS
+
+
+class SparseFeatureIndexerModel(ModelBase):
+    _predict_op_cls = _misc2.SparseFeatureIndexerPredictBatchOp
+
+
+class SparseFeatureIndexer(EstimatorBase):
+    """(reference: pipeline/dataproc/SparseFeatureIndexer.java)"""
+
+    _train_op_cls = _misc2.SparseFeatureIndexerTrainBatchOp
+    _model_cls = SparseFeatureIndexerModel
+    SELECTED_COL = _feat2.HasSelectedCol.SELECTED_COL
+    OUTPUT_COL = _feat2.HasOutputCol.OUTPUT_COL
+    KV_DELIMITER = _misc2.SparseFeatureIndexerTrainBatchOp.KV_DELIMITER
+    FEATURE_DELIMITER = \
+        _misc2.SparseFeatureIndexerTrainBatchOp.FEATURE_DELIMITER
+    MIN_FREQUENCY = _misc2.SparseFeatureIndexerTrainBatchOp.MIN_FREQUENCY
+
+
+class C45Model(ModelBase):
+    _predict_op_cls = _tree.C45PredictBatchOp
+
+
+class C45(EstimatorBase, _RichPredictParams):
+    """(reference: pipeline/classification/C45.java)"""
+
+    _train_op_cls = _tree.C45TrainBatchOp
+    _model_cls = C45Model
+    LABEL_COL = _tree.HasTreeTrainParams.LABEL_COL
+    MAX_DEPTH = _tree.HasTreeTrainParams.MAX_DEPTH
+    FEATURE_COLS = _cls.HasFeatureCols.FEATURE_COLS
+
+
+class CartModel(ModelBase):
+    _predict_op_cls = _tree.CartPredictBatchOp
+
+
+class Cart(EstimatorBase, _RichPredictParams):
+    """(reference: pipeline/classification/Cart.java)"""
+
+    _train_op_cls = _tree.CartTrainBatchOp
+    _model_cls = CartModel
+    LABEL_COL = _tree.HasTreeTrainParams.LABEL_COL
+    MAX_DEPTH = _tree.HasTreeTrainParams.MAX_DEPTH
+    FEATURE_COLS = _cls.HasFeatureCols.FEATURE_COLS
+
+
+class Id3Model(ModelBase):
+    _predict_op_cls = _tree.Id3PredictBatchOp
+
+
+class Id3(EstimatorBase, _RichPredictParams):
+    """(reference: pipeline/classification/Id3.java)"""
+
+    _train_op_cls = _tree.Id3TrainBatchOp
+    _model_cls = Id3Model
+    LABEL_COL = _tree.HasTreeTrainParams.LABEL_COL
+    MAX_DEPTH = _tree.HasTreeTrainParams.MAX_DEPTH
+    FEATURE_COLS = _cls.HasFeatureCols.FEATURE_COLS

@@ -1,0 +1,80 @@
+"""Streaming clickstream analytics: event-time windows, rolling aggregates,
+traffic indexes, hot items, and online anomaly flags — one stream DAG.
+
+Run:  JAX_PLATFORMS=cpu python examples/stream_window_analytics.py
+
+Flow (reference: the Alink stream SQL window tutorial —
+TumbleTimeWindowStreamOp + HotProductStreamOp + WebTrafficIndexStreamOp):
+1. synthesize a day of events (user, item, latency) with a latency spike,
+2. tumbling 1-hour windows aggregate request counts + mean latency,
+3. an over-count window appends a rolling p-latency mean per event,
+4. cumulative PV/UV and hot-item rankings re-emit per micro-batch,
+5. a KSigma outlier stream flags the latency spike as it streams past.
+"""
+
+import numpy as np
+
+from alink_tpu.common.mtable import MTable
+from alink_tpu.operator.stream import (
+    HotProductStreamOp,
+    KSigmaOutlierStreamOp,
+    OverCountWindowStreamOp,
+    TableSourceStreamOp,
+    TumbleTimeWindowStreamOp,
+    WebTrafficIndexStreamOp,
+)
+
+
+def make_events(n=2000, seed=3):
+    rng = np.random.default_rng(seed)
+    ts = np.sort(rng.uniform(0, 24 * 3600, n))
+    users = rng.choice([f"u{i}" for i in range(40)], n)
+    items = rng.choice([f"item{i}" for i in range(8)],
+                       n, p=np.asarray([4, 3, 2, 2, 1, 1, 1, 1]) / 15)
+    latency = rng.gamma(2.0, 30.0, n)
+    spike = (ts > 13 * 3600) & (ts < 13.5 * 3600)
+    latency[spike] *= 8  # incident half-hour
+    return MTable({"ts": ts, "user": users.astype(object),
+                   "item": items.astype(object), "latency_ms": latency})
+
+
+def main():
+    events = make_events()
+    src = lambda: TableSourceStreamOp(events, numChunks=24)  # noqa: E731
+
+    hourly = TumbleTimeWindowStreamOp(
+        timeCol="ts", windowTime=3600.0,
+        clause="count(*) as requests, avg(latency_ms) as mean_ms",
+    ).link_from(src()).collect()
+    worst = max(hourly.rows(), key=lambda r: r[1])
+    print(f"hours aggregated: {hourly.num_rows}; worst hour starts at "
+          f"{worst[-1] / 3600:.0f}h with mean {worst[1]:.0f} ms")
+
+    rolling = OverCountWindowStreamOp(
+        selectedCol="latency_ms", windowSize=100,
+        agg="mean").link_from(src()).collect()
+    print("rolling-100 latency at stream end:",
+          round(float(rolling.col("latency_ms_mean")[-1]), 1), "ms")
+
+    traffic = WebTrafficIndexStreamOp(selectedCol="user").link_from(
+        src()).collect()
+    pv, uv = [r[1] for r in list(traffic.rows())[-2:]]
+    print(f"cumulative PV={pv} UV={uv}")
+
+    hot = HotProductStreamOp(selectedCol="item", topN=3).link_from(
+        src()).collect()
+    print("hottest items:", [(r[0], int(r[1]))
+                             for r in list(hot.rows())[-3:]])
+
+    flagged = KSigmaOutlierStreamOp(
+        selectedCol="latency_ms", k=3.0,
+        predictionCol="is_anomaly").link_from(src()).collect()
+    anomalies = np.asarray(flagged.col("is_anomaly"), bool)
+    spike_ts = np.asarray(flagged.col("ts"))[anomalies]
+    print(f"{int(anomalies.sum())} anomalous events; "
+          f"median anomaly time {np.median(spike_ts) / 3600:.1f}h "
+          f"(incident injected at 13.0-13.5h)")
+
+
+if __name__ == "__main__":
+    main()

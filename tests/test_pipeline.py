@@ -181,3 +181,54 @@ def test_word2vec_pipeline():
                               predictionCol="vec")).fit(src)
     out = model.transform(src).collect()
     assert out.col("vec")[0].data.shape == (12,)
+
+
+def test_round3_pipeline_stages_roundtrip(tmp_path):
+    """The new feature/NLP/tree stages chain, persist, and reload."""
+    import numpy as np
+
+    from alink_tpu.common.mtable import MTable
+    from alink_tpu.operator.batch.base import TableSourceBatchOp
+    from alink_tpu.pipeline import Pipeline, PipelineModel
+    from alink_tpu.pipeline.estimators import (
+        Binarizer,
+        Cart,
+        MultiHotEncoder,
+        TargetEncoder,
+    )
+
+    rng = np.random.default_rng(0)
+    n = 120
+    t = MTable({
+        "tags": np.asarray([("a,b" if i % 2 else "b,c")
+                            for i in range(n)], object),
+        "cat": np.asarray([("p" if i % 2 else "q")
+                           for i in range(n)], object),
+        "x": rng.normal(size=n),
+        "y": np.asarray([i % 2 for i in range(n)], np.int64)})
+    src = TableSourceBatchOp(t)
+    pipe = Pipeline(
+        MultiHotEncoder(selectedCols=["tags"], outputCol="mh"),
+        TargetEncoder(selectedCols=["cat"], labelCol="y"),
+        Binarizer(selectedCol="x", threshold=0.0),
+        Cart(featureCols=["cat_te", "x"], labelCol="y",
+             predictionCol="p", maxDepth=3),
+    ).fit(src)
+    out = pipe.transform(src).collect()
+    acc = float(np.mean(np.asarray(out.col("p"))
+                        == np.asarray(t.col("y"))))
+    assert acc > 0.9
+    path = str(tmp_path / "pipe.ak")
+    pipe.save(path)
+    out2 = PipelineModel.load(path).transform(src).collect()
+    np.testing.assert_array_equal(out.col("p"), out2.col("p"))
+
+
+def test_round3_stage_registry_names():
+    from alink_tpu.pipeline.base import STAGE_REGISTRY
+
+    for name in ("MultiHotEncoder", "TargetEncoder", "MultiStringIndexer",
+                 "Binarizer", "Bucketizer", "CrossFeature", "WoeEncoder",
+                 "NaiveBayesTextClassifier", "Tokenizer", "RegexTokenizer",
+                 "SparseFeatureIndexer", "C45", "Cart", "Id3"):
+        assert name in STAGE_REGISTRY, name
