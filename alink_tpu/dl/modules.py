@@ -45,6 +45,10 @@ class BertConfig:
     dtype: Any = jnp.bfloat16  # compute dtype; params stay fp32
     use_ring_attention: bool = False  # seq-axis sequence parallelism
     remat: bool = False  # jax.checkpoint each layer (HBM <-> FLOPs trade)
+    # "mean": masked mean-pool (robust for from-scratch training);
+    # "cls": first-token pooling, matching the pretrained BERT pooler
+    # (reference checkpoints are trained with NSP on the CLS slot)
+    pool: str = "mean"
 
     @staticmethod
     def base(**kw) -> "BertConfig":
@@ -127,9 +131,11 @@ class TransformerEncoder(nn.Module):
                 x, attention_mask, deterministic
             )
 
-        # masked mean-pool (CLS-equivalent without a pretrained pooler)
-        m = attention_mask.astype(x.dtype)[:, :, None]
-        pooled = (x * m).sum(axis=1) / jnp.maximum(m.sum(axis=1), 1.0)
+        if c.pool == "cls":  # pretrained BERT pooler input is the CLS slot
+            pooled = x[:, 0]
+        else:  # masked mean-pool (CLS-equivalent without a pretrained pooler)
+            m = attention_mask.astype(x.dtype)[:, :, None]
+            pooled = (x * m).sum(axis=1) / jnp.maximum(m.sum(axis=1), 1.0)
         pooled = jnp.tanh(nn.Dense(c.hidden_size, dtype=c.dtype, name="pooler")(pooled))
         if return_pooled:  # embedding serving (BertTextEmbeddingBatchOp)
             return pooled.astype(jnp.float32)

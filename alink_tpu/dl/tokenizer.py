@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import collections
 import re
+import unicodedata
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -20,27 +21,80 @@ import numpy as np
 PAD, UNK, CLS, SEP, MASK = "[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"
 _SPECIALS = [PAD, UNK, CLS, SEP, MASK]
 
-_TOKEN_RE = re.compile(r"\w+|[^\w\s]", re.UNICODE)
+
+def _is_punctuation(ch: str) -> bool:
+    cp = ord(ch)
+    # ASCII non-alnum blocks count as punctuation (BERT convention, so that
+    # e.g. "$" and "`" split even though unicodedata calls them symbols)
+    if (33 <= cp <= 47 or 58 <= cp <= 64 or 91 <= cp <= 96 or
+            123 <= cp <= 126):
+        return True
+    return unicodedata.category(ch).startswith("P")
 
 
-def _basic_tokens(text: str) -> List[str]:
-    return _TOKEN_RE.findall(text.lower())
+def _is_cjk(cp: int) -> bool:
+    return (0x4E00 <= cp <= 0x9FFF or 0x3400 <= cp <= 0x4DBF or
+            0x20000 <= cp <= 0x2A6DF or 0x2A700 <= cp <= 0x2B73F or
+            0x2B740 <= cp <= 0x2B81F or 0x2B820 <= cp <= 0x2CEAF or
+            0xF900 <= cp <= 0xFAFF or 0x2F800 <= cp <= 0x2FA1F)
+
+
+def _basic_tokens(text: str, do_lower_case: bool = True) -> List[str]:
+    """BERT basic tokenization: clean control chars, isolate CJK chars,
+    optionally lowercase + strip accents, split on punctuation."""
+    if do_lower_case:
+        text = text.lower()
+        text = "".join(ch for ch in unicodedata.normalize("NFD", text)
+                       if unicodedata.category(ch) != "Mn")
+    out: List[str] = []
+    word: List[str] = []
+
+    def flush():
+        if word:
+            out.append("".join(word))
+            word.clear()
+
+    for ch in text:
+        # whitespace first: \t \n \r are category Cc but BERT treats them
+        # as word separators, not strippable control chars
+        if ch.isspace():
+            flush()
+            continue
+        cp = ord(ch)
+        if cp == 0 or cp == 0xFFFD or unicodedata.category(ch).startswith("C"):
+            continue
+        if _is_cjk(cp) or _is_punctuation(ch):
+            flush()
+            out.append(ch)
+        else:
+            word.append(ch)
+    flush()
+    return out
+
+
+_LEGACY_TOKEN_RE = re.compile(r"\w+|[^\w\s]", re.UNICODE)
 
 
 class Tokenizer:
-    def __init__(self, vocab: Dict[str, int], max_input_chars_per_word: int = 64):
+    def __init__(self, vocab: Dict[str, int], max_input_chars_per_word: int = 64,
+                 do_lower_case: bool = True, legacy: bool = False):
         self.vocab = vocab
         self.inv = {i: t for t, i in vocab.items()}
         self.max_chars = max_input_chars_per_word
+        self.do_lower_case = do_lower_case
+        # pre-round-4 models built their vocab with a \w+ regex (no accent
+        # stripping, "_" kept inside words); serving them must keep that
+        # behavior or their vocab entries stop matching
+        self.legacy = legacy
 
     # -- construction ------------------------------------------------------
     @staticmethod
-    def from_vocab_file(path: str) -> "Tokenizer":
+    def from_vocab_file(path: str, do_lower_case: bool = True) -> "Tokenizer":
         vocab = {}
         with open(path, encoding="utf-8") as f:
             for i, line in enumerate(f):
                 vocab[line.rstrip("\n")] = i
-        return Tokenizer(vocab)
+        return Tokenizer(vocab, do_lower_case=do_lower_case)
 
     @staticmethod
     def build(texts: Sequence[str], vocab_size: int = 8000) -> "Tokenizer":
@@ -90,8 +144,10 @@ class Tokenizer:
         return pieces
 
     def tokenize(self, text: str) -> List[str]:
+        words = (_LEGACY_TOKEN_RE.findall(text.lower()) if self.legacy
+                 else _basic_tokens(text, self.do_lower_case))
         out = []
-        for w in _basic_tokens(text):
+        for w in words:
             out.extend(self._wordpiece(w))
         return out
 
@@ -145,8 +201,10 @@ class Tokenizer:
         return [self.inv[i] for i in range(len(self.inv))]
 
     @staticmethod
-    def from_list(tokens: Sequence[str]) -> "Tokenizer":
-        return Tokenizer({t: i for i, t in enumerate(tokens)})
+    def from_list(tokens: Sequence[str], do_lower_case: bool = True,
+                  legacy: bool = False) -> "Tokenizer":
+        return Tokenizer({t: i for i, t in enumerate(tokens)},
+                         do_lower_case=do_lower_case, legacy=legacy)
 
     @property
     def vocab_size(self) -> int:

@@ -24,7 +24,8 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-from ...common.exceptions import AkIllegalDataException
+from ...common.exceptions import (AkIllegalArgumentException,
+                                  AkIllegalDataException)
 from ...common.model import model_to_table, table_to_model
 from ...common.mtable import AlinkTypes, MTable
 from ...common.params import MinValidator, ParamInfo
@@ -225,6 +226,16 @@ class BaseBertTextTrainBatchOp(ModelTrainOpMixin, BatchOperator, HasDLTrainParam
     )
     SEQ_SHARDS = ParamInfo("seqShards", int, default=1,
                            desc="sequence-parallel shards (ring attention)")
+    # pretrained ingest (reference: HasBertModelName + BertResources.java;
+    # checkpoint consumed by BaseEasyTransferTrainBatchOp.java)
+    BERT_MODEL_NAME = ParamInfo(
+        "bertModelName", str,
+        desc="pretrained model resolved from the plugin dir, e.g. "
+             "'base-uncased' (see dl.pretrained.MODEL_NAME_DIRS)")
+    CHECKPOINT_FILE_PATH = ParamInfo(
+        "checkpointFilePath", str,
+        desc="explicit pretrained checkpoint directory (HF layout or "
+             "google-research TF ckpt); overrides bertModelName")
 
     _min_inputs = 1
     _max_inputs = 1
@@ -260,8 +271,20 @@ class BaseBertTextTrainBatchOp(ModelTrainOpMixin, BatchOperator, HasDLTrainParam
             **common,
         )
 
+    def _resolve_pretrained(self):
+        """Checkpoint dir from checkpointFilePath / bertModelName, or None."""
+        path = self.get(self.CHECKPOINT_FILE_PATH)
+        if path:
+            return path
+        name = self.get(self.BERT_MODEL_NAME)
+        if not name:
+            return None
+        from ...dl.pretrained import resolve_bert_resource
+
+        return resolve_bert_resource(name)
+
     def _execute_impl(self, t: MTable) -> MTable:
-        from ...dl.modules import TransformerEncoder
+        from ...dl.modules import BertConfig, TransformerEncoder
         from ...dl.tokenizer import Tokenizer
         from ...dl.train import TrainConfig, train_model
 
@@ -272,10 +295,6 @@ class BaseBertTextTrainBatchOp(ModelTrainOpMixin, BatchOperator, HasDLTrainParam
 
         texts = [str(v) for v in t.col(text_col)]
         pairs = [str(v) for v in t.col(pair_col)] if pair_col else None
-        tok = Tokenizer.build(
-            texts + (pairs or []), vocab_size=self.get(self.VOCAB_SIZE)
-        )
-        enc = tok.encode_batch(texts, pairs, max_len=max_len)
 
         y_raw = t.col(label_col)
         if self._regression:
@@ -287,7 +306,29 @@ class BaseBertTextTrainBatchOp(ModelTrainOpMixin, BatchOperator, HasDLTrainParam
             y = np.asarray([lab_to_idx[v] for v in y_raw], np.int32)
             num_labels = len(labels)
 
-        cfg = self._bert_config(tok.vocab_size, num_labels)
+        pre_dir = self._resolve_pretrained()
+        pre_subtree = None
+        if pre_dir:
+            from ...dl.pretrained import load_bert_checkpoint, load_vocab_file
+
+            ckpt_cfg, pre_subtree = load_bert_checkpoint(pre_dir)
+            do_lower = ckpt_cfg.pop("do_lower_case", True)
+            tok = Tokenizer.from_list(load_vocab_file(pre_dir), do_lower)
+            if max_len > ckpt_cfg["max_position"]:
+                raise AkIllegalArgumentException(
+                    f"maxSeqLength={max_len} exceeds the pretrained "
+                    f"checkpoint's max_position={ckpt_cfg['max_position']}")
+            cfg = BertConfig(
+                num_labels=num_labels, regression=self._regression,
+                pool="cls", dropout=0.1,
+                use_ring_attention=self.get(self.SEQ_SHARDS) > 1,
+                **ckpt_cfg)
+        else:
+            tok = Tokenizer.build(
+                texts + (pairs or []), vocab_size=self.get(self.VOCAB_SIZE)
+            )
+            cfg = self._bert_config(tok.vocab_size, num_labels)
+        enc = tok.encode_batch(texts, pairs, max_len=max_len)
         if cfg.use_ring_attention:
             # mesh with a seq axis for ring attention (dp fills the rest)
             from ...dl.sharding import make_dl_mesh
@@ -305,8 +346,17 @@ class BaseBertTextTrainBatchOp(ModelTrainOpMixin, BatchOperator, HasDLTrainParam
             seed=self.get(self.RANDOM_SEED),
             weight_decay=0.01,
         )
+        init_params = None
+        if pre_subtree is not None:
+            from ...dl.pretrained import init_from_pretrained
+
+            sample = {k: v[:1] for k, v in enc.items()}
+            init_params = init_from_pretrained(
+                model, cfg, pre_subtree, sample,
+                seed=self.get(self.RANDOM_SEED))
         params, history = train_model(
             model, enc, y, tc, mesh=mesh, regression=self._regression,
+            init_params=init_params,
         )
         import dataclasses
 
@@ -324,6 +374,8 @@ class BaseBertTextTrainBatchOp(ModelTrainOpMixin, BatchOperator, HasDLTrainParam
             "regression": self._regression,
             "maxSeqLength": max_len,
             "vocab": tok.to_list(),
+            "doLowerCase": tok.do_lower_case,
+            "pretrainedFrom": pre_dir,
             "finalLoss": history.get("final_loss"),
         }
         return model_to_table(meta, {"params": _params_to_bytes(params)})
@@ -357,7 +409,12 @@ class BertTextModelMapper(RichModelMapper):
         cfg = BertConfig(dtype=jnp.bfloat16, **self.meta["bertConfig"])
         self.cfg = cfg
         self.model = TransformerEncoder(cfg)
-        self.tokenizer = Tokenizer.from_list(self.meta["vocab"])
+        # models serialized before the BERT-spec tokenizer carry no
+        # doLowerCase key; serve them with the legacy \w+ tokenization their
+        # vocab was built with
+        self.tokenizer = Tokenizer.from_list(
+            self.meta["vocab"], self.meta.get("doLowerCase", True),
+            legacy="doLowerCase" not in self.meta)
         max_len = int(self.meta["maxSeqLength"])
         sample = {
             "input_ids": np.zeros((1, max_len), np.int32),
