@@ -232,27 +232,21 @@ class _W:
 
     def __init__(self, raw: Dict[str, np.ndarray]):
         self.raw = _strip_prefix(raw)
-        self.used: set = set()
 
     def dense(self, prefix: str) -> Tuple[np.ndarray, np.ndarray]:
         """Returns (kernel (in,out), bias)."""
         if prefix + ".weight_t" in self.raw:  # already (in, out)
             k = self.raw[prefix + ".weight_t"]
-            self.used.add(prefix + ".weight_t")
         else:
             k = self.raw[prefix + ".weight"].T  # torch (out, in)
-            self.used.add(prefix + ".weight")
         b = self.raw[prefix + ".bias"]
-        self.used.add(prefix + ".bias")
         return np.ascontiguousarray(k, np.float32), b.astype(np.float32)
 
     def ln(self, prefix: str) -> Dict[str, np.ndarray]:
-        self.used.update({prefix + ".weight", prefix + ".bias"})
         return {"scale": self.raw[prefix + ".weight"].astype(np.float32),
                 "bias": self.raw[prefix + ".bias"].astype(np.float32)}
 
     def emb(self, name: str) -> np.ndarray:
-        self.used.add(name + ".weight")
         return self.raw[name + ".weight"].astype(np.float32)
 
     def has(self, name: str) -> bool:
@@ -347,21 +341,33 @@ def init_from_pretrained(model, cfg, subtree: Dict[str, Any], sample: dict,
                          seed: int = 0):
     """model.init with the encoder subtree grafted in; head (and any part the
     checkpoint lacks, e.g. pooler in some exports) keeps its fresh init."""
+    import warnings
+
     import jax
 
     template = model.init(jax.random.PRNGKey(seed), **sample)
     params = dict(template["params"])
-    merged = _merge(params, subtree)
+    skipped: list = []
+    merged = _merge(params, subtree, skipped=skipped)
+    if skipped:
+        # silently dropping checkpoint tensors would leave layers at random
+        # init and "fine-tuning" would quietly train from scratch
+        warnings.warn(
+            f"pretrained checkpoint tensors not consumed by the model "
+            f"(left at fresh init): {skipped[:8]}"
+            f"{' ...' if len(skipped) > 8 else ''}")
     return {**template, "params": merged}
 
 
-def _merge(template: Dict[str, Any], new: Dict[str, Any]) -> Dict[str, Any]:
+def _merge(template: Dict[str, Any], new: Dict[str, Any], *, skipped: list,
+           prefix: str = "") -> Dict[str, Any]:
     out = dict(template)
     for k, v in new.items():
         if k not in out:
-            continue  # checkpoint has a piece the model doesn't use
+            skipped.append(prefix + k)
+            continue
         if isinstance(v, dict) and isinstance(out[k], dict):
-            out[k] = _merge(out[k], v)
+            out[k] = _merge(out[k], v, skipped=skipped, prefix=prefix + k + ".")
         else:
             tv = out[k]
             if tuple(np.shape(tv)) != tuple(np.shape(v)):

@@ -228,9 +228,11 @@ def _register_basic():
         "linear": lambda a, k: _j(a[0]) @ _j(a[1]).T + (
             _j(a[2]) if len(a) > 2 and a[2] is not None else 0
         ),
-        "view": lambda a, k: jnp.reshape(_j(a[0]), a[1]),
-        "reshape": lambda a, k: jnp.reshape(_j(a[0]), a[1]),
-        "_unsafe_view": lambda a, k: jnp.reshape(_j(a[0]), a[1]),
+        "view": lambda a, k: jnp.reshape(_j(a[0]), _viewshape(_j(a[0]), a[1])),
+        "reshape": lambda a, k: jnp.reshape(
+            _j(a[0]), _viewshape(_j(a[0]), a[1])),
+        "_unsafe_view": lambda a, k: jnp.reshape(
+            _j(a[0]), _viewshape(_j(a[0]), a[1])),
         "expand": lambda a, k: jnp.broadcast_to(
             _j(a[0]), _expand_shape(_j(a[0]).shape, a[1])
         ),
@@ -294,6 +296,20 @@ def _register_basic():
         "native_group_norm": _group_norm,
         "scaled_dot_product_attention": _sdpa,
     })
+
+
+def _viewshape(x, shape: Sequence[int]) -> List[int]:
+    """torch.export bakes the EXAMPLE batch size into view/reshape targets;
+    when the element counts disagree at serving time (different batch), the
+    leading dim is re-derived so exported graphs stay batch-polymorphic."""
+    import math
+
+    shape = [int(s) if not hasattr(s, "shape") else s for s in shape]
+    if any(hasattr(s, "shape") for s in shape) or -1 in shape:
+        return shape
+    if math.prod(shape) != math.prod(x.shape):
+        shape[0] = -1
+    return shape
 
 
 def _expand_shape(cur: Tuple[int, ...], target: Sequence[int]):

@@ -313,7 +313,15 @@ class BaseBertTextTrainBatchOp(ModelTrainOpMixin, BatchOperator, HasDLTrainParam
 
             ckpt_cfg, pre_subtree = load_bert_checkpoint(pre_dir)
             do_lower = ckpt_cfg.pop("do_lower_case", True)
-            tok = Tokenizer.from_list(load_vocab_file(pre_dir), do_lower)
+            vocab_list = load_vocab_file(pre_dir)
+            if len(vocab_list) != ckpt_cfg["vocab_size"]:
+                # nn.Embed clamps out-of-range ids silently; a vocab/config
+                # mismatch must fail loudly, not map words to the last row
+                raise AkIllegalArgumentException(
+                    f"vocab.txt has {len(vocab_list)} entries but the "
+                    f"checkpoint config says vocab_size="
+                    f"{ckpt_cfg['vocab_size']} ({pre_dir})")
+            tok = Tokenizer.from_list(vocab_list, do_lower)
             if max_len > ckpt_cfg["max_position"]:
                 raise AkIllegalArgumentException(
                     f"maxSeqLength={max_len} exceeds the pretrained "

@@ -137,12 +137,26 @@ class MapStreamOp(StreamOperator):
 
     mapper_cls = None
 
+    # micro-batches kept in flight when the mapper supports async dispatch
+    # (device computes chunk i while chunk i+1's transfer is under way)
+    _pipeline_depth = 3
+
     def _stream_impl(self, it: Iterator[MTable]) -> Iterator[MTable]:
+        from collections import deque
+
         mapper = None
+        q: deque = deque()
         for chunk in it:
             if mapper is None:
                 mapper = self.mapper_cls(chunk.schema, self.get_params())
-            yield mapper.map_table(chunk)
+            if hasattr(mapper, "dispatch_table"):
+                q.append(mapper.dispatch_table(chunk))
+                if len(q) >= self._pipeline_depth:
+                    yield mapper.finalize_table(q.popleft())
+            else:
+                yield mapper.map_table(chunk)
+        while q:
+            yield mapper.finalize_table(q.popleft())
 
 
 class ModelMapStreamOp(StreamOperator):

