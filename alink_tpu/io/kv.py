@@ -109,8 +109,13 @@ def open_kv_store(uri: str) -> KvStore:
         return MemoryKvStore(uri[len("memory://"):])
     if uri.startswith(("redis://", "rediss://", "unix://")):
         return RedisKvStore(uri)
+    if uri.startswith("hbase://"):
+        from .hbase import HBaseKvStore  # plugin-gated on happybase
+
+        return HBaseKvStore(uri)
     raise AkIllegalArgumentException(
-        f"unsupported KV store uri '{uri}' (memory:// or redis://)")
+        f"unsupported KV store uri '{uri}' (memory:// / redis:// / "
+        f"hbase://host:port/table?family=cf)")
 
 
 def __getattr__(name):

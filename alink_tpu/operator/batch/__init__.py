@@ -643,6 +643,7 @@ from .clustering2 import (
     GroupGeoDbscanBatchOp,
     GroupGeoDbscanModelBatchOp,
 )
+from .script import JaxScriptBatchOp
 from .io2 import (
     AggLookupBatchOp,
     BertTextEmbeddingBatchOp,

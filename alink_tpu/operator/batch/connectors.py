@@ -107,8 +107,9 @@ class KvSinkBatchOp(BatchOperator, HasSelectedCols):
     _min_inputs = 1
     _max_inputs = 1
 
-    def _write(self, t: MTable, store: KvStore) -> None:
-        key_col = self.get(self.KEY_COL)
+    def _write(self, t: MTable, store: KvStore,
+               key_col: "str | None" = None) -> None:
+        key_col = key_col or self.get(self.KEY_COL)
         selected = self.get(HasSelectedCols.SELECTED_COLS)
         val_cols = [n for n in (selected or t.names) if n != key_col]
         keep = [key_col] + val_cols

@@ -58,7 +58,7 @@ from .modelpredict import TFSavedModelPredictBatchOp
 from .regression import StepwiseLinearRegTrainBatchOp
 from .sources import TFRecordSinkBatchOp, TFRecordSourceBatchOp
 from .statistics import SummarizerBatchOp
-from .udf2 import PandasUdfBatchOp
+from .script import JaxScriptBatchOp
 from .utils import ModelMapBatchOp
 from .xgboost import XGBoostPredictBatchOp, XGBoostTrainBatchOp
 
@@ -148,10 +148,57 @@ class LookupRedisStringBatchOp(LookupKvBatchOp):
                            types + [AlinkTypes.STRING])
 
 
-class LookupHBaseBatchOp(LookupKvBatchOp):
-    """HBase rowkey lookup over the shared KV abstraction (reference:
-    operator/batch/dataproc/LookupHBaseBatchOp.java — the HBase thrift
-    client plugs in behind the same mget contract)."""
+class _HasHBaseParams:
+    """The reference's HBase connection/table params (reference:
+    params/io/HBaseConfigParams.java zookeeperQuorum/timeout +
+    params/io/HBaseParams.java tableName/familyName). When these are set the
+    op talks to a real HBase thrift gateway through
+    :class:`alink_tpu.io.hbase.HBaseClient` (plugin-gated on happybase);
+    an explicit ``storeUri`` (e.g. ``memory://`` in tests) still wins."""
+
+    ZOOKEEPER_QUORUM = ParamInfo("zookeeperQuorum", str)
+    THRIFT_HOST = ParamInfo("thriftHost", str)
+    THRIFT_PORT = ParamInfo("thriftPort", int, default=9090)
+    HBASE_TABLE_NAME = ParamInfo("tableName", str)
+    FAMILY_NAME = ParamInfo("familyName", str, default="cf")
+    TIMEOUT = ParamInfo("timeout", int, desc="thrift timeout in ms")
+    # storeUri stops being required: HBase params are the primary route
+    STORE_URI = ParamInfo("storeUri", str,
+                          aliases=("pluginUri", "redisIp"))
+
+    def _open_hbase_store(self):
+        uri = self.get(self.STORE_URI)
+        if uri:
+            from ...io.kv import open_kv_store
+
+            return open_kv_store(uri)
+        from ...io.hbase import HBaseClient, HBaseKvStore
+
+        table = self.get(self.HBASE_TABLE_NAME)
+        if not table:
+            raise AkIllegalArgumentException(
+                "HBase ops need tableName (+ zookeeperQuorum/thriftHost), "
+                "or an explicit storeUri")
+        client = HBaseClient(
+            thrift_host=self.get(self.THRIFT_HOST),
+            thrift_port=self.get(self.THRIFT_PORT),
+            zookeeper_quorum=self.get(self.ZOOKEEPER_QUORUM),
+            timeout_ms=self.get(self.TIMEOUT))
+        return HBaseKvStore(client=client, table=table,
+                            family=self.get(self.FAMILY_NAME))
+
+
+class LookupHBaseBatchOp(_HasHBaseParams, LookupKvBatchOp):
+    """HBase rowkey lookup (reference: operator/batch/dataproc/
+    LookupHBaseBatchOp.java). Output columns are qualifiers in
+    ``familyName``; the batched thrift ``rows`` call serves each chunk."""
+
+    def _execute_impl(self, t: MTable) -> MTable:
+        store = self._open_hbase_store()
+        try:
+            return self._decorate(t, store)
+        finally:
+            store.close()
 
 
 class RedisRowSinkBatchOp(KvSinkBatchOp):
@@ -162,8 +209,32 @@ class RedisStringSinkBatchOp(KvSinkBatchOp):
     """(reference: operator/batch/sink/RedisStringSinkBatchOp.java)"""
 
 
-class HBaseSinkBatchOp(KvSinkBatchOp):
-    """(reference: operator/batch/sink/HBaseSinkBatchOp.java)"""
+class HBaseSinkBatchOp(_HasHBaseParams, KvSinkBatchOp):
+    """(reference: operator/batch/sink/HBaseSinkBatchOp.java — rowKeyCols
+    + familyName; each selected column lands as one qualifier)."""
+
+    ROW_KEY_COLS = ParamInfo("rowKeyCols", list, aliases=("rowKeyCol",))
+    KEY_COL = ParamInfo("keyCol", str, aliases=("rowKey",))
+
+    def _execute_impl(self, t: MTable) -> MTable:
+        # reference names the key column rowKeyCols; keyCol also accepted.
+        # Derived locally — executing an op must not write back params
+        key = self.get(self.KEY_COL)
+        if not key:
+            rk = self.get(self.ROW_KEY_COLS)
+            if isinstance(rk, str):  # singular alias invites a bare string
+                key = rk
+            elif rk:
+                key = rk[0]
+            else:
+                raise AkIllegalArgumentException(
+                    "HBaseSink needs rowKeyCols (or keyCol)")
+        store = self._open_hbase_store()
+        try:
+            self._write(t, store, key_col=key)
+        finally:
+            store.close()
+        return t
 
 
 # ---------------------------------------------------------------------------
@@ -174,7 +245,10 @@ class HBaseSinkBatchOp(KvSinkBatchOp):
 class CatalogSourceBatchOp(BatchOperator):
     """Read a table registered in a database catalog (reference:
     operator/batch/source/CatalogSourceBatchOp.java — Hive/ODPS/JDBC
-    catalogs; here the JDBC-sqlite catalog serves the role)."""
+    catalogs). ``dbPath`` routes by scheme: ``hive://host:port/db`` opens
+    the pyhive-backed HiveCatalog, ``odps://`` raises naming the missing
+    driver, plain paths use the built-in JDBC-sqlite catalog
+    (alink_tpu/io/hivecatalog.py)."""
 
     DB_PATH = ParamInfo("dbPath", str, optional=False,
                         aliases=("catalogPath", "url"))
@@ -184,21 +258,28 @@ class CatalogSourceBatchOp(BatchOperator):
     _max_inputs = 0
 
     def _execute_impl(self) -> MTable:
-        from ..sqlengine import SqliteCatalog
+        from ...io.hivecatalog import open_catalog
 
-        cat = SqliteCatalog(self.get(self.DB_PATH))
-        return cat.read_table(self.get(self.TABLE_NAME))
+        cat = open_catalog(self.get(self.DB_PATH))
+        try:
+            return cat.read_table(self.get(self.TABLE_NAME))
+        finally:
+            getattr(cat, "close", lambda: None)()
 
     def _out_schema(self):
-        from ..sqlengine import SqliteCatalog
+        from ...io.hivecatalog import open_catalog
 
-        cat = SqliteCatalog(self.get(self.DB_PATH))
-        return cat.get_table_schema(self.get(self.TABLE_NAME))
+        cat = open_catalog(self.get(self.DB_PATH))
+        try:
+            return cat.get_table_schema(self.get(self.TABLE_NAME))
+        finally:
+            getattr(cat, "close", lambda: None)()
 
 
 class CatalogSinkBatchOp(BatchOperator):
     """Write a table into a database catalog (reference:
-    operator/batch/sink/CatalogSinkBatchOp.java)."""
+    operator/batch/sink/CatalogSinkBatchOp.java). Scheme-routed like
+    CatalogSourceBatchOp."""
 
     DB_PATH = ParamInfo("dbPath", str, optional=False,
                         aliases=("catalogPath", "url"))
@@ -209,10 +290,13 @@ class CatalogSinkBatchOp(BatchOperator):
     _max_inputs = 1
 
     def _execute_impl(self, t: MTable) -> MTable:
-        from ..sqlengine import SqliteCatalog
+        from ...io.hivecatalog import open_catalog
 
-        cat = SqliteCatalog(self.get(self.DB_PATH))
-        cat.write_table(self.get(self.TABLE_NAME), t)
+        cat = open_catalog(self.get(self.DB_PATH))
+        try:
+            cat.write_table(self.get(self.TABLE_NAME), t)
+        finally:
+            getattr(cat, "close", lambda: None)()
         return t
 
     def _out_schema(self, in_schema):
@@ -272,15 +356,17 @@ class TFTableModelPredictBatchOp(KerasSequentialRegressorPredictBatchOp):
     operator/batch/dataproc/TFTableModelPredictBatchOp.java)."""
 
 
-class TensorFlowBatchOp(PandasUdfBatchOp):
-    """Run an arbitrary user python function over the table — the
-    reference ships the table to a user TF1 script via DLLauncher; here
-    the callable runs in process (import tensorflow inside it if
-    installed) (reference: operator/batch/dataproc/TensorFlowBatchOp.java)."""
+class TensorFlowBatchOp(JaxScriptBatchOp):
+    """Run an arbitrary user training script with the session mesh + a
+    dataset iterator handed in — the reference ships the table to a user
+    TF1 script on a formed cluster via DLLauncher; here ``main(ctx)`` is a
+    JAX script against the mesh (see JaxScriptBatchOp; the legacy ``func``
+    per-table shim is kept) (reference:
+    operator/batch/dataproc/TensorFlowBatchOp.java)."""
 
 
 class TensorFlow2BatchOp(TensorFlowBatchOp):
-    """(reference: operator/batch/dataproc/TensorFlow2BatchOp.java)"""
+    """(reference: operator/batch/tensorflow/TensorFlow2BatchOp.java)"""
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,178 @@
+"""User-script execution ops — the TensorFlow2BatchOp analog, TPU-first.
+
+Capability parity (reference: operator/batch/tensorflow/TensorFlow2BatchOp.java
++ TensorFlowBatchOp.java — an arbitrary user training script is shipped to a
+formed TF cluster via DLLauncherBatchOp with dataset + TaskContext handed in;
+params/dl/HasMainScriptFile.java, HasUserFiles.java, HasUserParams.java).
+
+TPU re-design: there is no cluster to form — the "cluster" is the session
+mesh. The user supplies a JAX script (``mainScriptFile`` path, or ``userFn``
+as a live callable, python-first) defining ``main(ctx)``; the op hands it a
+:class:`ScriptContext` carrying the session mesh, a batched dataset iterator
+over the input table(s), the parsed ``userParams``, and an ``output`` hook.
+Whatever the script outputs (MTable / dict of columns / pandas DataFrame)
+becomes the op output, so a custom flax/optax training loop drops into a DAG
+exactly where the reference put a TF script.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+import numpy as np
+
+from ...common.exceptions import AkIllegalArgumentException
+from ...common.mtable import MTable, TableSchema
+from ...common.params import ParamInfo
+from .base import BatchOperator
+
+
+class ScriptContext:
+    """What the user ``main`` receives (the TaskContext analog)."""
+
+    def __init__(self, inputs: List[MTable], mesh, user_params: dict,
+                 batch_size: int, num_epochs: int):
+        self.inputs = inputs
+        self.mesh = mesh
+        self.user_params = user_params
+        self.batch_size = batch_size
+        self.num_epochs = num_epochs
+        self._output: Optional[MTable] = None
+
+    # -- data ---------------------------------------------------------------
+    def table(self, i: int = 0) -> MTable:
+        return self.inputs[i]
+
+    def dataset(self, batch_size: Optional[int] = None,
+                epochs: Optional[int] = None, input_index: int = 0,
+                cols: Optional[List[str]] = None,
+                shuffle_seed: Optional[int] = 0,
+                ) -> Iterator[Dict[str, np.ndarray]]:
+        """Batched epoch iterator over an input table — the akdl
+        dataset-from-TFRecords analog, without the file hop."""
+        t = self.inputs[input_index]
+        names = cols or t.names
+        arrays = {n: np.asarray(t.col(n)) for n in names}
+        n = t.num_rows
+        bs = batch_size or self.batch_size
+        rng = (np.random.default_rng(shuffle_seed)
+               if shuffle_seed is not None else None)
+        for _ in range(epochs or self.num_epochs):
+            idx = rng.permutation(n) if rng is not None else np.arange(n)
+            for s in range(0, n, bs):
+                take = idx[s:s + bs]
+                yield {k: v[take] for k, v in arrays.items()}
+
+    # -- output ---------------------------------------------------------------
+    def output(self, table) -> None:
+        self._output = _coerce_table(table)
+
+
+def _coerce_table(obj) -> MTable:
+    if isinstance(obj, MTable):
+        return obj
+    if obj is None:
+        return MTable({})
+    if isinstance(obj, dict):
+        return MTable({k: np.asarray(v) for k, v in obj.items()})
+    if hasattr(obj, "columns") and hasattr(obj, "to_dict"):  # DataFrame
+        return MTable({c: np.asarray(obj[c]) for c in obj.columns})
+    raise AkIllegalArgumentException(
+        f"script output must be MTable / dict / DataFrame, got {type(obj)}")
+
+
+def _load_main(path: str) -> Callable:
+    spec = importlib.util.spec_from_file_location("alink_user_script", path)
+    if spec is None or spec.loader is None:
+        raise AkIllegalArgumentException(f"cannot load script {path!r}")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    main = getattr(mod, "main", None)
+    if main is None:
+        raise AkIllegalArgumentException(
+            f"script {path!r} must define main(ctx)")
+    return main
+
+
+class JaxScriptBatchOp(BatchOperator):
+    """Run a user JAX script against the session mesh + input tables.
+
+    The script's ``main(ctx)`` gets a :class:`ScriptContext`; its return
+    value (or ``ctx.output(...)``) becomes the op output (reference:
+    operator/batch/tensorflow/TensorFlow2BatchOp.java — same role, the
+    script contract is JAX here because the substrate is XLA, not a TF
+    cluster)."""
+
+    MAIN_SCRIPT_FILE = ParamInfo("mainScriptFile", str)
+    USER_FN = ParamInfo("userFn", object,
+                        desc="main(ctx) as a live callable (python-first "
+                             "alternative to mainScriptFile)")
+    USER_PARAMS = ParamInfo("userParams", str, default="{}",
+                            desc="JSON dict handed to the script")
+    BATCH_SIZE = ParamInfo("batchSize", int, default=128)
+    NUM_EPOCHS = ParamInfo("numEpochs", int, default=1)
+    OUTPUT_SCHEMA_STR = ParamInfo(
+        "outputSchemaStr", str,
+        desc="declared output schema; default: derived from the output")
+    # legacy shim: the pre-round-4 alias contract (a per-table pandas fn)
+    FUNC = ParamInfo("func", object)
+
+    _min_inputs = 0
+    _max_inputs = 8
+
+    def _resolve_main(self) -> Callable:
+        fn = self.get(self.USER_FN)
+        if fn is not None:
+            return fn
+        path = self.get(self.MAIN_SCRIPT_FILE)
+        if path:
+            return _load_main(path)
+        legacy = self.get(self.FUNC)
+        if legacy is not None:
+            # old TensorFlowBatchOp-alias behavior: apply fn to the whole
+            # table as a DataFrame
+            def main(ctx):
+                import pandas as pd
+
+                t = ctx.table(0)
+                df = pd.DataFrame({n: t.col(n) for n in t.names})
+                return legacy(df)
+
+            return main
+        raise AkIllegalArgumentException(
+            "set mainScriptFile, userFn, or func")
+
+    def _run(self, ins) -> MTable:
+        main = self._resolve_main()
+        try:
+            user_params = json.loads(self.get(self.USER_PARAMS) or "{}")
+        except ValueError as e:
+            raise AkIllegalArgumentException(
+                f"userParams must be a JSON object: {e}")
+        ctx = ScriptContext(
+            list(ins), self.env.mesh, user_params,
+            self.get(self.BATCH_SIZE), self.get(self.NUM_EPOCHS))
+        ret = main(ctx)
+        out = ctx._output if ctx._output is not None else _coerce_table(ret)
+        declared = self.get(self.OUTPUT_SCHEMA_STR)
+        if declared:
+            want = TableSchema.parse(declared)
+            if list(want.names) != list(out.names):
+                raise AkIllegalArgumentException(
+                    f"script produced columns {out.names}, outputSchemaStr "
+                    f"declares {want.names}")
+            out = MTable({n: out.col(n) for n in want.names}, want)
+        return out
+
+    def _execute_impl(self, *ins: MTable) -> MTable:
+        return self._run(ins)
+
+    def _out_schema(self, *in_schemas):
+        declared = self.get(self.OUTPUT_SCHEMA_STR)
+        if declared:
+            return TableSchema.parse(declared)
+        # no declared schema: fall back to the zero-row probe (runs the
+        # script on empty inputs), same as relational ops
+        return super()._out_schema(*in_schemas)

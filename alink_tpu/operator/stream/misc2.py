@@ -26,7 +26,7 @@ __all__: List[str] = [
     "JsonToTripleStreamOp", "KvToTripleStreamOp", "VectorToTripleStreamOp",
     "FlattenKObjectStreamOp", "FlattenMTableStreamOp", "LookupStreamOp",
     "RecommendationRankingStreamOp", "ModelStreamFileSinkStreamOp",
-    "TensorFlowStreamOp", "TensorFlow2StreamOp",
+    "TensorFlowStreamOp", "TensorFlow2StreamOp", "JaxScriptStreamOp",
     "BasePyScalarFnStreamOp", "BasePyTableFnStreamOp",
     "PandasUdfFilStreamOp", "BaseOnlinePredictStreamOp",
     "BaseSourceStreamOp", "BaseSinkStreamOp", "BaseSqlApiStreamOp",
@@ -153,13 +153,17 @@ def _func_aliases():
     from .windows import PandasUdfStreamOp, PyScalarFnStreamOp, \
         PyTableFnStreamOp
 
-    class TensorFlowStreamOp(PandasUdfStreamOp):
-        """Run a user python function per micro-batch — the reference
-        ships chunks to a TF1 script (reference: operator/stream/dataproc/
-        TensorFlowStreamOp.java)."""
+    from .script import JaxScriptStreamOp
+
+    class TensorFlowStreamOp(JaxScriptStreamOp):
+        """Run a user training/processing script over the micro-batch
+        stream with the session mesh handed in — the reference ships
+        chunks to a TF1 script on a formed cluster; here ``main(ctx)`` is
+        a JAX script (legacy per-chunk ``func`` kept) (reference:
+        operator/stream/dataproc/TensorFlowStreamOp.java)."""
 
     class TensorFlow2StreamOp(TensorFlowStreamOp):
-        """(reference: operator/stream/dataproc/TensorFlow2StreamOp.java)"""
+        """(reference: operator/stream/tensorflow/TensorFlow2StreamOp.java)"""
 
     class BasePyScalarFnStreamOp(PyScalarFnStreamOp):
         """(reference: operator/stream/utils/BasePyScalarFnStreamOp.java)"""
@@ -186,6 +190,7 @@ def _func_aliases():
                 PandasUdfFilStreamOp):
         cls.__module__ = __name__
         globals()[cls.__name__] = cls
+    globals()["JaxScriptStreamOp"] = JaxScriptStreamOp
 
 
 _func_aliases()

@@ -1,0 +1,108 @@
+"""User-script stream op — the TensorFlow2StreamOp analog, TPU-first.
+
+Capability parity (reference: operator/stream/tensorflow/TensorFlow2StreamOp
+.java + operator/stream/dataproc/TensorFlowStreamOp.java — the stream is fed
+into a user script running on a formed TF cluster). Here ``main(ctx)`` is a
+JAX script: ``ctx.chunks()`` iterates the micro-batch stream against the
+session mesh, ``ctx.emit(table)`` produces output chunks. The legacy
+``func`` per-chunk pandas contract is kept for migration.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Iterator, List, Optional
+
+from ...common.exceptions import AkIllegalArgumentException
+from ...common.mtable import MTable
+from ...common.params import ParamInfo
+from ..batch.script import _coerce_table, _load_main
+from .base import StreamOperator
+
+
+class StreamScriptContext:
+    """What the user ``main`` receives on the stream side. ``emit`` hands
+    each output chunk straight to the downstream consumer (bounded queue),
+    so long/unbounded streams keep streaming semantics and bounded memory."""
+
+    def __init__(self, it: Iterator[MTable], mesh, user_params: dict,
+                 emit_fn):
+        self.mesh = mesh
+        self.user_params = user_params
+        self._it = it
+        self._emit_fn = emit_fn
+
+    def chunks(self) -> Iterator[MTable]:
+        return self._it
+
+    def emit(self, table) -> None:
+        self._emit_fn(_coerce_table(table))
+
+
+class JaxScriptStreamOp(StreamOperator):
+    """Run a user JAX script over the micro-batch stream (reference:
+    operator/stream/tensorflow/TensorFlow2StreamOp.java)."""
+
+    MAIN_SCRIPT_FILE = ParamInfo("mainScriptFile", str)
+    USER_FN = ParamInfo("userFn", object)
+    USER_PARAMS = ParamInfo("userParams", str, default="{}")
+    FUNC = ParamInfo("func", object,
+                     desc="legacy per-chunk pandas fn (streaming preserved)")
+
+    _min_inputs = 1
+    _max_inputs = 1
+
+    def _stream_impl(self, it: Iterator[MTable]) -> Iterator[MTable]:
+        fn = self.get(self.USER_FN)
+        path = self.get(self.MAIN_SCRIPT_FILE)
+        legacy = self.get(self.FUNC)
+        if legacy is not None and fn is None and not path:
+            import pandas as pd
+
+            for chunk in it:
+                df = pd.DataFrame({n: chunk.col(n) for n in chunk.names})
+                yield _coerce_table(legacy(df))
+            return
+        main = fn if fn is not None else (_load_main(path) if path else None)
+        if main is None:
+            raise AkIllegalArgumentException(
+                "set mainScriptFile, userFn, or func")
+        try:
+            user_params = json.loads(self.get(self.USER_PARAMS) or "{}")
+        except ValueError as e:
+            raise AkIllegalArgumentException(
+                f"userParams must be a JSON object: {e}")
+        from ...common.env import MLEnvironmentFactory
+
+        mesh = MLEnvironmentFactory.get_default().mesh
+        # main runs in a worker thread; emits flow through a bounded queue
+        # so the consumer sees chunks as they are produced (backpressure
+        # instead of buffering the whole stream)
+        import queue
+        import threading
+
+        q: "queue.Queue" = queue.Queue(maxsize=8)
+        sentinel = object()
+        errors: List[BaseException] = []
+        ctx = StreamScriptContext(it, mesh, user_params, emit_fn=q.put)
+
+        def runner():
+            try:
+                ret = main(ctx)
+                if ret is not None:
+                    q.put(_coerce_table(ret))
+            except BaseException as e:  # surfaced to the consumer below
+                errors.append(e)
+            finally:
+                q.put(sentinel)
+
+        th = threading.Thread(target=runner, daemon=True)
+        th.start()
+        while True:
+            item = q.get()
+            if item is sentinel:
+                break
+            yield item
+        th.join()
+        if errors:
+            raise errors[0]

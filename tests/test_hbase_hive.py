@@ -1,0 +1,234 @@
+"""HBase client + Hive catalog adapters: contract round trips against
+protocol doubles, and honest plugin raises without drivers.
+
+(reference: common/io/hbase/HBase.java, connectors/connector-hbase/,
+common/io/catalog/HiveCatalog.java, OdpsCatalog.java)
+"""
+
+import numpy as np
+import pytest
+
+import alink_tpu.io.hbase as hb
+from alink_tpu.common.exceptions import AkPluginNotExistException
+from alink_tpu.common.mtable import AlinkTypes, MTable
+from alink_tpu.io.hbase import HBaseClient, HBaseKvStore
+from alink_tpu.io.hivecatalog import HiveCatalog, open_catalog
+
+
+# -- happybase protocol double ----------------------------------------------
+
+
+class FakeTable:
+    def __init__(self):
+        self.data = {}  # rowkey bytes -> {b"cf:qual": bytes}
+
+    def put(self, row, cells):
+        self.data.setdefault(row, {}).update(cells)
+
+    def _filter(self, cells, columns):
+        if not columns:
+            return dict(cells)
+        out = {}
+        for k, v in cells.items():
+            for c in columns:
+                fam = c if b":" not in c else None
+                if (fam and k.split(b":")[0] == fam) or k == c:
+                    out[k] = v
+        return out
+
+    def row(self, row, columns=None):
+        return self._filter(self.data.get(row, {}), columns)
+
+    def rows(self, rowkeys, columns=None):
+        return [(rk, self._filter(self.data[rk], columns))
+                for rk in rowkeys if rk in self.data]
+
+
+class FakeConnection:
+    def __init__(self):
+        self.tables = {}
+        self.closed = False
+
+    def create_table(self, name, families):
+        self.tables[name] = FakeTable()
+
+    def table(self, name):
+        return self.tables.setdefault(name, FakeTable())
+
+    def close(self):
+        self.closed = True
+
+
+def test_hbase_client_contract_roundtrip():
+    conn = FakeConnection()
+    c = HBaseClient(connection=conn)
+    c.create_table("t", "cf", "meta")
+    c.set("t", "r1", "cf", {"a": b"1", "b": b"x"})
+    c.set("t", "r1", "meta", {"ts": b"9"})
+    c.set("t", "r2", "cf", {"a": b"2"})
+
+    assert c.get_column("t", "r1", "cf", "a") == b"1"
+    assert c.get_column("t", "r1", "cf", "missing") is None
+    assert c.get_family_columns("t", "r1", "cf") == {"a": b"1", "b": b"x"}
+    assert c.get_row("t", "r1") == {"cf": {"a": b"1", "b": b"x"},
+                                    "meta": {"ts": b"9"}}
+    # batched get preserves order, misses are empty
+    rows = c.get_rows("t", ["r2", "nope", "r1"], "cf")
+    assert rows == [{"a": b"2"}, {}, {"a": b"1", "b": b"x"}]
+    c.close()
+    assert conn.closed
+
+
+def test_hbase_kv_store_json_values():
+    store = HBaseKvStore(client=HBaseClient(connection=FakeConnection()),
+                         table="t", family="cf")
+    store.set("k1", {"price": 3.5, "name": "ab"})
+    assert store.get("k1") == {"price": 3.5, "name": "ab"}
+    assert store.mget(["k1", "gone"]) == [{"price": 3.5, "name": "ab"}, None]
+
+
+def test_hbase_ops_end_to_end(monkeypatch):
+    """Sink rows into the (fake-thrift) cluster, look them back up through
+    LookupHBaseBatchOp — the full op path with reference param names."""
+    from alink_tpu.operator.batch import (HBaseSinkBatchOp,
+                                          LookupHBaseBatchOp)
+    from alink_tpu.operator.batch.base import TableSourceBatchOp
+
+    shared = FakeConnection()
+    monkeypatch.setattr(hb, "connection_factory",
+                        lambda host, port, timeout: shared)
+
+    items = MTable({"sku": np.asarray(["a", "b", "c"], object),
+                    "price": np.asarray([1.5, 2.5, 3.5]),
+                    "stock": np.asarray([10, 0, 7], np.int64)})
+    HBaseSinkBatchOp(
+        tableName="items", familyName="f", rowKeyCols=["sku"],
+        zookeeperQuorum="zk1:2181,zk2:2181",
+    ).link_from(TableSourceBatchOp(items)).collect()
+
+    q = MTable({"sku": np.asarray(["b", "zz", "a"], object)})
+    out = LookupHBaseBatchOp(
+        tableName="items", familyName="f", thriftHost="zk1",
+        selectedCols=["sku"], outputCols=["price", "stock"],
+        outputTypes=["DOUBLE", "DOUBLE"],
+    ).link_from(TableSourceBatchOp(q)).collect()
+    price = np.asarray(out.col("price"))
+    assert price[0] == 2.5 and np.isnan(price[1]) and price[2] == 1.5
+    assert out.schema.type_of("stock") == AlinkTypes.DOUBLE
+
+
+def test_hbase_without_driver_raises(monkeypatch):
+    monkeypatch.setattr(hb, "connection_factory", None)
+    with pytest.raises(AkPluginNotExistException, match="happybase"):
+        HBaseClient(thrift_host="h")
+
+
+# -- Hive catalog (DB-API double) -------------------------------------------
+
+
+class FakeCursor:
+    def __init__(self, owner):
+        self.owner = owner
+        self._result = []
+
+    def execute(self, sql, params=None):
+        self.owner.log.append((sql, params))
+        s = sql.strip()
+        up = s.upper()
+        if up == "SHOW TABLES":
+            self._result = [(n,) for n in self.owner.tables]
+        elif up.startswith("DESCRIBE"):
+            name = s.split("`")[1]
+            self._result = self.owner.tables[name]["schema"]
+        elif up.startswith("SELECT"):
+            name = s.split("`")[1]
+            self._result = self.owner.tables[name]["rows"]
+        elif up.startswith("CREATE TABLE"):
+            name = s.split("`")[1]
+            cols = []
+            inner = s[s.index("(") + 1: s.rindex(")")]
+            for piece in inner.split(","):
+                cn, ct = piece.strip().split()
+                cols.append((cn.strip("`"), ct.lower()))
+            self.owner.tables.setdefault(name, {"schema": cols, "rows": []})
+        elif up.startswith("INSERT INTO"):
+            name = s.split("`")[1]
+            width = len(self.owner.tables[name]["schema"])
+            vals = list(params)
+            rows = [tuple(vals[i:i + width])
+                    for i in range(0, len(vals), width)]
+            self.owner.tables[name]["rows"].extend(rows)
+
+    def fetchall(self):
+        return self._result
+
+
+class FakeHiveConn:
+    def __init__(self):
+        self.tables = {}
+        self.log = []
+
+    def cursor(self):
+        return FakeCursor(self)
+
+
+def test_hive_catalog_adapter_shape():
+    conn = FakeHiveConn()
+    conn.tables["sales"] = {
+        "schema": [("region", "string"), ("amount", "double"),
+                   ("qty", "bigint"), ("# Partition Information", "")],
+        "rows": [("east", 10.5, 3), ("west", None, 4)],
+    }
+    cat = HiveCatalog(connection=conn)
+    assert cat.list_tables() == ["sales"]
+    schema = cat.get_table_schema("sales")
+    assert schema.names == ["region", "amount", "qty"]
+    assert schema.types == [AlinkTypes.STRING, AlinkTypes.DOUBLE,
+                            AlinkTypes.LONG]
+    t = cat.read_table("sales")
+    assert t.num_rows == 2
+    amounts = np.asarray(t.col("amount"))
+    assert amounts[0] == 10.5 and np.isnan(amounts[1])
+
+    # write path: CREATE + one multi-row INSERT
+    out = MTable({"k": np.asarray(["a", "b"], object),
+                  "v": np.asarray([1.0, 2.0])})
+    cat.write_table("copied", out)
+    assert cat.read_table("copied").num_rows == 2
+    sqls = [s for s, _ in conn.log]
+    assert any(s.startswith("CREATE TABLE IF NOT EXISTS `copied`")
+               for s in sqls)
+    assert sum(s.startswith("INSERT INTO `copied`") for s in sqls) == 1
+
+
+def test_catalog_routing(tmp_path):
+    # plain path -> sqlite catalog (the built-in)
+    from alink_tpu.operator.sqlengine import SqliteCatalog
+
+    cat = open_catalog(str(tmp_path / "c.db"))
+    assert isinstance(cat, SqliteCatalog)
+    # odps:// -> honest raise naming the driver
+    with pytest.raises(AkPluginNotExistException, match="pyodps"):
+        open_catalog("odps://project/table")
+    # hive:// without pyhive -> honest raise naming the driver
+    with pytest.raises(AkPluginNotExistException, match="pyhive"):
+        open_catalog("hive://h:10000/db")
+    # hive:// with an injected connection parses host/port/db
+    c = HiveCatalog.from_url("hive://h:7000/mydb",
+                             connection=FakeHiveConn())
+    assert c.database == "mydb"
+
+
+def test_catalog_ops_on_sqlite(tmp_path):
+    from alink_tpu.operator.batch import (CatalogSinkBatchOp,
+                                          CatalogSourceBatchOp)
+    from alink_tpu.operator.batch.base import TableSourceBatchOp
+
+    db = str(tmp_path / "cat.db")
+    t = MTable({"a": np.asarray([1.0, 2.0]), "b": np.asarray([3, 4],
+                                                            np.int64)})
+    CatalogSinkBatchOp(dbPath=db, tableName="t1").link_from(
+        TableSourceBatchOp(t)).collect()
+    back = CatalogSourceBatchOp(dbPath=db, tableName="t1").collect()
+    assert back.num_rows == 2
+    np.testing.assert_allclose(np.asarray(back.col("a")), [1.0, 2.0])
