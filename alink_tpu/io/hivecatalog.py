@@ -124,8 +124,6 @@ class HiveCatalog:
             else:
                 cols[n] = np.asarray(vals, object)
                 out_types.append(tp)
-        if not rows:
-            cols = {n: np.zeros(0, object) for n in schema.names}
         return MTable(cols, TableSchema(schema.names, out_types))
 
     def write_table(self, name: str, t: MTable) -> None:

@@ -288,8 +288,13 @@ class SplitBatchOp(BatchOperator):
     _max_inputs = 1
 
     def _execute_impl(self, t: MTable):
+        # exact-count split (reference SplitBatchOp takes exactly
+        # round(fraction*n) rows, not a per-row bernoulli)
         rng = np.random.default_rng(self.get(self.SEED))
-        mask = rng.random(t.num_rows) < self.get(self.FRACTION)
+        n = t.num_rows
+        k = int(round(n * self.get(self.FRACTION)))
+        mask = np.zeros(n, bool)
+        mask[rng.choice(n, size=k, replace=False)] = True
         return t.filter_mask(mask), [t.filter_mask(~mask)]
 
 

@@ -68,7 +68,9 @@ class StandardScalerTrainBatchOp(ModelTrainOpMixin, BatchOperator, HasSelectedCo
                     default_feature_cols(t))
         X = t.to_numeric_block(cols, dtype=np.float64)
         mean = X.mean(axis=0)
-        std = X.std(axis=0, ddof=0)
+        # sample std (n-1), matching the reference's
+        # TableSummary.standardDeviation (basicstatistic/TableSummary.java)
+        std = X.std(axis=0, ddof=1) if X.shape[0] > 1 else np.ones(X.shape[1])
         meta = {
             "modelName": "StandardScalerModel",
             "selectedCols": cols,

@@ -173,6 +173,13 @@ class JaxScriptBatchOp(BatchOperator):
         declared = self.get(self.OUTPUT_SCHEMA_STR)
         if declared:
             return TableSchema.parse(declared)
-        # no declared schema: fall back to the zero-row probe (runs the
-        # script on empty inputs), same as relational ops
-        return super()._out_schema(*in_schemas)
+        if self.get(self.FUNC) is not None:
+            # legacy pandas-fn shim: cheap + side-effect-free, probe it
+            return super()._out_schema(*in_schemas)
+        # a user TRAINING script must not run at schema-access time (it may
+        # checkpoint, log externally, or assert non-empty data)
+        from ...common.exceptions import AkIllegalOperationException
+
+        raise AkIllegalOperationException(
+            "JaxScriptBatchOp needs outputSchemaStr for static schema "
+            "derivation — the user script is not probed with empty inputs")

@@ -85,8 +85,11 @@ class SelectOp(AlgoOperator):
     _min_inputs = 1
     _max_inputs = 1
 
-    def __init__(self, fields, **kwargs):
+    def __init__(self, fields=None, clause=None, **kwargs):
         super().__init__(**kwargs)
+        fields = fields if fields is not None else clause  # reference name
+        if fields is None:
+            raise AkIllegalArgumentException("select needs a clause")
         if isinstance(fields, str):
             self._clauses = _split_top_level(fields)
         else:
@@ -135,8 +138,11 @@ class FilterOp(AlgoOperator):
     _min_inputs = 1
     _max_inputs = 1
 
-    def __init__(self, predicate: str, **kwargs):
+    def __init__(self, predicate: str = None, clause: str = None, **kwargs):
         super().__init__(**kwargs)
+        predicate = predicate if predicate is not None else clause
+        if predicate is None:
+            raise AkIllegalArgumentException("filter needs a clause")
         self._predicate = predicate
 
     def _execute_impl(self, t: MTable) -> MTable:
@@ -319,31 +325,62 @@ class JoinOp(AlgoOperator):
     _min_inputs = 2
     _max_inputs = 2
 
-    def __init__(self, join_predicate: str, select_clause: str = "*", how: str = "inner", **kw):
+    def __init__(self, join_predicate: str = None, select_clause: str = "*",
+                 how: str = "inner", joinPredicate: str = None,
+                 selectClause: str = None, **kw):
         super().__init__(**kw)
+        join_predicate = join_predicate or joinPredicate  # reference names
+        if selectClause is not None:
+            select_clause = selectClause
+        if join_predicate is None:
+            raise AkIllegalArgumentException("join needs a joinPredicate")
         self._how = {"inner": "inner", "left": "left", "right": "right", "full": "outer"}[how]
         self._pairs = self._parse_predicate(join_predicate)
         self._select = select_clause
 
     @staticmethod
-    def _parse_predicate(pred: str) -> List[Tuple[str, str]]:
+    def _parse_predicate(pred: str) -> List[Tuple[Optional[str], str,
+                                                  Optional[str], str]]:
+        """Parse "a.k = b.k" / "k = k" fragments keeping the side qualifier
+        so swapped predicates ("b.k = a.v") join the right columns."""
         pairs = []
         for part in re.split(r"(?i)\s+and\s+", pred.strip()):
-            m = re.fullmatch(r"\s*(\w+)\s*=+\s*(\w+)\s*", part)
+            m = re.fullmatch(
+                r"\s*(?:([ab])\.)?(\w+)\s*=+\s*(?:([ab])\.)?(\w+)\s*", part)
             if not m:
                 raise AkParseErrorException(f"bad join predicate fragment {part!r}")
-            pairs.append((m.group(1), m.group(2)))
+            pairs.append((m.group(1), m.group(2), m.group(3), m.group(4)))
         return pairs
 
     def _execute_impl(self, a: MTable, b: MTable) -> MTable:
         da, db = _to_pandas(a), _to_pandas(b)
-        left_keys = [l if l in a.names else r for l, r in self._pairs]
-        right_keys = [r if r in b.names else l for l, r in self._pairs]
+        left_keys, right_keys = [], []
+        for q1, c1, q2, c2 in self._pairs:
+            # orient each pair to (left-table col, right-table col)
+            swap = (q1 == "b") or (q2 == "a") or (
+                q1 is None and q2 is None and c1 not in a.names)
+            if swap:
+                c1, c2 = c2, c1
+            left_keys.append(c1)
+            right_keys.append(c2)
         merged = da.merge(
             db, left_on=left_keys, right_on=right_keys, how=self._how,
             suffixes=("", "_r"),
         )
         out = _from_pandas(merged, like=(a, b))
         if self._select != "*":
-            return SelectOp(self._select)._execute_impl(out)
+            # reference clauses qualify columns a.<col>/b.<col>; resolve
+            # b-side duplicates to the pandas "_r" suffix the merge used
+            # (equal-named key pairs collapse into one unsuffixed column)
+            merged_keys = {l for l, r in zip(left_keys, right_keys) if l == r}
+
+            def repl(m):
+                side, col = m.group(1), m.group(2)
+                if (side == "b" and col in a.names
+                        and col not in merged_keys):
+                    return f"{col}_r"
+                return col
+
+            sel = re.sub(r"\b([ab])\.(\w+)", repl, self._select)
+            return SelectOp(sel)._execute_impl(out)
         return out

@@ -58,7 +58,30 @@ class LookupRedisStringStreamOp(StreamOperator):
 
 
 class LookupHBaseStreamOp(LookupKvStreamOp):
-    """(reference: operator/stream/dataproc/LookupHBaseStreamOp.java)"""
+    """(reference: operator/stream/dataproc/LookupHBaseStreamOp.java) —
+    same reference HBase params as the batch twin; the client handle stays
+    open across chunks."""
+
+    from ..batch.io2 import _HasHBaseParams as _HB
+
+    ZOOKEEPER_QUORUM = _HB.ZOOKEEPER_QUORUM
+    THRIFT_HOST = _HB.THRIFT_HOST
+    THRIFT_PORT = _HB.THRIFT_PORT
+    HBASE_TABLE_NAME = _HB.HBASE_TABLE_NAME
+    FAMILY_NAME = _HB.FAMILY_NAME
+    TIMEOUT = _HB.TIMEOUT
+    STORE_URI = _HB.STORE_URI  # optional here (HBase params are the route)
+
+    def _stream_impl(self, it):
+        from ..batch.io2 import LookupHBaseBatchOp
+
+        inner = LookupHBaseBatchOp(self.get_params().clone())
+        store = inner._open_hbase_store()
+        try:
+            for chunk in it:
+                yield inner._decorate(chunk, store)
+        finally:
+            store.close()
 
 
 class RedisRowSinkStreamOp(KvSinkStreamOp):
@@ -70,7 +93,36 @@ class RedisStringSinkStreamOp(KvSinkStreamOp):
 
 
 class HBaseSinkStreamOp(KvSinkStreamOp):
-    """(reference: operator/stream/sink/HBaseSinkStreamOp.java)"""
+    """(reference: operator/stream/sink/HBaseSinkStreamOp.java) — same
+    reference HBase params as the batch twin."""
+
+    from ..batch.io2 import _HasHBaseParams as _HB
+
+    ZOOKEEPER_QUORUM = _HB.ZOOKEEPER_QUORUM
+    THRIFT_HOST = _HB.THRIFT_HOST
+    THRIFT_PORT = _HB.THRIFT_PORT
+    HBASE_TABLE_NAME = _HB.HBASE_TABLE_NAME
+    FAMILY_NAME = _HB.FAMILY_NAME
+    TIMEOUT = _HB.TIMEOUT
+    STORE_URI = _HB.STORE_URI
+    KEY_COL = ParamInfo("keyCol", str, aliases=("rowKey",))
+    ROW_KEY_COLS = ParamInfo("rowKeyCols", list, aliases=("rowKeyCol",))
+
+    def _stream_impl(self, it):
+        from ..batch.io2 import HBaseSinkBatchOp
+
+        inner = HBaseSinkBatchOp(self.get_params().clone())
+        key = inner.get(inner.KEY_COL)
+        if not key:
+            rk = inner.get(inner.ROW_KEY_COLS)
+            key = rk if isinstance(rk, str) else (rk[0] if rk else None)
+        store = inner._open_hbase_store()
+        try:
+            for chunk in it:
+                inner._write(chunk, store, key_col=key)
+                yield chunk
+        finally:
+            store.close()
 
 
 def _sink_at_stream_end(name: str, batch_cls_name: str, ref: str):

@@ -48,6 +48,10 @@ class JaxScriptStreamOp(StreamOperator):
     USER_PARAMS = ParamInfo("userParams", str, default="{}")
     FUNC = ParamInfo("func", object,
                      desc="legacy per-chunk pandas fn (streaming preserved)")
+    # same session-resolution as the batch twin (AlgoOperator.env)
+    ML_ENVIRONMENT_ID = ParamInfo(
+        "MLEnvironmentId", int, default=0,
+        desc="session id of the MLEnvironment")
 
     _min_inputs = 1
     _max_inputs = 1
@@ -74,7 +78,7 @@ class JaxScriptStreamOp(StreamOperator):
                 f"userParams must be a JSON object: {e}")
         from ...common.env import MLEnvironmentFactory
 
-        mesh = MLEnvironmentFactory.get_default().mesh
+        mesh = MLEnvironmentFactory.get(self.get(self.ML_ENVIRONMENT_ID)).mesh
         # main runs in a worker thread; emits flow through a bounded queue
         # so the consumer sees chunks as they are produced (backpressure
         # instead of buffering the whole stream)
@@ -84,25 +88,49 @@ class JaxScriptStreamOp(StreamOperator):
         q: "queue.Queue" = queue.Queue(maxsize=8)
         sentinel = object()
         errors: List[BaseException] = []
-        ctx = StreamScriptContext(it, mesh, user_params, emit_fn=q.put)
+        stop = threading.Event()
+
+        def emit_put(item):
+            # abandoned consumers (downstream closed the generator) must
+            # not leave the script thread blocked on a full queue forever
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.2)
+                    return
+                except queue.Full:
+                    continue
+            raise GeneratorExit("output consumer closed")
+
+        ctx = StreamScriptContext(it, mesh, user_params, emit_fn=emit_put)
 
         def runner():
             try:
                 ret = main(ctx)
                 if ret is not None:
-                    q.put(_coerce_table(ret))
+                    emit_put(_coerce_table(ret))
             except BaseException as e:  # surfaced to the consumer below
-                errors.append(e)
+                if not stop.is_set():
+                    errors.append(e)
             finally:
+                # blocking put: in the normal path the consumer is draining;
+                # in the abandoned path the finally-drain below frees a slot
                 q.put(sentinel)
 
         th = threading.Thread(target=runner, daemon=True)
         th.start()
-        while True:
-            item = q.get()
-            if item is sentinel:
-                break
-            yield item
-        th.join()
-        if errors:
-            raise errors[0]
+        try:
+            while True:
+                item = q.get()
+                if item is sentinel:
+                    break
+                yield item
+        finally:
+            stop.set()
+            while not q.empty():  # unblock a producer waiting on put
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
+            th.join(timeout=10)
+            if errors:
+                raise errors[0]

@@ -1,0 +1,396 @@
+"""Behavior-parity golden-output gate: ~25 representative ops across
+families run a tiny fixed fixture and assert output SCHEMA + VALUES, so a
+name-parity alias that delivers different behavior cannot hide behind the
+class-name parity test (VERDICT r3 #7).
+
+Fixtures follow the reference's doc/test examples
+(/root/reference/core/src/test/java/com/alibaba/alink/operator/batch/ —
+e.g. the iris/scaler/binarizer doc snippets); golden values are the
+closed-form results of those fixtures.
+"""
+
+import numpy as np
+import pytest
+
+from alink_tpu.common.mtable import AlinkTypes, MTable
+from alink_tpu.operator.batch.base import TableSourceBatchOp
+
+
+def _src(cols, schema=None):
+    return TableSourceBatchOp(MTable(cols, schema))
+
+
+# -- dataproc / feature ------------------------------------------------------
+
+
+def test_standard_scaler_golden():
+    from alink_tpu.operator.batch import (StandardScalerPredictBatchOp,
+                                          StandardScalerTrainBatchOp)
+
+    x = np.array([1.0, 2.0, 3.0, 4.0])
+    src = _src({"f": x})
+    m = StandardScalerTrainBatchOp(selectedCols=["f"]).link_from(src)
+    out = StandardScalerPredictBatchOp().link_from(m, src).collect()
+    assert out.schema.type_of("f") == AlinkTypes.DOUBLE
+    want = (x - 2.5) / np.std(x, ddof=1)  # reference uses sample std
+    np.testing.assert_allclose(np.asarray(out.col("f")), want, atol=1e-6)
+
+
+def test_minmax_scaler_golden():
+    from alink_tpu.operator.batch import (MinMaxScalerPredictBatchOp,
+                                          MinMaxScalerTrainBatchOp)
+
+    x = np.array([2.0, 4.0, 6.0])
+    src = _src({"f": x})
+    m = MinMaxScalerTrainBatchOp(selectedCols=["f"]).link_from(src)
+    out = MinMaxScalerPredictBatchOp().link_from(m, src).collect()
+    np.testing.assert_allclose(np.asarray(out.col("f")), [0.0, 0.5, 1.0],
+                               atol=1e-9)
+
+
+def test_binarizer_golden():
+    from alink_tpu.operator.batch import BinarizerBatchOp
+
+    out = BinarizerBatchOp(selectedCol="f", threshold=1.5).link_from(
+        _src({"f": np.array([1.0, 2.0, 1.5, 3.0])})).collect()
+    np.testing.assert_allclose(np.asarray(out.col("f")),
+                               [0.0, 1.0, 0.0, 1.0])
+
+
+def test_one_hot_golden():
+    from alink_tpu.operator.batch import (OneHotPredictBatchOp,
+                                          OneHotTrainBatchOp)
+
+    src = _src({"c": np.asarray(["a", "b", "a", "c"], object)})
+    m = OneHotTrainBatchOp(selectedCols=["c"]).link_from(src)
+    out = OneHotPredictBatchOp().link_from(m, src).collect()
+    enc_col = [n for n in out.names if n != "c"][0]
+    vecs = [v for v in out.col(enc_col)]
+    # categories indexed; identical inputs -> identical encodings, a/b/c
+    # all distinct
+    assert str(vecs[0]) == str(vecs[2])
+    assert len({str(vecs[0]), str(vecs[1]), str(vecs[3])}) == 3
+
+
+def test_string_indexer_golden():
+    from alink_tpu.operator.batch import (StringIndexerPredictBatchOp,
+                                          StringIndexerTrainBatchOp)
+
+    src = _src({"c": np.asarray(["b", "a", "b", "b", "c"], object)})
+    m = StringIndexerTrainBatchOp(
+        selectedCol="c", stringOrderType="FREQUENCY_DESC").link_from(src)
+    out = StringIndexerPredictBatchOp(
+        selectedCols=["c"], outputCols=["idx"]).link_from(m, src).collect()
+    idx = np.asarray(out.col("idx"))
+    # most frequent value gets index 0
+    assert list(idx) == [0, idx[1], 0, 0, idx[4]]
+    assert {int(idx[1]), int(idx[4])} == {1, 2}
+
+
+def test_imputer_mean_golden():
+    from alink_tpu.operator.batch import (ImputerPredictBatchOp,
+                                          ImputerTrainBatchOp)
+
+    src = _src({"f": np.array([1.0, np.nan, 3.0])})
+    m = ImputerTrainBatchOp(selectedCols=["f"], strategy="MEAN").link_from(src)
+    out = ImputerPredictBatchOp().link_from(m, src).collect()
+    np.testing.assert_allclose(np.asarray(out.col("f")), [1.0, 2.0, 3.0])
+
+
+def test_quantile_discretizer_golden():
+    from alink_tpu.operator.batch import (QuantileDiscretizerPredictBatchOp,
+                                          QuantileDiscretizerTrainBatchOp)
+
+    x = np.arange(1.0, 9.0)  # 1..8
+    src = _src({"f": x})
+    m = QuantileDiscretizerTrainBatchOp(
+        selectedCols=["f"], numBuckets=2).link_from(src)
+    out = QuantileDiscretizerPredictBatchOp().link_from(m, src).collect()
+    b = np.asarray(out.col("f"))
+    assert set(b[:4]) == {0} and set(b[-3:]) == {1}  # median split
+
+
+def test_vector_assembler_golden():
+    from alink_tpu.operator.batch import VectorAssemblerBatchOp
+
+    out = VectorAssemblerBatchOp(
+        selectedCols=["a", "b"], outputCol="v").link_from(
+        _src({"a": np.array([1.0, 3.0]), "b": np.array([2.0, 4.0])})
+    ).collect()
+    v0 = out.col("v")[0]
+    np.testing.assert_allclose(np.asarray(v0.data if hasattr(v0, "data")
+                                          else v0), [1.0, 2.0])
+
+
+# -- SQL / relational --------------------------------------------------------
+
+
+def test_select_where_golden():
+    from alink_tpu.operator.batch import SelectBatchOp, WhereBatchOp
+
+    src = _src({"a": np.array([1.0, 2.0, 3.0]),
+                "b": np.asarray(["x", "y", "z"], object)})
+    out = SelectBatchOp(clause="b, a AS renamed").link_from(src).collect()
+    assert out.names == ["b", "renamed"]
+    out2 = WhereBatchOp(clause="a > 1.5").link_from(src).collect()
+    assert list(np.asarray(out2.col("b"))) == ["y", "z"]
+
+
+def test_join_golden():
+    from alink_tpu.operator.batch import JoinBatchOp
+
+    left = _src({"k": np.asarray(["a", "b", "c"], object),
+                 "x": np.array([1.0, 2.0, 3.0])})
+    right = _src({"k": np.asarray(["b", "c", "d"], object),
+                  "y": np.array([20.0, 30.0, 40.0])})
+    out = JoinBatchOp(
+        joinPredicate="a.k = b.k", selectClause="a.k, a.x, b.y",
+    ).link_from(left, right).collect()
+    assert out.num_rows == 2
+    got = sorted(zip(np.asarray(out.col("k")), np.asarray(out.col("x")),
+                     np.asarray(out.col("y"))))
+    assert got == [("b", 2.0, 20.0), ("c", 3.0, 30.0)]
+
+
+def test_union_all_golden():
+    from alink_tpu.operator.batch import UnionAllBatchOp
+
+    a = _src({"v": np.array([1.0, 2.0])})
+    b = _src({"v": np.array([3.0])})
+    out = UnionAllBatchOp().link_from(a, b).collect()
+    assert sorted(np.asarray(out.col("v"))) == [1.0, 2.0, 3.0]
+
+
+# -- statistics --------------------------------------------------------------
+
+
+def test_summarizer_golden():
+    from alink_tpu.operator.batch import SummarizerBatchOp
+
+    out = SummarizerBatchOp(selectedCols=["f"]).link_from(
+        _src({"f": np.array([1.0, 2.0, 3.0, 4.0])})).collect_summary()
+    assert out.count() == 4
+    np.testing.assert_allclose(out.mean("f"), 2.5)
+    np.testing.assert_allclose(out.variance("f"), 5.0 / 3.0, atol=1e-9)
+
+
+def test_correlation_golden():
+    from alink_tpu.operator.batch import CorrelationBatchOp
+
+    x = np.array([1.0, 2.0, 3.0, 4.0])
+    out = CorrelationBatchOp(selectedCols=["a", "b"]).link_from(
+        _src({"a": x, "b": 2 * x + 1})).collect_correlation()
+    m = np.asarray(out.correlation_matrix
+                   if hasattr(out, "correlation_matrix") else out)
+    np.testing.assert_allclose(m, [[1.0, 1.0], [1.0, 1.0]], atol=1e-9)
+
+
+def test_chi_square_golden():
+    from alink_tpu.operator.batch import ChiSquareTestBatchOp
+
+    # independent feature -> p ~ 1; chi2 = 0 for a perfectly balanced table
+    f = np.asarray(["x", "x", "y", "y"] * 4, object)
+    lab = np.asarray(["p", "q"] * 8, object)
+    out = ChiSquareTestBatchOp(
+        selectedCols=["f"], labelCol="label").link_from(
+        _src({"f": f, "label": lab})).collect()
+    # one row per tested column with a p-value payload
+    assert out.num_rows == 1
+    row = str(out.rows().__iter__().__next__())
+    assert "p" in row.lower()
+
+
+# -- evaluation --------------------------------------------------------------
+
+
+def test_eval_regression_golden():
+    from alink_tpu.operator.batch import EvalRegressionBatchOp
+
+    y = np.array([1.0, 2.0, 3.0])
+    p = np.array([1.0, 2.0, 5.0])
+    metrics = EvalRegressionBatchOp(
+        labelCol="y", predictionCol="p").link_from(
+        _src({"y": y, "p": p})).collect_metrics()
+    np.testing.assert_allclose(metrics.get("MAE"), 2.0 / 3.0, atol=1e-9)
+    np.testing.assert_allclose(metrics.get("RMSE"), np.sqrt(4.0 / 3.0),
+                               atol=1e-9)
+
+
+def test_eval_binary_golden():
+    import json
+
+    from alink_tpu.operator.batch import EvalBinaryClassBatchOp
+
+    # perfectly separable scores -> AUC 1.0
+    y = np.asarray(["pos", "pos", "neg", "neg"], object)
+    detail = [json.dumps({"pos": s, "neg": 1 - s})
+              for s in (0.9, 0.8, 0.2, 0.1)]
+    metrics = EvalBinaryClassBatchOp(
+        labelCol="y", predictionDetailCol="d",
+        positiveLabelValueString="pos").link_from(
+        _src({"y": y, "d": np.asarray(detail, object)})).collect_metrics()
+    np.testing.assert_allclose(metrics.get("AUC"), 1.0, atol=1e-9)
+
+
+# -- NLP ---------------------------------------------------------------------
+
+
+def test_tokenizer_ngram_golden():
+    from alink_tpu.operator.batch import NGramBatchOp, TokenizerBatchOp
+
+    src = _src({"t": np.asarray(["good good study"], object)})
+    tok = TokenizerBatchOp(selectedCol="t").link_from(src).collect()
+    assert np.asarray(tok.col("t"))[0] == "good good study"
+    ng = NGramBatchOp(selectedCol="t", n=2).link_from(src).collect()
+    val = str(np.asarray(ng.col("t"))[0])
+    assert "good_good" in val and "good_study" in val
+
+
+def test_docwordcount_golden():
+    from alink_tpu.operator.batch import DocWordCountBatchOp
+
+    out = DocWordCountBatchOp(
+        docIdCol="id", contentCol="t").link_from(
+        _src({"id": np.asarray([0], np.int64),
+              "t": np.asarray(["a b a"], object)})).collect()
+    got = {(str(w)): int(c) for w, c in zip(out.col("word"), out.col("cnt"))}
+    assert got == {"a": 2, "b": 1}
+
+
+# -- association rules -------------------------------------------------------
+
+
+def test_fpgrowth_golden():
+    from alink_tpu.operator.batch import FpGrowthBatchOp
+
+    rows = ["a,b", "a,b,c", "a,c", "a"]
+    op = FpGrowthBatchOp(
+        selectedCol="items", minSupportCount=2).link_from(
+        _src({"items": np.asarray(rows, object)}))
+    out = op.collect()
+    sets = {str(r[0]): int(r[1]) for r in out.rows()}
+    assert sets.get("a") == 4
+    assert sets.get("b") == 2 and sets.get("c") == 2
+    assert sets.get("a,b") == 2 or sets.get("b,a") == 2
+
+
+# -- graph -------------------------------------------------------------------
+
+
+def test_pagerank_golden():
+    from alink_tpu.operator.batch import PageRankBatchOp
+
+    # star graph: everything points at hub "h"
+    src = _src({"s": np.asarray(["a", "b", "c"], object),
+                "t": np.asarray(["h", "h", "h"], object)})
+    out = PageRankBatchOp(sourceCol="s", targetCol="t",
+                          maxIter=50).link_from(src).collect()
+    ranks = {str(v): float(r) for v, r in zip(out.col(out.names[0]),
+                                              out.col(out.names[1]))}
+    assert ranks["h"] == max(ranks.values())
+    leaf = [v for v in ranks if v != "h"]
+    np.testing.assert_allclose([ranks[leaf[0]]] * 2,
+                               [ranks[leaf[1]], ranks[leaf[2]]], rtol=1e-6)
+
+
+# -- classification / regression (learned behavior) --------------------------
+
+
+def test_linear_reg_recovers_coefficients():
+    from alink_tpu.operator.batch import (LinearRegPredictBatchOp,
+                                          LinearRegTrainBatchOp)
+
+    rng = np.random.default_rng(0)
+    a = rng.normal(size=200)
+    b = rng.normal(size=200)
+    y = 3.0 * a - 2.0 * b + 1.0  # noiseless -> exact recovery
+    src = _src({"a": a, "b": b, "y": y})
+    m = LinearRegTrainBatchOp(
+        featureCols=["a", "b"], labelCol="y").link_from(src)
+    out = LinearRegPredictBatchOp(predictionCol="p").link_from(
+        m, src).collect()
+    np.testing.assert_allclose(np.asarray(out.col("p")), y, atol=1e-3)
+
+
+def test_naive_bayes_golden():
+    from alink_tpu.operator.batch import (NaiveBayesPredictBatchOp,
+                                          NaiveBayesTrainBatchOp)
+
+    # deterministic class per feature signature
+    f = np.array([0.0, 0.0, 1.0, 1.0, 0.0, 1.0])
+    lab = np.asarray(["n", "n", "p", "p", "n", "p"], object)
+    src = _src({"f": f, "label": lab})
+    m = NaiveBayesTrainBatchOp(
+        featureCols=["f"], labelCol="label").link_from(src)
+    out = NaiveBayesPredictBatchOp(predictionCol="pred").link_from(
+        m, src).collect()
+    assert list(np.asarray(out.col("pred"))) == list(lab)
+
+
+def test_kmeans_separates_blobs():
+    from alink_tpu.operator.batch import (KMeansPredictBatchOp,
+                                          KMeansTrainBatchOp)
+
+    rng = np.random.default_rng(0)
+    a = np.concatenate([rng.normal(0, 0.1, 20), rng.normal(5, 0.1, 20)])
+    b = np.concatenate([rng.normal(0, 0.1, 20), rng.normal(5, 0.1, 20)])
+    src = _src({"a": a, "b": b})
+    m = KMeansTrainBatchOp(k=2, featureCols=["a", "b"],
+                           maxIter=20).link_from(src)
+    out = KMeansPredictBatchOp(predictionCol="c").link_from(m, src).collect()
+    c = np.asarray(out.col("c"))
+    assert len(set(c[:20])) == 1 and len(set(c[20:])) == 1
+    assert c[0] != c[20]
+
+
+# -- sample / split ----------------------------------------------------------
+
+
+def test_split_golden():
+    from alink_tpu.operator.batch import SplitBatchOp
+
+    src = _src({"v": np.arange(100.0)})
+    op = SplitBatchOp(fraction=0.8).link_from(src)
+    main = op.collect()
+    rest = op.get_side_output(0).collect()
+    assert main.num_rows == 80 and rest.num_rows == 20
+    together = sorted(list(np.asarray(main.col("v"))) +
+                      list(np.asarray(rest.col("v"))))
+    assert together == sorted(np.arange(100.0))
+
+
+def test_stratified_sample_golden():
+    from alink_tpu.operator.batch import StratifiedSampleBatchOp
+
+    g = np.asarray(["a"] * 40 + ["b"] * 40, object)
+    src = _src({"g": g, "v": np.arange(80.0)})
+    out = StratifiedSampleBatchOp(
+        strataCol="g", strataRatios="a:0.5,b:0.25").link_from(src).collect()
+    got = np.asarray(out.col("g"))
+    assert abs((got == "a").sum() - 20) <= 6
+    assert abs((got == "b").sum() - 10) <= 6
+
+
+# -- format ------------------------------------------------------------------
+
+
+def test_json_value_golden():
+    from alink_tpu.operator.batch import JsonValueBatchOp
+
+    src = _src({"j": np.asarray(['{"x": {"y": 7}}'], object)})
+    out = JsonValueBatchOp(
+        selectedCol="j", jsonPath=["$.x.y"],
+        outputCols=["v"]).link_from(src).collect()
+    assert str(np.asarray(out.col("v"))[0]) == "7"
+
+
+def test_vector_normalize_golden():
+    from alink_tpu.operator.batch import VectorNormalizeBatchOp
+
+    src = _src({"v": np.asarray(["3 4"], object)},
+               schema="v string")
+    out = VectorNormalizeBatchOp(selectedCol="v").link_from(src).collect()
+    got = out.col("v")[0]
+    arr = np.asarray(got.data if hasattr(got, "data") else
+                     [float(x) for x in str(got).split()])
+    np.testing.assert_allclose(arr, [0.6, 0.8], atol=1e-9)

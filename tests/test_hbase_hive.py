@@ -117,6 +117,27 @@ def test_hbase_ops_end_to_end(monkeypatch):
     assert out.schema.type_of("stock") == AlinkTypes.DOUBLE
 
 
+def test_hbase_stream_twins_take_reference_params(monkeypatch):
+    from alink_tpu.operator.stream import (HBaseSinkStreamOp,
+                                           LookupHBaseStreamOp)
+    from alink_tpu.operator.stream.base import TableSourceStreamOp
+
+    shared = FakeConnection()
+    monkeypatch.setattr(hb, "connection_factory",
+                        lambda host, port, timeout: shared)
+    items = MTable({"k": np.asarray(["a", "b", "c", "d"], object),
+                    "v": np.asarray([1.0, 2.0, 3.0, 4.0])})
+    HBaseSinkStreamOp(
+        tableName="st", familyName="f", rowKeyCols=["k"], thriftHost="h",
+    ).link_from(TableSourceStreamOp(items, chunkSize=2)).collect()
+    out = LookupHBaseStreamOp(
+        tableName="st", familyName="f", thriftHost="h",
+        selectedCols=["k"], outputCols=["v"], outputTypes=["DOUBLE"],
+    ).link_from(TableSourceStreamOp(
+        MTable({"k": np.asarray(["d", "a"], object)}), chunkSize=1)).collect()
+    assert list(np.asarray(out.col("v"))) == [4.0, 1.0]
+
+
 def test_hbase_without_driver_raises(monkeypatch):
     monkeypatch.setattr(hb, "connection_factory", None)
     with pytest.raises(AkPluginNotExistException, match="happybase"):
