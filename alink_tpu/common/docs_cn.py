@@ -1,0 +1,216 @@
+# -*- coding: utf-8 -*-
+"""Chinese documentation generation (reference: docs/cn/operator/* — the
+reference ships a full CN doc tree; here CN pages are GENERATED from the op
+catalog plus a curated bilingual term dictionary, the same codegen approach
+as the EN docs and the .pyi stubs).
+
+Titles are derived by segmenting the op class name into known algorithm /
+role terms; param rows reuse the registered metadata with CN descriptions
+for the ubiquitous params. Terms without a dictionary entry keep their
+English form (standard practice in Chinese ML docs: "FM 回归预测").
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, List
+
+# algorithm / component terms, longest-match-first at render time
+TERMS_CN: Dict[str, str] = {
+    "KMeans": "K均值聚类", "GeoKMeans": "经纬度K均值聚类", "GMM": "高斯混合模型",
+    "Lda": "LDA主题模型", "Dbscan": "DBSCAN密度聚类", "BisectingKMeans": "二分K均值聚类",
+    "KModes": "K众数聚类", "Agnes": "AGNES层次聚类", "Som": "自组织映射",
+    "LinearReg": "线性回归", "LinearSvm": "线性SVM", "LogisticRegression": "逻辑回归",
+    "Softmax": "Softmax多分类", "RidgeReg": "岭回归", "LassoReg": "Lasso回归",
+    "GlmReg": "广义线性回归", "Glm": "广义线性模型", "IsotonicReg": "保序回归",
+    "AftSurvivalReg": "生存回归", "NaiveBayesTextClassifier": "朴素贝叶斯文本分类",
+    "NaiveBayes": "朴素贝叶斯", "DecisionTreeClassifier": "决策树分类",
+    "DecisionTreeRegressor": "决策树回归", "DecisionTree": "决策树",
+    "RandomForestClassifier": "随机森林分类", "RandomForestRegressor": "随机森林回归",
+    "RandomForest": "随机森林", "GbdtClassifier": "GBDT分类",
+    "GbdtRegressor": "GBDT回归", "Gbdt": "梯度提升树", "XGBoostRegressor": "XGBoost回归",
+    "XGBoostReg": "XGBoost回归", "XGBoost": "XGBoost",
+    "FmClassifier": "FM分类", "FmRegressor": "FM回归", "FmRecommend": "FM推荐",
+    "Knn": "K近邻", "Mlp": "多层感知机", "MultilayerPerceptron": "多层感知机",
+    "OneVsRest": "OneVsRest多分类", "Bert": "BERT", "TextClassifier": "文本分类",
+    "TextPairClassifier": "文本对分类", "TextPairRegressor": "文本对回归",
+    "TextRegressor": "文本回归", "TextEmbedding": "文本向量化",
+    "KerasSequentialClassifier": "Keras顺序模型分类",
+    "KerasSequentialRegressor": "Keras顺序模型回归",
+    "Als": "ALS交替最小二乘", "ItemCf": "ItemCF物品协同过滤",
+    "UserCf": "UserCF用户协同过滤", "Swing": "Swing推荐",
+    "StandardScaler": "标准化", "MinMaxScaler": "归一化", "MaxAbsScaler": "绝对值最大化",
+    "VectorNormalize": "向量正则化", "VectorAssembler": "向量聚合",
+    "VectorStandardScaler": "向量标准化", "VectorMinMaxScaler": "向量归一化",
+    "VectorMaxAbsScaler": "向量绝对值最大化", "VectorImputer": "向量缺失值填充",
+    "VectorPolynomialExpand": "向量多项式展开", "VectorInteraction": "向量交互",
+    "VectorSizeHint": "向量长度校验", "VectorSlice": "向量切片",
+    "VectorElementwiseProduct": "向量按位乘积", "VectorToColumns": "向量转列",
+    "OneHot": "独热编码", "QuantileDiscretizer": "分位数离散化",
+    "EqualWidthDiscretizer": "等宽离散化", "Bucketizer": "分桶",
+    "FeatureHasher": "特征哈希", "Binarizer": "二值化", "Pca": "主成分分析",
+    "ChiSqSelector": "卡方特征选择", "ChiSquareTest": "卡方检验",
+    "Correlation": "相关系数", "Summarizer": "全表统计", "AutoCross": "自动特征交叉",
+    "Dct": "离散余弦变换", "StringIndexer": "字符串编码",
+    "IndexToString": "编码还原字符串", "Imputer": "缺失值填充", "Lookup": "表查找",
+    "StratifiedSample": "分层采样", "WeightedSample": "加权采样", "Sample": "随机采样",
+    "SampleWithSize": "固定条数采样", "Split": "数据拆分", "Shuffle": "乱序",
+    "FirstN": "前N条", "Rebalance": "重分布", "UnionAll": "全并集", "Union": "并集",
+    "Intersect": "交集", "IntersectAll": "全交集", "Minus": "差集",
+    "MinusAll": "全差集", "Distinct": "去重", "OrderBy": "排序", "GroupBy": "分组聚合",
+    "Select": "选择", "Where": "过滤", "Filter": "过滤", "As": "重命名",
+    "Join": "连接", "LeftOuterJoin": "左外连接", "RightOuterJoin": "右外连接",
+    "FullOuterJoin": "全外连接", "SqlQuery": "SQL查询", "SqlCmd": "SQL命令",
+    "Tokenizer": "文本分词", "RegexTokenizer": "正则分词", "Segment": "中文分词",
+    "StopWordsRemover": "停用词过滤", "WordCount": "词频统计",
+    "DocWordCount": "文档词频统计", "DocHashCountVectorizer": "文档哈希向量化",
+    "DocCountVectorizer": "文档向量化", "NGram": "NGram",
+    "KeywordsExtraction": "关键词抽取", "TfidfVectorizer": "TF-IDF向量化",
+    "Word2Vec": "Word2Vec词向量", "SimHashSimilarity": "SimHash相似度",
+    "StringSimilarityPairwise": "字符串两两相似度",
+    "TextSimilarityPairwise": "文本两两相似度", "StringNearestNeighbor": "字符串最近邻",
+    "TextNearestNeighbor": "文本最近邻", "VectorNearestNeighbor": "向量最近邻",
+    "ApproxVectorNearestNeighbor": "向量近似最近邻", "StringApproxNearestNeighbor":
+    "字符串近似最近邻", "TextApproxNearestNeighbor": "文本近似最近邻",
+    "PageRank": "PageRank", "ConnectedComponents": "连通分量", "KCore": "K核",
+    "Louvain": "Louvain社区发现", "LabelPropagation": "标签传播",
+    "ShortestPath": "最短路径", "TriangleList": "三角形枚举", "LineVertex": "LINE图嵌入",
+    "Line": "LINE图嵌入", "Node2Vec": "Node2Vec图嵌入", "DeepWalk": "DeepWalk图嵌入",
+    "MetaPath2Vec": "MetaPath2Vec图嵌入", "SimRank": "SimRank相似度",
+    "CommonNeighbors": "共同邻居", "Mds": "多维缩放", "TreeDepth": "树深度",
+    "Arima": "ARIMA时间序列", "AutoArima": "自动ARIMA", "Garch": "GARCH波动率",
+    "AutoGarch": "自动GARCH", "HoltWinters": "HoltWinters三次指数平滑",
+    "DeepAR": "DeepAR概率预测", "LSTNet": "LSTNet时间序列", "Prophet": "Prophet时间序列",
+    "TFT": "TFT时间序列", "LookupValueInTimeSeries": "时间序列取值",
+    "LookupVectorInTimeSeries": "时间序列取向量", "ShiftStream": "平移",
+    "Shift": "平移", "DifferenceStream": "差分", "Difference": "差分",
+    "Ftrl": "FTRL在线学习", "OnlineFm": "在线FM", "OnlineLearning": "在线学习",
+    "FpGrowth": "FP-Growth频繁项集", "PrefixSpan": "PrefixSpan序列模式",
+    "Apriori": "Apriori频繁项集", "ApplyAssociationRule": "关联规则应用",
+    "Scorecard": "评分卡", "GroupScorecard": "分群评分卡", "Psi": "PSI稳定性",
+    "Vif": "方差膨胀系数", "Stepwise": "逐步回归", "ConstrainedLinearReg": "带约束线性回归",
+    "ConstrainedLogisticRegression": "带约束逻辑回归",
+    "Mfcc": "MFCC音频特征", "ExtractMfccFeature": "MFCC特征提取",
+    "ReadImageToTensor": "图片转张量", "WriteTensorToImage": "张量转图片",
+    "ReadAudioToTensor": "音频转张量",
+    "Eval": "评估", "BinaryClass": "二分类", "MultiClass": "多分类",
+    "Regression": "回归", "Cluster": "聚类", "Ranking": "排序", "Outlier": "异常检测",
+    "TimeSeries": "时间序列", "Csv": "CSV", "Text": "文本", "LibSvm": "LibSvm",
+    "TsvSource": "TSV源", "Ak": "AK", "TFRecordDataset": "TFRecord数据集",
+    "TFRecord": "TFRecord", "Parquet": "Parquet", "Xls": "Excel",
+    "Mem": "内存", "Random": "随机", "NumSeq": "数字序列", "Kafka": "Kafka",
+    "Redis": "Redis", "HBase": "HBase", "Catalog": "数据目录", "ModelStream": "模型流",
+    "Export2File": "导出文件", "JsonValue": "JSON取值", "JsonToColumns": "JSON转列",
+    "KvToColumns": "KV转列", "CsvToColumns": "CSV转列", "ColumnsToCsv": "列转CSV",
+    "ColumnsToJson": "列转JSON", "ColumnsToKv": "列转KV",
+    "ColumnsToVector": "列转向量", "ColumnsToTriple": "列转三元组",
+    "AnyToTriple": "任意转三元组", "TripleToColumns": "三元组转列",
+    "TripleToCsv": "三元组转CSV", "TripleToJson": "三元组转JSON",
+    "TripleToKv": "三元组转KV", "TripleToVector": "三元组转向量",
+    "FlattenMTable": "展开MTable", "FlattenKObject": "展开K对象",
+    "TensorToVector": "张量转向量", "VectorToTensor": "向量转张量",
+    "Sbs": "SBS特征选择", "Sfs": "SFS特征选择", "Sffs": "SFFS特征选择",
+    "Sfbs": "SFBS特征选择", "Iforest": "孤立森林", "Sos": "随机离群选择",
+    "Lof": "局部离群因子", "Cblof": "基于聚类的离群检测", "Copod": "COPOD离群检测",
+    "Ecod": "ECOD离群检测", "Hbos": "直方图离群检测", "OcsvmOutlier": "单类SVM异常检测",
+    "Ocsvm": "单类SVM", "MahalanobisOutlier": "马氏距离异常检测",
+    "BoxPlotOutlier": "箱线图异常检测", "EsdOutlier": "ESD异常检测",
+    "KsigmaOutlier": "K-Sigma异常检测", "ShortMoM": "短期均值异常检测",
+    "Dbscan2": "DBSCAN异常检测",
+}
+
+ROLE_CN = [
+    ("TrainBatchOp", "训练 (批)"), ("PredictBatchOp", "预测 (批)"),
+    ("TrainStreamOp", "训练 (流)"), ("PredictStreamOp", "预测 (流)"),
+    ("ModelInfoBatchOp", "模型信息 (批)"),
+    ("SourceBatchOp", "数据源 (批)"), ("SinkBatchOp", "数据汇 (批)"),
+    ("SourceStreamOp", "数据源 (流)"), ("SinkStreamOp", "数据汇 (流)"),
+    ("BatchOp", "(批)"), ("StreamOp", "(流)"), ("LocalOp", "(本地)"),
+]
+
+PARAM_CN: Dict[str, str] = {
+    "selectedCols": "计算列列表", "selectedCol": "计算列", "outputCols": "输出结果列列表",
+    "outputCol": "输出结果列", "reservedCols": "算法保留列", "labelCol": "标签列",
+    "featureCols": "特征列列表", "vectorCol": "向量列", "predictionCol": "预测结果列",
+    "predictionDetailCol": "预测详细信息列", "groupCols": "分组列列表",
+    "groupCol": "分组列", "maxIter": "最大迭代步数", "numEpochs": "训练轮数",
+    "batchSize": "批大小", "learningRate": "学习率", "k": "聚类中心数/近邻数",
+    "filePath": "文件路径", "schemaStr": "Schema字符串", "fraction": "采样比例/拆分比例",
+    "randomSeed": "随机数种子", "weightCol": "权重列", "timeCol": "时间列",
+    "valueCol": "数值列", "itemCol": "物品列", "userCol": "用户列", "rateCol": "打分列",
+    "numTrees": "树的棵数", "maxDepth": "树的最大深度", "numBuckets": "分桶数",
+    "threshold": "阈值", "epsilon": "收敛阈值", "topN": "前N个",
+    "distanceType": "距离度量方式", "l1": "L1正则化系数", "l2": "L2正则化系数",
+    "withIntercept": "是否有截距项", "tableName": "表名", "familyName": "列族名",
+    "rowKeyCols": "RowKey列", "zookeeperQuorum": "Zookeeper地址",
+    "pluginVersion": "插件版本", "modelPath": "模型路径", "maxSeqLength": "最大序列长度",
+    "bertModelName": "预训练模型名称", "checkpointFilePath": "预训练模型路径",
+    "textCol": "文本列", "textPairCol": "文本对列", "clause": "运算语句",
+    "joinPredicate": "连接条件", "selectClause": "选择语句", "chunkSize": "微批条数",
+}
+
+
+def cn_title(op_name: str) -> str:
+    """Segment an op class name into role suffix + known algorithm terms."""
+    base, role = op_name, ""
+    for suf, cn in ROLE_CN:
+        if op_name.endswith(suf):
+            base = op_name[: -len(suf)]
+            role = cn
+            break
+    # longest-match term substitution over the remaining camel-case name
+    out = base
+    for term in sorted(TERMS_CN, key=len, reverse=True):
+        if term and term in out:
+            out = out.replace(term, TERMS_CN[term] + " ")
+    out = re.sub(r"\s+", " ", out).strip()
+    return f"{out} {role}".strip() if role else out
+
+
+def generate_docs_cn(out_dir: str) -> List[str]:
+    """Write per-category CN markdown docs mirroring docs/en (reference:
+    docs/cn/operator/*). Returns the written file paths."""
+    from .catalog import list_operators, op_info, port_specs
+
+    written = []
+    for flavor, ops in list_operators().items():
+        by_module: Dict[str, List[type]] = {}
+        for cls in ops:
+            by_module.setdefault(cls.__module__.rsplit(".", 1)[-1],
+                                 []).append(cls)
+        flavor_dir = os.path.join(out_dir, flavor)
+        os.makedirs(flavor_dir, exist_ok=True)
+        for module, classes in sorted(by_module.items()):
+            lines = [f"# {flavor}/{module}", ""]
+            for cls in classes:
+                info = op_info(cls)
+                lines.append(f"## {info['name']}")
+                lines.append("")
+                lines.append(f"**中文名**：{cn_title(info['name'])}")
+                lines.append("")
+                if info["doc"]:
+                    first = info["doc"].split("\n")[0]
+                    lines.append(first)
+                    lines.append("")
+                ports = info["ports"]
+                lines.append(
+                    f"**端口**：输入 {ports['inputs'] or '（数据源）'} → "
+                    f"输出 {ports['outputs']}")
+                lines.append("")
+                if info["params"]:
+                    lines.append("| 名称 | 类型 | 默认值 | 描述 |")
+                    lines.append("|---|---|---|---|")
+                    for p in info["params"]:
+                        default = ("必选" if not p["optional"]
+                                   else repr(p["default"]))
+                        desc = PARAM_CN.get(p["name"], p["desc"] or "")
+                        lines.append(
+                            f"| {p['name']} | {p['type']} | {default} |"
+                            f" {desc.replace('|', chr(92) + '|')} |")
+                    lines.append("")
+            path = os.path.join(flavor_dir, f"{module}.md")
+            with open(path, "w", encoding="utf-8") as f:
+                f.write("\n".join(lines))
+            written.append(path)
+    return written

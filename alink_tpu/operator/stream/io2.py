@@ -57,20 +57,13 @@ class LookupRedisStringStreamOp(StreamOperator):
             store.close()
 
 
-class LookupHBaseStreamOp(LookupKvStreamOp):
+from ..batch.io2 import _HasHBaseParams
+
+
+class LookupHBaseStreamOp(_HasHBaseParams, LookupKvStreamOp):
     """(reference: operator/stream/dataproc/LookupHBaseStreamOp.java) —
-    same reference HBase params as the batch twin; the client handle stays
-    open across chunks."""
-
-    from ..batch.io2 import _HasHBaseParams as _HB
-
-    ZOOKEEPER_QUORUM = _HB.ZOOKEEPER_QUORUM
-    THRIFT_HOST = _HB.THRIFT_HOST
-    THRIFT_PORT = _HB.THRIFT_PORT
-    HBASE_TABLE_NAME = _HB.HBASE_TABLE_NAME
-    FAMILY_NAME = _HB.FAMILY_NAME
-    TIMEOUT = _HB.TIMEOUT
-    STORE_URI = _HB.STORE_URI  # optional here (HBase params are the route)
+    same reference HBase params as the batch twin (the mixin); the client
+    handle stays open across chunks."""
 
     def _stream_impl(self, it):
         from ..batch.io2 import LookupHBaseBatchOp
@@ -92,23 +85,15 @@ class RedisStringSinkStreamOp(KvSinkStreamOp):
     """(reference: operator/stream/sink/RedisStringSinkStreamOp.java)"""
 
 
-class HBaseSinkStreamOp(KvSinkStreamOp):
+class HBaseSinkStreamOp(_HasHBaseParams, KvSinkStreamOp):
     """(reference: operator/stream/sink/HBaseSinkStreamOp.java) — same
-    reference HBase params as the batch twin."""
+    reference HBase params as the batch twin (the mixin)."""
 
-    from ..batch.io2 import _HasHBaseParams as _HB
-
-    ZOOKEEPER_QUORUM = _HB.ZOOKEEPER_QUORUM
-    THRIFT_HOST = _HB.THRIFT_HOST
-    THRIFT_PORT = _HB.THRIFT_PORT
-    HBASE_TABLE_NAME = _HB.HBASE_TABLE_NAME
-    FAMILY_NAME = _HB.FAMILY_NAME
-    TIMEOUT = _HB.TIMEOUT
-    STORE_URI = _HB.STORE_URI
     KEY_COL = ParamInfo("keyCol", str, aliases=("rowKey",))
     ROW_KEY_COLS = ParamInfo("rowKeyCols", list, aliases=("rowKeyCol",))
 
     def _stream_impl(self, it):
+        from ...common.exceptions import AkIllegalArgumentException
         from ..batch.io2 import HBaseSinkBatchOp
 
         inner = HBaseSinkBatchOp(self.get_params().clone())
@@ -116,6 +101,9 @@ class HBaseSinkStreamOp(KvSinkStreamOp):
         if not key:
             rk = inner.get(inner.ROW_KEY_COLS)
             key = rk if isinstance(rk, str) else (rk[0] if rk else None)
+        if not key:
+            raise AkIllegalArgumentException(
+                "HBaseSink needs rowKeyCols (or keyCol)")
         store = inner._open_hbase_store()
         try:
             for chunk in it:

@@ -166,7 +166,8 @@ def test_standard_scaler():
     for c in ("a", "b"):
         v = np.asarray(out.col(c))
         assert abs(v.mean()) < 1e-9
-        assert abs(v.std() - 1.0) < 1e-9
+        # scaled by sample std (n-1), the reference's convention
+        assert abs(v.std(ddof=1) - 1.0) < 1e-9
 
 
 def test_model_save_load_roundtrip(tmp_path):

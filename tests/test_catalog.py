@@ -60,3 +60,15 @@ def test_generate_stubs(tmp_path):
     batch = open([f for f in files if "batch" in f][0]).read()
     assert "class KMeansTrainBatchOp" in batch
     assert "k: Optional[int]" in batch
+
+
+def test_generate_docs_cn(tmp_path):
+    from alink_tpu.common.docs_cn import cn_title, generate_docs_cn
+
+    files = generate_docs_cn(str(tmp_path))
+    assert len(files) > 50
+    content = open([f for f in files if f.endswith("clustering.md")][0],
+                   encoding="utf-8").read()
+    assert "K均值聚类 训练 (批)" in content
+    assert "预测结果列" in content  # param rows carry CN descriptions
+    assert cn_title("LogisticRegressionTrainBatchOp") == "逻辑回归 训练 (批)"

@@ -1,0 +1,27 @@
+"""The quickstart notebook executes end-to-end (reference ships pyalink
+notebooks; ours is examples/quickstart.ipynb)."""
+
+import json
+import os
+
+
+def test_quickstart_notebook_runs(capsys):
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(root, "examples", "quickstart.ipynb")
+    with open(path) as f:
+        nb = json.load(f)
+    code_cells = [
+        "\n".join(c["source"]) for c in nb["cells"]
+        if c["cell_type"] == "code"
+    ]
+    assert len(code_cells) >= 4
+    cwd = os.getcwd()
+    os.chdir(os.path.join(root, "examples"))
+    try:
+        ns: dict = {}
+        for i, src in enumerate(code_cells):
+            exec(compile(src, f"cell-{i}", "exec"), ns)  # noqa: S102
+    finally:
+        os.chdir(cwd)
+    out = capsys.readouterr().out
+    assert "cluster purity vs species:" in out
