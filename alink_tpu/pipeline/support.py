@@ -1,0 +1,364 @@
+"""Pipeline support surface: the reference's infrastructure class names
+bound to this framework's equivalents (reference:
+core/src/main/java/com/alibaba/alink/pipeline/Trainer.java, MapModel.java,
+MapTransformer.java, LocalPredictorLoader.java, ModelExporterUtils.java,
+tuning/PipelineCandidates*.java, tuning/ValueDist*.java, ...).
+
+Where the reference class is a role this framework fills with a different
+mechanism (e.g. Trainer's name-reflection → explicit class attributes),
+the name binds to the component that fills it; where it is a small real
+utility (ValueDist samplers, candidate enumerators, file-backed model
+data), it is implemented here.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..common.mtable import MTable
+from ..common.params import ParamInfo
+from ..operator.batch.base import BatchOperator, TableSourceBatchOp
+from .base import (EstimatorBase, ModelBase, PipelineStageBase,
+                   TransformerBase)
+from .local_predictor import LocalPredictor
+from .pipeline import Pipeline, PipelineModel
+
+
+# -- reference base-class names over our bases -------------------------------
+
+
+class Trainer(EstimatorBase):
+    """(reference: pipeline/Trainer.java — fit-by-reflection base; here the
+    op binding is the explicit ``_train_op_cls`` contract of
+    EstimatorBase)."""
+
+
+class TrainerLegacy(Trainer):
+    """(reference: pipeline/TrainerLegacy.java)"""
+
+
+class MapModel(ModelBase):
+    """(reference: pipeline/MapModel.java)"""
+
+
+class MapTransformer(TransformerBase):
+    """(reference: pipeline/MapTransformer.java)"""
+
+
+class FlatMapTransformer(TransformerBase):
+    """(reference: pipeline/FlatMapTransformer.java — map ops here may
+    change row counts, so the same transform contract covers flat-map)."""
+
+
+class LocalPredictable:
+    """Mixin marker (reference: pipeline/LocalPredictable.java): stages
+    that can serve row-at-a-time through a LocalPredictor."""
+
+    def collect_local_predictor(self, input_schema) -> LocalPredictor:
+        model = self if isinstance(self, PipelineModel) else \
+            PipelineModel(self)  # single fitted stage
+        return LocalPredictor(model, input_schema)
+
+
+class LocalPredictorLoader:
+    """(reference: pipeline/LocalPredictorLoader.java)"""
+
+    @staticmethod
+    def load(path: str, input_schema) -> LocalPredictor:
+        return LocalPredictor(PipelineModel.load(path), input_schema)
+
+
+class ModelExporterUtils:
+    """(reference: pipeline/ModelExporterUtils.java — packs stage models
+    into one table; PipelineModel.save/load own that here)."""
+
+    @staticmethod
+    def save(model: PipelineModel, path: str) -> None:
+        model.save(path)
+
+    @staticmethod
+    def load(path: str) -> PipelineModel:
+        return PipelineModel.load(path)
+
+
+class LegacyModelExporterUtils(ModelExporterUtils):
+    """(reference: pipeline/LegacyModelExporterUtils.java)"""
+
+
+class ModelFileData:
+    """Model data held as a file path, materialized on demand (reference:
+    pipeline/ModelFileData.java)."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def get_table(self) -> MTable:
+        from ..io.ak import read_ak
+
+        return read_ak(self.path)
+
+
+class ModelPipeFileData(ModelFileData):
+    """(reference: pipeline/ModelPipeFileData.java)"""
+
+
+def EstimatorTrainerAnnotation(**kw) -> Callable[[type], type]:
+    """(reference: pipeline/EstimatorTrainerAnnotation.java — an annotation
+    recording the estimator↔trainer binding; here a decorator that stamps
+    the same metadata onto the class)."""
+
+    def mark(cls: type) -> type:
+        cls._estimator_trainer_meta = dict(kw)
+        return cls
+
+    return mark
+
+
+class EstimatorTrainerCatalog:
+    """Estimator name -> train/predict op names (reference:
+    pipeline/EstimatorTrainerCatalog.java), built from the generated spec
+    tables plus the hand-written stages."""
+
+    @staticmethod
+    def lookup(name: str) -> Optional[tuple]:
+        from . import generated
+
+        if name in generated.ESTIMATORS:
+            return generated.ESTIMATORS[name]
+        from .base import STAGE_REGISTRY
+
+        cls = STAGE_REGISTRY.get(name)
+        if cls is not None and getattr(cls, "_train_op_cls", None) is not None:
+            mc = getattr(cls, "_model_cls", None)
+            pred = getattr(mc, "_predict_op_cls", None) if mc else None
+            return (cls._train_op_cls.__name__,
+                    pred.__name__ if pred else None,
+                    mc.__name__ if mc else None)
+        return None
+
+    @staticmethod
+    def names() -> List[str]:
+        from . import generated
+        from .base import STAGE_REGISTRY
+
+        out = set(generated.ESTIMATORS)
+        out.update(n for n, c in STAGE_REGISTRY.items()
+                   if getattr(c, "_train_op_cls", None) is not None)
+        return sorted(out)
+
+
+class PipelineWithStepTrain(Pipeline):
+    """Pipeline whose fit records every stage's intermediate output table
+    (reference: pipeline/PipelineWithStepTrain.java)."""
+
+    def fit(self, data) -> PipelineModel:
+        self.step_results: List[MTable] = []
+        op = PipelineStageBase._as_op(data)
+        fitted = []
+        for stage in self.stages:
+            if isinstance(stage, EstimatorBase):
+                model = stage.fit(op)
+                fitted.append(model)
+                op = model.transform(op)
+            else:
+                fitted.append(stage)
+                op = stage.transform(op)
+            self.step_results.append(op.collect())
+        return PipelineModel(*fitted)
+
+
+class RecommenderUtil:
+    """(reference: pipeline/recommendation/RecommenderUtil.java)"""
+
+    @staticmethod
+    def recommend(model: MTable, data, recomm_op_cls, **params):
+        op = recomm_op_cls(**params)
+        return op.link_from(TableSourceBatchOp(model),
+                            PipelineStageBase._as_op(data))
+
+
+# -- small real transformers --------------------------------------------------
+
+
+class Select(TransformerBase):
+    """SQL-select as a pipeline stage (reference: pipeline/sql/Select.java)."""
+
+    CLAUSE = ParamInfo("clause", str, optional=False)
+
+    def transform(self, data):
+        from ..operator.batch import SelectBatchOp
+
+        return SelectBatchOp(clause=self.get(self.CLAUSE)).link_from(
+            self._as_op(data))
+
+
+class BaseFormatTrans(TransformerBase):
+    """(reference: pipeline/dataproc/format/BaseFormatTrans.java — base of
+    the Columns/Csv/Json/Kv/Vector/Triple converters generated above)."""
+
+
+class BertTokenizer(TransformerBase):
+    """WordPiece-tokenize a text column into a token-string column
+    (reference: pipeline/nlp/BertTokenizer.java). Uses the staged
+    pretrained vocab when ``bertModelName``/``vocabPath`` is set, else a
+    corpus-built vocab."""
+
+    SELECTED_COL = ParamInfo("selectedCol", str, optional=False)
+    OUTPUT_COL = ParamInfo("outputCol", str)
+    BERT_MODEL_NAME = ParamInfo("bertModelName", str)
+    VOCAB_PATH = ParamInfo("vocabPath", str)
+
+    def transform(self, data):
+        from ..dl.pretrained import load_vocab_file, resolve_bert_resource
+        from ..dl.tokenizer import Tokenizer
+        from ..operator.batch.udf2 import PandasUdfBatchOp
+
+        col = self.get(self.SELECTED_COL)
+        out_col = self.get(self.OUTPUT_COL) or col
+        vocab_path = self.get(self.VOCAB_PATH)
+        name = self.get(self.BERT_MODEL_NAME)
+        tok: Optional[Tokenizer] = None
+        if vocab_path:
+            tok = Tokenizer.from_list(load_vocab_file(vocab_path))
+        elif name:
+            tok = Tokenizer.from_list(load_vocab_file(
+                resolve_bert_resource(name)))
+
+        def run(df):
+            t = tok or Tokenizer.build([str(v) for v in df[col]])
+            df = df.copy()
+            df[out_col] = [" ".join(t.tokenize(str(v))) for v in df[col]]
+            return df
+
+        return PandasUdfBatchOp(func=run).link_from(self._as_op(data))
+
+
+# -- tuning value distributions ----------------------------------------------
+
+
+class ValueDist:
+    """A sampleable hyper-parameter value distribution (reference:
+    pipeline/tuning/ValueDist.java)."""
+
+    def sample(self, rng: np.random.Generator):
+        raise NotImplementedError
+
+    # reference-style static constructors
+    @staticmethod
+    def randInteger(start: int, end: int) -> "ValueDistInteger":
+        return ValueDistInteger(start, end)
+
+    @staticmethod
+    def randLong(start: int, end: int) -> "ValueDistLong":
+        return ValueDistLong(start, end)
+
+    @staticmethod
+    def uniform(low: float, high: float) -> "ValueDistFunc":
+        return ValueDistFunc(lambda r: float(r.uniform(low, high)))
+
+    @staticmethod
+    def exponential(scale: float) -> "ValueDistFunc":
+        return ValueDistFunc(lambda r: float(r.exponential(scale)))
+
+    @staticmethod
+    def normal(mu: float, sigma: float) -> "ValueDistFunc":
+        return ValueDistFunc(lambda r: float(r.normal(mu, sigma)))
+
+    @staticmethod
+    def stdNormal() -> "ValueDistFunc":
+        return ValueDistFunc(lambda r: float(r.standard_normal()))
+
+    @staticmethod
+    def chi2(df: float) -> "ValueDistFunc":
+        return ValueDistFunc(lambda r: float(r.chisquare(df)))
+
+    @staticmethod
+    def randArray(values: Sequence) -> "ValueDistArray":
+        return ValueDistArray(values)
+
+
+class ValueDistInteger(ValueDist):
+    def __init__(self, start: int, end: int):
+        self.start, self.end = int(start), int(end)
+
+    def sample(self, rng):
+        return int(rng.integers(self.start, self.end + 1))
+
+
+class ValueDistLong(ValueDistInteger):
+    """(reference: pipeline/tuning/ValueDistLong.java)"""
+
+
+class ValueDistArray(ValueDist):
+    def __init__(self, values: Sequence):
+        self.values = list(values)
+
+    def sample(self, rng):
+        return self.values[int(rng.integers(len(self.values)))]
+
+
+class ValueDistFunc(ValueDist):
+    def __init__(self, fn: Callable):
+        self.fn = fn
+
+    def sample(self, rng):
+        return self.fn(rng)
+
+
+class ValueDistUtils:
+    """(reference: pipeline/tuning/ValueDistUtils.java)"""
+
+    @staticmethod
+    def sample_many(dist: ValueDist, n: int, seed: int = 0) -> list:
+        rng = np.random.default_rng(seed)
+        return [dist.sample(rng) for _ in range(n)]
+
+
+# -- candidate enumerators (reference: tuning/PipelineCandidates*.java) ------
+
+
+class PipelineCandidatesBase:
+    """Iterable of (stage, ParamInfo, value) combos to evaluate."""
+
+    def candidates(self) -> List[tuple]:
+        raise NotImplementedError
+
+
+class PipelineCandidatesGrid(PipelineCandidatesBase):
+    def __init__(self, param_grid):
+        self.param_grid = param_grid
+
+    def candidates(self):
+        return list(self.param_grid.candidates())
+
+
+class PipelineCandidatesRandom(PipelineCandidatesBase):
+    def __init__(self, param_dist, num_candidates: int = 10, seed: int = 0):
+        self.param_dist = param_dist
+        self.num_candidates = num_candidates
+        self.seed = seed
+
+    def candidates(self):
+        return list(self.param_dist.sample(self.num_candidates,
+                                           seed=self.seed))
+
+
+class PipelineCandidatesBayes(PipelineCandidatesBase):
+    """Sequential candidates need scores fed back; expose the TPE proposal
+    directly (see tuning.BayesSearchCV for the full loop)."""
+
+    def __init__(self, param_range, num_candidates: int = 20, seed: int = 0):
+        self.param_range = param_range
+        self.num_candidates = num_candidates
+        self.seed = seed
+
+    def candidates(self):
+        from .tuning import BayesSearchCV
+
+        rng = np.random.default_rng(self.seed)
+        return [tuple((stage, info, BayesSearchCV._draw(rng, spec))
+                      for stage, info, spec in self.param_range._items)
+                for _ in range(self.num_candidates)]

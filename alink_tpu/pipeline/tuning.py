@@ -345,8 +345,10 @@ class BayesSearchCV(_BaseSearch):
         self.num_initial = max(2, num_initial)
         self.gamma = gamma
 
-    # -- sampling helpers ---------------------------------------------------
-    def _draw(self, rng, spec):
+    # -- sampling helpers (stateless: shared by TreeParzenEstimator and
+    # PipelineCandidatesBayes without constructing a search object) --------
+    @staticmethod
+    def _draw(rng, spec):
         if spec[0] == "choice":
             return spec[1][rng.integers(len(spec[1]))]
         _, low, high, log, integer = spec
@@ -356,7 +358,16 @@ class BayesSearchCV(_BaseSearch):
             v = float(rng.uniform(low, high))
         return int(round(v)) if integer else v
 
-    def _tpe_draw(self, rng, spec, good_vals, bad_vals):
+    @staticmethod
+    def _split_good_bad(observations, gamma, larger_is_better):
+        """observations: [(values, score)] -> (good values, bad values)."""
+        ordered = sorted(observations, key=lambda o: o[1],
+                         reverse=larger_is_better)
+        n_good = max(1, int(np.ceil(gamma * len(ordered))))
+        return [o[0] for o in ordered[:n_good]], [o[0] for o in ordered[n_good:]]
+
+    @staticmethod
+    def _tpe_draw(rng, spec, good_vals, bad_vals):
         if spec[0] == "choice":
             choices = spec[1]
             counts = np.ones(len(choices))
@@ -392,12 +403,8 @@ class BayesSearchCV(_BaseSearch):
             if k < self.num_initial or not observed:
                 values = tuple(self._draw(rng, spec) for _, _, spec in items)
             else:
-                ordered = sorted(
-                    observed, key=lambda o: o[1],
-                    reverse=self.evaluator.larger_is_better)
-                n_good = max(1, int(np.ceil(self.gamma * len(ordered))))
-                good = [o[0] for o in ordered[:n_good]]
-                bad = [o[0] for o in ordered[n_good:]]
+                good, bad = self._split_good_bad(
+                    observed, self.gamma, self.evaluator.larger_is_better)
                 values = tuple(
                     self._tpe_draw(rng, spec,
                                    [gv[i] for gv in good],
@@ -411,3 +418,137 @@ class BayesSearchCV(_BaseSearch):
             if not np.isnan(score):
                 observed.append((values, score))
         return self._finish(t, candidates, scores)
+
+
+class BayesSearchTVSplit(BayesSearchCV):
+    """TPE search evaluated on one train/validation split instead of CV
+    (reference: pipeline/tuning/* TVSplit family; Bayes slot as in
+    BayesSearchCV)."""
+
+    def __init__(self, estimator, param_range: ParamRange, evaluator,
+                 num_candidates=20, num_initial=5, gamma=0.3,
+                 train_ratio=0.8, seed=0, num_threads=1):
+        super().__init__(estimator, param_range, evaluator,
+                         num_candidates=num_candidates,
+                         num_initial=num_initial, gamma=gamma, seed=seed,
+                         num_threads=num_threads)
+        self.train_ratio = train_ratio
+
+
+class GaussianProcessRegression:
+    """RBF-kernel GP regressor on small design matrices — the surrogate
+    model the reference ships for tuning (reference:
+    pipeline/tuning/GaussianProcessRegression.java). fit(X, y) then
+    predict(X*) -> (mean, std)."""
+
+    def __init__(self, length_scale: float = 1.0, noise: float = 1e-6):
+        self.length_scale = float(length_scale)
+        self.noise = float(noise)
+        self._X = self._alpha = self._L = None
+
+    @staticmethod
+    def _as_design(X):
+        X = np.asarray(X, float)
+        return X[:, None] if X.ndim == 1 else X
+
+    def _kernel(self, A, B):
+        d2 = ((A[:, None, :] - B[None, :, :]) ** 2).sum(-1)
+        return np.exp(-0.5 * d2 / (self.length_scale ** 2))
+
+    def fit(self, X, y):
+        X = self._as_design(X)
+        y = np.asarray(y, float)
+        K = self._kernel(X, X) + self.noise * np.eye(len(X))
+        self._L = np.linalg.cholesky(K)
+        self._alpha = np.linalg.solve(
+            self._L.T, np.linalg.solve(self._L, y))
+        self._X = X
+        return self
+
+    def predict(self, Xs):
+        Xs = self._as_design(Xs)
+        Ks = self._kernel(Xs, self._X)
+        mean = Ks @ self._alpha
+        v = np.linalg.solve(self._L, Ks.T)
+        var = np.clip(1.0 - (v ** 2).sum(0), 1e-12, None)
+        return mean, np.sqrt(var)
+
+
+class TreeParzenEstimator:
+    """The TPE proposal rule as a standalone component (reference names it
+    pipeline/tuning/TreeParzenEstimator.java; BayesSearchCV embeds the same
+    good/bad KDE-ratio logic)."""
+
+    def __init__(self, gamma: float = 0.3, seed: int = 0):
+        self.gamma = gamma
+        self._rng = np.random.default_rng(seed)
+
+    def propose(self, spec, observations, larger_is_better=True):
+        """spec: ("range", low, high, log, integer) or ("choice", values);
+        observations: [(value, score)]. Returns the next value to try."""
+        if not observations:
+            return BayesSearchCV._draw(self._rng, spec)
+        good, bad = BayesSearchCV._split_good_bad(
+            observations, self.gamma, larger_is_better)
+        return BayesSearchCV._tpe_draw(self._rng, spec, good, bad)
+
+
+class Report:
+    """Per-candidate tuning report (reference: pipeline/tuning/Report.java)."""
+
+    def __init__(self, result: TuningResult):
+        self.items = result.reports
+
+    def to_list(self):
+        return list(self.items)
+
+    def __str__(self):
+        return "\n".join(
+            f"{i}: score={r['score']} params={r['params']}"
+            for i, r in enumerate(self.items))
+
+
+# reference fit() returns a XxxModel; TuningResult IS that model here — the
+# named classes keep the reference's type surface
+class BaseTuning(_BaseSearch):
+    pass
+
+
+class BaseGridSearch(GridSearchCV):
+    pass
+
+
+class BaseRandomSearch(RandomSearchCV):
+    pass
+
+
+class BaseBayesSearch(BayesSearchCV):
+    pass
+
+
+class BaseTuningModel(TuningResult):
+    pass
+
+
+class GridSearchCVModel(TuningResult):
+    pass
+
+
+class GridSearchTVSplitModel(TuningResult):
+    pass
+
+
+class RandomSearchCVModel(TuningResult):
+    pass
+
+
+class RandomSearchTVSplitModel(TuningResult):
+    pass
+
+
+class BayesSearchCVModel(TuningResult):
+    pass
+
+
+class BayesSearchTVSplitModel(TuningResult):
+    pass
