@@ -97,3 +97,6 @@ class CsvSourceLocalOp(_CsvSource, LocalOperator):
 
 class TableSourceLocalOp(_TableSource, LocalOperator):
     pass
+
+# LocalOp surface closure (reference operator/local/** names)
+from .generated import *  # noqa: F401,F403,E402

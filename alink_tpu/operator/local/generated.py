@@ -1,0 +1,71 @@
+"""LocalOp surface closure (reference: operator/local/** — the *LocalOp
+family runs algorithms in-process without forming a Flink cluster,
+core/src/main/java/com/alibaba/alink/operator/local/LocalOperator.java).
+
+This framework executes in-process BY DESIGN (SURVEY §1 L0: JAX/XLA replaces
+the Flink substrate), so each reference LocalOp name binds to the batch op
+that already executes locally: the classes are real subclasses (same params,
+same behavior, isinstance-compatible), generated from the name table below.
+Three names map irregularly: DbscanTrainLocalOp -> GroupDbscanModelBatchOp
+(the model-producing DBSCAN trainer here), InternalCsvSourceLocalOp ->
+CsvSourceBatchOp, WithTrainInfoLocalOp -> TrainInfoBatchOp.
+"""
+
+from __future__ import annotations
+
+from .. import batch as _B
+
+__all__ = []
+
+# reference *LocalOp name -> our batch op name
+IRREGULAR = {
+    "DbscanTrainLocalOp": "GroupDbscanModelBatchOp",
+    "InternalCsvSourceLocalOp": "CsvSourceBatchOp",
+    "WithTrainInfoLocalOp": "TrainInfoBatchOp",
+}
+
+REGULAR = [
+    "AkSinkLocalOp", "AkSourceLocalOp", "AppendIdLocalOp",
+    "AppendModelStreamFileSinkLocalOp", "AsLocalOp",
+    "BaseNearestNeighborTrainLocalOp", "BaseRecommLocalOp",
+    "BaseSinkLocalOp", "BaseSourceLocalOp", "BaseSqlApiLocalOp",
+    "CsvSinkLocalOp", "DbscanLocalOp", "DbscanPredictLocalOp",
+    "DistinctLocalOp", "EvalBinaryClassLocalOp", "EvalClusterLocalOp",
+    "EvalMultiClassLocalOp", "EvalMultiLabelLocalOp", "EvalOutlierLocalOp",
+    "EvalRankingLocalOp", "EvalRegressionLocalOp", "EvalTimeSeriesLocalOp",
+    "ExtractModelInfoLocalOp", "FilterLocalOp", "FirstNLocalOp",
+    "FlatMapLocalOp", "GroupByLocalOp", "HBaseSinkLocalOp",
+    "InternalFullStatsLocalOp", "LibSvmSinkLocalOp", "LibSvmSourceLocalOp",
+    "MTableSerializeLocalOp", "MapLocalOp", "ModelMapLocalOp",
+    "OrderByLocalOp", "ParquetSourceLocalOp", "RedisRowSinkLocalOp",
+    "RedisStringSinkLocalOp", "SampleLocalOp", "SampleWithSizeLocalOp",
+    "SelectLocalOp", "SummarizerLocalOp", "TFRecordDatasetSinkLocalOp",
+    "TFRecordDatasetSourceLocalOp", "TensorSerializeLocalOp",
+    "TextSinkLocalOp", "TextSourceLocalOp", "TsvSinkLocalOp",
+    "TsvSourceLocalOp", "UnionAllLocalOp",
+    "VectorApproxNearestNeighborPredictLocalOp",
+    "VectorApproxNearestNeighborTrainLocalOp",
+    "VectorNearestNeighborPredictLocalOp",
+    "VectorNearestNeighborTrainLocalOp", "VectorSerializeLocalOp",
+    "WhereLocalOp", "WithModelInfoLocalOp",
+]
+
+
+def _build():
+    g = globals()
+    pairs = [(n, n[: -len("LocalOp")] + "BatchOp") for n in REGULAR]
+    pairs += list(IRREGULAR.items())
+    for local_name, batch_name in pairs:
+        if local_name in g:
+            continue
+        base = getattr(_B, batch_name)
+        g[local_name] = type(local_name, (base,), {
+            "__doc__": (f"In-process twin of {batch_name} (reference: "
+                        f"operator/local/**/{local_name}.java — execution "
+                        f"is local by design on this substrate)."),
+            "__module__": __name__,
+        })
+        __all__.append(local_name)
+
+
+_build()

@@ -16,9 +16,8 @@ there are generated.
 
 from __future__ import annotations
 
-from typing import Dict, Optional, Type
+from typing import Dict, Type
 
-from ..common.mtable import MTable
 from ..common.params import ParamInfo
 from ..operator import batch as _B
 from .base import EstimatorBase, ModelBase, TransformerBase
@@ -89,6 +88,8 @@ ESTIMATORS: Dict[str, tuple] = {
 }
 
 MODELS: Dict[str, str] = {
+    'IndexToString': 'IndexToStringPredictBatchOp',
+    'TFTableModelPredictor': 'TFTableModelPredictBatchOp',
     'AggLookup': 'AggLookupBatchOp',
     'AutoCrossAlgoModel': 'AutoCrossPredictBatchOp',
     'AutoCrossModel': 'AutoCrossPredictBatchOp',
@@ -135,7 +136,6 @@ MODELS: Dict[str, str] = {
     'LinearSvrModel': 'LinearSvrPredictBatchOp',
     'LogisticRegressionModel': 'LogisticRegressionPredictBatchOp',
     'Lookup': 'LookupBatchOp',
-    'LookupRecentDaysModel': 'LookupRecentDaysBatchOp',
     'MaxAbsScalerModel': 'MaxAbsScalerPredictBatchOp',
     'MultiHotEncoderModel': 'MultiHotPredictBatchOp',
     'MultiStringIndexerModel': 'MultiStringIndexerPredictBatchOp',
@@ -171,6 +171,7 @@ MODELS: Dict[str, str] = {
 }
 
 TRANSFORMERS: Dict[str, str] = {
+    'LookupRecentDaysModel': 'LookupRecentDaysBatchOp',
     'Binarizer': 'BinarizerBatchOp',
     'Bucketizer': 'BucketizerBatchOp',
     'ColumnsToCsv': 'ColumnsToCsvBatchOp',
@@ -186,7 +187,6 @@ TRANSFORMERS: Dict[str, str] = {
     'ExtractMfccFeature': 'ExtractMfccFeatureBatchOp',
     'HashCrossFeature': 'HashCrossFeatureBatchOp',
     'IForestOutlier4GroupedData': 'IForestOutlier4GroupedDataBatchOp',
-    'IndexToString': 'IndexToStringPredictBatchOp',
     'JsonToColumns': 'JsonToColumnsBatchOp',
     'JsonToCsv': 'JsonToCsvBatchOp',
     'JsonToKv': 'JsonToKvBatchOp',
@@ -208,7 +208,6 @@ TRANSFORMERS: Dict[str, str] = {
     'StopWordsRemover': 'StopWordsRemoverBatchOp',
     'StringSimilarityPairwise': 'StringSimilarityPairwiseBatchOp',
     'TFSavedModelPredictor': 'TFSavedModelPredictBatchOp',
-    'TFTableModelPredictor': 'TFTableModelPredictBatchOp',
     'TensorReshape': 'TensorReshapeBatchOp',
     'TensorToVector': 'TensorToVectorBatchOp',
     'TextSimilarityPairwise': 'TextSimilarityPairwiseBatchOp',
@@ -314,9 +313,7 @@ def _build():
         predict_op = getattr(_B, predict_name)
         if not taken(model_name):
             put(_make_model(model_name, predict_op))
-        from .base import STAGE_REGISTRY
-
-        model_cls = g.get(model_name) or STAGE_REGISTRY.get(model_name) \
+        model_cls = g.get(model_name) or _reg.get(model_name) \
             or getattr(_hand, model_name, None)
         if taken(name):
             continue

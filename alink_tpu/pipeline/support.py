@@ -13,14 +13,13 @@ data), it is implemented here.
 
 from __future__ import annotations
 
-import os
-from typing import Any, Callable, List, Optional, Sequence, Tuple
+from typing import Callable, List, Optional, Sequence
 
 import numpy as np
 
 from ..common.mtable import MTable
 from ..common.params import ParamInfo
-from ..operator.batch.base import BatchOperator, TableSourceBatchOp
+from ..operator.batch.base import TableSourceBatchOp
 from .base import (EstimatorBase, ModelBase, PipelineStageBase,
                    TransformerBase)
 from .local_predictor import LocalPredictor

@@ -103,3 +103,57 @@ def test_pipeline_with_step_train():
     assert len(pipe.step_results) == 2
     assert "c" in pipe.step_results[-1].names
     assert pm.transform(t).collect().num_rows == 50
+
+
+REF_LOCAL = "/root/reference/core/src/main/java/com/alibaba/alink/operator/local"
+
+
+@pytest.mark.skipif(not os.path.isdir(REF_LOCAL),
+                    reason="reference tree not present")
+def test_every_reference_local_op_exists():
+    import alink_tpu.operator.local as L
+
+    names = []
+    for root, _, files in os.walk(REF_LOCAL):
+        names += [f[:-5] for f in files if f.endswith("LocalOp.java")]
+    missing = [n for n in sorted(names) if not hasattr(L, n)]
+    assert not missing, f"{len(missing)} missing: {missing[:20]}"
+
+
+def test_local_op_smoke():
+    """A LocalOp chain behaves like its batch twins (it IS them)."""
+    import alink_tpu.operator.local as L
+    from alink_tpu.operator.batch import SummarizerBatchOp
+
+    t = MTable({"a": np.array([1.0, 2.0, 3.0])})
+    src = L.TableSourceLocalOp(t)
+    s = L.SummarizerLocalOp(selectedCols=["a"]).link_from(src)
+    assert isinstance(s, SummarizerBatchOp)
+    assert s.collect_summary().mean("a") == 2.0
+
+
+def test_generated_stage_arity_matches_role():
+    """Every generated stage's bound op arity must match its base class
+    (TransformerBase links 1 input, ModelBase links model+data = 2), so a
+    misclassified spec entry cannot ship a dead-on-arrival stage."""
+    from alink_tpu.pipeline import generated as G
+    from alink_tpu.pipeline.base import (EstimatorBase, ModelBase,
+                                         TransformerBase)
+
+    bad = []
+    for name in G.__all__:
+        cls = getattr(G, name)
+        if issubclass(cls, ModelBase):
+            op = cls._predict_op_cls
+            if getattr(op, "_min_inputs", 2) < 2 or \
+                    getattr(op, "_max_inputs", 2) < 2:
+                bad.append((name, op.__name__, "model needs 2-input op"))
+        elif issubclass(cls, TransformerBase):
+            op = cls._map_op_cls
+            if getattr(op, "_max_inputs", 1) != 1 or \
+                    getattr(op, "_min_inputs", 1) != 1:
+                bad.append((name, op.__name__, "transformer needs 1-input op"))
+        elif issubclass(cls, EstimatorBase):
+            if getattr(cls._train_op_cls, "_min_inputs", 1) < 1:
+                bad.append((name, cls._train_op_cls.__name__, "train arity"))
+    assert not bad, bad
