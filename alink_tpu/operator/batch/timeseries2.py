@@ -740,8 +740,89 @@ class LookupRecentDaysMapper(SISOMapper):
 
 class LookupRecentDaysBatchOp(MapBatchOp, HasSelectedCol, HasOutputCol,
                               HasReservedCols):
-    """(reference: operator/batch/dataproc/LookupRecentDaysBatchOp.java)"""
+    """Recent-days feature lookup (reference:
+    operator/batch/feature/LookupRecentDaysBatchOp.java — a ModelMapBatchOp
+    whose MODEL table carries group keys + precomputed recent-days feature
+    columns, common/dataproc/LookupRecentDaysModelMapper.java).
+
+    Two forms:
+    - 2 inputs ``(model, data)`` — the reference contract: data rows are
+      decorated by key lookup into the model table (keys = ``mapKeyCols``
+      or the shared column names); misses yield NULLs.
+    - 1 input — self-series convenience: count/sum/mean/min/max of the
+      row's own series over the trailing ``numDays`` window.
+    """
 
     mapper_cls = LookupRecentDaysMapper
     TIME_COL = LookupRecentDaysMapper.TIME_COL
     NUM_DAYS = LookupRecentDaysMapper.NUM_DAYS
+    MAP_KEY_COLS = ParamInfo("mapKeyCols", list,
+                             desc="model-table key columns; default: the "
+                                  "columns shared with the data table")
+    FEATURE_SCHEMA_STR = ParamInfo(
+        "featureSchemaStr", str,
+        desc="declared schema of the looked-up feature columns")
+
+    _min_inputs = 1
+    _max_inputs = 2
+
+    def _lookup_cols(self, model_schema, data_schema):
+        keys = self.get(self.MAP_KEY_COLS) or [
+            n for n in model_schema.names if n in set(data_schema.names)]
+        if not keys:
+            raise AkIllegalArgumentException(
+                "LookupRecentDays needs mapKeyCols (no shared columns "
+                "between model and data)")
+        feat = self.get(self.FEATURE_SCHEMA_STR)
+        if feat:
+            from ...common.mtable import TableSchema
+
+            fs = TableSchema.parse(feat)
+            feats = list(zip(fs.names, fs.types))
+        else:
+            feats = [(n, model_schema.type_of(n))
+                     for n in model_schema.names if n not in set(keys)]
+        return keys, feats
+
+    def _execute_impl(self, *ins: MTable) -> MTable:
+        if len(ins) == 1:
+            return super()._execute_impl(ins[0])
+        model, t = ins
+        keys, feats = self._lookup_cols(model.schema, t.schema)
+        index: Dict[tuple, tuple] = {}
+        kcols = [model.col(k) for k in keys]
+        vcols = [model.col(n) for n, _ in feats]
+        for i in range(model.num_rows):
+            index[tuple(c[i] for c in kcols)] = tuple(c[i] for c in vcols)
+        dk = [t.col(k) for k in keys]
+        cols = {n: t.col(n) for n in t.names}
+        types = dict(zip(t.names, t.schema.types))
+        from ...common.mtable import TableSchema
+
+        for j, (n, tp) in enumerate(feats):
+            vals = []
+            for i in range(t.num_rows):
+                hit = index.get(tuple(c[i] for c in dk))
+                vals.append(None if hit is None else hit[j])
+            if AlinkTypes.is_numeric(tp):
+                cols[n] = np.asarray(
+                    [np.nan if v is None else float(v) for v in vals])
+                types[n] = AlinkTypes.DOUBLE
+            else:
+                cols[n] = np.asarray(vals, object)
+                types[n] = tp
+        names = list(t.names) + [n for n, _ in feats]
+        return MTable(cols, TableSchema(names, [types[n] for n in names]))
+
+    def _out_schema(self, *in_schemas):
+        if len(in_schemas) == 1:
+            return super()._out_schema(*in_schemas)
+        model_schema, data_schema = in_schemas
+        keys, feats = self._lookup_cols(model_schema, data_schema)
+        from ...common.mtable import TableSchema
+
+        names = list(data_schema.names) + [n for n, _ in feats]
+        types = list(data_schema.types) + [
+            AlinkTypes.DOUBLE if AlinkTypes.is_numeric(tp) else tp
+            for _, tp in feats]
+        return TableSchema(names, types)

@@ -88,6 +88,7 @@ ESTIMATORS: Dict[str, tuple] = {
 }
 
 MODELS: Dict[str, str] = {
+    'LookupRecentDaysModel': 'LookupRecentDaysBatchOp',
     'IndexToString': 'IndexToStringPredictBatchOp',
     'TFTableModelPredictor': 'TFTableModelPredictBatchOp',
     'AggLookup': 'AggLookupBatchOp',
@@ -171,7 +172,6 @@ MODELS: Dict[str, str] = {
 }
 
 TRANSFORMERS: Dict[str, str] = {
-    'LookupRecentDaysModel': 'LookupRecentDaysBatchOp',
     'Binarizer': 'BinarizerBatchOp',
     'Bucketizer': 'BucketizerBatchOp',
     'ColumnsToCsv': 'ColumnsToCsvBatchOp',

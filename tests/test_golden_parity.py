@@ -394,3 +394,104 @@ def test_vector_normalize_golden():
     arr = np.asarray(got.data if hasattr(got, "data") else
                      [float(x) for x in str(got).split()])
     np.testing.assert_allclose(arr, [0.6, 0.8], atol=1e-9)
+
+
+# -- outlier / timeseries / stream additions (round-4 widening) --------------
+
+
+def test_ksigma_outlier_golden():
+    from alink_tpu.operator.batch import KSigmaOutlierBatchOp
+
+    x = np.concatenate([np.zeros(50) + np.arange(50) * 0.01, [100.0]])
+    out = KSigmaOutlierBatchOp(
+        selectedCol="f", predictionCol="o", k=3.0).link_from(
+        _src({"f": x})).collect()
+    flags = np.asarray(out.col("o"))
+    assert bool(flags[-1]) is True
+    assert not any(bool(v) for v in flags[:50])
+
+
+def test_holtwinters_forecast_golden():
+    from alink_tpu.operator.batch import HoltWintersBatchOp
+
+    # pure linear trend -> forecast continues the line
+    n = 30
+    vals = 2.0 * np.arange(n) + 5.0
+    times = np.arange(n).astype("datetime64[D]").astype(object)
+    out = HoltWintersBatchOp(
+        valueCol="v", timeCol="t", predictNum=3).link_from(
+        _src({"t": np.asarray([str(x) for x in times], object),
+              "v": vals})).collect()
+    pred_col = [c for c in out.names if c not in ("t", "v")][0]
+    pred = out.col(pred_col)
+    flat = np.asarray(pred[0].data if hasattr(pred[0], "data") else pred[0],
+                      float).ravel()
+    want = 2.0 * (np.arange(3) + n) + 5.0
+    np.testing.assert_allclose(flat[:3], want, rtol=0.05)
+
+
+def test_eval_multiclass_golden():
+    from alink_tpu.operator.batch import EvalMultiClassBatchOp
+
+    y = np.asarray(["a", "b", "c", "a", "b", "c"], object)
+    p = np.asarray(["a", "b", "c", "a", "c", "c"], object)  # 5/6 right
+    m = EvalMultiClassBatchOp(labelCol="y", predictionCol="p").link_from(
+        _src({"y": y, "p": p})).collect_metrics()
+    np.testing.assert_allclose(m.get("Accuracy"), 5.0 / 6.0, atol=1e-9)
+
+
+def test_ftrl_stream_learns_golden():
+    from alink_tpu.common.mtable import MTable as MT
+    from alink_tpu.operator.stream import (FtrlPredictStreamOp,
+                                           FtrlTrainStreamOp)
+    from alink_tpu.operator.stream.base import TableSourceStreamOp
+
+    rng = np.random.default_rng(0)
+    n = 3000
+    X = rng.normal(size=(n, 2))
+    y = (X[:, 0] - X[:, 1] > 0).astype(np.int64)
+    t = MT({"f0": X[:, 0], "f1": X[:, 1], "label": y})
+    train = FtrlTrainStreamOp(
+        featureCols=["f0", "f1"], labelCol="label",
+    ).link_from(TableSourceStreamOp(t, chunkSize=500))
+    pred = FtrlPredictStreamOp(
+        predictionCol="p").link_from(train, TableSourceStreamOp(
+            t, chunkSize=500)).collect()
+    acc = float((np.asarray(pred.col("p")).astype(np.int64)
+                 == y[: pred.num_rows]).mean())
+    assert acc > 0.9, acc
+
+
+def test_tumble_window_agg_golden():
+    from alink_tpu.common.mtable import MTable as MT
+    from alink_tpu.operator.stream import TumbleTimeWindowStreamOp
+    from alink_tpu.operator.stream.base import TableSourceStreamOp
+
+    ts = np.asarray([0.0, 1.0, 2.0, 10.0, 11.0, 20.0])
+    v = np.asarray([1.0, 2.0, 3.0, 10.0, 20.0, 7.0])
+    t = MT({"ts": ts, "v": v})
+    out = TumbleTimeWindowStreamOp(
+        timeCol="ts", windowTime=10,
+        clause="SUM(v) AS total").link_from(
+        TableSourceStreamOp(t, chunkSize=6)).collect()
+    totals = sorted(np.asarray(out.col("total")))
+    assert totals == [6.0, 7.0, 30.0]
+
+
+def test_lookup_recent_days_model_map():
+    """The reference contract: (model, data) key lookup decorating rows
+    with precomputed recent-days features (reference:
+    common/dataproc/LookupRecentDaysModelMapper.java)."""
+    from alink_tpu.operator.batch import LookupRecentDaysBatchOp
+
+    model = _src({"shop": np.asarray(["a", "b"], object),
+                  "sales_7d": np.asarray([70.0, 140.0]),
+                  "visits_7d": np.asarray([700.0, 1400.0])})
+    data = _src({"shop": np.asarray(["b", "zz", "a"], object),
+                 "day": np.asarray([1.0, 2.0, 3.0])})
+    out = LookupRecentDaysBatchOp(selectedCol="shop").link_from(
+        model, data).collect()
+    s = np.asarray(out.col("sales_7d"))
+    assert s[0] == 140.0 and np.isnan(s[1]) and s[2] == 70.0
+    v = np.asarray(out.col("visits_7d"))
+    assert v[0] == 1400.0 and v[2] == 700.0
