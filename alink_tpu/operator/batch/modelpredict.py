@@ -31,7 +31,7 @@ from ...common.exceptions import (
     AkUnsupportedOperationException,
 )
 from ...common.mtable import AlinkTypes, MTable, TableSchema
-from ...common.params import ParamInfo
+from ...common.params import InValidator, ParamInfo
 from ...mapper import (
     HasReservedCols,
     HasSelectedCols,
@@ -56,6 +56,14 @@ class HasIngestParams(HasSelectedCols, HasReservedCols):
         desc="fixed device batch (tail is padded) so one compiled program "
         "serves any table size",
     )
+    PRECISION = ParamInfo(
+        "precision", str, default="float32",
+        validator=InValidator("float32", "bfloat16"),
+        desc="compute precision for the ingested model: float32 (numerics "
+        "parity) or bfloat16 (TPU-native: MXU matmuls, half the HBM "
+        "traffic; outputs return fp32). Implemented for the torch ingest; "
+        "other formats raise when set to bfloat16",
+    )
 
 
 class _BaseIngestMapper(Mapper):
@@ -75,9 +83,19 @@ class _BaseIngestMapper(Mapper):
         [(name, per-row shape or None)]."""
         raise NotImplementedError
 
+    # formats that honor precision="bfloat16"; others must raise rather
+    # than silently serving fp32 under a bf16-labelled op
+    _supports_bf16 = False
+
     # -- shared machinery ---------------------------------------------------
     def _ensure_loaded(self):
         if self._fn is None:
+            if (self.get(HasIngestParams.PRECISION) != "float32"
+                    and not self._supports_bf16):
+                raise AkUnsupportedOperationException(
+                    f"{type(self).__name__} does not implement the bfloat16 "
+                    f"serving policy yet (torch ingest does); remove "
+                    f"precision or use the torch path")
             self._load(self.get(HasIngestParams.MODEL_PATH))
 
     def _bind_inputs(self, t: MTable) -> List[np.ndarray]:
@@ -299,10 +317,14 @@ class TorchModelMapper(_BaseIngestMapper, HasIngestParams):
     """(reference: operator/common/pytorch/TorchModelPredictMapper +
     predictor-torch TorchJavaPredictor.java:29-33)"""
 
+    _supports_bf16 = True
+
     def _load(self, path: str):
         from ...onnx import load_torch_fn
 
-        jfn, conv = load_torch_fn(path)
+        prec = self.get(HasIngestParams.PRECISION)
+        jfn, conv = load_torch_fn(
+            path, dtype=None if prec == "float32" else prec)
         self._in_names = list(conv.user_inputs)
         out_info = []
         # output shapes from the exported graph's fake tensors

@@ -273,8 +273,14 @@ def bench_resnet50(batch=256, steps=4):
     host (37.5KB/row on the wire — 4x less than fp32 NCHW), normalization +
     layout transpose + the model are fused into ONE XLA program, and batches
     dispatch ahead so transfer overlaps compute. Reports:
+    Serving runs the bfloat16 inference policy (precision="bfloat16" on
+    the ingest ops: MXU-native matmuls/convs, ~2x the fp32 on-device rate;
+    fp32-agreement is covered by tests/test_ingest.py on an MLP — random-
+    weight ResNet top-1 agreed 64/64 in manual runs, not a CI gate).
     - rows_per_sec: host uint8 in -> host logits out (includes transfer)
-    - rows_per_sec_on_device: inputs pre-staged, pure compute
+    - rows_per_sec_on_device: input pre-staged, the same fused
+      normalize+model program, bf16 policy
+    - rows_per_sec_on_device_fp32: ditto at fp32 (numerics-parity path)
     - tunnel_MB_per_s + wire_floor_rows_per_sec: measured device_put
       bandwidth and the throughput ceiling it implies for this wire format
       (under axon the tunnel, not the chip, is the binding constraint)."""
@@ -286,15 +292,22 @@ def bench_resnet50(batch=256, steps=4):
 
     model = _resnet50_torch()
     x = torch.randn(batch, 3, 224, 224)
-    fn, _ = load_torch_fn(model, (x,))
+    # bf16 inference policy: MXU-native matmuls/convs, half the HBM traffic
+    fn, _ = load_torch_fn(model, (x,), dtype="bfloat16")
+    fn32, _ = load_torch_fn(model, (x,))
 
     mean = np.array([0.485, 0.456, 0.406], np.float32) * 255.0
     std = np.array([0.229, 0.224, 0.225], np.float32) * 255.0
 
-    @jax.jit
-    def serve(u8):  # uint8 NHWC in; normalize/transpose fused on device
-        xf = (u8.astype(jnp.float32) - mean) / std
-        return fn(xf.transpose(0, 3, 1, 2))[0]
+    def make_serve(f):
+        @jax.jit
+        def serve(u8):  # uint8 NHWC in; normalize/transpose fused on device
+            xf = (u8.astype(jnp.float32) - mean) / std
+            return f(xf.transpose(0, 3, 1, 2))[0]
+
+        return serve
+
+    serve, serve32 = make_serve(fn), make_serve(fn32)
 
     rng = np.random.RandomState(0)
     bufs = [rng.randint(0, 256, (batch, 224, 224, 3), np.uint8)
@@ -324,16 +337,20 @@ def bench_resnet50(batch=256, steps=4):
     dt = time.perf_counter() - t0
     assert logits.shape == (batch * steps, 1000)
 
-    # device-resident variant: stage once, time compute only
-    xd = jax.device_put(bufs[0])
-    np.asarray(serve(xd))
-    t1 = time.perf_counter()
-    for _ in range(steps):
-        out_d = serve(xd)
-    _ = np.asarray(out_d[:1, :1])  # dependent fetch = real sync
-    dt_dev = time.perf_counter() - t1
+    # device-resident variants: stage once, time the SAME fused serve
+    # program (bf16 policy + the fp32 numerics-parity path)
+    def time_dev(f, reps=steps):
+        xd = jax.device_put(bufs[0])
+        np.asarray(f(xd)[:1, :1])
+        t1 = time.perf_counter()
+        for _ in range(reps):
+            out_d = f(xd)
+        _ = np.asarray(out_d[:1, :1])  # dependent fetch = real sync
+        return batch * reps / (time.perf_counter() - t1)
+
     return {"rows_per_sec": round(batch * steps / dt, 1),
-            "rows_per_sec_on_device": round(batch * steps / dt_dev, 1),
+            "rows_per_sec_on_device": round(time_dev(serve), 1),
+            "rows_per_sec_on_device_fp32": round(time_dev(serve32), 1),
             "tunnel_MB_per_s": round(mbps, 1),
             "wire_floor_rows_per_sec": round(wire_floor, 1),
             "batch": batch}

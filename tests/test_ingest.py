@@ -293,3 +293,49 @@ def test_stablehlo_short_table(tmp_path):
     got = np.stack(list(out.col("y")))
     np.testing.assert_allclose(got, X.astype(np.float32).sum(1)[:, None]
                                @ np.ones((1, 2)), atol=1e-5)
+
+
+def test_torch_predict_bfloat16_precision(tmp_path):
+    """precision="bfloat16" serves the ingested model in the TPU-native
+    policy with fp32-close outputs."""
+    import os
+
+    import torch
+    import torch.nn as nn
+
+    from alink_tpu.common.mtable import MTable
+    from alink_tpu.operator.batch import TorchModelPredictBatchOp
+    from alink_tpu.operator.batch.base import TableSourceBatchOp
+
+    torch.manual_seed(0)
+    model = nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 1))
+    ep = torch.export.export(model.eval(), (torch.randn(4, 8),))
+    path = os.path.join(tmp_path, "m.pt2")
+    torch.export.save(ep, path)
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(64, 8).astype(np.float64)
+    t = MTable({f"f{i}": X[:, i] for i in range(8)})
+
+    def run(prec):
+        out = TorchModelPredictBatchOp(
+            modelPath=path, selectedCols=[f"f{i}" for i in range(8)],
+            outputCols=["s"], precision=prec,
+        ).link_from(TableSourceBatchOp(t)).collect()
+        return np.asarray(out.col("s"))
+
+    s32, s16 = run("float32"), run("bfloat16")
+    assert s16.dtype == np.float64  # outputs come back as fp32/double
+    np.testing.assert_allclose(s16, s32, atol=0.05, rtol=0.05)
+    # the policy must actually engage: bf16 rounding makes outputs differ
+    assert not np.array_equal(s16, s32)
+    # and other formats must refuse rather than silently serving fp32
+    import pytest as _pytest
+
+    from alink_tpu.common.exceptions import AkUnsupportedOperationException
+    from alink_tpu.operator.batch import StableHloModelPredictBatchOp
+
+    with _pytest.raises(AkUnsupportedOperationException, match="bfloat16"):
+        StableHloModelPredictBatchOp(
+            modelPath=path, selectedCols=["f0"], precision="bfloat16",
+        ).link_from(TableSourceBatchOp(t)).collect()
