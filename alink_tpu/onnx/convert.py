@@ -31,9 +31,16 @@ def _static_ints(v) -> List[int]:
 
 
 class OnnxToJax:
-    """Compile an OnnxModel into ``fn(**inputs) -> dict[name, array]``."""
+    """Compile an OnnxModel into ``fn(**inputs) -> dict[name, array]``.
 
-    def __init__(self, model: OnnxModel):
+    ``dtype="bfloat16"`` applies the TPU-native inference policy: float
+    initializers load as bf16, float inputs cast on device, float outputs
+    return fp32 (matmuls ride the MXU at native bf16)."""
+
+    def __init__(self, model: OnnxModel, dtype=None):
+        from .precision import resolve_dtype
+
+        self.dtype = resolve_dtype(dtype)
         self.model = model
         self.graph = model.graph
         self.input_names = [
@@ -55,9 +62,15 @@ class OnnxToJax:
         _ensure_registered()
         graph = self.graph
 
+        inits = graph.initializers
+        if self.dtype is not None:
+            from .precision import cast_float_state
+
+            inits = cast_float_state(inits, self.dtype)
+
         def run(**inputs):
             env: Dict[str, Any] = {}
-            env.update(graph.initializers)
+            env.update(inits)
             env.update(inputs)
             env[""] = None  # optional (omitted) input slot
             for node in graph.nodes:
@@ -81,6 +94,10 @@ class OnnxToJax:
         import jax
 
         fn = self.function()
+        if self.dtype is not None:
+            from .precision import wrap_named
+
+            return wrap_named(fn, self.dtype)
 
         # foreign models carry f32 semantics: pin full-precision matmuls so
         # TPU results match the source runtime (ONNX Runtime / torch CPU);

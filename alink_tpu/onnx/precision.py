@@ -1,0 +1,66 @@
+"""Shared bf16 inference-policy helpers for the foreign-model converters.
+
+One source of truth for the policy all ingest formats apply: float weights
+load in the compute dtype, float inputs cast on device, float outputs
+return fp32 (integer tensors pass through untouched).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+
+def resolve_dtype(dtype) -> Optional[Any]:
+    """None -> None; anything else -> a dtype (jnp.dtype resolves
+    'bfloat16' through ml_dtypes)."""
+    if dtype is None:
+        return None
+    import jax.numpy as jnp
+
+    return jnp.dtype(dtype)
+
+
+def cast_float_state(state: Dict[str, np.ndarray], dtype) -> Dict[str, Any]:
+    """Cast the float entries of a weight/initializer dict to ``dtype``."""
+    return {
+        k: (np.asarray(v).astype(dtype)
+            if np.issubdtype(np.asarray(v).dtype, np.floating) else v)
+        for k, v in state.items()
+    }
+
+
+def wrap_positional(fn, dtype):
+    """jit-wrap a positional fn returning a LIST of arrays under the policy."""
+    import jax
+    import jax.numpy as jnp
+
+    def wrapped(*args):
+        cast = [a.astype(dtype)
+                if jnp.issubdtype(a.dtype, jnp.floating) else a
+                for a in map(jnp.asarray, args)]
+        out = fn(*cast)
+        return [o.astype(jnp.float32)
+                if jnp.issubdtype(o.dtype, jnp.floating) else o
+                for o in out]
+
+    return jax.jit(wrapped)
+
+
+def wrap_named(fn, dtype):
+    """jit-wrap a kwargs fn returning a DICT of arrays under the policy."""
+    import jax
+    import jax.numpy as jnp
+
+    def wrapped(**inputs):
+        cast = {k: (v.astype(dtype)
+                    if jnp.issubdtype(v.dtype, jnp.floating) else v)
+                for k, v in ((k, jnp.asarray(v))
+                             for k, v in inputs.items())}
+        out = fn(**cast)
+        return {k: (v.astype(jnp.float32)
+                    if jnp.issubdtype(v.dtype, jnp.floating) else v)
+                for k, v in out.items()}
+
+    return jax.jit(wrapped)

@@ -41,12 +41,9 @@ class TorchToJax:
     def __init__(self, ep, dtype=None):
         import torch
 
-        if dtype is not None:
-            import jax.numpy as jnp  # jnp.dtype resolves bfloat16 (ml_dtypes)
+        from .precision import resolve_dtype
 
-            self.dtype = jnp.dtype(dtype)
-        else:
-            self.dtype = None
+        self.dtype = resolve_dtype(dtype)
         self.ep = ep.run_decompositions({})
         sig = self.ep.graph_signature
         self.user_inputs = list(sig.user_inputs)
@@ -65,9 +62,9 @@ class TorchToJax:
                 if hasattr(val, "detach"):
                     state[spec.arg.name] = _t2np(val)
         if self.dtype is not None:
-            state = {k: (v.astype(self.dtype)
-                         if np.issubdtype(v.dtype, np.floating) else v)
-                     for k, v in state.items()}
+            from .precision import cast_float_state
+
+            state = cast_float_state(state, self.dtype)
         self.state = state
 
     def function(self) -> Callable[..., List[Any]]:
@@ -107,24 +104,14 @@ class TorchToJax:
 
     def jitted(self) -> Callable[..., List[Any]]:
         import jax
-        import jax.numpy as jnp
 
         fn = self.function()
         if self.dtype is not None:
             # bf16 policy: cast float inputs to the compute dtype, outputs
             # back to fp32; matmuls ride the MXU at native bf16
-            cdt = jnp.dtype(self.dtype)
+            from .precision import wrap_positional
 
-            def wrapped(*args):
-                cast = [a.astype(cdt)
-                        if jnp.issubdtype(a.dtype, jnp.floating) else a
-                        for a in map(jnp.asarray, args)]
-                out = fn(*cast)
-                return [o.astype(jnp.float32)
-                        if jnp.issubdtype(o.dtype, jnp.floating) else o
-                        for o in out]
-
-            return jax.jit(wrapped)
+            return wrap_positional(fn, self.dtype)
 
         # pin f32 matmul precision — foreign-model numerics parity on TPU
         def wrapped(*args):

@@ -61,8 +61,8 @@ class HasIngestParams(HasSelectedCols, HasReservedCols):
         validator=InValidator("float32", "bfloat16"),
         desc="compute precision for the ingested model: float32 (numerics "
         "parity) or bfloat16 (TPU-native: MXU matmuls, half the HBM "
-        "traffic; outputs return fp32). Implemented for the torch ingest; "
-        "other formats raise when set to bfloat16",
+        "traffic; outputs return fp32). Implemented for the torch and "
+        "ONNX ingests; other formats raise when set to bfloat16",
     )
 
 
@@ -94,8 +94,8 @@ class _BaseIngestMapper(Mapper):
                     and not self._supports_bf16):
                 raise AkUnsupportedOperationException(
                     f"{type(self).__name__} does not implement the bfloat16 "
-                    f"serving policy yet (torch ingest does); remove "
-                    f"precision or use the torch path")
+                    f"serving policy yet (the torch and ONNX ingests do); "
+                    f"remove precision or use one of those paths")
             self._load(self.get(HasIngestParams.MODEL_PATH))
 
     def _bind_inputs(self, t: MTable) -> List[np.ndarray]:
@@ -291,10 +291,14 @@ class OnnxModelMapper(_BaseIngestMapper, HasIngestParams):
     """(reference: operator/common/onnx/OnnxModelPredictMapper +
     predictor-onnx OnnxJavaPredictor.java:36)"""
 
+    _supports_bf16 = True
+
     def _load(self, path: str):
         from ...onnx import OnnxModel, OnnxToJax
 
-        conv = OnnxToJax(OnnxModel.load(path))
+        prec = self.get(HasIngestParams.PRECISION)
+        conv = OnnxToJax(OnnxModel.load(path),
+                         dtype=None if prec == "float32" else prec)
         jfn = conv.jitted()
         self._in_names = conv.input_names
         self._out_info = []

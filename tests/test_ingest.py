@@ -329,6 +329,24 @@ def test_torch_predict_bfloat16_precision(tmp_path):
     np.testing.assert_allclose(s16, s32, atol=0.05, rtol=0.05)
     # the policy must actually engage: bf16 rounding makes outputs differ
     assert not np.array_equal(s16, s32)
+
+    # the ONNX ingest honors the same policy (artifact built with the
+    # in-repo ONNX writer, same as the other ONNX tests)
+    onnx_path = os.path.join(tmp_path, "m.onnx")
+    _mlp_onnx(onnx_path, np.random.RandomState(7))
+    Xo = np.random.RandomState(2).randn(32, 4)
+    to = MTable({f"g{i}": Xo[:, i] for i in range(4)})
+
+    def run_onnx(prec):
+        out = OnnxModelPredictBatchOp(
+            modelPath=onnx_path, selectedCols=[f"g{i}" for i in range(4)],
+            outputCols=["probs"], precision=prec, predictBatchSize=8,
+        ).link_from(TableSourceBatchOp(to)).collect()
+        return np.stack([np.asarray(v) for v in out.col("probs")])
+
+    o32, o16 = run_onnx("float32"), run_onnx("bfloat16")
+    np.testing.assert_allclose(o16, o32, atol=0.05, rtol=0.05)
+    assert not np.array_equal(o16, o32)
     # and other formats must refuse rather than silently serving fp32
     import pytest as _pytest
 
