@@ -361,8 +361,11 @@ _TF_DTYPE = {
 class TFGraphToJax:
     """Compile a frozen ConcreteFunction's GraphDef into one JAX callable."""
 
-    def __init__(self, frozen_fn, tf=None):
+    def __init__(self, frozen_fn, tf=None, dtype=None):
+        from .precision import resolve_dtype
+
         self._tf = tf or _require_tf()
+        self.dtype = resolve_dtype(dtype)
         self.frozen = frozen_fn
         gd = frozen_fn.graph.as_graph_def()
         self.nodes = {n.name: n for n in gd.node}
@@ -373,6 +376,12 @@ class TFGraphToJax:
             if n.op == "Const":
                 self.consts[n.name] = np.asarray(
                     self._tf.make_ndarray(n.attr["value"].tensor))
+        if self.dtype is not None:
+            # frozen variables (weights) are float consts; int consts
+            # (shapes/axes/paddings) pass through untouched
+            from .precision import cast_float_state
+
+            self.consts = cast_float_state(self.consts, self.dtype)
         missing = sorted({
             n.op for n in gd.node
             if n.op not in _build_op_table()
@@ -430,12 +439,14 @@ class TFGraphToJax:
         return fn
 
 
-def load_saved_model_fn(path: str, signature: str = "serving_default"):
+def load_saved_model_fn(path: str, signature: str = "serving_default",
+                        dtype=None):
     """SavedModel → (jitted fn, input names, [(out name, per-row shape)]).
 
     The signature's variables freeze into constants and the GraphDef
     compiles through :class:`TFGraphToJax` — one XLA program, no TF in the
-    serving path."""
+    serving path. ``dtype="bfloat16"`` applies the TPU-native inference
+    policy (weights/inputs bf16, outputs fp32)."""
     tf = _require_tf()
     from tensorflow.python.framework.convert_to_constants import (
         convert_variables_to_constants_v2,
@@ -457,11 +468,24 @@ def load_saved_model_fn(path: str, signature: str = "serving_default"):
                 f"{sorted(sigs)}")
     sig = sigs[signature]
     frozen = convert_variables_to_constants_v2(sig)
-    conv = TFGraphToJax(frozen, tf=tf)
+    conv = TFGraphToJax(frozen, tf=tf, dtype=dtype)
 
     import jax
 
-    jfn = jax.jit(conv.jax_fn())
+    if conv.dtype is not None:
+        from .precision import wrap_positional
+
+        jfn = wrap_positional(conv.jax_fn(), conv.dtype)
+    else:
+        # fp32 numerics parity vs the TF reference: pin full-precision
+        # matmuls (same contract as the torch/ONNX fp32 paths)
+        fn = conv.jax_fn()
+
+        def _pinned(*args, _fn=fn):
+            with jax.default_matmul_precision("highest"):
+                return _fn(*args)
+
+        jfn = jax.jit(_pinned)
 
     in_names = [t.name.split(":")[0] for t in frozen.inputs]
     # flat output order ↔ structured output names (TF flattens dicts sorted

@@ -61,8 +61,8 @@ class HasIngestParams(HasSelectedCols, HasReservedCols):
         validator=InValidator("float32", "bfloat16"),
         desc="compute precision for the ingested model: float32 (numerics "
         "parity) or bfloat16 (TPU-native: MXU matmuls, half the HBM "
-        "traffic; outputs return fp32). Implemented for the torch and "
-        "ONNX ingests; other formats raise when set to bfloat16",
+        "traffic; outputs return fp32). Implemented for the torch, ONNX "
+        "and SavedModel ingests; StableHLO raises when set to bfloat16",
     )
 
 
@@ -87,6 +87,11 @@ class _BaseIngestMapper(Mapper):
     # than silently serving fp32 under a bf16-labelled op
     _supports_bf16 = False
 
+    def _ingest_dtype(self):
+        """precision param -> converter dtype (None = fp32 parity path)."""
+        prec = self.get(HasIngestParams.PRECISION)
+        return None if prec == "float32" else prec
+
     # -- shared machinery ---------------------------------------------------
     def _ensure_loaded(self):
         if self._fn is None:
@@ -94,7 +99,7 @@ class _BaseIngestMapper(Mapper):
                     and not self._supports_bf16):
                 raise AkUnsupportedOperationException(
                     f"{type(self).__name__} does not implement the bfloat16 "
-                    f"serving policy yet (the torch and ONNX ingests do); "
+                    f"serving policy yet (torch/ONNX/SavedModel do); "
                     f"remove precision or use one of those paths")
             self._load(self.get(HasIngestParams.MODEL_PATH))
 
@@ -296,9 +301,7 @@ class OnnxModelMapper(_BaseIngestMapper, HasIngestParams):
     def _load(self, path: str):
         from ...onnx import OnnxModel, OnnxToJax
 
-        prec = self.get(HasIngestParams.PRECISION)
-        conv = OnnxToJax(OnnxModel.load(path),
-                         dtype=None if prec == "float32" else prec)
+        conv = OnnxToJax(OnnxModel.load(path), dtype=self._ingest_dtype())
         jfn = conv.jitted()
         self._in_names = conv.input_names
         self._out_info = []
@@ -326,9 +329,7 @@ class TorchModelMapper(_BaseIngestMapper, HasIngestParams):
     def _load(self, path: str):
         from ...onnx import load_torch_fn
 
-        prec = self.get(HasIngestParams.PRECISION)
-        jfn, conv = load_torch_fn(
-            path, dtype=None if prec == "float32" else prec)
+        jfn, conv = load_torch_fn(path, dtype=self._ingest_dtype())
         self._in_names = list(conv.user_inputs)
         out_info = []
         # output shapes from the exported graph's fake tensors
@@ -432,11 +433,14 @@ class TFSavedModelMapper(_BaseIngestMapper, HasIngestParams):
         "signatureDefKey", str, default="serving_default",
         aliases=("signatureDef",))
 
+    _supports_bf16 = True
+
     def _load(self, path: str):
         from ...onnx.tfsaved import load_saved_model_fn
 
         jfn, in_names, out_info = load_saved_model_fn(
-            path, self.get(self.SIGNATURE_DEF_KEY))
+            path, self.get(self.SIGNATURE_DEF_KEY),
+            dtype=self._ingest_dtype())
         self._in_names = in_names
         self._out_info = out_info
         self._fn = jfn

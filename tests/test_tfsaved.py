@@ -117,3 +117,26 @@ def test_op_manifests_published():
     assert {"Conv2D", "MatMul", "FusedBatchNormV3", "Softmax"} <= set(tf_ops)
     assert {"Conv", "Gemm", "Relu", "MatMul"} <= set(onnx_ops)
     assert len(tf_ops) >= 80 and len(onnx_ops) >= 35
+
+
+def test_savedmodel_bfloat16_policy(mlp_path):
+    """precision="bfloat16" serves the frozen graph under the TPU-native
+    policy: outputs differ from fp32 (policy engaged) but agree closely."""
+    jfn32, _, _ = load_saved_model_fn(mlp_path)
+    jfn16, _, _ = load_saved_model_fn(mlp_path, dtype="bfloat16")
+    x = np.random.default_rng(4).random((6, 4), dtype=np.float32)
+    o32 = np.asarray(jfn32(x)[0])
+    o16 = np.asarray(jfn16(x)[0])
+    assert o16.dtype == np.float32
+    np.testing.assert_allclose(o16, o32, atol=0.03)
+    assert not np.array_equal(o16, o32)
+
+    # through the op
+    rng = np.random.default_rng(5)
+    vecs = [DenseVector(rng.random(4)) for _ in range(5)]
+    t = MTable.from_rows([(v,) for v in vecs], "features DENSE_VECTOR")
+    out = MemSourceBatchOp.from_table(t).link(TFSavedModelPredictBatchOp(
+        modelPath=mlp_path, selectedCols=["features"], outputCols=["p"],
+        precision="bfloat16", predictBatchSize=4)).collect()
+    probs = np.stack([np.asarray(p) for p in out.col("p")])
+    np.testing.assert_allclose(probs.sum(axis=1), 1.0, atol=0.02)
