@@ -173,6 +173,11 @@ def open_catalog(url_or_path: str, connection: Any = None):
             "common/io/catalog/OdpsCatalog.java); it is not available in "
             "this environment — stage the table as CSV/Parquet or use the "
             "sqlite/hive catalog instead")
+    if url_or_path.startswith("datahub://"):
+        raise AkPluginNotExistException(
+            "datahub:// catalogs need the 'pydatahub' package (reference: "
+            "connectors/connector-datahub); it is not available in this "
+            "environment — use the Kafka connector for streaming buses")
     from ..operator.sqlengine import SqliteCatalog
 
     return SqliteCatalog(url_or_path)

@@ -93,20 +93,14 @@ class OnnxToJax:
     def jitted(self) -> Callable[..., Dict[str, Any]]:
         import jax
 
+        from .precision import wrap_named, wrap_pinned_named
+
         fn = self.function()
         if self.dtype is not None:
-            from .precision import wrap_named
-
             return wrap_named(fn, self.dtype)
-
         # foreign models carry f32 semantics: pin full-precision matmuls so
-        # TPU results match the source runtime (ONNX Runtime / torch CPU);
-        # callers wanting bf16 speed can re-trace under their own context
-        def wrapped(**inputs):
-            with jax.default_matmul_precision("highest"):
-                return fn(**inputs)
-
-        return jax.jit(wrapped)
+        # TPU results match the source runtime (ONNX Runtime / torch CPU)
+        return wrap_pinned_named(fn)
 
 
 def load_onnx_fn(path: str) -> Tuple[Callable, OnnxToJax]:

@@ -13,13 +13,15 @@ import numpy as np
 
 
 def resolve_dtype(dtype) -> Optional[Any]:
-    """None -> None; anything else -> a dtype (jnp.dtype resolves
-    'bfloat16' through ml_dtypes)."""
+    """None or an explicit fp32 request -> None (the fp32 parity path,
+    which pins full-precision matmuls); anything else -> a dtype
+    (jnp.dtype resolves 'bfloat16' through ml_dtypes)."""
     if dtype is None:
         return None
     import jax.numpy as jnp
 
-    return jnp.dtype(dtype)
+    dt = jnp.dtype(dtype)
+    return None if dt == jnp.float32 else dt
 
 
 def cast_float_state(state: Dict[str, np.ndarray], dtype) -> Dict[str, Any]:
@@ -62,5 +64,28 @@ def wrap_named(fn, dtype):
         return {k: (v.astype(jnp.float32)
                     if jnp.issubdtype(v.dtype, jnp.floating) else v)
                 for k, v in out.items()}
+
+    return jax.jit(wrapped)
+
+
+def wrap_pinned_positional(fn):
+    """jit-wrap a positional fn with the fp32 numerics-parity pin (full-
+    precision matmuls, so TPU results match the source runtime)."""
+    import jax
+
+    def wrapped(*args):
+        with jax.default_matmul_precision("highest"):
+            return fn(*args)
+
+    return jax.jit(wrapped)
+
+
+def wrap_pinned_named(fn):
+    """Named-argument twin of :func:`wrap_pinned_positional`."""
+    import jax
+
+    def wrapped(**inputs):
+        with jax.default_matmul_precision("highest"):
+            return fn(**inputs)
 
     return jax.jit(wrapped)

@@ -472,20 +472,13 @@ def load_saved_model_fn(path: str, signature: str = "serving_default",
 
     import jax
 
-    if conv.dtype is not None:
-        from .precision import wrap_positional
+    from .precision import wrap_pinned_positional, wrap_positional
 
+    if conv.dtype is not None:
         jfn = wrap_positional(conv.jax_fn(), conv.dtype)
     else:
-        # fp32 numerics parity vs the TF reference: pin full-precision
-        # matmuls (same contract as the torch/ONNX fp32 paths)
-        fn = conv.jax_fn()
-
-        def _pinned(*args, _fn=fn):
-            with jax.default_matmul_precision("highest"):
-                return _fn(*args)
-
-        jfn = jax.jit(_pinned)
+        # fp32 numerics parity vs the TF reference
+        jfn = wrap_pinned_positional(conv.jax_fn())
 
     in_names = [t.name.split(":")[0] for t in frozen.inputs]
     # flat output order ↔ structured output names (TF flattens dicts sorted

@@ -106,19 +106,14 @@ class TorchToJax:
         import jax
 
         fn = self.function()
+        from .precision import wrap_pinned_positional, wrap_positional
+
         if self.dtype is not None:
             # bf16 policy: cast float inputs to the compute dtype, outputs
             # back to fp32; matmuls ride the MXU at native bf16
-            from .precision import wrap_positional
-
             return wrap_positional(fn, self.dtype)
-
         # pin f32 matmul precision — foreign-model numerics parity on TPU
-        def wrapped(*args):
-            with jax.default_matmul_precision("highest"):
-                return fn(*args)
-
-        return jax.jit(wrapped)
+        return wrap_pinned_positional(fn)
 
 
 def load_torch_fn(path_or_module, example_args: Optional[tuple] = None,

@@ -228,9 +228,11 @@ def test_catalog_routing(tmp_path):
 
     cat = open_catalog(str(tmp_path / "c.db"))
     assert isinstance(cat, SqliteCatalog)
-    # odps:// -> honest raise naming the driver
+    # odps:// / datahub:// -> honest raises naming the driver
     with pytest.raises(AkPluginNotExistException, match="pyodps"):
         open_catalog("odps://project/table")
+    with pytest.raises(AkPluginNotExistException, match="pydatahub"):
+        open_catalog("datahub://project/topic")
     # hive:// without pyhive -> honest raise naming the driver
     with pytest.raises(AkPluginNotExistException, match="pyhive"):
         open_catalog("hive://h:10000/db")
