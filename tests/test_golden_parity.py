@@ -495,3 +495,76 @@ def test_lookup_recent_days_model_map():
     assert s[0] == 140.0 and np.isnan(s[1]) and s[2] == 70.0
     v = np.asarray(out.col("visits_7d"))
     assert v[0] == 1400.0 and v[2] == 700.0
+
+
+# -- recommendation / similarity / finance (round-4 widening, part 2) --------
+
+
+def test_als_rate_recovery_golden():
+    """ALS on a noiseless block-structured rating matrix recovers the
+    pattern (reference fixture style: AlsTrainBatchOpTest)."""
+    from alink_tpu.operator.batch import (AlsRateRecommBatchOp,
+                                          AlsTrainBatchOp)
+
+    users = np.repeat(np.arange(8), 6)
+    items = np.tile(np.arange(6), 8)
+    rates = np.where((users % 2) == (items % 2), 5.0, 1.0)
+    t = _src({"u": users.astype(np.int64), "i": items.astype(np.int64),
+              "r": rates})
+    m = AlsTrainBatchOp(userCol="u", itemCol="i", rateCol="r", rank=4,
+                        numIter=15, lambda_=0.01).link_from(t)
+    pred = AlsRateRecommBatchOp(userCol="u", itemCol="i",
+                                predictionCol="p").link_from(m, t).collect()
+    p = np.asarray(pred.col("p"))
+    assert float(np.mean(p[rates == 5.0])) > float(np.mean(p[rates == 1.0])) + 2.0
+
+
+def test_string_similarity_golden():
+    from alink_tpu.operator.batch import StringSimilarityPairwiseBatchOp
+
+    t = _src({"a": np.asarray(["kitten", "abc"], object),
+              "b": np.asarray(["sitting", "abc"], object)})
+    out = StringSimilarityPairwiseBatchOp(
+        selectedCols=["a", "b"], metric="LEVENSHTEIN",
+        outputCol="d").link_from(t).collect()
+    d = np.asarray(out.col("d"))
+    assert d[0] == 3.0 and d[1] == 0.0  # classic kitten->sitting distance
+
+
+def test_word_count_golden():
+    from alink_tpu.operator.batch import WordCountBatchOp
+
+    out = WordCountBatchOp(selectedCol="t").link_from(
+        _src({"t": np.asarray(["b a b", "a b"], object)})).collect()
+    got = {str(w): int(c) for w, c in zip(out.col(out.names[0]),
+                                          out.col(out.names[1]))}
+    assert got == {"b": 3, "a": 2}
+
+
+def test_psi_golden():
+    """PSI of identical distributions is ~0 (reference: finance PSI)."""
+    from alink_tpu.operator.batch import PsiBatchOp
+
+    rng = np.random.default_rng(0)
+    base = rng.normal(size=1000)
+    t1 = _src({"score": base})
+    t2 = _src({"score": base + 1e-9})
+    out = PsiBatchOp(selectedCol="score").link_from(t1, t2).collect()
+    psi_col = [n for n in out.names if "psi" in n.lower()]
+    psi = float(np.asarray(out.col(psi_col[0] if psi_col
+                                   else out.names[-1]))[-1])
+    assert abs(psi) < 1e-3
+
+
+def test_index_to_string_roundtrip_golden():
+    from alink_tpu.operator.batch import (IndexToStringPredictBatchOp,
+                                          StringIndexerPredictBatchOp,
+                                          StringIndexerTrainBatchOp)
+
+    src = _src({"c": np.asarray(["x", "y", "z", "x"], object)})
+    m = StringIndexerTrainBatchOp(selectedCol="c").link_from(src)
+    idx = StringIndexerPredictBatchOp(
+        selectedCols=["c"], outputCols=["i"]).link_from(m, src)
+    back = IndexToStringPredictBatchOp(
+        selectedCol="i", outputCol="c2").link_from(m, idx).collect()
+    assert list(np.asarray(back.col("c2"))) == ["x", "y", "z", "x"]
