@@ -18,7 +18,8 @@ Public surface:
 - :mod:`tokenizer` — WordPiece-style tokenizer with corpus-built vocab
 """
 
-from .attention import full_attention, ring_attention
+from .attention import (blockwise_attention, full_attention,
+                        ring_attention)
 from .modules import BertConfig, TransformerEncoder, KerasSequential, parse_layers
 from .sharding import param_shardings, make_dl_mesh
 from .train import TrainConfig, train_model, predict_model
@@ -29,6 +30,7 @@ __all__ = [
     "TransformerEncoder",
     "KerasSequential",
     "parse_layers",
+    "blockwise_attention",
     "full_attention",
     "ring_attention",
     "param_shardings",

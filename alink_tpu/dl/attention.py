@@ -55,6 +55,78 @@ def full_attention(
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)
 
 
+def _online_softmax_update(o, m, l, s, v, p_dtype):
+    """One online-softmax accumulation step over a new score block ``s``
+    (B, H, Q, K) — shared by the ring and blockwise kernels so their
+    numerics cannot diverge. Accumulators o/m/l stay fp32."""
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    # guard fully-masked rows: exp(-inf - -inf) -> exp(0) must not fire
+    corr = jnp.exp(jnp.maximum(m - m_new, _NEG_INF))
+    p = jnp.exp(s - m_new[..., None])
+    l = l * corr + p.sum(axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(p_dtype), v)
+    o = o * corr.transpose(0, 2, 1)[..., None] + pv.astype(jnp.float32)
+    return o, m_new, l
+
+
+def blockwise_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mask: Optional[jax.Array] = None,
+    *,
+    block_size: int = 512,
+    causal: bool = False,
+) -> jax.Array:
+    """Memory-efficient attention: the (S, S) score matrix never
+    materializes — K/V are consumed in ``block_size`` chunks under a
+    ``lax.scan`` with the same online-softmax update ring attention uses
+    (block axis instead of device axis). The single-device long-context
+    complement to :func:`ring_attention`: O(S*block) live memory, fully
+    static shapes, XLA-schedulable.
+
+    q, k, v: (B, S, H, D); mask: (B, S) with 1 = valid key.
+    """
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    nb = -(-sk // block_size)
+    pad = nb * block_size - sk
+    if pad:
+        zpad = ((0, 0), (0, pad), (0, 0), (0, 0))
+        k = jnp.pad(k, zpad)
+        v = jnp.pad(v, zpad)
+    # padded keys are always masked off
+    kmask = jnp.ones((b, sk), jnp.int32) if mask is None else mask
+    kmask = jnp.pad(kmask, ((0, 0), (0, pad)))
+    kb = k.reshape(b, nb, block_size, h, d).transpose(1, 0, 2, 3, 4)
+    vb = v.reshape(b, nb, block_size, h, d).transpose(1, 0, 2, 3, 4)
+    mb = kmask.reshape(b, nb, block_size).transpose(1, 0, 2)
+
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    q_pos = jnp.arange(sq)
+
+    def step(carry, blk):
+        o, m, l = carry
+        kk, vv, mm, i = blk
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, kk).astype(jnp.float32) * scale
+        s = jnp.where(mm[:, None, None, :] > 0, s, _NEG_INF)
+        if causal:
+            k_pos = i * block_size + jnp.arange(block_size)
+            s = jnp.where(
+                q_pos[None, None, :, None] >= k_pos[None, None, None, :],
+                s, _NEG_INF)
+        o, m, l = _online_softmax_update(o, m, l, s, vv, q.dtype)
+        return (o, m, l), None
+
+    o0 = jnp.zeros((b, sq, h, d), jnp.float32)
+    m0 = jnp.full((b, h, sq), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, sq), jnp.float32)
+    (o, m, l), _ = jax.lax.scan(
+        step, (o0, m0, l0), (kb, vb, mb, jnp.arange(nb)))
+    l = jnp.maximum(l, 1e-30)
+    return (o / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
+
+
 def _ring_body(q, k, v, mask, axis_name: str, causal: bool):
     """Manual kernel: local q against the rotating ring of k/v shards."""
     n = jax.lax.axis_size(axis_name)
@@ -85,18 +157,12 @@ def _ring_body(q, k, v, mask, axis_name: str, causal: bool):
             k_pos = src * sk + jnp.arange(sk)
             s = jnp.where(q_pos[None, None, :, None] >= k_pos[None, None, None, :],
                           s, _NEG_INF)
-        m_new = jnp.maximum(m, s.max(axis=-1))
-        # guard fully-masked rows: exp(-inf - -inf) -> exp(0) must not fire
-        corr = jnp.exp(jnp.maximum(m - m_new, _NEG_INF))
-        p = jnp.exp(s - m_new[..., None])
-        l = l * corr + p.sum(axis=-1)
-        pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(q.dtype), v).astype(jnp.float32)
-        o = o * corr.transpose(0, 2, 1)[..., None] + pv
+        o, m, l = _online_softmax_update(o, m, l, s, v, q.dtype)
         k = jax.lax.ppermute(k, axis_name, perm)
         v = jax.lax.ppermute(v, axis_name, perm)
         if kmask is not None:
             kmask = jax.lax.ppermute(kmask, axis_name, perm)
-        return o, m_new, l, k, v, kmask
+        return o, m, l, k, v, kmask
 
     o, m, l, *_ = jax.lax.fori_loop(0, n, step, (o0, m0, l0, k, v, mask))
     l = jnp.maximum(l, 1e-30)

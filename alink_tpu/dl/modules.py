@@ -27,7 +27,7 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from ..common.exceptions import AkIllegalArgumentException
-from .attention import full_attention, ring_attention
+from .attention import blockwise_attention, full_attention, ring_attention
 
 
 @dataclass(frozen=True)
@@ -49,6 +49,10 @@ class BertConfig:
     # "cls": first-token pooling, matching the pretrained BERT pooler
     # (reference checkpoints are trained with NSP on the CLS slot)
     pool: str = "mean"
+    # >0: single-device memory-efficient attention — K/V consumed in blocks
+    # of this size under an online softmax, so the (S, S) score matrix never
+    # materializes (long-context on one chip; composes with remat)
+    attention_block_size: int = 0
 
     @staticmethod
     def base(**kw) -> "BertConfig":
@@ -76,6 +80,9 @@ class SelfAttention(nn.Module):
         ]
         if c.use_ring_attention and self.mesh is not None:
             o = ring_attention(q, k, v, mask, mesh=self.mesh)
+        elif c.attention_block_size:
+            o = blockwise_attention(q, k, v, mask,
+                                    block_size=c.attention_block_size)
         else:
             o = full_attention(q, k, v, mask)
         o = o.reshape(x.shape[0], x.shape[1], h * d)
