@@ -5,6 +5,27 @@ import json
 import os
 
 
+def _run_nb(path, capsys=None):
+    with open(path) as f:
+        nb = json.load(f)
+    code_cells = ["\n".join(c["source"]) for c in nb["cells"]
+                  if c["cell_type"] == "code"]
+    assert len(code_cells) >= 3
+    cwd = os.getcwd()
+    os.chdir(os.path.dirname(path))
+    try:
+        ns: dict = {}
+        for i, src in enumerate(code_cells):
+            exec(compile(src, f"cell-{i}", "exec"), ns)  # noqa: S102
+    finally:
+        os.chdir(cwd)
+
+
+def test_online_learning_notebook_runs():
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    _run_nb(os.path.join(root, "examples", "online_learning.ipynb"))
+
+
 def test_quickstart_notebook_runs(capsys):
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     path = os.path.join(root, "examples", "quickstart.ipynb")
