@@ -14,7 +14,9 @@ the reference itself publishes no numbers ("published": {}).
 - #2 softmax_mnist: SoftmaxTrainBatchOp (L-BFGS, one compiled program) on
   MNIST-shaped data (784 features, 10 classes) — samples/sec + accuracy.
 - #3 resnet50_predict: ResNet-50 (defined in torch, ingested via
-  torch.export -> StableHLO -> jit) batch inference rows/sec.
+  torch.export -> StableHLO -> jit) batch inference rows/sec;
+  resnet50_savedmodel is the metric-of-record TF SavedModel path
+  (SavedModelBundle replacement), on-device rows/sec at bf16 + fp32.
 - #5 torch_stream_predict: TorchModelPredictStreamOp rows/sec on a micro-
   batch stream.
 - gbdt_train: histogram GBDT training throughput (riskiest perf item).
@@ -26,6 +28,7 @@ the reference itself publishes no numbers ("published": {}).
 from __future__ import annotations
 
 import json
+import os
 import time
 
 import numpy as np
@@ -357,6 +360,40 @@ def bench_resnet50(batch=256, steps=4):
             "batch": batch}
 
 
+def bench_resnet50_savedmodel(batch=128, steps=8):
+    """#3's metric-of-record path verbatim: a TF SavedModel ResNet-50
+    compiled to ONE XLA program (the SavedModelBundle replacement,
+    reference: predictor-tf TFPredictorServiceImpl.java:139). On-device
+    rows/sec at both precisions; numerics vs TF are pinned by
+    tests/test_tfsaved.py. Requires tensorflow at load time only."""
+    import tempfile
+
+    import jax
+    import tensorflow as tf
+
+    from alink_tpu.onnx.tfsaved import load_saved_model_fn
+
+    model = tf.keras.applications.ResNet50(weights=None)
+    d = os.path.join(tempfile.mkdtemp(), "rn50")
+    tf.saved_model.save(model, d)
+    x = np.random.RandomState(0).rand(batch, 224, 224, 3).astype(np.float32)
+
+    def time_fn(jfn, reps=steps):
+        xd = jax.device_put(x)
+        np.asarray(jfn(xd)[0][:1, :1])  # compile + real sync
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = jfn(xd)
+        _ = np.asarray(out[0][:1, :1])
+        return batch * reps / (time.perf_counter() - t0)
+
+    jfn16, _, _ = load_saved_model_fn(d, dtype="bfloat16")
+    jfn32, _, _ = load_saved_model_fn(d)
+    return {"rows_per_sec_on_device": round(time_fn(jfn16), 1),
+            "rows_per_sec_on_device_fp32": round(time_fn(jfn32), 1),
+            "batch": batch}
+
+
 def bench_torch_stream(rows=16384):
     """#5: Torch model predict through the stream op, rows/sec. Micro-batches
     are pipelined (dispatch-ahead in MapStreamOp, one device round trip per
@@ -472,6 +509,7 @@ def main():
         ("gbdt_train", bench_gbdt),
         ("torch_stream_predict", bench_torch_stream),
         ("resnet50_predict", bench_resnet50),
+        ("resnet50_savedmodel", bench_resnet50_savedmodel),
         ("bert_text_quality", bench_bert_quality),
     ):
         try:
