@@ -413,7 +413,6 @@ def bench_torch_stream(rows=16384):
     model = nn.Sequential(nn.Linear(16, 64), nn.ReLU(),
                           nn.Linear(64, 1)).eval()
     ep = torch.export.export(model, (torch.randn(4, 16),))
-    import os
     path = os.path.join(tempfile.mkdtemp(), "m.pt2")
     torch.export.save(ep, path)
 
