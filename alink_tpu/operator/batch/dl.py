@@ -226,6 +226,11 @@ class BaseBertTextTrainBatchOp(ModelTrainOpMixin, BatchOperator, HasDLTrainParam
     )
     SEQ_SHARDS = ParamInfo("seqShards", int, default=1,
                            desc="sequence-parallel shards (ring attention)")
+    ATTENTION_BLOCK_SIZE = ParamInfo(
+        "attentionBlockSize", int, default=0, validator=MinValidator(0),
+        desc="0 = full attention; >0 = single-device memory-efficient "
+             "blockwise attention with this K/V block (long documents "
+             "beyond the reference's 512-token ceiling)")
     # pretrained ingest (reference: HasBertModelName + BertResources.java;
     # checkpoint consumed by BaseEasyTransferTrainBatchOp.java)
     BERT_MODEL_NAME = ParamInfo(
@@ -258,6 +263,7 @@ class BaseBertTextTrainBatchOp(ModelTrainOpMixin, BatchOperator, HasDLTrainParam
             num_labels=num_labels,
             regression=self._regression,
             use_ring_attention=self.get(self.SEQ_SHARDS) > 1,
+            attention_block_size=self.get(self.ATTENTION_BLOCK_SIZE),
         )
         if size == "base":
             return BertConfig.base(**common)
@@ -330,6 +336,7 @@ class BaseBertTextTrainBatchOp(ModelTrainOpMixin, BatchOperator, HasDLTrainParam
                 num_labels=num_labels, regression=self._regression,
                 pool="cls", dropout=0.1,
                 use_ring_attention=self.get(self.SEQ_SHARDS) > 1,
+                attention_block_size=self.get(self.ATTENTION_BLOCK_SIZE),
                 **ckpt_cfg)
         else:
             tok = Tokenizer.build(

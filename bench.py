@@ -158,8 +158,6 @@ def bench_softmax_mnist():
     the REAL handwritten-digits dataset (data/digits.csv, 1797 x 64,
     sklearn's UCI digits — the checked-in MNIST stand-in), train/test split
     so the number carries signal."""
-    import os
-
     from alink_tpu.operator.batch import (SoftmaxPredictBatchOp,
                                           SoftmaxTrainBatchOp)
     from alink_tpu.common.mtable import MTable

@@ -219,3 +219,35 @@ def test_long_context_blockwise_encoder():
     params = model.init(jax.random.PRNGKey(0), ids)
     logits = model.apply(params, ids)
     assert np.all(np.isfinite(np.asarray(logits)))
+
+
+def test_bert_op_blockwise_long_text():
+    """attentionBlockSize on the op: a >512-token document trains and
+    serves — past the reference's HasMaxSeqLength ceiling."""
+    from alink_tpu.common.mtable import MTable
+    from alink_tpu.operator.batch.base import TableSourceBatchOp
+    from alink_tpu.operator.batch.dl import (
+        BertTextClassifierPredictBatchOp, BertTextClassifierTrainBatchOp)
+
+    rng = np.random.default_rng(0)
+    texts, labels = [], []
+    for i in range(32):
+        y = i % 2
+        word = "good" if y else "bad"
+        words = ["the"] * 450 + [word] * 150
+        rng.shuffle(words)
+        texts.append(" ".join(words))
+        labels.append(y)
+    t = MTable({"text": texts, "label": np.asarray(labels, np.int64)})
+    src = TableSourceBatchOp(t)
+    m = BertTextClassifierTrainBatchOp(
+        textCol="text", labelCol="label", maxSeqLength=768,
+        vocabSize=64, hiddenSize=32, numLayers=1, numHeads=2,
+        intermediateSize=64, attentionBlockSize=128,
+        numEpochs=12, batchSize=8, learningRate=3e-3,
+    ).link_from(src)
+    pred = BertTextClassifierPredictBatchOp(
+        predictionCol="p").link_from(m, src).collect()
+    acc = float((np.asarray(pred.col("p"))
+                 == np.asarray(labels)).mean())
+    assert acc >= 0.9, acc
