@@ -88,11 +88,11 @@ def bench_bert():
     # delta between two run lengths cancels dispatch/sync overhead; taking the
     # per-length minimum over trials rejects interference independently for
     # each length (a plain min-of-deltas would select corrupted trials).
-    # 5 trials: the tunneled chip is shared, and midday contention showed
+    # 4 trials: the tunneled chip is shared, and midday contention showed
     # ~20% swings that 3 trials let through
     eff_steps = TIMED_STEPS - TIMED_STEPS // 3
-    t_hi = min(run(TIMED_STEPS) for _ in range(5))
-    t_lo = min(run(TIMED_STEPS // 3) for _ in range(5))
+    t_hi = min(run(TIMED_STEPS) for _ in range(4))
+    t_lo = min(run(TIMED_STEPS // 3) for _ in range(4))
     dt = max(t_hi - t_lo, 1e-9)
 
     samples_per_sec = batch * eff_steps / dt
@@ -267,7 +267,7 @@ def _resnet50_torch():
     return ResNet50().eval()
 
 
-def bench_resnet50(batch=256, steps=4):
+def bench_resnet50(batch=256, steps=3):
     """#3: ResNet-50 batch inference rows/sec through the torch.export ->
     StableHLO ingest path (the SavedModelBundle analog on TPU). The e2e path
     models the real serving pipeline: decoded images are uint8 NHWC on the
@@ -362,8 +362,10 @@ def bench_resnet50_savedmodel(batch=128, steps=8):
     """#3's metric-of-record path verbatim: a TF SavedModel ResNet-50
     compiled to ONE XLA program (the SavedModelBundle replacement,
     reference: predictor-tf TFPredictorServiceImpl.java:139). On-device
-    rows/sec at both precisions; numerics vs TF are pinned by
-    tests/test_tfsaved.py. Requires tensorflow at load time only."""
+    bf16 rows/sec (the serving policy; the fp32 figure lives in
+    resnet50_predict, numerics vs TF are pinned by tests/test_tfsaved.py).
+    Keras build + freeze + compile dominate the wall — one precision keeps
+    the bench inside the driver's window."""
     import tempfile
 
     import jax
@@ -386,9 +388,7 @@ def bench_resnet50_savedmodel(batch=128, steps=8):
         return batch * reps / (time.perf_counter() - t0)
 
     jfn16, _, _ = load_saved_model_fn(d, dtype="bfloat16")
-    jfn32, _, _ = load_saved_model_fn(d)
     return {"rows_per_sec_on_device": round(time_fn(jfn16), 1),
-            "rows_per_sec_on_device_fp32": round(time_fn(jfn32), 1),
             "batch": batch}
 
 
