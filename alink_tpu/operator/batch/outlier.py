@@ -354,7 +354,22 @@ class EvalOutlierBatchOp(BatchOperator):
         y = np.asarray(
             [str(v) in pos_vals for v in t.col(self.get(self.LABEL_COL))]
         )
-        pred = np.asarray(t.col(self.get(self.PREDICTION_COL))).astype(bool)
+        raw_pred = t.col(self.get(self.PREDICTION_COL))
+
+        def _flag(v):
+            # bool/numeric predictions are truth-valued; strings carry the
+            # label domain and go through the outlier value set (a bare
+            # .astype(bool) made every non-empty string an outlier).
+            # Per-element dispatch so object-dtype columns mixing bools/
+            # ints/None keep their truth-value semantics
+            if v is None:
+                return False
+            if isinstance(v, (bool, np.bool_, int, float,
+                              np.integer, np.floating)):
+                return bool(v)
+            return str(v) in pos_vals
+
+        pred = np.asarray([_flag(v) for v in raw_pred])
         tp = int((pred & y).sum())
         fp = int((pred & ~y).sum())
         fn = int((~pred & y).sum())

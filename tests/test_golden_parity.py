@@ -568,3 +568,72 @@ def test_index_to_string_roundtrip_golden():
     back = IndexToStringPredictBatchOp(
         selectedCol="i", outputCol="c2").link_from(m, idx).collect()
     assert list(np.asarray(back.col("c2"))) == ["x", "y", "z", "x"]
+
+
+# -- eval / timeseries / text-vectorizer (round-4 widening, part 3) ----------
+
+
+def test_eval_ranking_golden():
+    """Perfect rankings score 1.0 on every available metric (reference:
+    ranking eval)."""
+    from alink_tpu.operator.batch import EvalRankingBatchOp
+
+    lab = np.asarray(['["a","b"]', '["c"]'], object)
+    pred = np.asarray(['["a","b"]', '["c"]'], object)
+    m = EvalRankingBatchOp(labelCol="l", predictionCol="p").link_from(
+        _src({"l": lab, "p": pred})).collect_metrics()
+    for key in ("precisionAtK", "recallAtK", "ndcg", "map", "hitRate"):
+        np.testing.assert_allclose(float(m.get(key)), 1.0, atol=1e-9,
+                                   err_msg=key)
+
+
+def test_arima_linear_trend_golden():
+    """ARIMA(0,1,0) on y_t = 2t (pure drift) forecasts the next steps by
+    continuing the constant difference."""
+    from alink_tpu.operator.batch import ArimaBatchOp
+
+    n = 40
+    vals = 2.0 * np.arange(n) + 3.0
+    out = ArimaBatchOp(valueCol="v", order=[0, 1, 0],
+                       predictNum=3).link_from(
+        _src({"v": vals})).collect()
+    pcol = [c for c in out.names if c not in ("v",)][0]
+    pred = out.col(pcol)
+    flat = np.asarray(pred[0].data if hasattr(pred[0], "data") else pred[0],
+                      float).ravel()[:3]
+    want = 2.0 * (np.arange(3) + n) + 3.0
+    np.testing.assert_allclose(flat, want, rtol=0.02)
+
+
+def test_doc_count_vectorizer_golden():
+    from alink_tpu.operator.batch import (DocCountVectorizerPredictBatchOp,
+                                          DocCountVectorizerTrainBatchOp)
+
+    src = _src({"t": np.asarray(["a b a", "b c"], object)})
+    m = DocCountVectorizerTrainBatchOp(selectedCol="t",
+                                       featureType="WORD_COUNT").link_from(src)
+    out = DocCountVectorizerPredictBatchOp(
+        selectedCol="t", outputCol="v").link_from(m, src).collect()
+    v0 = out.col("v")[0]
+    # doc "a b a": counts {a: 2, b: 1} in some vocab order
+    arr = np.asarray(v0.to_dense().data if hasattr(v0, "to_dense")
+                     else (v0.data if hasattr(v0, "data") else v0), float)
+    assert sorted(arr[arr > 0].tolist()) == [1.0, 2.0]
+
+
+def test_eval_outlier_golden():
+    from alink_tpu.operator.batch import EvalOutlierBatchOp
+
+    y = np.asarray(["in", "in", "out", "out"], object)
+    p = np.asarray(["in", "out", "out", "out"], object)  # 1 FP
+    m = EvalOutlierBatchOp(
+        labelCol="y", predictionCol="p",
+        outlierValueStrings=["out"]).link_from(
+        _src({"y": y, "p": p})).collect_metrics()
+    # recall of the outlier class is 2/2; precision 2/3 — this fixture
+    # caught the string-prediction .astype(bool) bug (everything counted
+    # as an outlier, precision 0.5)
+    np.testing.assert_allclose(float(m.get("Recall")), 1.0, atol=1e-9)
+    np.testing.assert_allclose(float(m.get("Precision")), 2.0 / 3.0,
+                               atol=1e-9)
+    np.testing.assert_allclose(float(m.get("F1")), 0.8, atol=1e-9)
