@@ -637,3 +637,67 @@ def test_eval_outlier_golden():
     np.testing.assert_allclose(float(m.get("Precision")), 2.0 / 3.0,
                                atol=1e-9)
     np.testing.assert_allclose(float(m.get("F1")), 0.8, atol=1e-9)
+
+
+# -- stats / feature determinism (round-4 widening, part 4) ------------------
+
+
+def test_spearman_correlation_golden():
+    """Monotone nonlinear relation: Pearson < 1 but Spearman == 1."""
+    from alink_tpu.operator.batch import CorrelationBatchOp
+
+    x = np.array([1.0, 2.0, 3.0, 4.0, 5.0])
+    y = np.exp(x)  # monotone, nonlinear
+    m = CorrelationBatchOp(selectedCols=["a", "b"],
+                           method="SPEARMAN").link_from(
+        _src({"a": x, "b": y})).collect_correlation()
+    mat = np.asarray(m.correlation_matrix
+                     if hasattr(m, "correlation_matrix") else m)
+    np.testing.assert_allclose(mat, 1.0, atol=1e-9)
+    p = CorrelationBatchOp(selectedCols=["a", "b"],
+                           method="PEARSON").link_from(
+        _src({"a": x, "b": y})).collect_correlation()
+    pm = np.asarray(p.correlation_matrix
+                    if hasattr(p, "correlation_matrix") else p)
+    assert pm[0, 1] < 0.95  # nonlinearity visibly lowers Pearson
+
+
+def test_quantile_golden():
+    from alink_tpu.operator.batch import QuantileBatchOp
+
+    out = QuantileBatchOp(selectedCols=["f"], quantileNum=4).link_from(
+        _src({"f": np.arange(0.0, 101.0)})).collect()
+    vals = sorted(float(v) for v in np.asarray(out.col(out.names[-1])))
+    # quartiles of 0..100
+    np.testing.assert_allclose(vals, [0.0, 25.0, 50.0, 75.0, 100.0],
+                               atol=1.0)
+
+
+def test_feature_hasher_deterministic_golden():
+    """Same input -> same hashed vector; different rows with equal values
+    collide exactly (pure function of the row values)."""
+    from alink_tpu.operator.batch import FeatureHasherBatchOp
+
+    t = _src({"c": np.asarray(["x", "y", "x"], object),
+              "n": np.array([1.0, 2.0, 1.0])})
+    out = FeatureHasherBatchOp(
+        selectedCols=["c", "n"], numFeatures=64,
+        outputCol="v").link_from(t).collect()
+    vs = [str(v) for v in out.col("v")]
+    assert vs[0] == vs[2] and vs[0] != vs[1]
+
+
+def test_gmm_separates_blobs_golden():
+    from alink_tpu.operator.batch import (GmmPredictBatchOp,
+                                          GmmTrainBatchOp)
+
+    rng = np.random.default_rng(0)
+    a = np.concatenate([rng.normal(0, 0.2, 30), rng.normal(6, 0.2, 30)])
+    b = np.concatenate([rng.normal(0, 0.2, 30), rng.normal(6, 0.2, 30)])
+    src = _src({"a": a, "b": b})
+    m = GmmTrainBatchOp(k=2, featureCols=["a", "b"],
+                        maxIter=30).link_from(src)
+    out = GmmPredictBatchOp(predictionCol="c").link_from(m, src).collect()
+    c = np.asarray(out.col("c"))
+    assert len(set(c[:30])) == 1 and len(set(c[30:])) == 1
+    assert c[0] != c[30]
