@@ -701,3 +701,58 @@ def test_gmm_separates_blobs_golden():
     c = np.asarray(out.col("c"))
     assert len(set(c[:30])) == 1 and len(set(c[30:])) == 1
     assert c[0] != c[30]
+
+
+# -- format round-trips / tf-idf / hop windows (round-4 widening, part 5) ----
+
+
+def test_columns_json_roundtrip_golden():
+    from alink_tpu.operator.batch import (ColumnsToJsonBatchOp,
+                                          JsonToColumnsBatchOp)
+
+    t = _src({"a": np.array([1.5, 2.5]),
+              "b": np.asarray(["x", "y"], object)})
+    j = ColumnsToJsonBatchOp(jsonCol="j", selectedCols=["a", "b"],
+                             reservedCols=[]).link_from(t)
+    back = JsonToColumnsBatchOp(
+        jsonCol="j", schemaStr="a double, b string",
+        reservedCols=[]).link_from(j).collect()
+    np.testing.assert_allclose(np.asarray(back.col("a")), [1.5, 2.5])
+    assert list(np.asarray(back.col("b"))) == ["x", "y"]
+
+
+def test_tfidf_golden():
+    """Word present in every doc gets IDF contribution log(...)=smallest;
+    the classic tf-idf ordering holds."""
+    from alink_tpu.operator.batch import DocWordCountBatchOp, TfidfBatchOp
+
+    t = _src({"id": np.asarray([0, 1], np.int64),
+              "txt": np.asarray(["common rare", "common"], object)})
+    wc = DocWordCountBatchOp(docIdCol="id", contentCol="txt").link_from(t)
+    out = TfidfBatchOp(docIdCol="docId", wordCol="word",
+                       countCol="cnt").link_from(wc).collect()
+    rows = {(int(r[list(out.names).index("docId")]),
+             str(r[list(out.names).index("word")])): r
+            for r in out.rows()}
+    tfidf_col = [n for n in out.names if "tfidf" in n.lower()][0]
+    i = list(out.names).index(tfidf_col)
+    # "rare" (doc 0) must out-score "common" (doc 0)
+    assert rows[(0, "rare")][i] > rows[(0, "common")][i]
+
+
+def test_hop_window_golden():
+    """Hop windows of size 10 sliding by 5: each event lands in two
+    windows; sums per window are exact."""
+    from alink_tpu.common.mtable import MTable as MT
+    from alink_tpu.operator.stream import HopTimeWindowStreamOp
+    from alink_tpu.operator.stream.base import TableSourceStreamOp
+
+    ts = np.asarray([1.0, 6.0, 11.0])
+    v = np.asarray([1.0, 10.0, 100.0])
+    out = HopTimeWindowStreamOp(
+        timeCol="ts", windowTime=10, hopTime=5,
+        clause="SUM(v) AS total").link_from(
+        TableSourceStreamOp(MT({"ts": ts, "v": v}), chunkSize=3)).collect()
+    totals = sorted(np.asarray(out.col("total")))
+    # windows: [-5,5): 1 ; [0,10): 11 ; [5,15): 110 ; [10,20): 100
+    assert totals == [1.0, 11.0, 100.0, 110.0]
