@@ -756,3 +756,36 @@ def test_hop_window_golden():
     totals = sorted(np.asarray(out.col("total")))
     # windows: [-5,5): 1 ; [0,10): 11 ; [5,15): 110 ; [10,20): 100
     assert totals == [1.0, 11.0, 100.0, 110.0]
+
+
+def test_session_window_golden():
+    """Session windows with gap 5: events within the gap merge, a larger
+    silence starts a new session."""
+    from alink_tpu.common.mtable import MTable as MT
+    from alink_tpu.operator.stream import SessionTimeWindowStreamOp
+    from alink_tpu.operator.stream.base import TableSourceStreamOp
+
+    ts = np.asarray([0.0, 2.0, 4.0, 20.0, 22.0])
+    v = np.asarray([1.0, 1.0, 1.0, 10.0, 10.0])
+    out = SessionTimeWindowStreamOp(
+        timeCol="ts", sessionGapTime=5,
+        clause="SUM(v) AS total").link_from(
+        TableSourceStreamOp(MT({"ts": ts, "v": v}), chunkSize=5)).collect()
+    totals = sorted(np.asarray(out.col("total")))
+    assert totals == [3.0, 20.0]
+
+
+def test_over_count_window_golden():
+    """Trailing count window of 2: each row sees the sum of itself and the
+    previous row."""
+    from alink_tpu.common.mtable import MTable as MT
+    from alink_tpu.operator.stream import OverCountWindowStreamOp
+    from alink_tpu.operator.stream.base import TableSourceStreamOp
+
+    v = np.asarray([1.0, 2.0, 4.0, 8.0])
+    out = OverCountWindowStreamOp(
+        selectedCol="v", windowSize=2, agg="sum",
+        outputCol="s").link_from(
+        TableSourceStreamOp(MT({"v": v}), chunkSize=4)).collect()
+    s = list(np.asarray(out.col("s")))
+    assert s == [1.0, 3.0, 6.0, 12.0]
