@@ -55,6 +55,21 @@ class AlinkGlobalConfiguration:
         # kept for API parity; identifies the execution substrate instead
         return "jax-xla"
 
+    _wire_precision = "auto"
+
+    @classmethod
+    def get_wire_precision(cls) -> str:
+        """Host->device wire policy for float blocks: "auto" (bf16 above a
+        size threshold), "bf16" (always), or "fp32" (never downcast)."""
+        return cls._wire_precision
+
+    @classmethod
+    def set_wire_precision(cls, p: str):
+        if p not in ("auto", "bf16", "fp32"):
+            raise AkIllegalArgumentException(
+                f"wire precision must be auto|bf16|fp32, got {p!r}")
+        cls._wire_precision = p
+
 
 _cache_enabled = False
 

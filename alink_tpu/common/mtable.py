@@ -358,7 +358,17 @@ class MTable:
     ) -> np.ndarray:
         """Gather numeric + vector columns into one dense ``(n, d)`` block.
         Vector columns expand to their (padded) width; this is the host-side
-        staging step before a single host→device transfer."""
+        staging step before a single host→device transfer. Memoized per
+        instance (columns are immutable after construction), so repeated
+        jobs over the same table skip the concatenate."""
+        memo_key = (tuple(names), np.dtype(dtype).str, vector_size)
+        memo = getattr(self, "_block_memo", None)
+        if memo is None:
+            memo = {}
+            object.__setattr__(self, "_block_memo", memo)
+        cached = memo.get(memo_key)
+        if cached is not None:
+            return cached
         blocks = []
         for n in names:
             t = self.schema.type_of(n)
@@ -372,14 +382,27 @@ class MTable:
             else:
                 raise AkIllegalDataException(f"column {n!r} of type {t} is not numeric")
         if len(blocks) == 1:
-            return blocks[0]
-        return np.concatenate(blocks, axis=1)
+            # own the memoized buffer: the single-column path can alias the
+            # caller's source array, and an aliased memo would silently
+            # track external mutations the multi-column (copied) path won't
+            out = blocks[0]
+            if out.base is not None:  # reshape view over the source column
+                out = out.copy()
+        else:
+            out = np.concatenate(blocks, axis=1)
+        out.setflags(write=False)  # shared across jobs; mutators must copy
+        memo[memo_key] = out
+        return out
 
     def to_device(self, names: Sequence[str], dtype=np.float32, sharding=None):
         import jax
 
         block = self.to_numeric_block(names, dtype=dtype)
-        return jax.device_put(block, sharding) if sharding is not None else jax.device_put(block)
+        if sharding is None:
+            from .staging import stage_replicated
+
+            return stage_replicated(block)
+        return jax.device_put(block, sharding)
 
     def to_dataframe(self):
         import pandas as pd

@@ -58,13 +58,13 @@ class HBaseClient:
             self._conn = connection
         else:
             host = thrift_host
-            if host is None and zookeeper_quorum:
+            if not host and zookeeper_quorum:
                 # reference connects via zookeeper; the thrift gateway
                 # conventionally runs alongside the first quorum host
                 host = zookeeper_quorum.split(",")[0].split(":")[0]
-            if host is None:
+            if not host:
                 raise AkIllegalArgumentException(
-                    "HBase needs thriftHost or zookeeperQuorum")
+                    "HBase needs a non-empty thriftHost or zookeeperQuorum")
             factory = connection_factory or _default_connection
             self._conn = factory(host, thrift_port, timeout_ms)
 
@@ -140,6 +140,9 @@ class HBaseKvStore(KvStore):
                 if kv.startswith("family="):
                     family = kv.split("=", 1)[1]
             host, _, port = hostport.partition(":")
+            if not host:
+                raise AkIllegalArgumentException(
+                    f"hbase uri {uri!r} names no host")
             if not table:
                 raise AkIllegalArgumentException(
                     f"hbase uri {uri!r} names no table")

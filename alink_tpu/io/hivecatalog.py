@@ -7,8 +7,8 @@ CatalogSourceBatchOp/CatalogSinkBatchOp route by catalog object).
 
 Here the route key is the catalog URL scheme: ``hive://host:port/database``
 opens :class:`HiveCatalog` over HiveServer2 (plugin-gated on `pyhive`);
-``odps://`` raises naming `pyodps` (no driver in this image); plain paths
-stay on the built-in sqlite catalog. The adapter speaks the exact contract
+``odps://`` opens :class:`alink_tpu.io.odps.OdpsCatalog` (plugin-gated on
+`pyodps`); plain paths stay on the built-in sqlite catalog. The adapter speaks the exact contract
 ``SqliteCatalog`` does — list_tables / get_table_schema / read_table /
 write_table — so every catalog consumer (ops, WebUI, SQL engine) works
 against Hive unchanged. Tests inject a DB-API connection double via
@@ -168,16 +168,15 @@ def open_catalog(url_or_path: str, connection: Any = None):
     if url_or_path.startswith("hive://"):
         return HiveCatalog.from_url(url_or_path, connection=connection)
     if url_or_path.startswith("odps://"):
-        raise AkPluginNotExistException(
-            "odps:// catalogs need the 'pyodps' package (reference: "
-            "common/io/catalog/OdpsCatalog.java); it is not available in "
-            "this environment — stage the table as CSV/Parquet or use the "
-            "sqlite/hive catalog instead")
+        from .odps import OdpsCatalog
+
+        return OdpsCatalog.from_url(url_or_path, client=connection)
     if url_or_path.startswith("datahub://"):
         raise AkPluginNotExistException(
-            "datahub:// catalogs need the 'pydatahub' package (reference: "
-            "connectors/connector-datahub); it is not available in this "
-            "environment — use the Kafka connector for streaming buses")
+            "datahub:// is a streaming bus, not a table catalog — use "
+            "DatahubSourceStreamOp / DatahubSinkStreamOp (reference: "
+            "connectors/connector-datahub); the wire client is gated on "
+            "the 'pydatahub' package")
     from ..operator.sqlengine import SqliteCatalog
 
     return SqliteCatalog(url_or_path)

@@ -379,11 +379,16 @@ class LinearModelMapper(RichModelMapper):
                 else:
                     s = (blk.val[..., None] * w[blk.idx]).sum(axis=1)
                 return s + self.intercept
+        from ...common.staging import stage_replicated
+
         X = get_feature_block(
             t, merged, vector_size=self.meta["dim"],
-        ).astype(np.float32)
+        ).astype(np.float32, copy=False)
+        # content-cached device staging: re-predicting the same table does
+        # not re-push the feature block host->device
+        Xd = stage_replicated(X)
         return np.asarray(
-            jax.device_get(self._score_jit(X, self.weights, self.intercept))
+            jax.device_get(self._score_jit(Xd, self.weights, self.intercept))
         )
 
     def predict_proba_block(self, t: MTable):

@@ -19,6 +19,7 @@ loop over columnar slices feeding the same vectorized kernels.
 from __future__ import annotations
 
 import json
+import math
 from typing import Callable, Dict, List, Optional
 
 import numpy as np
@@ -364,6 +365,8 @@ class EvalOutlierBatchOp(BatchOperator):
             # ints/None keep their truth-value semantics
             if v is None:
                 return False
+            if isinstance(v, (float, np.floating)) and math.isnan(v):
+                return False  # missing prediction is not an outlier
             if isinstance(v, (bool, np.bool_, int, float,
                               np.integer, np.floating)):
                 return bool(v)

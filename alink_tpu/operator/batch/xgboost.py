@@ -8,9 +8,25 @@ XGBoostImpl.java, core side operator/common/tree/BaseXGBoostTrainBatchOp.java
 Re-design: the xgboost python package plays the plugin role; when absent the
 op raises with actionable guidance (exactly how the reference behaves with
 the plugin jar missing) and points at the TPU-native histogram GBDT
-(GbdtTrainBatchOp), which is the first-class boosted-tree path here. No
-Rabit tracker: single-process xgboost over the host data (the distributed
-boosted-tree path on TPU is the native GBDT)."""
+(GbdtTrainBatchOp), which is the first-class boosted-tree path here.
+
+Distributed boosting — the formal decision (closes the long-standing
+partial; see README "Distributed boosting"):
+
+- The FIRST-CLASS distributed boosted-tree path is the native histogram
+  GBDT (``tree/grow.py``): binned features are sharded over the mesh's
+  data axis and every histogram build is a ``psum`` over ICI inside one
+  compiled program. That is the same scatter/reduce the reference reaches
+  through Rabit's CPU-side allreduce (TrackerImpl.java:11-15 wrapping
+  ml.dmlc.xgboost4j RabitTracker), executed where this framework's data
+  already lives — on device, with XLA collectives. Re-introducing a
+  host-side Rabit ring would move training data off the mesh to host CPU
+  workers and forfeit both the MXU and ICI.
+- The xgboost bridge therefore stays single-process BY DESIGN (CPU
+  fidelity path: exact reference semantics, model interchange). For users
+  who need multi-worker xgboost itself, :class:`XGBoostTracker` exposes
+  the TrackerImpl-analog rendezvous over xgboost's own tracker, gated on
+  the plugin package exactly like the ops."""
 
 from __future__ import annotations
 
@@ -55,6 +71,55 @@ def _require_xgboost():
     except ImportError as e:
         raise AkUnsupportedOperationException(
             f"XGBoost bridge unavailable: {_GUIDANCE}") from e
+
+
+class XGBoostTracker:
+    """Multi-worker xgboost rendezvous (reference:
+    plugins/xgboost-bridge/.../TrackerImpl.java:11-15 — start a Rabit
+    tracker, hand each worker its env, join).
+
+    Wraps xgboost's own tracker (``xgboost.tracker.RabitTracker``) rather
+    than reimplementing the ring: the tracker is pure CPU-side
+    coordination, so the plugin's implementation is the correct one to
+    reuse. Plugin-gated like the ops; ``tracker_factory`` injects a double
+    for offline tests."""
+
+    def __init__(self, num_workers: int, host: str = "127.0.0.1",
+                 port: int = 0, tracker_factory=None):
+        self.num_workers = int(num_workers)
+        if tracker_factory is None:
+            xgb = _require_xgboost()
+            from xgboost.tracker import RabitTracker
+
+            def tracker_factory(host_ip, n_workers, port):
+                return RabitTracker(host_ip=host_ip, n_workers=n_workers,
+                                    port=port)
+        self._tracker = tracker_factory(host, self.num_workers, port)
+        self._started = False
+
+    def start(self) -> None:
+        self._tracker.start()
+        self._started = True
+
+    def worker_args(self) -> dict:
+        """The per-worker rendezvous env (dmlc tracker URI/port + world
+        size) each worker passes to ``xgboost.collective.init`` —
+        TrackerImpl.getWorkerEnvs analog."""
+        if not self._started:
+            raise AkUnsupportedOperationException(
+                "tracker not started; call start() first")
+        args = dict(self._tracker.worker_args())
+        args.setdefault("dmlc_num_worker", self.num_workers)
+        return args
+
+    def wait_for(self, timeout: Optional[int] = None) -> None:
+        self._tracker.wait_for(timeout) if timeout is not None \
+            else self._tracker.wait_for()
+
+    def stop(self) -> None:
+        free = getattr(self._tracker, "free", None)
+        if free:
+            free()
 
 
 class XGBoostTrainBatchOp(ModelTrainOpMixin, BatchOperator, HasVectorCol,

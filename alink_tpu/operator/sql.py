@@ -124,6 +124,12 @@ class SelectOp(AlgoOperator):
                     raise AkParseErrorException(f"bad select expression {clause!r}: {e}")
                 name = alias or expr
                 arr = np.asarray(series.to_numpy() if hasattr(series, "to_numpy") else series)
+                if arr.ndim == 0:
+                    # constant expression ('tag', 1+2): broadcast to n rows
+                    val = arr.item()
+                    arr = np.full(
+                        t.num_rows, val,
+                        dtype=object if isinstance(val, str) else None)
                 out_cols[name] = arr
                 out_names.append(name)
                 from ..common.mtable import _infer_type
@@ -381,6 +387,12 @@ class JoinOp(AlgoOperator):
                     return f"{col}_r"
                 return col
 
-            sel = re.sub(r"\b([ab])\.(\w+)", repl, self._select)
+            # tokenize around quoted literals so 'b.x' inside a string is
+            # never rewritten as a column qualifier
+            parts = re.split(r"('(?:[^']|'')*')", self._select)
+            sel = "".join(
+                p if i % 2 else re.sub(r"\b([ab])\.(\w+)", repl, p)
+                for i, p in enumerate(parts)
+            )
             return SelectOp(sel)._execute_impl(out)
         return out

@@ -49,6 +49,8 @@ from .sources import (
     TsvSourceStreamOp,
 )
 from .connectors import (
+    DatahubSinkStreamOp,
+    DatahubSourceStreamOp,
     GenerateFeatureOfWindowStreamOp,
     KafkaSinkStreamOp,
     KafkaSourceStreamOp,
@@ -90,6 +92,8 @@ __all__ = [
     "TFRecordSourceStreamOp",
     "TsvSinkStreamOp",
     "TsvSourceStreamOp",
+    "DatahubSinkStreamOp",
+    "DatahubSourceStreamOp",
     "GenerateFeatureOfWindowStreamOp",
     "KafkaSinkStreamOp",
     "KafkaSourceStreamOp",

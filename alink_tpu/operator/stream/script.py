@@ -118,10 +118,12 @@ class JaxScriptStreamOp(StreamOperator):
 
         th = threading.Thread(target=runner, daemon=True)
         th.start()
+        completed = False
         try:
             while True:
                 item = q.get()
                 if item is sentinel:
+                    completed = True
                     break
                 yield item
         finally:
@@ -132,5 +134,8 @@ class JaxScriptStreamOp(StreamOperator):
                 except queue.Empty:
                     break
             th.join(timeout=10)
-            if errors:
+            # script errors surface only on the normal (sentinel) path; when
+            # the consumer closes the stream early (GeneratorExit unwinding)
+            # raising here would replace the close with a spurious error
+            if errors and completed:
                 raise errors[0]

@@ -55,6 +55,7 @@ def optimize(
     history: int = 10,
     num_search_step: int = 40,
     batch_size: int = 0,
+    _lower_only: bool = False,
 ) -> OptimResult:
     """Minimize ``psum(obj.local_loss)/N + l1·|w| + l2/2·|w|²`` over the mesh.
 
@@ -308,5 +309,9 @@ def optimize(
             check_vma=False,
         )
     )
+    if _lower_only:
+        # introspection hook (weak-scaling tests): the lowered-but-unrun
+        # program, so callers can compile() and read cost_analysis()
+        return f.lower(Xs, ys, mask, wts, w_init)
     w, loss, gnorm, k = jax.device_get(f(Xs, ys, mask, wts, w_init))
     return OptimResult(np.asarray(w), float(loss), float(gnorm), int(k))

@@ -87,24 +87,14 @@ def shard_rows(
 ):
     """Pad rows to a multiple of the data-axis size and place the array sharded
     on its leading dim. Returns the sharded array (and optionally the validity
-    mask for the padded tail — weight-0 rows for algorithms that aggregate)."""
-    import jax
-    import jax.numpy as jnp
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    mask for the padded tail — weight-0 rows for algorithms that aggregate).
 
-    n_shards = mesh.shape[axis]
-    n = arr.shape[0]
-    n_pad = pad_to_multiple(max(n, n_shards), n_shards)
-    if n_pad != n:
-        pad_width = [(0, n_pad - n)] + [(0, 0)] * (arr.ndim - 1)
-        arr = np.pad(arr, pad_width)
-    sharding = NamedSharding(mesh, P(axis))
-    out = jax.device_put(arr, sharding)
-    if not with_mask:
-        return out
-    mask = np.zeros(n_pad, dtype=arr.dtype if arr.dtype.kind == "f" else np.float32)
-    mask[:n] = 1.0
-    return out, jax.device_put(mask, sharding)
+    Staging goes through the content-keyed device cache
+    (``common/staging.py``): re-staging the same table to the same mesh is
+    free, and large float blocks ride the bf16 wire (upcast on device)."""
+    from ..common.staging import stage_sharded
+
+    return stage_sharded(np.asarray(arr), mesh, axis, with_mask=with_mask)
 
 
 class IterativeComQueue:

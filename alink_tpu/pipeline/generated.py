@@ -254,13 +254,26 @@ RECOMMENDERS: Dict[str, str] = {
 }
 
 
+# serving-only param names: when train and predict ops both define one, the
+# predict op's definition (default/validator) is the one the estimator's
+# transform path actually honors, so mirror that — not first-wins
+_SERVING_PARAM_NAMES = frozenset(
+    {"predictionCol", "predictionDetailCol", "reservedCols"})
+
+
 def _mirror_params(*op_classes) -> Dict[str, ParamInfo]:
     out: Dict[str, ParamInfo] = {}
     for cls in op_classes:
+        mine: Dict[str, ParamInfo] = {}
         for klass in cls.__mro__:
             for k, v in vars(klass).items():
-                if isinstance(v, ParamInfo) and k not in out:
-                    out[k] = v
+                if isinstance(v, ParamInfo) and k not in mine:
+                    mine[k] = v  # most-derived definition wins within a class
+        for k, v in mine.items():
+            if k not in out or (
+                out[k] is not v and v.name in _SERVING_PARAM_NAMES
+            ):
+                out[k] = v
     return out
 
 

@@ -322,6 +322,16 @@ def _shard(mesh, arr):
     return jax.device_put(arr, NamedSharding(mesh, P(AXIS_DATA)))
 
 
+def _shard_cached(mesh, arr):
+    """Staging-cache variant of ``_shard`` for train-constant blocks (binned
+    features, labels, masks). Per-round arrays (gradients) stay on the direct
+    path — their content changes every boosting round."""
+    from ..common.staging import stage_sharded
+
+    arr = np.asarray(arr)
+    return stage_sharded(arr, mesh, AXIS_DATA, pad_rows_to=arr.shape[0])
+
+
 
 def _compact_bins(bins_pad: np.ndarray, num_bins: int) -> np.ndarray:
     """uint8 the bins rectangle when codes fit: the axon tunnel is ~5 MB/s,
@@ -592,9 +602,11 @@ def train_gbdt(
         y_enc = np.asarray(y, np.float32)[:, None]
     y_pad = _pad_rows(y_enc, dp * num_chunks)
 
-    bins_s = _shard(mesh, bins_pad)
-    y_s = _shard(mesh, y_pad)
-    valid_s = _shard(mesh, valid)
+    # train-constant blocks ride the content-keyed staging cache: re-training
+    # on the same table (warm bench runs, tuning sweeps) skips the re-push
+    bins_s = _shard_cached(mesh, bins_pad)
+    y_s = _shard_cached(mesh, y_pad)
+    valid_s = _shard_cached(mesh, valid)
     jax.block_until_ready((bins_s, y_s, valid_s))
     t_staged = _time.perf_counter()
 

@@ -184,9 +184,22 @@ def bench_softmax_mnist():
 
     # cold includes compile / persistent-cache load; warm is the compiled
     # steady state (min of 2 rejects tunnel-contention spikes — the r3
-    # "regression" was an unsplit cold number measured under midday load)
+    # "regression" was an unsplit cold number measured under midday load).
+    # Warm runs hit the device staging cache (common/staging.py): the 62MB
+    # feature block is pushed once (as bf16 wire) and reused across jobs.
+    from alink_tpu.common.staging import staging_cache_stats
+
+    s0 = staging_cache_stats()
     wall_cold = run_once()
+    s1 = staging_cache_stats()
     wall = min(run_once(), run_once())
+    s2 = staging_cache_stats()
+    staging = {
+        "cold_wire_MB": round((s1["wire_bytes_sent"] - s0["wire_bytes_sent"]) / 1e6, 1),
+        "warm_wire_MB": round((s2["wire_bytes_sent"] - s1["wire_bytes_sent"]) / 2e6, 1),
+        "warm_cache_hits": s2["hits"] - s1["hits"],
+        "bf16_wire_MB_saved": round((s2["wire_bytes_saved"] - s0["wire_bytes_saved"]) / 1e6, 1),
+    }
     effective_samples = n * 30  # samples touched per L-BFGS data pass
 
     # real-data accuracy: UCI digits with an 80/20 split
@@ -209,7 +222,8 @@ def bench_softmax_mnist():
             "samples_per_sec_cold": round(effective_samples / wall_cold, 1),
             "accuracy_digits_holdout": round(acc, 4),
             "wall_clock_s": round(wall, 3),
-            "wall_clock_cold_s": round(wall_cold, 3)}
+            "wall_clock_cold_s": round(wall_cold, 3),
+            "staging": staging}
 
 
 def _resnet50_torch():

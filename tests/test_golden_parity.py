@@ -152,6 +152,23 @@ def test_join_golden():
     assert got == [("b", 2.0, 20.0), ("c", 3.0, 30.0)]
 
 
+def test_join_select_string_literal_not_rewritten():
+    """Qualifier rewriting must skip quoted spans: 'b.' inside a string
+    literal is data, not a column reference (ADVICE r4)."""
+    from alink_tpu.operator.batch import JoinBatchOp
+
+    left = _src({"k": np.asarray(["a", "b"], object),
+                 "x": np.array([1.0, 2.0])})
+    right = _src({"k": np.asarray(["a", "b"], object),
+                  "y": np.array([10.0, 20.0])})
+    out = JoinBatchOp(
+        joinPredicate="a.k = b.k",
+        selectClause="a.k, b.y, 'b.tag' AS tag",
+    ).link_from(left, right).collect()
+    assert list(np.asarray(out.col("tag"))) == ["b.tag", "b.tag"]
+    assert sorted(np.asarray(out.col("y"))) == [10.0, 20.0]
+
+
 def test_union_all_golden():
     from alink_tpu.operator.batch import UnionAllBatchOp
 

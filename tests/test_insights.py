@@ -1,0 +1,176 @@
+"""AutoDiscovery detector taxonomy (reference: common/insights/Mining.java +
+InsightType.java — outstanding/evenness/attribution/changepoint/trend/
+seasonality/cross-correlation/clustering detectors with ranked scores)."""
+
+import json
+
+import numpy as np
+
+from alink_tpu.common.mtable import MTable
+from alink_tpu.operator.batch import AutoDiscoveryBatchOp
+from alink_tpu.operator.batch.base import TableSourceBatchOp
+
+
+def _discover(t: MTable, **kw) -> MTable:
+    return AutoDiscoveryBatchOp(**kw).link_from(
+        TableSourceBatchOp(t)).collect()
+
+
+def _types(out: MTable):
+    return list(out.col("type"))
+
+
+def test_outstanding_no1():
+    rng = np.random.default_rng(0)
+    seg = np.asarray([f"s{i}" for i in range(10) for _ in range(20)], object)
+    sales = rng.uniform(1, 2, 200)
+    sales[seg == "s3"] += 40.0  # s3's sum dwarfs the power-law tail
+    out = _discover(MTable({"seg": seg, "sales": sales}), topN=50)
+    rows = [r for r in zip(out.col("type"), out.col("description"))
+            if r[0] == "outstanding_no1"]
+    assert rows, _types(out)
+    assert any("s3" in d for _, d in rows)
+
+
+def test_outstanding_last_negative_extreme():
+    rng = np.random.default_rng(7)
+    seg = np.asarray([f"s{i}" for i in range(8) for _ in range(25)], object)
+    profit = 1.0 + 0.2 * rng.standard_normal(200)
+    profit[seg == "s5"] = -30.0
+    out = _discover(MTable({"seg": seg, "profit": profit}), topN=50)
+    rows = [d for ty, d in zip(out.col("type"), out.col("description"))
+            if ty == "outstanding_last"]
+    assert rows and any("s5" in d for d in rows)
+
+
+def test_evenness():
+    seg = np.asarray(["a", "b", "c", "d"] * 50, object)
+    v = np.ones(200)
+    out = _discover(MTable({"seg": seg, "v": v}), topN=50)
+    assert "evenness" in _types(out)
+
+
+def test_attribution_majority_share():
+    seg = np.asarray(["big"] * 150 + ["s1"] * 25 + ["s2"] * 25, object)
+    rev = np.where(seg == "big", 10.0, 1.0)
+    out = _discover(MTable({"seg": seg, "rev": rev}), topN=50)
+    rows = [d for ty, d in zip(out.col("type"), out.col("description"))
+            if ty == "attribution"]
+    assert rows and any("big" in d for d in rows)
+
+
+def test_change_point_and_trend():
+    # ordered breakdown labels t00..t19 -> series detectors engage
+    seg = np.asarray([f"t{i:02d}" for i in range(20) for _ in range(10)],
+                     object)
+    step = np.where([int(s[1:]) >= 12 for s in seg], 50.0, 1.0)
+    rng = np.random.default_rng(1)
+    stepv = step + 0.1 * rng.standard_normal(200)
+    ramp = np.asarray([float(s[1:]) for s in seg])
+    ramp = ramp + 0.05 * rng.standard_normal(200)
+    out = _discover(MTable({"t": seg, "step_m": stepv, "ramp_m": ramp}),
+                    topN=60)
+    kinds = _types(out)
+    assert "change_point" in kinds, kinds
+    assert "trend" in kinds, kinds
+    cp = [d for ty, d in zip(out.col("type"), out.col("description"))
+          if ty == "change_point" and "step_m" in d]
+    assert any("t12" in d or "t11" in d for d in cp), cp
+
+
+def test_trend_with_unpadded_numeric_labels():
+    """Month-style labels '1'..'12' must order numerically, not lexically
+    ('1','10','11','12','2',... would scramble the series)."""
+    rng = np.random.default_rng(8)
+    seg = np.asarray([str(m) for m in range(1, 13) for _ in range(15)],
+                     object)
+    v = np.asarray([float(s) * 5 for s in seg]) \
+        + 0.1 * rng.standard_normal(180)
+    out = _discover(MTable({"month": seg, "v": v}), topN=60)
+    rows = [d for ty, d in zip(out.col("type"), out.col("description"))
+            if ty == "trend"]
+    assert rows and "rises" in rows[0], _types(out)
+
+
+def test_seasonality():
+    seg = np.asarray([f"t{i:02d}" for i in range(24) for _ in range(5)],
+                     object)
+    period4 = np.asarray([np.sin(2 * np.pi * int(s[1:]) / 4.0) * 10
+                          for s in seg])
+    out = _discover(MTable({"t": seg, "wave": period4}), topN=60)
+    rows = [(d, det) for ty, d, det in zip(
+        out.col("type"), out.col("description"), out.col("detail"))
+        if ty == "seasonality"]
+    assert rows, _types(out)
+    assert any(json.loads(det)["period"] == 4 for _, det in rows)
+
+
+def test_series_outlier():
+    seg = np.asarray([f"s{i:02d}" for i in range(15) for _ in range(10)],
+                     object)
+    v = np.ones(150)
+    v[seg == "s07"] = 90.0
+    out = _discover(MTable({"seg": seg, "v": v}), topN=60)
+    assert "series_outlier" in _types(out) or "outstanding_no1" in _types(out)
+
+
+def test_distribution_skew():
+    rng = np.random.default_rng(2)
+    skewed = np.exp(rng.standard_normal(500) * 1.5)
+    out = _discover(MTable({"x": skewed}), topN=50)
+    rows = [d for ty, d in zip(out.col("type"), out.col("description"))
+            if ty == "distribution"]
+    assert rows and "right-skewed" in rows[0]
+
+
+def test_clustering_2d():
+    rng = np.random.default_rng(3)
+    a = np.concatenate([rng.normal(-5, 0.3, 100), rng.normal(5, 0.3, 100)])
+    b = np.concatenate([rng.normal(-5, 0.3, 100), rng.normal(5, 0.3, 100)])
+    out = _discover(MTable({"a": a, "b": b}), topN=50)
+    assert "clustering_2d" in _types(out)
+
+
+def test_subspace_mining_scaled_by_impact():
+    # within region=x only, segment c runs hot; full-space mean is diluted
+    rng = np.random.default_rng(4)
+    n = 400
+    region = np.asarray(["x"] * 200 + ["y"] * 200, object)
+    seg = np.asarray((["c"] * 50 + ["d"] * 150) * 2, object)
+    m = rng.standard_normal(n)
+    m[(region == "x") & (seg == "c")] += 8.0
+    out = _discover(MTable({"region": region, "seg": seg, "m": m}), topN=60)
+    descs = " | ".join(out.col("description"))
+    assert "[region='x']" in descs, descs
+
+
+def test_ranking_decay_diversifies():
+    """One loud subject must not flood the top-N (InsightDecay analog)."""
+    rng = np.random.default_rng(5)
+    seg = np.asarray([f"s{i}" for i in range(10) for _ in range(20)], object)
+    hot = rng.uniform(1, 2, 200)
+    hot[seg == "s0"] += 100.0
+    other = np.exp(rng.standard_normal(200) * 2)  # skewed too
+    out = _discover(MTable({"seg": seg, "hot": hot, "other": other}), topN=10)
+    assert len(set(_types(out))) >= 3
+    scores = np.asarray(out.col("score"))
+    assert (np.diff(scores) <= 1e-12).all()  # ranked descending
+
+
+def test_detail_column_is_json():
+    seg = np.asarray(["a"] * 100 + ["b"] * 100, object)
+    v = np.where(seg == "a", 10.0, 1.0)
+    out = _discover(MTable({"seg": seg, "v": v}), topN=50)
+    for det in out.col("detail"):
+        json.loads(det)  # every detail cell parses
+
+
+def test_time_limit_respected():
+    rng = np.random.default_rng(6)
+    cols = {f"c{i}": rng.standard_normal(200) for i in range(6)}
+    cols["seg"] = np.asarray(["a", "b"] * 100, object)
+    import time as _t
+
+    t0 = _t.monotonic()
+    _discover(MTable(cols), timeLimitSeconds=0.001, topN=5)
+    assert _t.monotonic() - t0 < 10.0

@@ -74,6 +74,45 @@ def test_xgboost_gated_or_works():
     assert model.num_rows > 0
 
 
+def test_xgboost_tracker_rendezvous_contract():
+    """TrackerImpl-analog: start -> per-worker envs -> wait/stop, against a
+    tracker double (xgboost absent in this image; the wire path reuses
+    xgboost.tracker.RabitTracker verbatim)."""
+    from alink_tpu.operator.batch.xgboost import XGBoostTracker
+
+    events = []
+
+    class FakeRabit:
+        def __init__(self, host_ip, n_workers, port):
+            self.args = {"dmlc_tracker_uri": host_ip,
+                         "dmlc_tracker_port": 9091}
+
+        def start(self):
+            events.append("start")
+
+        def worker_args(self):
+            return dict(self.args)
+
+        def wait_for(self, *a):
+            events.append("wait")
+
+        def free(self):
+            events.append("free")
+
+    tr = XGBoostTracker(
+        num_workers=2,
+        tracker_factory=lambda h, n, p: FakeRabit(h, n, p))
+    with pytest.raises(AkUnsupportedOperationException):
+        tr.worker_args()  # must start first
+    tr.start()
+    env = tr.worker_args()
+    assert env["dmlc_num_worker"] == 2
+    assert env["dmlc_tracker_uri"] == "127.0.0.1"
+    tr.wait_for()
+    tr.stop()
+    assert events == ["start", "wait", "free"]
+
+
 def test_split_work_distributed_info():
     from alink_tpu.operator.local import split_work
 

@@ -144,6 +144,24 @@ def test_eval_outlier():
     assert m["Precision"] > 0.2
 
 
+def test_eval_outlier_nan_prediction_not_outlier():
+    """NaN predictions are missing, not outliers (ADVICE r4: bool(nan) is
+    True, so a bare truth test counted every NaN as a detection)."""
+    from alink_tpu.operator.batch import EvalOutlierBatchOp
+    from alink_tpu.operator.batch.base import TableSourceBatchOp
+
+    t = MTable({
+        "label": np.array([1, 0, 0, 1], np.int64),
+        "o": np.array([1.0, np.nan, 0.0, 1.0]),
+    })
+    m = EvalOutlierBatchOp(
+        labelCol="label", predictionCol="o",
+    ).link_from(TableSourceBatchOp(t)).collect_metrics()
+    # row 1 (label 0, NaN pred) must count as a true negative: precision 1.0
+    assert m["Precision"] == 1.0
+    assert m["Recall"] == 1.0
+
+
 def test_esd_nan_aware_and_ecod_left_tail():
     from alink_tpu.outlier import ecod, esd
 
