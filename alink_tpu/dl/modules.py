@@ -114,7 +114,8 @@ class TransformerEncoder(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
-                 *, deterministic: bool = True, return_pooled: bool = False):
+                 *, deterministic: bool = True, return_pooled: bool = False,
+                 return_sequence: bool = False):
         c = self.cfg
         b, s = input_ids.shape
         if attention_mask is None:
@@ -138,6 +139,8 @@ class TransformerEncoder(nn.Module):
                 x, attention_mask, deterministic
             )
 
+        if return_sequence:  # token-level states (MLM pretraining heads)
+            return x.astype(jnp.float32)
         if c.pool == "cls":  # pretrained BERT pooler input is the CLS slot
             pooled = x[:, 0]
         else:  # masked mean-pool (CLS-equivalent without a pretrained pooler)

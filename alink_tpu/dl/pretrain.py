@@ -1,0 +1,129 @@
+"""Masked-LM pretraining for the BERT stack.
+
+Capability parity with the reference's pretrain-then-finetune story: its
+BERT ops consume checkpoints produced by upstream MLM pretraining
+(reference: core/src/main/java/com/alibaba/alink/common/dl/
+BaseEasyTransferTrainBatchOp.java + BertResources.java — the ops download
+google-research checkpoints; pretraining itself lives outside the Java
+code). Here pretraining is in-framework: one jitted MLM step over the
+TransformerEncoder, BERT's 80/10/10 masking, and a tied-embedding output
+head (logits = states @ tok_emb.T, the original BERT weight tying) — so a
+user can produce, save (HF layout via ``save_bert_checkpoint``) and re-ingest
+domain checkpoints without leaving the framework."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .modules import BertConfig, TransformerEncoder
+from .tokenizer import MASK, Tokenizer
+
+
+def _mask_tokens(ids: np.ndarray, attn: np.ndarray, mask_id: int,
+                 vocab_size: int, rng: np.random.Generator,
+                 mask_prob: float, n_specials: int = 5):
+    """BERT masking: select ``mask_prob`` of real tokens; 80% -> [MASK],
+    10% -> random token, 10% -> kept. Returns (masked_ids, target_mask)."""
+    sel = (rng.random(ids.shape) < mask_prob) & (attn == 1) \
+        & (ids >= n_specials)
+    masked = ids.copy()
+    r = rng.random(ids.shape)
+    masked[sel & (r < 0.8)] = mask_id
+    rand_sel = sel & (r >= 0.8) & (r < 0.9)
+    masked[rand_sel] = rng.integers(
+        n_specials, vocab_size, size=int(rand_sel.sum()))
+    return masked, sel
+
+
+def pretrain_mlm(
+    texts: Sequence[str],
+    *,
+    vocab_size: int = 2000,
+    hidden_size: int = 128,
+    num_layers: int = 2,
+    num_heads: int = 4,
+    intermediate_size: int = 256,
+    max_len: int = 48,
+    epochs: int = 30,
+    batch_size: int = 64,
+    learning_rate: float = 3e-4,
+    mask_prob: float = 0.15,
+    seed: int = 0,
+    tokenizer: Optional[Tokenizer] = None,
+) -> Tuple[BertConfig, dict, Tokenizer, List[float]]:
+    """MLM-pretrain a tiny BERT on raw texts. Returns
+    ``(cfg, params, tokenizer, loss_history)`` — params fit
+    ``save_bert_checkpoint`` and the fine-tune ``checkpointFilePath`` path.
+    """
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    tok = tokenizer or Tokenizer.build(list(texts), vocab_size=vocab_size)
+    cfg = BertConfig(
+        vocab_size=tok.vocab_size, hidden_size=hidden_size,
+        num_layers=num_layers, num_heads=num_heads,
+        intermediate_size=intermediate_size, max_position=max_len,
+        dropout=0.0, pool="cls")
+    model = TransformerEncoder(cfg)
+
+    enc = tok.encode_batch([str(t) for t in texts], max_len=max_len)
+    ids = np.asarray(enc["input_ids"], np.int32)
+    attn = np.asarray(enc["attention_mask"], np.int32)
+    mask_id = tok.vocab[MASK]
+
+    params = model.init(jax.random.PRNGKey(seed), ids[:1], attn[:1])
+    tx = optax.adamw(learning_rate, weight_decay=0.01)
+    opt_state = tx.init(params["params"])
+
+    @jax.jit
+    def step(params, opt_state, masked, attn, targets, sel):
+        def loss(p):
+            states = model.apply({"params": p["params"]}, masked, attn,
+                                 return_sequence=True)
+            emb = p["params"]["tok_emb"]["embedding"].astype(jnp.float32)
+            logits = states @ emb.T  # tied-embedding MLM head
+            ll = optax.softmax_cross_entropy_with_integer_labels(
+                logits, targets)
+            w = sel.astype(jnp.float32)
+            return (ll * w).sum() / jnp.maximum(w.sum(), 1.0)
+
+        l, g = jax.value_and_grad(loss)(params)
+        updates, opt_state2 = tx.update(g["params"], opt_state,
+                                        params["params"])
+        new_p = optax.apply_updates(params["params"], updates)
+        return {"params": new_p}, opt_state2, l
+
+    rng = np.random.default_rng(seed)
+    n = ids.shape[0]
+    history: List[float] = []
+    for ep in range(epochs):
+        order = rng.permutation(n)
+        ep_losses = []
+        for s in range(0, n, batch_size):
+            idx = order[s:s + batch_size]
+            masked, sel = _mask_tokens(
+                ids[idx], attn[idx], mask_id, tok.vocab_size, rng, mask_prob)
+            params, opt_state, l = step(
+                params, opt_state, masked, attn[idx], ids[idx], sel)
+            ep_losses.append(float(l))
+        history.append(float(np.mean(ep_losses)))
+    return cfg, jax.device_get(params), tok, history
+
+
+def pretrain_and_save(texts: Sequence[str], out_dir: str, **kw) -> dict:
+    """Pretrain + write the HF-layout checkpoint dir consumed by
+    ``checkpointFilePath`` on the BERT ops. Returns a summary dict."""
+    from .pretrained import save_bert_checkpoint
+
+    cfg, params, tok, history = pretrain_mlm(texts, **kw)
+    save_bert_checkpoint(params, cfg, out_dir, tok.to_list())
+    return {
+        "path": out_dir,
+        "vocab_size": tok.vocab_size,
+        "initial_loss": round(history[0], 4),
+        "final_loss": round(history[-1], 4),
+        "epochs": len(history),
+    }

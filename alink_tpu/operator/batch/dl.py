@@ -241,6 +241,12 @@ class BaseBertTextTrainBatchOp(ModelTrainOpMixin, BatchOperator, HasDLTrainParam
         "checkpointFilePath", str,
         desc="explicit pretrained checkpoint directory (HF layout or "
              "google-research TF ckpt); overrides bertModelName")
+    POOLING_STRATEGY = ParamInfo(
+        "poolingStrategy", str, default="auto",
+        desc="auto | cls | mean — auto uses cls for pretrained checkpoints "
+             "(the reference BERT pooler convention; NSP trains the CLS "
+             "slot) and mean for from-scratch or NSP-less in-framework "
+             "checkpoints")
 
     _min_inputs = 1
     _max_inputs = 1
@@ -332,9 +338,12 @@ class BaseBertTextTrainBatchOp(ModelTrainOpMixin, BatchOperator, HasDLTrainParam
                 raise AkIllegalArgumentException(
                     f"maxSeqLength={max_len} exceeds the pretrained "
                     f"checkpoint's max_position={ckpt_cfg['max_position']}")
+            pool = self.get(self.POOLING_STRATEGY)
+            if pool == "auto":
+                pool = "cls"  # HF/google checkpoints train CLS via NSP
             cfg = BertConfig(
                 num_labels=num_labels, regression=self._regression,
-                pool="cls", dropout=0.1,
+                pool=pool, dropout=0.1,
                 use_ring_attention=self.get(self.SEQ_SHARDS) > 1,
                 attention_block_size=self.get(self.ATTENTION_BLOCK_SIZE),
                 **ckpt_cfg)
