@@ -59,8 +59,10 @@ class AlinkGlobalConfiguration:
 
     @classmethod
     def get_wire_precision(cls) -> str:
-        """Host->device wire policy for float blocks: "auto" (bf16 above a
-        size threshold), "bf16" (always), or "fp32" (never downcast)."""
+        """Host->device wire policy for float blocks: "auto" (precision-safe
+        default — bf16 only above a size threshold AND on a measured-slow
+        tunnel, exact fp32 otherwise), "bf16" (always downcast, explicit
+        opt-in), or "fp32" (never downcast)."""
         return cls._wire_precision
 
     @classmethod
@@ -139,6 +141,7 @@ class MLEnvironment:
         self._parallelism = parallelism
         self.lazy_manager = LazyObjectsManager()
         self._pool: Optional[ThreadPoolExecutor] = None
+        self._dag_pool: Optional[ThreadPoolExecutor] = None
         self._lock = threading.Lock()
 
     # -- host-side thread pool (AlinkLocalSession analog) ------------------
@@ -150,6 +153,22 @@ class MLEnvironment:
                     max_workers=self.parallelism, thread_name_prefix="alink-local"
                 )
             return self._pool
+
+    # -- DAG scheduler pool -------------------------------------------------
+    @property
+    def dag_pool(self) -> ThreadPoolExecutor:
+        """Threads running DAG *node* tasks (common/executor.py). Separate
+        from ``executor`` so a node blocking on intra-op shard futures can
+        never starve the pool those shards run on (two-level submit to one
+        pool deadlocks once every worker waits on queued inner tasks)."""
+        from .executor import _dag_pool_size
+
+        with self._lock:
+            if self._dag_pool is None:
+                self._dag_pool = ThreadPoolExecutor(
+                    max_workers=_dag_pool_size(self),
+                    thread_name_prefix="alink-dag")
+            return self._dag_pool
 
     @property
     def parallelism(self) -> int:
@@ -181,6 +200,9 @@ class MLEnvironment:
         if self._pool is not None:
             self._pool.shutdown(wait=False)
             self._pool = None
+        if self._dag_pool is not None:
+            self._dag_pool.shutdown(wait=False)
+            self._dag_pool = None
 
 
 class MLEnvironmentFactory:

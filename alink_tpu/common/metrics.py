@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import contextlib
 import json
+import threading
 import time
 from collections import defaultdict
 from typing import Any, Dict, List, Optional
@@ -39,6 +40,16 @@ class StepMetrics:
     def record(self, name: str, **values):
         if self.enabled:
             self._series[name].append(dict(values))
+
+    def record_bounded(self, name: str, limit: int, **values):
+        """record() with a ring bound — high-frequency series (the executor
+        emits per-node records on every collect/execute) must not grow
+        without bound in long-lived serving processes."""
+        if self.enabled:
+            s = self._series[name]
+            s.append(dict(values))
+            if len(s) > limit:
+                del s[: len(s) - limit]
 
     def add_time(self, name: str, seconds: float):
         if self.enabled:
@@ -77,6 +88,55 @@ class StepMetrics:
 
 
 metrics = StepMetrics()
+
+
+# ---------------------------------------------------------------------------
+# Executor node-phase accounting
+# ---------------------------------------------------------------------------
+# The DAG executor opens a per-node context on the thread running the node;
+# lower layers (device streaming, staging) add transfer/compute seconds into
+# whatever node is active without knowing about the executor. No-op when no
+# node context is open (direct op calls, tests).
+
+_node_ctx = threading.local()
+
+
+@contextlib.contextmanager
+def node_phase_context(phases: Dict[str, float]):
+    prev = getattr(_node_ctx, "phases", None)
+    _node_ctx.phases = phases
+    try:
+        yield phases
+    finally:
+        _node_ctx.phases = prev
+
+
+def add_node_phase(key: str, seconds: float):
+    phases = getattr(_node_ctx, "phases", None)
+    if phases is not None:
+        phases[key] = phases.get(key, 0.0) + seconds
+
+
+def executor_trace() -> List[Dict[str, Any]]:
+    """Per-node records of the last executed DAGs: one dict per node with
+    ``op``/``wall_s`` plus any phases (``transfer_s``, ``compute_s``,
+    ``fused``) the node reported. Feeds the BENCH ``executor`` extra."""
+    return metrics.series("executor.node")
+
+
+def executor_phase_summary() -> Dict[str, Any]:
+    """Aggregate the executor trace per op class: count, total wall, and the
+    transfer/compute split where nodes reported one."""
+    out: Dict[str, Dict[str, float]] = {}
+    for rec in executor_trace():
+        d = out.setdefault(rec.get("op", "?"),
+                           {"count": 0, "wall_s": 0.0})
+        d["count"] += 1
+        d["wall_s"] = round(d["wall_s"] + rec.get("wall_s", 0.0), 6)
+        for k in ("transfer_s", "compute_s"):
+            if k in rec:
+                d[k] = round(d.get(k, 0.0) + rec[k], 6)
+    return out
 
 
 @contextlib.contextmanager

@@ -360,7 +360,14 @@ class MTable:
         Vector columns expand to their (padded) width; this is the host-side
         staging step before a single host→device transfer. Memoized per
         instance (columns are immutable after construction), so repeated
-        jobs over the same table skip the concatenate."""
+        jobs over the same table skip the concatenate.
+
+        The returned array is **read-only and shared**: the same buffer is
+        handed to every caller (including concurrent DAG-executor nodes) and
+        keyed into the device staging cache by content, so an in-place
+        mutation would silently corrupt every other job's view and desync
+        the content cache. The write flag is cleared — mutating raises
+        ``ValueError``; callers that need a scratch buffer must ``copy()``."""
         memo_key = (tuple(names), np.dtype(dtype).str, vector_size)
         memo = getattr(self, "_block_memo", None)
         if memo is None:

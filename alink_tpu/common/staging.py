@@ -9,17 +9,20 @@ same bytes host->device. On a tunneled single-chip dev setup the wire runs at
 ~5 MB/s, so a 60 MB feature block costs ~13 s per push — the cache makes the
 second and later pushes free.
 
-Wire precision: float32/float64 blocks at or above a size threshold are cast
+Wire precision: float32 blocks at or above a size threshold are cast
 to bfloat16 on the host (halving wire bytes), shipped, and upcast to float32
 on device, so compute keeps fp32 accumulation. Controlled by
 ``AlinkGlobalConfiguration`` wire-precision policy:
 
-- ``"auto"`` (default): bf16 wire for float blocks >= threshold (4 MiB)
-- ``"bf16"``: always use the bf16 wire for float blocks
+- ``"auto"`` (default): **precision-safe by default** — bf16 wire only for
+  float blocks >= threshold (4 MiB) AND a measured-slow tunnel (see
+  :func:`wire_is_slow`); on local/PCIe-class wires auto is exact fp32.
+- ``"bf16"``: always use the bf16 wire for float blocks (explicit opt-in)
 - ``"fp32"``: never downcast on the wire
 
 Env overrides: ``ALINK_WIRE_PRECISION``, ``ALINK_STAGING_CACHE_BYTES``
-(0 disables the cache).
+(0 disables the cache), ``ALINK_ASSUME_SLOW_WIRE`` (1/0 forces the
+slow-tunnel gate instead of probing).
 """
 
 from __future__ import annotations
@@ -120,6 +123,14 @@ class StagingCache:
             self._bytes -= nbytes
             self.stats.evictions += 1
 
+    def note_wire(self, sent: int = 0, saved: int = 0) -> None:
+        """Record wire traffic under the cache lock — the pipelined executor
+        feeds staging from several DAG/transfer threads at once, so unlocked
+        ``+=`` on the counters loses updates."""
+        with self._lock:
+            self.stats.wire_bytes_sent += sent
+            self.stats.wire_bytes_saved += saved
+
     def stats_dict(self):
         with self._lock:
             d = self.stats.as_dict()
@@ -145,8 +156,57 @@ def clear_staging_cache() -> None:
 
 
 # ---------------------------------------------------------------------------
-# Wire precision policy
+# Wire precision policy + tunnel probe
 # ---------------------------------------------------------------------------
+
+_SLOW_WIRE_MBPS = 64.0
+_PROBE_BYTES = 1 * 1024 * 1024
+_wire_probe: dict = {"slow": None, "mbps": None}
+_probe_lock = threading.Lock()
+
+
+def measured_wire_mbps() -> Optional[float]:
+    """Host→device bandwidth from the one-shot probe (None before it ran)."""
+    return _wire_probe["mbps"]
+
+
+def wire_is_slow() -> bool:
+    """Whether the host→device wire is a tunneled/remote-class bottleneck.
+
+    Resolution order: ``ALINK_ASSUME_SLOW_WIRE`` (1/0 forces the answer) >
+    a cached one-shot probe (a 1 MiB ``device_put`` with a dependent fetch;
+    < ~64 MB/s counts as slow — PCIe-class wires measure in GB/s, the axon
+    tunnel in single-digit MB/s). The answer gates the ``auto`` bf16 wire
+    policy and content-cache use inside streaming."""
+    env = os.environ.get("ALINK_ASSUME_SLOW_WIRE")
+    if env is not None and env != "":
+        return env.lower() in ("1", "true", "yes")
+    if _wire_probe["slow"] is None:
+        # single-flight: concurrent transfer threads must not each run a
+        # probe (they would measure a self-contended wire), and callers who
+        # resolve the gate before streaming (stream_map does) keep the probe
+        # clear of their own traffic
+        with _probe_lock:
+            if _wire_probe["slow"] is None:
+                import time
+
+                try:
+                    import jax
+
+                    buf = np.arange(_PROBE_BYTES, dtype=np.uint8)
+                    _ = float(jax.device_put(buf[:1024])[0])  # warm gather
+                    t0 = time.perf_counter()
+                    _ = float(jax.device_put(buf)[0])  # dependent fetch =
+                    dt = max(time.perf_counter() - t0, 1e-9)  # real sync
+                    mbps = _PROBE_BYTES / 1e6 / dt
+                    _wire_probe["mbps"] = mbps
+                    _wire_probe["slow"] = mbps < _SLOW_WIRE_MBPS
+                except Exception:
+                    # transient (backend not up yet): answer fast-for-now
+                    # but do NOT cache — retry on the next call
+                    return False
+    return _wire_probe["slow"]
+
 
 def wire_precision() -> str:
     env = os.environ.get("ALINK_WIRE_PRECISION")
@@ -157,17 +217,32 @@ def wire_precision() -> str:
     return AlinkGlobalConfiguration.get_wire_precision()
 
 
+def _policy_key() -> str:
+    """Cache-key component for the wire policy. Under ``auto`` the effective
+    cast depends on the slow-wire gate, so the gate's answer must be part of
+    the key — otherwise flipping ALINK_ASSUME_SLOW_WIRE mid-process could
+    return a bf16-rounded cached array to a caller expecting exact fp32."""
+    pol = wire_precision()
+    if pol != "auto":
+        return pol
+    return "auto-slow" if wire_is_slow() else "auto-fast"
+
+
 def _wire_cast(arr: np.ndarray) -> Tuple[np.ndarray, bool]:
     """Return (wire_array, downcast?) under the active wire policy.
 
     Only float32 blocks ride the bf16 wire: float64 stays full-precision
     (quantizing 52 mantissa bits to 7 is not a wire optimization), and the
-    upcast on device restores the caller's exact dtype contract."""
+    upcast on device restores the caller's exact dtype contract. ``auto`` is
+    precision-safe by default: it downcasts only when the block is large AND
+    the wire measured slow (halving bytes on a 5 MB/s tunnel is seconds per
+    block; on a local wire the bf16 rounding buys nothing)."""
     policy = wire_precision()
     if policy == "fp32" or arr.dtype != np.float32:
         return arr, False
     if policy == "bf16" or (
         policy == "auto" and arr.nbytes >= _WIRE_THRESHOLD_BYTES
+        and wire_is_slow()
     ):
         import ml_dtypes
 
@@ -226,7 +301,7 @@ def stage_sharded(
     sharding = NamedSharding(mesh, P(axis))
 
     key = ("rows", _digest(arr), _mesh_key(mesh), axis, pad_rows_to,
-           wire_precision())
+           _policy_key())
     hit = _cache.get(key)
     if hit is not None:
         out, _ = hit
@@ -239,8 +314,8 @@ def stage_sharded(
         dev = jax.device_put(wire, sharding)
         if downcast:
             dev = dev.astype(padded.dtype)  # restore the caller's dtype
-            _cache.stats.wire_bytes_saved += padded.nbytes - wire.nbytes
-        _cache.stats.wire_bytes_sent += wire.nbytes
+        _cache.note_wire(sent=wire.nbytes,
+                         saved=padded.nbytes - wire.nbytes if downcast else 0)
         out = dev
         _cache.put(key, (out, out.nbytes), out.nbytes)
 
@@ -254,7 +329,7 @@ def stage_sharded(
     mask = np.zeros(pad_rows_to, dtype=mdtype)
     mask[:n] = 1.0
     mdev = jax.device_put(mask, sharding)
-    _cache.stats.wire_bytes_sent += mask.nbytes
+    _cache.note_wire(sent=mask.nbytes)
     _cache.put(mkey, (mdev, mdev.nbytes), mdev.nbytes)
     return out, mdev
 
@@ -273,7 +348,7 @@ def stage_replicated(arr: np.ndarray, mesh=None):
         sharding = None
         mkey = ("default", getattr(jax.devices()[0], "id", 0))
 
-    key = ("repl", _digest(arr), mkey, wire_precision())
+    key = ("repl", _digest(arr), mkey, _policy_key())
     hit = _cache.get(key)
     if hit is not None:
         return hit[0]
@@ -282,7 +357,7 @@ def stage_replicated(arr: np.ndarray, mesh=None):
         jax.device_put(wire)
     if downcast:
         dev = dev.astype(arr.dtype)  # restore the caller's dtype
-        _cache.stats.wire_bytes_saved += arr.nbytes - wire.nbytes
-    _cache.stats.wire_bytes_sent += wire.nbytes
+    _cache.note_wire(sent=wire.nbytes,
+                     saved=arr.nbytes - wire.nbytes if downcast else 0)
     _cache.put(key, (dev, dev.nbytes), dev.nbytes)
     return dev

@@ -135,6 +135,20 @@ class OdpsCatalog:
                 else:
                     cols[n] = np.asarray([int(v) for v in vals], np.int64)
                     out_types.append(tp)
+            elif tp == AlinkTypes.BOOLEAN:
+                # keep raw truth values (mirror the Hive reader):
+                # stringifying booleans turns every False into the non-empty
+                # string "False", which astype(bool) reads as True. Nullable
+                # booleans follow the framework-wide nullable rule (DOUBLE +
+                # NaN, same as nullable ints) — a bool column has no NaN slot
+                if any(v is None for v in vals):
+                    cols[n] = np.asarray(
+                        [np.nan if v is None else float(bool(v))
+                         for v in vals])
+                    out_types.append(AlinkTypes.DOUBLE)
+                else:
+                    cols[n] = np.asarray([bool(v) for v in vals], np.bool_)
+                    out_types.append(tp)
             else:
                 cols[n] = np.asarray(
                     [None if v is None else str(v) for v in vals], object)

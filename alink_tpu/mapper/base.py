@@ -81,6 +81,16 @@ class Mapper(WithParams):
     def map_table(self, t: MTable) -> MTable:
         raise NotImplementedError
 
+    # -- fusion protocol ---------------------------------------------------
+    def block_kernel(self, input_schema: TableSchema):
+        """Optional device-fusion hook. Pure row-wise numeric mappers return
+        ``(in_cols, out_cols, out_types, fn)`` where ``fn`` is a jax-traceable
+        ``(n, len(in_cols)) float32 -> (n, len(out_cols)) float32`` transform;
+        :class:`FusedMapperChain` composes consecutive kernels into ONE jitted
+        program (one host→device round trip for the whole run). ``None``
+        (the default) means "execute via map_table"."""
+        return None
+
     # -- row shim (serving parity with reference Mapper.map(Row)) ----------
     def map_row(self, row: Sequence, input_schema: Optional[TableSchema] = None):
         schema = input_schema or self.data_schema
@@ -221,6 +231,128 @@ class MapperChain:
     def map_row(self, row, input_schema: TableSchema):
         t = MTable.from_rows([row], input_schema)
         return self.map_table(t).get_row(0)
+
+
+class BlockKernelMapper(Mapper, HasReservedCols):
+    """Row-wise numeric mapper defined entirely by a jax block kernel.
+
+    Single-op execution and fused-chain execution share ONE code path
+    (:func:`run_kernel_chain`), so a fused run of N such mappers is
+    bit-identical to node-by-node execution: the same IEEE elementwise ops
+    on the same float32 columns, only the host↔device round trips between
+    nodes disappear. Implement :meth:`kernel`."""
+
+    def kernel(self, input_schema: TableSchema):
+        """Return (in_cols, out_cols, out_types, fn) — see Mapper.block_kernel."""
+        raise NotImplementedError
+
+    def block_kernel(self, input_schema: TableSchema):
+        return self.kernel(input_schema)
+
+    def output_schema(self, input_schema: TableSchema) -> TableSchema:
+        _, out_cols, out_types, _ = self.kernel(input_schema)
+        return self._append_result_schema(input_schema, list(out_cols),
+                                          list(out_types))
+
+    def map_table(self, t: MTable) -> MTable:
+        return run_kernel_chain(t, [(self, self.kernel(t.schema))])
+
+
+def run_kernel_chain(t: MTable, specs) -> MTable:
+    """Execute ``specs`` — [(mapper, (in_cols, out_cols, out_types, fn))] —
+    as ONE jitted program over one staged input block: stage the union of
+    required source columns once, thread columns between kernels on device,
+    fetch the surviving outputs in a single device→host transfer."""
+    import jax
+    import jax.numpy as jnp
+
+    host_needed: List[str] = []
+    produced: set = set()
+    for _, (in_cols, out_cols, _, _) in specs:
+        for c in in_cols:
+            if c not in produced and c not in host_needed:
+                host_needed.append(c)
+        produced.update(out_cols)
+
+    # final schema = the same output_schema fold node-by-node execution does
+    schema = t.schema
+    out_types_by_col: Dict[str, str] = {}
+    for m, (_, out_cols, out_types, _) in specs:
+        schema = m.output_schema(schema)
+        out_types_by_col.update(dict(zip(out_cols, out_types)))
+    final_produced = [n for n in schema.names if n in produced]
+
+    def run(B):
+        colmap = {c: B[:, i] for i, c in enumerate(host_needed)}
+        for _, (in_cols, out_cols, out_types, fn) in specs:
+            X = jnp.stack([colmap[c] for c in in_cols], axis=1)
+            Y = fn(X)
+            for j, c in enumerate(out_cols):
+                v = Y[:, j]
+                # node-by-node execution truncates LONG/INT outputs to int64
+                # on the host between nodes; replay that on device so fused
+                # and unfused runs stay bit-identical for integer columns.
+                # trunc (toward zero, C-cast semantics) rather than an
+                # integer astype: jnp.int64 silently canonicalizes to int32
+                # without x64 and would clamp values beyond 2**31
+                if out_types[j] in (AlinkTypes.LONG, AlinkTypes.INT):
+                    v = jnp.trunc(v)
+                colmap[c] = v
+        return jnp.stack([colmap[c] for c in final_produced], axis=1)
+
+    n = t.num_rows
+    if host_needed:
+        block = t.to_numeric_block(host_needed, dtype=np.float32)
+    else:
+        block = np.zeros((n, 0), np.float32)
+    if n == 0:
+        out_block = np.zeros((0, len(final_produced)), np.float32)
+    else:
+        out_block = np.asarray(jax.jit(run)(block))
+
+    cols: Dict[str, Any] = {}
+    for name in schema.names:
+        if name in produced:
+            vals = out_block[:, final_produced.index(name)]
+            tp = out_types_by_col.get(name, AlinkTypes.DOUBLE)
+            if tp == AlinkTypes.DOUBLE:
+                vals = vals.astype(np.float64)
+            elif tp in (AlinkTypes.LONG, AlinkTypes.INT):
+                vals = vals.astype(np.int64)
+            cols[name] = vals
+        else:
+            cols[name] = t.col(name)
+    return MTable(cols, schema)
+
+
+class FusedMapperChain(MapperChain):
+    """MapperChain that additionally composes consecutive kernel-capable
+    mappers (``block_kernel``) into one jitted device program. Mappers
+    without a kernel run via ``map_table`` exactly as in the plain chain, so
+    outputs are always bit-identical to node-by-node execution."""
+
+    def map_table(self, t: MTable) -> MTable:
+        i = 0
+        while i < len(self.mappers):
+            m = self.mappers[i]
+            spec = m.block_kernel(t.schema)
+            if spec is None:
+                t = m.map_table(t)
+                i += 1
+                continue
+            run = [(m, spec)]
+            schema = m.output_schema(t.schema)
+            j = i + 1
+            while j < len(self.mappers):
+                nxt = self.mappers[j].block_kernel(schema)
+                if nxt is None:
+                    break
+                run.append((self.mappers[j], nxt))
+                schema = self.mappers[j].output_schema(schema)
+                j += 1
+            t = run_kernel_chain(t, run)
+            i = j
+        return t
 
 
 def get_feature_block(

@@ -90,6 +90,10 @@ class AlgoOperator(WithParams):
         raise NotImplementedError(type(self).__name__)
 
     def _evaluate(self) -> MTable:
+        """Serial, memoized pull-evaluation of this node (and recursively its
+        upstreams). The pipelined engine (common/executor.py) schedules whole
+        sub-DAGs and then reads results back through this same method, so the
+        exactly-once contract lives in one place."""
         with self._eval_lock:
             if not self._executed:
                 ins = [op._evaluate() for op in self._inputs]
@@ -103,21 +107,27 @@ class AlgoOperator(WithParams):
                 self._executed = True
             return self._output
 
-    def _flush_lazy(self):
-        # independent pending sinks run on the session thread pool — the
-        # AlinkLocalSession local-engine analog (reference:
-        # operator/local/AlinkLocalSession.java:20-45 fixed pools); shared
-        # upstreams are protected by the per-op evaluation lock
+    def _set_result(self, table: MTable, sides: Sequence[MTable] = ()):
+        """Install an externally computed result (fused mapper chains write
+        the chain tail this way), preserving the memoization contract."""
+        with self._eval_lock:
+            if not self._executed:
+                self._output = table
+                self._side_tables = list(sides)
+                self._executed = True
+
+    def _flush_lazy(self, extra_roots: Sequence["AlgoOperator"] = ()):
+        # the pipelined DAG engine schedules every pending sink (plus any
+        # extra roots) as one topological job: independent branches run
+        # concurrently on the session's DAG pool, linear mapper runs fuse,
+        # and shared upstreams stay exactly-once via the per-op eval lock
+        from ..common.executor import run_dag
+
         mgr = self.env.lazy_manager
         pending = list(mgr.pending_ops())
-        if len(pending) > 1:
-            results = list(self.env.executor.map(
-                lambda op: op._evaluate(), pending))
-            for op, r in zip(pending, results):
-                mgr.fill(op, r)
-        else:
-            for op in pending:
-                mgr.fill(op, op._evaluate())
+        run_dag(self.env, list(extra_roots) + pending)
+        for op in pending:
+            mgr.fill(op, op._evaluate())
 
     # -- results -----------------------------------------------------------
     def get_output_table(self) -> MTable:
@@ -196,9 +206,8 @@ class AlgoOperator(WithParams):
         return list(self.schema.types)
 
     def collect(self) -> MTable:
-        out = self._evaluate()
-        self._flush_lazy()
-        return out
+        self._flush_lazy(extra_roots=[self])
+        return self._evaluate()
 
     def collect_to_dataframe(self):
         return self.collect().to_dataframe()

@@ -28,7 +28,7 @@ from ...common.exceptions import (AkIllegalArgumentException,
                                   AkIllegalDataException)
 from ...common.model import model_to_table, table_to_model
 from ...common.mtable import AlinkTypes, MTable
-from ...common.params import MinValidator, ParamInfo
+from ...common.params import InValidator, MinValidator, ParamInfo
 from ...mapper import (
     HasFeatureCols,
     HasPredictionCol,
@@ -243,6 +243,7 @@ class BaseBertTextTrainBatchOp(ModelTrainOpMixin, BatchOperator, HasDLTrainParam
              "google-research TF ckpt); overrides bertModelName")
     POOLING_STRATEGY = ParamInfo(
         "poolingStrategy", str, default="auto",
+        validator=InValidator("auto", "cls", "mean"),
         desc="auto | cls | mean — auto uses cls for pretrained checkpoints "
              "(the reference BERT pooler convention; NSP trains the CLS "
              "slot) and mean for from-scratch or NSP-less in-framework "
@@ -259,6 +260,15 @@ class BaseBertTextTrainBatchOp(ModelTrainOpMixin, BatchOperator, HasDLTrainParam
             "labelType": in_schema.type_of(self.get(self.LABEL_COL)),
         }
 
+    def _resolve_pooling(self, pretrained: bool) -> str:
+        """poolingStrategy with 'auto' resolved: cls for pretrained
+        checkpoints (NSP trains the CLS slot), mean for in-framework /
+        from-scratch models — exactly what the param doc promises."""
+        pool = self.get(self.POOLING_STRATEGY)
+        if pool == "auto":
+            return "cls" if pretrained else "mean"
+        return pool
+
     def _bert_config(self, vocab_size: int, num_labels: int):
         from ...dl.modules import BertConfig
 
@@ -268,6 +278,7 @@ class BaseBertTextTrainBatchOp(ModelTrainOpMixin, BatchOperator, HasDLTrainParam
             max_position=self.get(self.MAX_SEQ_LENGTH),
             num_labels=num_labels,
             regression=self._regression,
+            pool=self._resolve_pooling(pretrained=False),
             use_ring_attention=self.get(self.SEQ_SHARDS) > 1,
             attention_block_size=self.get(self.ATTENTION_BLOCK_SIZE),
         )
@@ -338,9 +349,7 @@ class BaseBertTextTrainBatchOp(ModelTrainOpMixin, BatchOperator, HasDLTrainParam
                 raise AkIllegalArgumentException(
                     f"maxSeqLength={max_len} exceeds the pretrained "
                     f"checkpoint's max_position={ckpt_cfg['max_position']}")
-            pool = self.get(self.POOLING_STRATEGY)
-            if pool == "auto":
-                pool = "cls"  # HF/google checkpoints train CLS via NSP
+            pool = self._resolve_pooling(pretrained=True)
             cfg = BertConfig(
                 num_labels=num_labels, regression=self._regression,
                 pool=pool, dropout=0.1,

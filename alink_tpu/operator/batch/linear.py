@@ -342,6 +342,12 @@ class LinearModelMapper(RichModelMapper):
     """(reference: operator/common/linear/LinearModelMapper.java +
     SoftmaxModelMapper.java)"""
 
+    # feature blocks at/above the threshold stream as ~4 MiB micro-batches
+    # with transfer/compute overlap (common/streaming.py); below it one
+    # staged push is cheaper than pipeline bookkeeping
+    STREAM_THRESHOLD_BYTES = 16 * 1024 * 1024
+    STREAM_CHUNK_BYTES = 4 * 1024 * 1024
+
     def load_model(self, model: MTable):
         import jax
 
@@ -384,6 +390,26 @@ class LinearModelMapper(RichModelMapper):
         X = get_feature_block(
             t, merged, vector_size=self.meta["dim"],
         ).astype(np.float32, copy=False)
+        if X.nbytes >= self.STREAM_THRESHOLD_BYTES:
+            # big blocks stream in double-buffered micro-batches: device_put
+            # of chunk k+1 (through the content-keyed staging cache, so
+            # re-predicting the same table stays free) overlaps the matmul
+            # on chunk k instead of one long blocking push
+            from ...common.staging import wire_is_slow
+            from ...common.streaming import iter_row_chunks, stream_map
+
+            wire_is_slow()  # resolve the gate before transfers contend
+            rows = max(1, self.STREAM_CHUNK_BYTES // max(X.strides[0], 1))
+            parts = [
+                np.asarray(s)
+                for _, s in stream_map(
+                    lambda xd: self._score_jit(
+                        xd, self.weights, self.intercept),
+                    iter_row_chunks([X], rows),
+                    put=lambda arrs: [stage_replicated(a) for a in arrs],
+                )
+            ]
+            return np.concatenate(parts, axis=0)
         # content-cached device staging: re-predicting the same table does
         # not re-push the feature block host->device
         Xd = stage_replicated(X)

@@ -139,10 +139,11 @@ class _BaseIngestMapper(Mapper):
             types.append(_col_type_for(shape))
         return self._append_result_schema(input_schema, names, types)
 
-    # bounded dispatch-ahead: JAX dispatch is asynchronous, so keeping a few
-    # batches in flight overlaps host->device transfer of batch i+1 with the
-    # device computing batch i — the difference between wire-bound and
-    # compute-bound serving on a tunneled/remote accelerator
+    # bounded dispatch-ahead: host->device transfer of batch i+1 runs on the
+    # shared transfer threads (common/streaming.py double buffering) while
+    # the device computes batch i, and at most PIPELINE_DEPTH executions are
+    # in flight — the difference between wire-bound and compute-bound serving
+    # on a tunneled/remote accelerator
     PIPELINE_DEPTH = 3
 
     def _iter_batches(self, t: MTable):
@@ -166,17 +167,27 @@ class _BaseIngestMapper(Mapper):
                 ]
             yield m, chunk
 
+    def _wire_cache_mode(self):
+        """Content-cache staging for predict batches only under the explicit
+        bfloat16 serving policy: the staging cache's auto-bf16 wire would
+        silently round fp32 inputs on slow tunnels, and precision="float32"
+        is the documented numerics-parity contract."""
+        return "auto" if self._ingest_dtype() else False
+
     def _dispatch_batches(self, t: MTable):
-        """Dispatch every fixed-size device batch of ``t``, throttled so at
-        most PIPELINE_DEPTH executions are in flight (bounds pinned input
-        buffers even when a stream chunk spans many batches); returns
-        [(valid_rows, [device result refs])]."""
+        """Dispatch every fixed-size device batch of ``t``, with transfers
+        double-buffered ahead of compute and at most PIPELINE_DEPTH
+        executions in flight (bounds pinned input buffers even when a stream
+        chunk spans many batches); returns [(valid_rows, [device refs])]."""
         import jax
+
+        from ...common.streaming import stream_map
 
         pending = []
         inflight: deque = deque()
-        for m, chunk in self._iter_batches(t):
-            res = self._fn(*chunk)
+        for m, res in stream_map(self._fn, self._iter_batches(t),
+                                 depth=self.PIPELINE_DEPTH,
+                                 use_cache=self._wire_cache_mode()):
             pending.append((m, res))
             inflight.append(res)
             if len(inflight) >= self.PIPELINE_DEPTH:
@@ -204,6 +215,8 @@ class _BaseIngestMapper(Mapper):
     def map_table(self, t: MTable) -> MTable:
         import jax
 
+        from ...common.streaming import stream_map
+
         self._ensure_loaded()
         outs: List[List[np.ndarray]] = [[] for _ in self._out_info]
         inflight: deque = deque()
@@ -224,8 +237,9 @@ class _BaseIngestMapper(Mapper):
                     outs[i].append(np.asarray(jnp.concatenate(parts, axis=0)))
             group.clear()
 
-        for m, chunk in self._iter_batches(t):
-            res = self._fn(*chunk)
+        for m, res in stream_map(self._fn, self._iter_batches(t),
+                                 depth=self.PIPELINE_DEPTH,
+                                 use_cache=self._wire_cache_mode()):
             inflight.append(res)
             group.append((m, res))
             if len(inflight) >= self.PIPELINE_DEPTH:

@@ -25,8 +25,19 @@ class MapBatchOp(BatchOperator):
 
     mapper_cls: Type = None
 
+    # mapper-chain fusion contract (common/executor.py): linear runs of
+    # mapper ops collapse into one scheduled FusedMapperChain unit; the data
+    # edge is input[_fusion_data_index], and _fusion_mapper builds the ready-
+    # to-run mapper once upstream deps are evaluated. Ops whose mapper is
+    # side-effectful or not row-wise set _fusable = False.
+    _fusable = True
+    _fusion_data_index = 0
+
     def __init__(self, params=None, **kwargs):
         super().__init__(params, **kwargs)
+
+    def _fusion_mapper(self, data_schema):
+        return self._make_mapper(data_schema)
 
     def _make_mapper(self, data_schema):
         # cached per input schema: foreign-model mappers (modelpredict) load
@@ -55,11 +66,22 @@ class ModelMapBatchOp(BatchOperator):
 
     mapper_cls: Type = None
 
+    _fusable = True
+    _fusion_data_index = 1  # input[0] is the model table
+
     def __init__(self, params=None, **kwargs):
         super().__init__(params, **kwargs)
 
     def _make_mapper(self, model_schema, data_schema):
         return self.mapper_cls(model_schema, data_schema, self.get_params())
+
+    def _fusion_mapper(self, data_schema):
+        # deps are evaluated before a fused unit runs, so the model read is
+        # a memoized fetch — same load path as _execute_impl
+        model = self._inputs[0]._evaluate()
+        mapper = self._make_mapper(model.schema, data_schema)
+        mapper.load_model(model)
+        return mapper
 
     def _execute_impl(self, model: MTable, t: MTable) -> MTable:
         mapper = self._make_mapper(model.schema, t.schema)

@@ -342,13 +342,21 @@ def bench_resnet50(batch=256, steps=3):
     row_bytes = 224 * 224 * 3
     wire_floor = mbps * 1e6 / row_bytes
 
-    # end-to-end: dispatch all batches (transfers overlap compute), trim +
-    # concatenate logits ON DEVICE, one host fetch — the same
-    # round-trip-minimising discipline the ingest mapper now uses
+    # end-to-end through the double-buffered streamer (common/streaming.py):
+    # each batch ships as 4 parallel row-chunk transfers reassembled on
+    # device (the tunnel is per-stream limited, so aggregate wire bandwidth
+    # scales with stream count), device_put of batch k+1 overlaps compute on
+    # batch k, and logits are trimmed + concatenated ON DEVICE and fetched
+    # with one host transfer
     import jax.numpy as jnp
 
+    from alink_tpu.common.streaming import stream_map
+
+    stream_phases = {}
     t0 = time.perf_counter()
-    refs = [serve(b) for b in bufs]
+    refs = [r for _, r in stream_map(
+        serve, ((i, [b]) for i, b in enumerate(bufs)),
+        depth=max(2, steps - 1), split=4, phases=stream_phases)]
     logits = np.asarray(jnp.concatenate(refs, axis=0))
     dt = time.perf_counter() - t0
     assert logits.shape == (batch * steps, 1000)
@@ -369,6 +377,12 @@ def bench_resnet50(batch=256, steps=3):
             "rows_per_sec_on_device_fp32": round(time_dev(serve32), 1),
             "tunnel_MB_per_s": round(mbps, 1),
             "wire_floor_rows_per_sec": round(wire_floor, 1),
+            "stream": {"wall_s": round(dt, 3),
+                       "transfer_s": round(
+                           stream_phases.get("transfer_s", 0.0), 3),
+                       "compute_s": round(
+                           stream_phases.get("compute_s", 0.0), 3),
+                       "in_flight": max(2, steps - 1), "split": 4},
             "batch": batch}
 
 
@@ -512,6 +526,75 @@ def bench_bert_quality():
             "wall_clock_s": round(time.perf_counter() - t0, 2)}
 
 
+def bench_executor(rows=2_000_000):
+    """Pipelined DAG executor (common/executor.py): two independent branches
+    off one shared source run concurrently on the DAG pool, and a 3-op
+    row-wise mapper chain fuses into a single jitted unit. Reports the
+    engine's own per-node trace (the same records BENCH readers should use
+    to diagnose scheduling regressions): node wall times, the transfer/
+    compute phase split where nodes report one, fused-chain count, and the
+    concurrency win vs the old serial walk (node_wall_sum ≈ what depth-first
+    evaluation would have cost)."""
+    from alink_tpu.common.metrics import executor_trace, metrics
+    from alink_tpu.common.mtable import AlinkTypes, MTable
+    from alink_tpu.mapper.base import BlockKernelMapper
+    from alink_tpu.operator.batch import TableSourceBatchOp
+    from alink_tpu.operator.batch.utils import MapBatchOp
+
+    def affine_op(col, out, a, b):
+        class _M(BlockKernelMapper):
+            def kernel(self, schema):
+                def fn(X):
+                    return X * a + b
+
+                return ([col], [out], [AlinkTypes.DOUBLE], fn)
+
+        class _Op(MapBatchOp):
+            mapper_cls = _M
+
+        return _Op()
+
+    rng = np.random.RandomState(0)
+    src = TableSourceBatchOp(
+        MTable({"x": rng.rand(rows), "y": rng.rand(rows)}))
+
+    def branch(col):
+        def work(t):
+            v = np.asarray(t.col(col))
+            for _ in range(4):  # real host work, ~O(0.5s) per branch
+                v = np.sort(v)[::-1].copy()
+            return MTable({col: v})
+
+        return src.apply_func(work, out_schema=f"{col} double")
+
+    chain = affine_op("x", "x1", 2.0, 1.0).link_from(src)
+    chain = affine_op("x1", "x2", 0.5, -3.0).link_from(chain)
+    chain = affine_op("x2", "x3", 4.0, 0.25).link_from(chain)
+
+    n0 = len(executor_trace())
+    sink: dict = {}
+    branch("x").lazy_collect(lambda t: sink.setdefault("a", t.num_rows))
+    branch("y").lazy_collect(lambda t: sink.setdefault("b", t.num_rows))
+    chain.lazy_collect(lambda t: sink.setdefault("c", t.num_rows))
+    t0 = time.perf_counter()
+    src.execute()
+    wall = time.perf_counter() - t0
+    assert sink == {"a": rows, "b": rows, "c": rows}
+
+    trace = executor_trace()[n0:]
+    node_wall = sum(r.get("wall_s", 0.0) for r in trace)
+    run = metrics.last("executor.run") or {}
+    return {
+        "wall_s": round(wall, 3),
+        "node_wall_sum_s": round(node_wall, 3),
+        "speedup_vs_serial": round(node_wall / wall, 2) if wall > 0 else None,
+        "nodes": run.get("nodes"),
+        "scheduled_units": run.get("units"),
+        "fused_chains": run.get("fused_chains"),
+        "trace": sorted(trace, key=lambda r: -r.get("wall_s", 0.0))[:6],
+    }
+
+
 def main():
     extras = {}
     for name, fn in (
@@ -522,6 +605,7 @@ def main():
         ("resnet50_predict", bench_resnet50),
         ("resnet50_savedmodel", bench_resnet50_savedmodel),
         ("bert_text_quality", bench_bert_quality),
+        ("executor", bench_executor),
     ):
         try:
             extras[name] = fn()

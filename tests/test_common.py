@@ -144,6 +144,25 @@ def test_mtable_vector_column_to_block():
     np.testing.assert_array_equal(block, [[1, 2, 0], [3, 4, 1]])
 
 
+def test_mtable_numeric_block_is_readonly_and_shared():
+    """to_numeric_block returns ONE memoized buffer shared by every caller
+    (and content-keyed into the staging cache), so in-place mutation must
+    raise instead of silently corrupting other jobs' views."""
+    t = MTable({"a": [1.0, 2.0], "b": [3.0, 4.0]})
+    block = t.to_numeric_block(["a", "b"])
+    with pytest.raises(ValueError):
+        block[0, 0] = 99.0
+    # same memoized object on repeat, unchanged content
+    again = t.to_numeric_block(["a", "b"])
+    assert again is block
+    np.testing.assert_array_equal(block, [[1, 3], [2, 4]])
+    # single-column blocks share the contract (they own a copied buffer)
+    single = t.to_numeric_block(["a"])
+    with pytest.raises(ValueError):
+        single[0, 0] = 99.0
+    np.testing.assert_array_equal(np.asarray(t.col("a")), [1.0, 2.0])
+
+
 def test_mtable_payload_roundtrip():
     t = MTable(
         {

@@ -251,3 +251,42 @@ def test_bert_op_blockwise_long_text():
     acc = float((np.asarray(pred.col("p"))
                  == np.asarray(labels)).mean())
     assert acc >= 0.9, acc
+
+
+def test_pooling_strategy_validated_and_threaded():
+    """poolingStrategy is validated (auto|cls|mean) and threads through
+    _bert_config: auto resolves to mean for in-framework checkpoints, an
+    explicit value wins as-is."""
+    from alink_tpu.common.exceptions import AkIllegalArgumentException
+
+    with pytest.raises(AkIllegalArgumentException):
+        BertTextClassifierTrainBatchOp(
+            textCol="text", labelCol="label", poolingStrategy="max")
+
+    def cfg_of(**kw):
+        op = BertTextClassifierTrainBatchOp(
+            textCol="text", labelCol="label", bertSize="tiny",
+            maxSeqLength=16, **kw)
+        return op._bert_config(vocab_size=64, num_labels=2)
+
+    assert cfg_of().pool == "mean"                       # auto -> mean
+    assert cfg_of(poolingStrategy="cls").pool == "cls"   # explicit wins
+    assert cfg_of(poolingStrategy="mean").pool == "mean"
+    assert cfg_of().num_labels == 2
+
+
+def test_pooling_cls_trains_in_framework():
+    """An in-framework (from-scratch) run with explicit cls pooling goes
+    end-to-end — the param is honored, not silently mean."""
+    t = _text_table()
+    src = TableSourceBatchOp(t)
+    train = BertTextClassifierTrainBatchOp(
+        textCol="text", labelCol="label", bertSize="tiny", maxSeqLength=16,
+        numEpochs=2, batchSize=16, learningRate=1e-3, vocabSize=256,
+        poolingStrategy="cls",
+    ).link_from(src)
+    model = train.collect()
+    from alink_tpu.common.model import table_to_model
+
+    meta, _ = table_to_model(model)
+    assert meta["bertConfig"]["pool"] == "cls"

@@ -124,6 +124,42 @@ def test_odps_read_nulls_and_values():
     assert list(np.asarray(t.col("id"))) == [1, 2]
 
 
+def test_odps_boolean_round_trips_false():
+    """BOOLEAN columns must keep raw truth values: the old reader
+    stringified them, and astype(bool) of the non-empty string "False" is
+    True — every False silently flipped."""
+    cat = OdpsCatalog(client=_sales_client())
+    t = cat.read_table("sales")
+    ok = t.col("ok")
+    assert ok.dtype == np.bool_
+    assert list(np.asarray(ok, bool)) == [True, False]
+    assert list(np.asarray(ok).astype(bool)) == [True, False]
+
+    # and back out through write_table: the wire sees real bools
+    client = FakeOdpsClient()
+    out_cat = OdpsCatalog(client=client)
+    out_cat.write_table("flags", MTable(
+        {"ok": np.asarray([True, False])},
+        "ok boolean"))
+    assert client.tables["flags"].rows == [(True,), (False,)]
+    back = out_cat.read_table("flags")
+    assert list(np.asarray(back.col("ok"), bool)) == [True, False]
+
+
+def test_odps_nullable_boolean_promotes_to_double_nan():
+    """Null booleans follow the framework-wide nullable rule (DOUBLE + NaN,
+    like nullable ints) — False must stay distinguishable from null."""
+    c = FakeOdpsClient()
+    t = FakeOdpsTable([FakeColumn("b", "boolean")],
+                      [(True,), (None,), (False,)])
+    t.name = "nb"
+    c.tables["nb"] = t
+    out = OdpsCatalog(client=c).read_table("nb")
+    assert out.schema.type_of("b") == AlinkTypes.DOUBLE
+    vals = np.asarray(out.col("b"))
+    assert vals[0] == 1.0 and np.isnan(vals[1]) and vals[2] == 0.0
+
+
 def test_odps_write_creates_and_appends():
     client = FakeOdpsClient()
     cat = OdpsCatalog(client=client)

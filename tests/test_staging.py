@@ -89,6 +89,62 @@ def test_auto_policy_keeps_small_blocks_exact():
     np.testing.assert_array_equal(np.asarray(a)[:64], X)
 
 
+def test_auto_policy_big_block_exact_on_fast_wire(monkeypatch):
+    """auto is precision-safe by default: a ≥4 MiB float block stays exact
+    fp32 on a fast (local/PCIe-class) wire — bf16 only engages when the
+    tunnel measures slow."""
+    monkeypatch.setenv("ALINK_ASSUME_SLOW_WIRE", "0")
+    X = np.random.RandomState(4).normal(size=(1 << 20, 2)).astype(np.float32)
+    assert X.nbytes >= 4 * 1024 * 1024
+    a = stage_replicated(X)
+    np.testing.assert_array_equal(np.asarray(a), X)
+    assert staging_cache_stats()["wire_bytes_saved"] == 0
+
+
+def test_auto_policy_big_block_bf16_on_slow_wire(monkeypatch):
+    """...and the slow-tunnel gate actually exercises the bf16 tradeoff on
+    the same ≥4 MiB block: wire bytes halve, values round to bf16."""
+    monkeypatch.setenv("ALINK_ASSUME_SLOW_WIRE", "1")
+    X = np.random.RandomState(5).normal(size=(1 << 20, 2)).astype(np.float32)
+    assert X.nbytes >= 4 * 1024 * 1024
+    a = stage_replicated(X)
+    assert a.dtype == np.float32
+    got = np.asarray(a)
+    np.testing.assert_allclose(got, X, rtol=8e-3, atol=8e-3)  # bf16 rounding
+    assert (got != X).any()  # the downcast really happened
+    assert staging_cache_stats()["wire_bytes_saved"] == X.nbytes // 2
+
+
+def test_auto_cache_key_tracks_slow_gate(monkeypatch):
+    """Flipping the slow-wire gate mid-process must not serve a bf16-rounded
+    cached array to a caller expecting exact fp32 (the key carries the
+    effective auto decision, not just the policy name)."""
+    monkeypatch.setenv("ALINK_ASSUME_SLOW_WIRE", "1")
+    X = np.random.RandomState(6).normal(size=(1 << 20, 2)).astype(np.float32)
+    a = np.asarray(stage_replicated(X))
+    assert (a != X).any()                      # slow gate: bf16 wire
+    monkeypatch.setenv("ALINK_ASSUME_SLOW_WIRE", "0")
+    b = np.asarray(stage_replicated(X))
+    np.testing.assert_array_equal(b, X)        # fast gate: exact, no reuse
+
+
+def test_wire_stats_are_locked_under_concurrency():
+    """stage_* from many threads (the pipelined executor does this) must not
+    lose wire-byte updates: total sent == sum of distinct block sizes."""
+    import threading
+
+    AlinkGlobalConfiguration.set_wire_precision("fp32")
+    blocks = [np.full((256, 16), float(i), np.float32) for i in range(16)]
+    threads = [threading.Thread(target=stage_replicated, args=(b,))
+               for b in blocks]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert staging_cache_stats()["wire_bytes_sent"] == sum(
+        b.nbytes for b in blocks)
+
+
 def test_int_arrays_never_downcast():
     mesh = default_mesh()
     AlinkGlobalConfiguration.set_wire_precision("bf16")
