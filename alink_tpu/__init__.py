@@ -15,11 +15,17 @@ from .common.env import enable_compilation_cache as _enable_cc  # noqa: E402
 _enable_cc()
 
 from .common import (  # noqa: F401
+    AkException,
+    AkRetryableException,
     AlinkTypes,
     DenseMatrix,
     DenseVector,
+    FaultSpec,
     MTable,
     Params,
+    RetryPolicy,
     SparseVector,
     TableSchema,
+    is_retryable,
+    with_retries,
 )

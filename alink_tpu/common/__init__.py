@@ -7,7 +7,20 @@ from .exceptions import (
     AkColumnNotFoundException,
     AkUnsupportedOperationException,
     AkExecutionErrorException,
+    AkCircuitOpenException,
+    AkRetryableException,
     AkPreconditions,
+    is_retryable,
+    mark_retryable,
+)
+from .faults import FaultSpec
+from .resilience import (
+    CircuitBreaker,
+    DeadLetterBuffer,
+    RetryPolicy,
+    dead_letters,
+    resilience_summary,
+    with_retries,
 )
 from .linalg import (
     DenseMatrix,

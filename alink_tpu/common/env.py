@@ -22,6 +22,35 @@ from typing import Dict, Optional
 
 from .exceptions import AkIllegalArgumentException
 
+_FALSEY = ("0", "off", "false", "no", "")
+
+
+def env_int(name: str, default: int) -> int:
+    """Integer env knob; malformed values fall back to the default (config
+    typos must never crash a running job)."""
+    try:
+        raw = os.environ.get(name)
+        return default if raw is None or raw.strip() == "" else int(raw)
+    except ValueError:
+        return default
+
+
+def env_float(name: str, default: "float | None") -> "float | None":
+    try:
+        raw = os.environ.get(name)
+        return default if raw is None or raw.strip() == "" else float(raw)
+    except ValueError:
+        return default
+
+
+def env_flag(name: str, default: bool = False) -> bool:
+    """Boolean env knob: "0"/"off"/"false"/"no" are false, anything else
+    present is true, absent is the default."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    return raw.strip().lower() not in _FALSEY
+
 
 class AlinkGlobalConfiguration:
     """Process-global config (reference: common/AlinkGlobalConfiguration.java).

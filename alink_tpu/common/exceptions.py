@@ -3,6 +3,14 @@
 Capability parity with the reference's ``common/exceptions`` package
 (``AkIllegalOperationException`` etc., reference: core/src/main/java/com/alibaba/alink/
 common/exceptions/), re-expressed as a small Python hierarchy.
+
+On top of the reference's code taxonomy this module adds the
+retryable/fatal classification the resilience layer
+(``common/resilience.py``) keys every policy decision on: the reference
+delegates transient-failure handling to Flink's task-retry machinery,
+while here :func:`is_retryable` is the single place that decides whether
+an error is worth another attempt — framework code never pattern-matches
+exception text at call sites.
 """
 
 from __future__ import annotations
@@ -58,6 +66,80 @@ class AkParseErrorException(AkException):
 
 class AkPluginNotExistException(AkException):
     code = "AK_PLUGIN_NOT_EXIST"
+
+
+class AkRetryableException(AkException):
+    """Transient by contract: callers may retry under a
+    :class:`~alink_tpu.common.resilience.RetryPolicy`. Connectors raise (or
+    wrap into) this for timeouts, throttling, and flaky transport."""
+
+    code = "AK_RETRYABLE"
+
+
+class AkCircuitOpenException(AkRetryableException):
+    """A circuit breaker is open for the target endpoint: the call was
+    rejected without being attempted. Retryable — the breaker half-opens
+    after its reset timeout."""
+
+    code = "AK_CIRCUIT_OPEN"
+
+
+# OSError subclasses that signal a *state* problem, not a transient one —
+# retrying "file not found" only burns the deadline budget
+_NON_TRANSIENT_OS = (
+    FileNotFoundError, PermissionError, IsADirectoryError,
+    NotADirectoryError, FileExistsError,
+)
+
+# status keywords XLA/jax runtime errors carry when the device, transfer
+# tunnel, or compile service hiccuped (vs. genuine program errors like
+# INVALID_ARGUMENT shape mismatches)
+_TRANSIENT_XLA_MARKERS = (
+    "RESOURCE_EXHAUSTED", "UNAVAILABLE", "DEADLINE_EXCEEDED", "ABORTED",
+    "CANCELLED", "CONNECTION RESET", "SOCKET CLOSED", "TRANSFER",
+)
+
+
+def mark_retryable(exc: BaseException) -> BaseException:
+    """Tag any exception instance as retryable without changing its type
+    (for call sites that know a specific library error is transient)."""
+    exc.__alink_retryable__ = True  # type: ignore[attr-defined]
+    return exc
+
+
+def is_retryable(exc: BaseException) -> bool:
+    """Central transient/fatal classification. True for errors worth a
+    backed-off retry: explicit :class:`AkRetryableException`, exceptions
+    tagged via :func:`mark_retryable`, connector client errors that declare
+    themselves retriable (kafka-python's ``KafkaError.retriable``),
+    timeouts/connection drops/transient OS errors, and XLA runtime errors
+    whose status marks a device/transfer hiccup. Everything else — in
+    particular every other classified ``Ak*`` error — is fatal."""
+    if isinstance(exc, AkRetryableException):
+        return True
+    if getattr(exc, "__alink_retryable__", False):
+        return True
+    if getattr(exc, "retriable", False):  # kafka-python KafkaError contract
+        return True
+    if isinstance(exc, AkException):
+        return False  # deliberately classified: arguments, state, data, ...
+    if isinstance(exc, (KeyboardInterrupt, SystemExit, GeneratorExit)):
+        return False
+    if isinstance(exc, (TimeoutError, ConnectionError)):
+        return True
+    if isinstance(exc, OSError):
+        return not isinstance(exc, _NON_TRANSIENT_OS)
+    # concurrent.futures.TimeoutError stopped aliasing the builtin only on
+    # old interpreters; match by name to stay version-agnostic, and catch
+    # XLA runtime faults (jaxlib raises XlaRuntimeError for both program
+    # bugs and infrastructure hiccups — only the latter statuses retry)
+    name = type(exc).__name__
+    if name == "TimeoutError":
+        return True
+    if name == "XlaRuntimeError":
+        msg = str(exc).upper()
+        return any(m in msg for m in _TRANSIENT_XLA_MARKERS)
+    return False
 
 
 class AkPreconditions:

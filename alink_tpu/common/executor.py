@@ -27,6 +27,19 @@ another. This module replaces that walk with a real scheduler:
    the lower layers report (``transfer_s``/``compute_s`` from
    ``common/streaming.py``) into ``common/metrics.py``; BENCH surfaces the
    breakdown as the ``executor`` extra.
+4. **Fault tolerance** — failed units are retried under the central
+   :class:`~alink_tpu.common.resilience.RetryPolicy` when the error is
+   transient (``is_retryable``); this is safe because ``_executed`` is only
+   set on success, so a retry re-runs exactly the failed work. Degradation
+   ladder: a fused chain that fails *defuses* and re-runs node-by-node
+   before its failure counts as an attempt (rules out fusion itself), and
+   a DAG-pool failure (shutdown/exhaustion) falls back to the serial
+   recursive walk instead of erroring. A run that ultimately fails
+   propagates the first failure unchanged, drains in-flight branches, and
+   leaves the DAG re-collectable: a later ``collect()`` re-plans only the
+   unfinished sub-DAG (successful upstreams stay memoized). The ``unit``
+   fault-injection point (``common/faults.py``) fires at the start of
+   every attempt.
 
 Knobs (env):
 
@@ -34,6 +47,8 @@ Knobs (env):
 - ``ALINK_DAG_FUSION=0``      — schedule every node individually.
 - ``ALINK_DAG_POOL_SIZE``     — DAG pool width (default: session parallelism,
   capped at 8; node-internal work still uses the session pool).
+- ``ALINK_RETRIES=off``       — fail fast on the first error (no unit
+  retries, no defusion, no serial degradation).
 """
 
 from __future__ import annotations
@@ -44,7 +59,9 @@ import time
 from concurrent.futures import FIRST_COMPLETED, wait
 from typing import Any, Dict, List, Optional, Sequence
 
+from .faults import maybe_fail
 from .metrics import metrics, node_phase_context
+from .resilience import RetryPolicy, retries_enabled, with_retries
 
 _DAG_THREAD_PREFIX = "alink-dag"
 _TRACE_LIMIT = 4096  # ring bound on trace series: long-lived processes
@@ -234,16 +251,59 @@ def _dag_pool_size(env) -> int:
     return max(2, min(8, env.parallelism))
 
 
+def _run_unit_resilient(unit: _Unit) -> Dict[str, Any]:
+    """One unit through the resilience ladder. Every attempt starts at the
+    ``unit`` fault-injection tap; a fused chain's first failure defuses it
+    (node-by-node re-run, intermediates materialize) *within the same
+    attempt*, so retry budget is only spent once fusion is ruled out as the
+    cause. Returns attempt accounting for the node trace."""
+    state = {"defused": False, "attempts": 0}
+
+    def attempt():
+        state["attempts"] += 1
+        try:
+            maybe_fail("unit", label=unit.label())
+            if state["defused"]:
+                for op in unit.ops:
+                    op._evaluate()
+            else:
+                unit.run()
+        except (KeyboardInterrupt, SystemExit, GeneratorExit):
+            raise
+        except BaseException:
+            if (retries_enabled() and unit.fused
+                    and not state["defused"]):
+                state["defused"] = True
+                metrics.incr("resilience.defused")
+                # the defused re-run goes through the injection tap too —
+                # a persistent fatal fault must propagate, not be absorbed
+                # by defusion. May raise: counts as this attempt's failure
+                # and enters the retry loop.
+                maybe_fail("unit", label=unit.label())
+                for op in unit.ops:
+                    op._evaluate()
+            else:
+                raise
+
+    with_retries(attempt, name=f"unit:{unit.label()}",
+                 counter="resilience.unit_retries")
+    return state
+
+
 def _run_unit(unit: _Unit, record: bool):
     phases: Dict[str, Any] = {}
     t0 = time.perf_counter()
     with node_phase_context(phases):
-        unit.run()
+        state = _run_unit_resilient(unit)
     if record:
         wall = time.perf_counter() - t0
         rec = {"op": unit.label(), "wall_s": round(wall, 6)}
         if unit.fused:
             rec["fused"] = len(unit.ops)
+        if state["attempts"] > 1:
+            rec["attempts"] = state["attempts"]
+        if state["defused"]:
+            rec["defused"] = True
         for k, v in phases.items():
             rec[k] = round(v, 6) if isinstance(v, float) else v
         metrics.record_bounded("executor.node", _TRACE_LIMIT, **rec)
@@ -257,7 +317,13 @@ def run_dag(env, roots: Sequence[Any], record: bool = True) -> None:
 
     Falls back to the serial recursive walk when the scheduler is disabled,
     when called from inside a DAG worker (nested ``collect()`` in an op body
-    must not wait on its own pool), or when the graph is trivial."""
+    must not wait on its own pool), when the graph is trivial, or — with
+    retries enabled — when the DAG pool itself fails (shutdown mid-flight,
+    thread exhaustion): losing the concurrency win beats failing the job.
+
+    A failing run raises the *first* unit failure unchanged after draining
+    every in-flight branch; completed units stay memoized, so a later
+    ``collect()`` re-plans only the unfinished sub-DAG."""
     roots = [r for r in roots if r is not None]
     if not roots:
         return
@@ -276,15 +342,33 @@ def run_dag(env, roots: Sequence[Any], record: bool = True) -> None:
     t_start = time.perf_counter()
     ready = [u for u in units if u.indegree == 0]
     remaining = len(units)
-    pool = env.dag_pool
     futures: Dict[Any, _Unit] = {}
     first_exc: Optional[BaseException] = None
+    degraded = False
 
-    while (ready or futures) and remaining:
+    try:
+        pool = env.dag_pool
+    except BaseException:
+        if not retries_enabled():
+            raise
+        pool, degraded = None, True
+
+    while (ready or futures) and remaining and not degraded:
         if first_exc is None:
-            for u in ready:
-                futures[pool.submit(_run_unit, u, record)] = u
-            ready = []
+            try:
+                while ready:
+                    u = ready[-1]
+                    futures[pool.submit(_run_unit, u, record)] = u
+                    ready.pop()
+            except BaseException as exc:
+                # pool broke (shutdown/exhaustion), not the unit itself:
+                # degrade to the serial walk instead of failing the job
+                if not retries_enabled():
+                    if first_exc is None:
+                        first_exc = exc
+                    ready = []
+                else:
+                    degraded = True
         if not futures:
             break
         done, _ = wait(list(futures), return_when=FIRST_COMPLETED)
@@ -300,12 +384,23 @@ def run_dag(env, roots: Sequence[Any], record: bool = True) -> None:
                 c.indegree -= 1
                 if c.indegree == 0:
                     ready.append(c)
+    if degraded:
+        # drain whatever the pool still runs, then finish serially —
+        # memoization skips every unit that already completed
+        if futures:
+            wait(list(futures))
+            futures.clear()
+        metrics.incr("resilience.degraded_serial")
+        if first_exc is None:
+            for r in roots:
+                r._evaluate()
     if record:
         metrics.add_time("executor.schedule", time.perf_counter() - t_start)
         metrics.record_bounded(
             "executor.run", _TRACE_LIMIT,
             units=len(units), nodes=len(nodes),
             fused_chains=sum(1 for u in units if u.fused),
+            degraded=degraded,
             wall_s=round(time.perf_counter() - t_start, 6))
     if first_exc is not None:
         raise first_exc

@@ -1,0 +1,225 @@
+"""Deterministic fault injection for resilience testing.
+
+The only way to trust a fault-tolerance layer is to run real jobs under
+injected failure. This module is the seeded chaos tap the runtime layers
+consult at named injection points — and it ships as a *public* testing
+utility, so user pipelines can be certified under fault the same way the
+framework's own suite is.
+
+Injection points wired into the runtime:
+
+- ``unit``      — start of every scheduled DAG unit attempt
+  (``common/executor.py``).
+- ``transfer``  — host→device transfer submission
+  (``common/streaming.py``).
+- ``io``        — connector poll/read/write calls (Kafka/DataHub source
+  polls and sinks, ODPS read/write, HBase batch gets).
+
+Spec grammar (``ALINK_FAULT_SPEC``)::
+
+    point:key=value[,key=value...][;point:...]
+
+    unit:rate=0.3,kinds=transient;io:count=2
+
+- ``rate=F``   — each call at the point fails with probability *F*, drawn
+  from a per-point RNG seeded by ``ALINK_FAULT_SEED`` (default 0): the
+  same spec + seed replays the exact same fault schedule.
+- ``count=N``  — the first *N* calls at the point fail, then all pass
+  (takes precedence over ``rate``).
+- ``kinds``    — ``transient`` (raises :class:`InjectedFaultError`, which
+  the taxonomy classifies retryable) or ``fatal`` (raises
+  :class:`InjectedFatalError`, never retried).
+
+Usage::
+
+    from alink_tpu.common import faults
+
+    faults.install(faults.FaultSpec.parse("unit:rate=0.3", seed=7))
+    try:
+        op.collect()          # completes despite injected unit faults
+    finally:
+        faults.clear()
+
+or externally: ``ALINK_FAULT_SPEC='io:count=2' python job.py``.
+
+Injected faults are counted per point (``faults.injected.<point>``) in
+``common/metrics.py`` so a run under injection reports how much fault
+pressure it actually absorbed.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import zlib
+from random import Random
+from typing import Dict, Optional
+
+from .env import env_int
+from .exceptions import AkException, AkRetryableException
+from .metrics import metrics
+
+
+class InjectedFaultError(AkRetryableException):
+    """Synthetic *transient* fault — classified retryable by the taxonomy."""
+
+    code = "AK_INJECTED_FAULT"
+
+
+class InjectedFatalError(AkException):
+    """Synthetic *fatal* fault — never retried; must propagate unchanged."""
+
+    code = "AK_INJECTED_FATAL"
+
+
+class _Rule:
+    __slots__ = ("rate", "count", "kind", "_rng", "_calls", "_fired")
+
+    def __init__(self, rate: float = 0.0, count: int = 0,
+                 kind: str = "transient", seed: int = 0, point: str = ""):
+        self.rate = rate
+        self.count = count
+        self.kind = kind
+        # per-point stream: independent of call order at *other* points, so
+        # adding a branch to a DAG does not reshuffle every fault schedule
+        self._rng = Random(seed ^ zlib.crc32(point.encode()))
+        self._calls = 0
+        self._fired = 0
+
+    def should_fire(self) -> bool:
+        self._calls += 1
+        if self.count > 0:
+            if self._fired < self.count:
+                self._fired += 1
+                return True
+            return False
+        if self.rate > 0.0 and self._rng.random() < self.rate:
+            self._fired += 1
+            return True
+        return False
+
+
+class FaultSpec:
+    """A parsed, seeded fault schedule. Thread-safe: DAG units fire from
+    pool workers concurrently."""
+
+    def __init__(self, rules: Dict[str, _Rule], seed: int = 0,
+                 source: str = ""):
+        self._rules = rules
+        self.seed = seed
+        self.source = source
+        self._lock = threading.Lock()
+
+    @classmethod
+    def parse(cls, spec: str, seed: int = 0) -> "FaultSpec":
+        from .exceptions import AkParseErrorException
+
+        rules: Dict[str, _Rule] = {}
+        for part in spec.split(";"):
+            part = part.strip()
+            if not part:
+                continue
+            point, sep, body = part.partition(":")
+            point = point.strip()
+            if not sep or not point:
+                raise AkParseErrorException(
+                    f"bad fault spec segment {part!r} "
+                    f"(want point:key=value,...)")
+            kw: Dict[str, str] = {}
+            for item in body.split(","):
+                item = item.strip()
+                if not item:
+                    continue
+                k, sep2, v = item.partition("=")
+                if not sep2:
+                    raise AkParseErrorException(
+                        f"bad fault spec item {item!r} in segment {part!r}")
+                kw[k.strip()] = v.strip()
+            kind = kw.get("kinds", kw.get("kind", "transient"))
+            if kind not in ("transient", "fatal"):
+                raise AkParseErrorException(
+                    f"fault kind must be transient|fatal, got {kind!r}")
+            try:
+                rate = float(kw.get("rate", "0"))
+                count = int(kw.get("count", "0"))
+            except ValueError as e:
+                raise AkParseErrorException(
+                    f"bad rate/count in fault spec segment {part!r}") from e
+            rules[point] = _Rule(rate=rate, count=count, kind=kind,
+                                 seed=seed, point=point)
+        return cls(rules, seed=seed, source=spec)
+
+    def fire(self, point: str, label: str = "") -> None:
+        rule = self._rules.get(point)
+        if rule is None:
+            return
+        with self._lock:
+            fire = rule.should_fire()
+            kind = rule.kind
+        if not fire:
+            return
+        metrics.incr(f"faults.injected.{point}")
+        where = f"{point}:{label}" if label else point
+        if kind == "fatal":
+            raise InjectedFatalError(f"injected fatal fault at {where}")
+        raise InjectedFaultError(f"injected transient fault at {where}")
+
+    def __repr__(self):
+        return f"FaultSpec({self.source!r}, seed={self.seed})"
+
+
+# ---------------------------------------------------------------------------
+# Active-spec management
+# ---------------------------------------------------------------------------
+
+_installed: Optional[FaultSpec] = None
+# (env string, seed) -> parsed spec; env specs keep rule state across calls
+# so count=N semantics hold process-wide
+_env_cache: Dict[tuple, FaultSpec] = {}
+_state_lock = threading.Lock()
+
+
+def install(spec: Optional[FaultSpec]) -> None:
+    """Programmatically activate a spec (tests); overrides the env spec."""
+    global _installed
+    with _state_lock:
+        _installed = spec
+
+
+def clear() -> None:
+    """Deactivate injection and forget cached env specs (their count state
+    is meaningless once the env changes)."""
+    global _installed
+    with _state_lock:
+        _installed = None
+        _env_cache.clear()
+
+
+def active() -> Optional[FaultSpec]:
+    # lock-free fast path: the tap sits on hot paths (every H2D transfer
+    # submission, every DAG unit attempt, every connector poll) and must
+    # not serialize transfer threads on a global mutex when injection is
+    # off. Reading `_installed` and probing os.environ are plain dict
+    # lookups; the lock is only taken once a spec is actually configured.
+    spec = _installed
+    if spec is not None:
+        return spec
+    env = os.environ.get("ALINK_FAULT_SPEC")
+    if not env or not env.strip():
+        return None
+    env = env.strip()
+    seed = env_int("ALINK_FAULT_SEED", 0)
+    key = (env, seed)
+    with _state_lock:
+        spec = _env_cache.get(key)
+        if spec is None:
+            spec = _env_cache[key] = FaultSpec.parse(env, seed=seed)
+        return spec
+
+
+def maybe_fail(point: str, label: str = "") -> None:
+    """The injection tap. A no-op (two lock-free dict lookups) when no
+    spec is active — cheap enough to leave in every production code path."""
+    spec = active()
+    if spec is not None:
+        spec.fire(point, label)

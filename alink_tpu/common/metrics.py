@@ -21,20 +21,26 @@ from __future__ import annotations
 
 import contextlib
 import json
+import logging
 import threading
 import time
 from collections import defaultdict
 from typing import Any, Dict, List, Optional
 
+logger = logging.getLogger("alink_tpu.metrics")
+
 
 class StepMetrics:
     """In-process metric streams: named series of {step, **values} dicts plus
-    aggregated timers. One global instance (``metrics``) serves the whole
-    session; algorithms record cheaply, callers read ``series``/``summary``."""
+    aggregated timers and monotonic counters. One global instance
+    (``metrics``) serves the whole session; algorithms record cheaply,
+    callers read ``series``/``counters``/``summary``."""
 
     def __init__(self):
         self._series: Dict[str, List[Dict[str, Any]]] = defaultdict(list)
         self._timers: Dict[str, List[float]] = defaultdict(list)
+        self._counters: Dict[str, int] = defaultdict(int)
+        self._counter_lock = threading.Lock()
         self.enabled = True
 
     def record(self, name: str, **values):
@@ -54,6 +60,23 @@ class StepMetrics:
     def add_time(self, name: str, seconds: float):
         if self.enabled:
             self._timers[name].append(seconds)
+
+    def incr(self, name: str, n: int = 1):
+        """Monotonic event counter (retries, dead-letter drops, defusions).
+        Counters count even while recording is disabled — they are the
+        signal that something went wrong, which is exactly when a metrics
+        blackout must not hide it."""
+        with self._counter_lock:
+            self._counters[name] += n
+
+    def counter(self, name: str) -> int:
+        with self._counter_lock:
+            return self._counters.get(name, 0)
+
+    def counters(self, prefix: str = "") -> Dict[str, int]:
+        with self._counter_lock:
+            return {k: v for k, v in self._counters.items()
+                    if k.startswith(prefix)}
 
     def series(self, name: str) -> List[Dict[str, Any]]:
         return list(self._series.get(name, []))
@@ -77,6 +100,9 @@ class StepMetrics:
             out.setdefault(name, {})
             out[name] = {**(out[name] or {}), "points": len(s),
                          "last": s[-1] if s else None}
+        for name, v in self.counters().items():
+            out.setdefault(name, {})
+            out[name] = {**(out[name] or {}), "count": v}
         return out
 
     def to_json(self) -> str:
@@ -85,6 +111,8 @@ class StepMetrics:
     def reset(self):
         self._series.clear()
         self._timers.clear()
+        with self._counter_lock:
+            self._counters.clear()
 
 
 metrics = StepMetrics()
@@ -150,23 +178,39 @@ def timed(name: str, recorder: Optional[StepMetrics] = None):
         rec.add_time(name, time.perf_counter() - t0)
 
 
+_drop_logged = False
+
+
+def _count_drop(where: str, exc: BaseException):
+    """A failure inside the metrics/profiling machinery itself must not
+    abort the measured code — but it must not vanish either: count it in
+    ``metrics.dropped`` and log the first occurrence at debug."""
+    global _drop_logged
+    metrics.incr("metrics.dropped")
+    if not _drop_logged:
+        _drop_logged = True
+        logger.debug("metrics drop at %s: %r (further drops counted in "
+                     "the 'metrics.dropped' counter only)", where, exc)
+
+
 @contextlib.contextmanager
 def profile_trace(log_dir: str, *, host_tracer_level: int = 2):
     """``jax.profiler`` trace context (Perfetto/TensorBoard viewable). No-op
-    fallback if the profiler cannot start (e.g. twice in one process)."""
+    fallback if the profiler cannot start (e.g. twice in one process);
+    start/stop failures are counted in ``metrics.dropped``, never raised."""
     import jax
 
     started = False
     try:
         jax.profiler.start_trace(log_dir)
         started = True
-    except Exception:
-        pass
+    except Exception as e:
+        _count_drop("profile_trace.start", e)
     try:
         yield
     finally:
         if started:
             try:
                 jax.profiler.stop_trace()
-            except Exception:
-                pass
+            except Exception as e:
+                _count_drop("profile_trace.stop", e)

@@ -101,8 +101,16 @@ def stream_map(
     (bit-identical input, k× the wire streams). ``phases`` (optional dict)
     accumulates ``transfer_s`` / ``compute_s`` / ``batches``; the same
     numbers also land on the active executor node trace, so BENCH and the
-    per-node breakdown see the split without extra plumbing."""
+    per-node breakdown see the split without extra plumbing.
+
+    Transfers retry under the central
+    :class:`~alink_tpu.common.resilience.RetryPolicy` when the failure is
+    transient (wire drop, device RESOURCE_EXHAUSTED) — safe because a
+    ``device_put`` is idempotent; the ``transfer`` fault-injection point
+    fires before every attempt."""
+    from .faults import maybe_fail
     from .metrics import add_node_phase
+    from .resilience import with_retries
 
     if use_cache == "auto":
         from .staging import wire_is_slow
@@ -117,8 +125,13 @@ def stream_map(
     pool = transfer_pool()
 
     def timed_put(arrays):
+        def attempt():
+            maybe_fail("transfer")
+            return put(arrays)
+
         t0 = time.perf_counter()
-        devs = put(arrays)
+        devs = with_retries(attempt, name="h2d.transfer",
+                            counter="resilience.transfer_retries")
         return devs, t0, time.perf_counter()
 
     def submit(arrays):

@@ -21,6 +21,7 @@ import threading
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from ..common.exceptions import AkPluginNotExistException
+from ..common.resilience import CircuitBreaker, with_retries
 
 _TERMINAL_CURSOR = -1
 
@@ -125,17 +126,34 @@ class _WireDatahubConsumer:
             sid: self._dh.get_cursor(project, topic, sid, ctype).cursor
             for sid in self._shards}
         self._schema = self._dh.get_topic(project, topic).record_schema
+        self._carry: List[Tuple] = []
 
     def poll_batch(self, max_records: int, timeout_ms: int) -> List[Tuple]:
-        out: List[Tuple] = []
+        # start from rows a previous failed poll already consumed: earlier
+        # shards' cursors advance as the loop runs, so dropping their rows
+        # on a later shard's failure would silently lose them when the
+        # caller retries the whole poll
+        out: List[Tuple] = self._carry
+        self._carry = []
         per_shard = max(1, max_records // max(len(self._shards), 1))
-        for sid in self._shards:
-            res = self._dh.get_tuple_records(
-                self._project, self._topic, sid, self._schema,
-                self._cursors[sid], per_shard)
-            if res.record_count:
-                self._cursors[sid] = res.next_cursor
-                out.extend(tuple(r.values) for r in res.records)
+        breaker = CircuitBreaker.for_endpoint(
+            f"datahub:{self._project}/{self._topic}")
+        try:
+            for sid in self._shards:
+                # per-shard retry: the cursor only advances on success, so
+                # a retried read replays the same records (no loss/skip)
+                res = with_retries(
+                    lambda sid=sid: self._dh.get_tuple_records(
+                        self._project, self._topic, sid, self._schema,
+                        self._cursors[sid], per_shard),
+                    name="datahub.poll", breaker=breaker,
+                    counter="resilience.io_retries")
+                if res.record_count:
+                    self._cursors[sid] = res.next_cursor
+                    out.extend(tuple(r.values) for r in res.records)
+        except BaseException:
+            self._carry = out  # hand back on the next poll attempt
+            raise
         return out
 
     def close(self):
@@ -162,7 +180,14 @@ class _WireDatahubProducer:
         for row in rows:
             rec = self._TupleRecord(schema=self._schema, values=list(row))
             records.append(rec)
-        self._dh.put_records(self._project, self._topic, records)
+        # whole-batch retry: at-least-once on transient put failures
+        with_retries(
+            lambda: self._dh.put_records(self._project, self._topic,
+                                         records),
+            name="datahub.put",
+            breaker=CircuitBreaker.for_endpoint(
+                f"datahub:{self._project}/{self._topic}"),
+            counter="resilience.io_retries")
 
     def flush(self):
         pass

@@ -21,6 +21,8 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from ..common.exceptions import (AkIllegalArgumentException,
                                  AkPluginNotExistException)
+from ..common.faults import maybe_fail
+from ..common.resilience import CircuitBreaker, with_retries
 from .kv import KvStore
 
 # test / embedding hook: callable(host, port, timeout_ms) -> happybase-like
@@ -56,6 +58,8 @@ class HBaseClient:
                  connection: Any = None):
         if connection is not None:
             self._conn = connection
+            breaker_key = None  # injected double: private breaker, no
+            #                     cross-test / cross-instance coupling
         else:
             host = thrift_host
             if not host and zookeeper_quorum:
@@ -67,6 +71,21 @@ class HBaseClient:
                     "HBase needs a non-empty thriftHost or zookeeperQuorum")
             factory = connection_factory or _default_connection
             self._conn = factory(host, thrift_port, timeout_ms)
+            breaker_key = f"hbase:{host}:{thrift_port}"
+        self._breaker = (CircuitBreaker(name="hbase:injected")
+                         if breaker_key is None
+                         else CircuitBreaker.for_endpoint(breaker_key))
+
+    def _call(self, name: str, fn):
+        """Thrift round trip under retry + per-gateway breaker; the ``io``
+        injection point fires before every attempt. Gets are idempotent;
+        puts are last-writer-wins per cell, so a retried put converges."""
+        def attempt():
+            maybe_fail("io", label=name)
+            return fn()
+
+        return with_retries(attempt, name=name, breaker=self._breaker,
+                            counter="resilience.io_retries")
 
     # -- reference HBase.java surface --------------------------------------
     def create_table(self, table: str, *families: str) -> None:
@@ -75,22 +94,27 @@ class HBaseClient:
     def set(self, table: str, row_key: str, family: str,
             data: Dict[str, bytes]) -> None:
         cells = {f"{family}:{q}".encode(): v for q, v in data.items()}
-        self._conn.table(table).put(row_key.encode(), cells)
+        self._call("hbase.put",
+                   lambda: self._conn.table(table).put(row_key.encode(),
+                                                       cells))
 
     def get_column(self, table: str, row_key: str, family: str,
                    column: str) -> Optional[bytes]:
         cell = f"{family}:{column}".encode()
-        row = self._conn.table(table).row(row_key.encode(), columns=[cell])
+        row = self._call("hbase.get", lambda: self._conn.table(table).row(
+            row_key.encode(), columns=[cell]))
         return row.get(cell)
 
     def get_family_columns(self, table: str, row_key: str,
                            family: str) -> Dict[str, bytes]:
-        row = self._conn.table(table).row(
-            row_key.encode(), columns=[family.encode()])
+        row = self._call("hbase.get", lambda: self._conn.table(table).row(
+            row_key.encode(), columns=[family.encode()]))
         return {k.decode().split(":", 1)[1]: v for k, v in row.items()}
 
     def get_row(self, table: str, row_key: str) -> Dict[str, Dict[str, bytes]]:
-        row = self._conn.table(table).row(row_key.encode())
+        row = self._call("hbase.get",
+                         lambda: self._conn.table(table).row(
+                             row_key.encode()))
         out: Dict[str, Dict[str, bytes]] = {}
         for k, v in row.items():
             fam, qual = k.decode().split(":", 1)
@@ -101,9 +125,12 @@ class HBaseClient:
                  family: str) -> List[Dict[str, bytes]]:
         """Batched lookup: one thrift call for all keys, order preserved,
         misses as empty dicts."""
-        tbl = self._conn.table(table)
-        got = dict(tbl.rows([k.encode() for k in row_keys],
-                            columns=[family.encode()]))
+        def fetch():
+            tbl = self._conn.table(table)
+            return dict(tbl.rows([k.encode() for k in row_keys],
+                                 columns=[family.encode()]))
+
+        got = self._call("hbase.mget", fetch)
         out = []
         for k in row_keys:
             row = got.get(k.encode(), {})

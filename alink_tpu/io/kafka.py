@@ -28,6 +28,7 @@ import numpy as np
 
 from ..common.exceptions import AkPluginNotExistException
 from ..common.mtable import MTable, TableSchema
+from ..common.resilience import CircuitBreaker, with_retries
 
 
 # -- in-process broker (test double / demo transport) -------------------------
@@ -105,14 +106,22 @@ class _KafkaPythonConsumer:
     def __init__(self, servers: str, topic: str, group_id: Optional[str],
                  startup_mode: str):
         kafka = _require_kafka()
-        self._consumer = kafka.KafkaConsumer(
-            topic,
-            bootstrap_servers=servers.split(","),
-            group_id=group_id,
-            auto_offset_reset=(
-                "earliest" if startup_mode == "EARLIEST" else "latest"),
-            enable_auto_commit=True,
-        )
+        # broker bootstrap is the flakiest moment of a consumer's life
+        # (NoBrokersAvailable during a rolling restart is routine): retry
+        # under the central policy behind a per-cluster breaker.
+        # kafka-python errors carry `.retriable`, which is_retryable honors.
+        self._consumer = with_retries(
+            lambda: kafka.KafkaConsumer(
+                topic,
+                bootstrap_servers=servers.split(","),
+                group_id=group_id,
+                auto_offset_reset=(
+                    "earliest" if startup_mode == "EARLIEST" else "latest"),
+                enable_auto_commit=True,
+            ),
+            name="kafka.connect",
+            breaker=CircuitBreaker.for_endpoint(f"kafka:{servers}"),
+            counter="resilience.io_retries")
 
     def poll_batch(self, max_records: int, timeout_ms: int) -> List[bytes]:
         polled = self._consumer.poll(
@@ -151,8 +160,12 @@ class _MemoryProducer:
 class _KafkaPythonProducer:
     def __init__(self, servers: str):
         kafka = _require_kafka()
-        self._producer = kafka.KafkaProducer(
-            bootstrap_servers=servers.split(","))
+        self._producer = with_retries(
+            lambda: kafka.KafkaProducer(
+                bootstrap_servers=servers.split(",")),
+            name="kafka.connect",
+            breaker=CircuitBreaker.for_endpoint(f"kafka:{servers}"),
+            counter="resilience.io_retries")
 
     def send(self, topic: str, payload: bytes):
         self._producer.send(topic, payload)

@@ -21,7 +21,9 @@ import numpy as np
 
 from ..common.exceptions import (AkIllegalArgumentException,
                                  AkPluginNotExistException)
+from ..common.faults import maybe_fail
 from ..common.mtable import AlinkTypes, MTable, TableSchema
+from ..common.resilience import CircuitBreaker, with_retries
 
 # ODPS type name -> framework type (reference: OdpsCatalog's type mapping
 # through the flink-odps InputOutputFormat bridge)
@@ -58,7 +60,8 @@ class OdpsCatalog:
                  project: Optional[str] = None,
                  endpoint: Optional[str] = None,
                  client: Any = None):
-        if client is not None:
+        injected = client is not None
+        if injected:
             self._o = client
         else:
             try:
@@ -75,6 +78,24 @@ class OdpsCatalog:
             self._o = ODPS(access_id, access_key, project,
                            endpoint=endpoint)
         self.project = project
+        # one breaker per project endpoint: every catalog op against a dead
+        # MaxCompute service trips it, so whole-DAG runs fail fast instead
+        # of paying the full retry budget per table. Injected doubles get a
+        # private breaker (no cross-test / cross-instance coupling).
+        self._breaker = (
+            CircuitBreaker(name="odps:injected") if injected
+            else CircuitBreaker.for_endpoint(
+                f"odps:{endpoint or ''}/{project or 'local'}"))
+
+    def _call(self, name: str, fn):
+        """REST round trip under retry + breaker; the ``io`` injection
+        point fires before every attempt."""
+        def attempt():
+            maybe_fail("io", label=name)
+            return fn()
+
+        return with_retries(attempt, name=name, breaker=self._breaker,
+                            counter="resilience.io_retries")
 
     @staticmethod
     def from_url(url: str, client: Any = None) -> "OdpsCatalog":
@@ -98,10 +119,12 @@ class OdpsCatalog:
 
     # -- catalog contract (same as SqliteCatalog/HiveCatalog) ---------------
     def list_tables(self) -> List[str]:
-        return sorted(t.name for t in self._o.list_tables())
+        return sorted(t.name for t in self._call(
+            "odps.list_tables", self._o.list_tables))
 
     def get_table_schema(self, name: str) -> TableSchema:
-        tbl = self._o.get_table(name)
+        tbl = self._call("odps.get_table",
+                         lambda: self._o.get_table(name))
         names, types = [], []
         for col in tbl.table_schema.columns:
             names.append(col.name)
@@ -114,9 +137,15 @@ class OdpsCatalog:
 
     def read_table(self, name: str) -> MTable:
         schema = self.get_table_schema(name)
-        with self._o.get_table(name).open_reader() as reader:
-            rows = [tuple(r.values) if hasattr(r, "values") else tuple(r)
-                    for r in reader]
+
+        def _read():
+            # re-opening the reader per attempt makes the retry a clean
+            # full-scan replay (reads are idempotent)
+            with self._o.get_table(name).open_reader() as reader:
+                return [tuple(r.values) if hasattr(r, "values")
+                        else tuple(r) for r in reader]
+
+        rows = self._call(f"odps.read:{name}", _read)
         cols = {}
         out_types = []
         for i, (n, tp) in enumerate(zip(schema.names, schema.types)):
@@ -156,11 +185,13 @@ class OdpsCatalog:
         return MTable(cols, TableSchema(schema.names, out_types))
 
     def write_table(self, name: str, t: MTable) -> None:
-        if not self._o.exist_table(name):
+        if not self._call("odps.exist_table",
+                          lambda: self._o.exist_table(name)):
             decls = ", ".join(
                 f"{n} {_ALINK_TO_ODPS.get(t.schema.type_of(n), 'STRING')}"
                 for n in t.names)
-            self._o.create_table(name, decls)
+            self._call("odps.create_table",
+                       lambda: self._o.create_table(name, decls))
         rows = []
         for row in t.rows():
             clean = []
@@ -173,8 +204,15 @@ class OdpsCatalog:
                     v = bool(v)
                 clean.append(v)
             rows.append(clean)
-        with self._o.get_table(name).open_writer() as writer:
-            writer.write(rows)
+
+        def _write():
+            # a fresh writer per attempt; on retry the whole batch is
+            # re-put (at-least-once — document-level contract, same as the
+            # reference's batched output formats)
+            with self._o.get_table(name).open_writer() as writer:
+                writer.write(rows)
+
+        self._call(f"odps.write:{name}", _write)
 
     def close(self) -> None:
         pass  # pyodps clients are connectionless (REST)

@@ -125,7 +125,25 @@ class AlgoOperator(WithParams):
 
         mgr = self.env.lazy_manager
         pending = list(mgr.pending_ops())
-        run_dag(self.env, list(extra_roots) + pending)
+        try:
+            run_dag(self.env, list(extra_roots) + pending)
+        except BaseException:
+            # graceful degradation on a failed run: sinks whose branches
+            # DID complete still fire and clear, while failed branches stay
+            # pending — a later execute()/collect() re-plans only the
+            # unfinished sub-DAG (successful upstreams remain memoized).
+            # A raising sink callback must not mask the run's failure (or
+            # starve its sibling sinks): callback errors are counted and
+            # the original exception propagates unchanged.
+            from ..common.metrics import metrics
+
+            for op in pending:
+                if op._executed:
+                    try:
+                        mgr.fill(op, op._evaluate())
+                    except Exception:
+                        metrics.incr("resilience.sink_callback_errors")
+            raise
         for op in pending:
             mgr.fill(op, op._evaluate())
 

@@ -35,12 +35,15 @@ than hidden."""
 from __future__ import annotations
 
 import json
+import logging
 from typing import Iterator, Optional
 
 from ...common.mtable import MTable, TableSchema
 from ...common.params import ParamInfo
 from ...io.filesystem import file_open, get_file_system
 from .base import StreamOperator
+
+logger = logging.getLogger("alink_tpu.checkpoint")
 
 
 class StreamCheckpoint:
@@ -54,10 +57,34 @@ class StreamCheckpoint:
         self._fs.makedirs(parent)
 
     def last_acked(self) -> int:
+        """The last durably acked chunk id, or -1 for "no checkpoint".
+
+        This runs on exactly the restart-after-crash path, so it must
+        survive what crashes leave behind: a journal truncated mid-write or
+        corrupted reads as "no checkpoint" (full at-least-once replay —
+        always safe, never lossy) instead of crashing the resuming job,
+        and a stale ``.tmp`` from an interrupted :meth:`ack` is removed."""
+        tmp = self.path + ".tmp"
+        try:
+            if self._fs.exists(tmp):
+                self._fs.delete(tmp)
+        except OSError as e:
+            logger.warning("could not clean stale checkpoint tmp %s: %s",
+                           tmp, e)
         if not self._fs.exists(self.path):
             return -1
-        with file_open(self.path) as f:
-            return int(json.load(f).get("last_acked", -1))
+        try:
+            with file_open(self.path) as f:
+                return int(json.load(f).get("last_acked", -1))
+        except (ValueError, TypeError, KeyError, AttributeError,
+                OSError) as e:
+            # json.JSONDecodeError is a ValueError; int(None) a TypeError;
+            # a valid-JSON-but-non-dict journal ('[1]', '3') an AttributeError
+            logger.warning(
+                "unreadable checkpoint journal %s (%s: %s) — treating as "
+                "no checkpoint; the stream replays from the beginning "
+                "(at-least-once)", self.path, type(e).__name__, e)
+            return -1
 
     def ack(self, chunk_id: int) -> None:
         tmp = self.path + ".tmp"

@@ -6,8 +6,20 @@ from __future__ import annotations
 
 from typing import Iterator
 
+from ...common.exceptions import AkCircuitOpenException, is_retryable
+from ...common.faults import maybe_fail
 from ...common.params import InValidator, ParamInfo
 from ...common.mtable import MTable, TableSchema
+from ...common.resilience import (dead_letter_enabled, dead_letters,
+                                  with_retries)
+
+
+def _poll_retryable(exc: BaseException) -> bool:
+    """Outer poll-loop classification: circuit-open means the endpoint's
+    own (inner) retry layer already gave up and the breaker is failing
+    fast — re-polling through it would just burn backoff against an open
+    circuit, so propagate instead."""
+    return is_retryable(exc) and not isinstance(exc, AkCircuitOpenException)
 from ...io.kafka import _decode_rows, _encode_row, _open_consumer, _open_producer
 from ...io.kv import open_kv_store
 from ..batch.connectors import KvSinkBatchOp, LookupKvBatchOp
@@ -65,25 +77,59 @@ class KvSinkStreamOp(StreamOperator):
         return in_schema
 
 
+def _decode_with_dead_letter(decode, payloads, exc, source: str):
+    """Batch decode failed: with ``ALINK_DEAD_LETTER=on``, sieve the batch
+    payload-by-payload — rows that decode alone stay in the chunk, poison
+    rows go to the bounded dead-letter buffer (counted in
+    ``resilience.dead_letter``) instead of aborting the job. Without the
+    knob the original decode error propagates unchanged. Returns the
+    decoded good-subset chunk, or None when every row was poison."""
+    if not dead_letter_enabled():
+        raise exc
+    good = []
+    for p in payloads:
+        try:
+            decode([p])
+        except Exception as row_exc:
+            dead_letters.add(source, p, row_exc)
+        else:
+            good.append(p)
+    return decode(good) if good else None
+
+
 def _bounded_poll(consumer, decode, chunk: int, max_messages: int,
-                  idle_ms: int, sleep_when_idle: bool = False):
+                  idle_ms: int, sleep_when_idle: bool = False,
+                  source: str = "bus"):
     """Shared bounded micro-batch poll loop for bus-style sources (Kafka,
     DataHub): chunked polls, a cumulative-idle bound so batch-style replays
     and tests terminate, and an optional message budget.
 
     The idle bound accumulates short poll slices and resets on data, so a
     slow first poll (real-broker consumer-group join) doesn't end the
-    stream before any message arrives."""
+    stream before any message arrives.
+
+    Resilience: each poll retries under the central RetryPolicy on
+    transient broker errors (the ``io`` fault-injection point fires before
+    every poll attempt), and malformed payloads are dead-lettered instead
+    of aborting when ``ALINK_DEAD_LETTER=on``."""
     poll_slice = max(50, min(idle_ms, 200))
     idle_spent = 0
     taken = 0
+
     try:
         while True:
             budget = chunk if not max_messages \
                 else min(chunk, max_messages - taken)
             if budget <= 0:
                 return
-            payloads = consumer.poll_batch(budget, poll_slice)
+
+            def poll():
+                maybe_fail("io", label=f"{source}.poll")
+                return consumer.poll_batch(budget, poll_slice)
+
+            payloads = with_retries(poll, name=f"{source}.poll",
+                                    classify=_poll_retryable,
+                                    counter="resilience.io_retries")
             if not payloads:
                 idle_spent += poll_slice
                 if idle_spent >= idle_ms:
@@ -95,7 +141,14 @@ def _bounded_poll(consumer, decode, chunk: int, max_messages: int,
                 continue
             idle_spent = 0
             taken += len(payloads)
-            yield decode(payloads)
+            try:
+                t = decode(payloads)
+            except Exception as exc:
+                t = _decode_with_dead_letter(
+                    decode, payloads, exc, f"{source}.decode")
+                if t is None:
+                    continue
+            yield t
     finally:
         consumer.close()
 
@@ -139,7 +192,8 @@ class KafkaSourceStreamOp(StreamOperator):
             consumer,
             lambda payloads: _decode_rows(payloads, schema, fmt, delim),
             max(1, self.get(self.CHUNK_SIZE)),
-            self.get(self.MAX_MESSAGES), self.get(self.IDLE_TIMEOUT_MS))
+            self.get(self.MAX_MESSAGES), self.get(self.IDLE_TIMEOUT_MS),
+            source="kafka")
 
     def _out_schema(self) -> TableSchema:
         return TableSchema.parse(self.get(self.SCHEMA_STR))
@@ -164,11 +218,19 @@ class KafkaSinkStreamOp(StreamOperator):
         topic = self.get(self.TOPIC)
         fmt = self.get(self.FORMAT)
         delim = self.get(self.FIELD_DELIMITER)
+
+        def send_chunk(t):
+            # retried per chunk on transient broker errors: a mid-chunk
+            # failure re-sends the whole chunk (at-least-once — same
+            # contract as every offset-batched producer)
+            maybe_fail("io", label="kafka.sink")
+            for row in t.rows():
+                producer.send(topic, _encode_row(t.names, row, fmt, delim))
+
         try:
             for t in it:
-                for row in t.rows():
-                    producer.send(
-                        topic, _encode_row(t.names, row, fmt, delim))
+                with_retries(lambda: send_chunk(t), name="kafka.sink",
+                             counter="resilience.io_retries")
                 yield t
         finally:
             producer.flush()
@@ -211,7 +273,7 @@ class DatahubSourceStreamOp(StreamOperator):
             consumer, lambda rows: MTable.from_rows(rows, schema),
             max(1, self.get(self.CHUNK_SIZE)),
             self.get(self.MAX_MESSAGES), self.get(self.IDLE_TIMEOUT_MS),
-            sleep_when_idle=True)
+            sleep_when_idle=True, source="datahub")
 
     def _out_schema(self) -> TableSchema:
         return TableSchema.parse(self.get(self.SCHEMA_STR))
@@ -233,9 +295,16 @@ class DatahubSinkStreamOp(StreamOperator):
 
         producer = open_datahub_producer(
             self.get(self.ENDPOINT), self.get(self.TOPIC))
+
+        def send_chunk(t):
+            # at-least-once per chunk under retry, like the Kafka twin
+            maybe_fail("io", label="datahub.sink")
+            producer.send_rows(list(t.rows()))
+
         try:
             for t in it:
-                producer.send_rows(list(t.rows()))
+                with_retries(lambda: send_chunk(t), name="datahub.sink",
+                             counter="resilience.io_retries")
                 yield t
         finally:
             producer.flush()

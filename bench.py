@@ -595,6 +595,87 @@ def bench_executor(rows=2_000_000):
     }
 
 
+def bench_resilience(rows=20_000):
+    """Fault-tolerant runtime (common/resilience.py, common/faults.py):
+    run a multi-branch DAG under a seeded 30% transient unit-fault rate and
+    a Kafka memory-broker round trip under 2 injected transient IO faults,
+    assert both produce output identical to the fault-free run, and report
+    the resilience counters (retries absorbed, defusions, dead-letter
+    volume) — the same readout long-running jobs should watch."""
+    from alink_tpu.common import faults
+    from alink_tpu.common.mtable import MTable
+    from alink_tpu.common.resilience import resilience_summary
+    from alink_tpu.io.kafka import MemoryKafkaBroker
+    from alink_tpu.operator.batch import TableSourceBatchOp
+    from alink_tpu.operator.stream import (KafkaSinkStreamOp,
+                                           KafkaSourceStreamOp,
+                                           TableSourceStreamOp)
+
+    rng = np.random.RandomState(0)
+    t = MTable({"x": rng.rand(rows), "y": rng.rand(rows)})
+
+    def run_dag_job():
+        src = TableSourceBatchOp(t)
+        a = src.apply_func(
+            lambda m: MTable({"x": np.sort(np.asarray(m.col("x")))}),
+            out_schema="x double")
+        b = src.apply_func(
+            lambda m: MTable({"y": np.asarray(m.col("y")) * 2.0}),
+            out_schema="y double")
+        got = {}
+        a.lazy_collect(lambda m: got.setdefault("a", np.asarray(m.col("x"))))
+        b.lazy_collect(lambda m: got.setdefault("b", np.asarray(m.col("y"))))
+        src.execute()
+        return got
+
+    def run_kafka_job(tag):
+        rows_in = MTable.from_rows(
+            [(i, float(i) * 0.5) for i in range(512)], "k long, v double")
+        MemoryKafkaBroker.named(f"bench-res-{tag}")  # fresh broker
+        sink = KafkaSinkStreamOp(
+            bootstrapServers=f"memory://bench-res-{tag}", topic="t",
+        ).link_from(TableSourceStreamOp(rows_in, chunkSize=128))
+        for _ in sink._stream():
+            pass
+        out = []
+        src = KafkaSourceStreamOp(
+            bootstrapServers=f"memory://bench-res-{tag}", topic="t",
+            schemaStr="k long, v double", maxMessages=512,
+            idleTimeoutMs=200)
+        for chunk in src._stream():
+            out.extend(chunk.rows())
+        return out
+
+    faults.clear()
+    clean_dag = run_dag_job()
+    clean_kafka = run_kafka_job("clean")
+    t0 = time.perf_counter()
+    # widen the attempt budget under the 30% rate so the drill never
+    # exhausts retries by seed luck (0.3^8 per unit)
+    prev_attempts = os.environ.get("ALINK_RETRY_MAX_ATTEMPTS")
+    os.environ["ALINK_RETRY_MAX_ATTEMPTS"] = "8"
+    faults.install(faults.FaultSpec.parse(
+        "unit:rate=0.3,kinds=transient;io:count=2", seed=7))
+    try:
+        faulty_dag = run_dag_job()
+        faulty_kafka = run_kafka_job("faulty")
+    finally:
+        faults.clear()
+        if prev_attempts is None:
+            os.environ.pop("ALINK_RETRY_MAX_ATTEMPTS", None)
+        else:
+            os.environ["ALINK_RETRY_MAX_ATTEMPTS"] = prev_attempts
+    wall = time.perf_counter() - t0
+    dag_parity = all(
+        np.array_equal(clean_dag[k], faulty_dag[k]) for k in ("a", "b"))
+    return {
+        "dag_parity_under_30pct_unit_faults": dag_parity,
+        "kafka_parity_under_io_faults": clean_kafka == faulty_kafka,
+        "faulted_wall_s": round(wall, 3),
+        "counters": resilience_summary(),
+    }
+
+
 def main():
     extras = {}
     for name, fn in (
@@ -606,6 +687,7 @@ def main():
         ("resnet50_savedmodel", bench_resnet50_savedmodel),
         ("bert_text_quality", bench_bert_quality),
         ("executor", bench_executor),
+        ("resilience", bench_resilience),
     ):
         try:
             extras[name] = fn()

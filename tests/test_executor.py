@@ -97,6 +97,110 @@ def test_exception_propagates_from_scheduled_branch():
         bad.collect()
 
 
+def test_first_failure_propagates_with_branches_in_flight():
+    """A fast-failing branch raises while a slow sibling is mid-flight:
+    the original exception propagates unchanged (same instance), the
+    in-flight future is drained (no deadlock, slow branch completes), and
+    the run returns promptly."""
+    src = TableSourceBatchOp(_table())
+    marker = RuntimeError("fast branch down")
+    SLEEP = 0.3
+
+    def fail_fast(t):
+        raise marker
+
+    slow_done = threading.Event()
+
+    def slow(t):
+        time.sleep(SLEEP)
+        slow_done.set()
+        return t
+
+    bad = src.apply_func(fail_fast, out_schema="x double")
+    ok = src.apply_func(slow, out_schema=_table().schema.to_str())
+    got = {}
+    bad.lazy_collect(lambda t: got.setdefault("bad", t))
+    ok.lazy_collect(lambda t: got.setdefault("ok", t))
+    try:
+        with pytest.raises(RuntimeError) as ei:
+            src.execute()
+        assert ei.value is marker        # unchanged, not wrapped
+        assert slow_done.is_set()        # in-flight branch was drained
+        assert ok._executed
+        assert got.get("ok") is not None  # completed sink still fired
+    finally:
+        # the always-failing sink stays pending by design (a later execute
+        # would re-plan it); drop it so it can't poison other tests
+        src.env.lazy_manager.clear()
+
+
+def test_sink_callback_error_does_not_mask_dag_failure():
+    """When a branch fails AND a completed sibling's lazy callback raises,
+    the caller still sees the original DAG failure (the callback error is
+    counted, not propagated), and other completed sinks still fire."""
+    from alink_tpu.common.metrics import metrics
+
+    src = TableSourceBatchOp(_table())
+    marker = RuntimeError("real infrastructure failure")
+
+    def fail(t):
+        raise marker
+
+    bad = src.apply_func(fail, out_schema="x double")
+    ok1 = src.select(["x"])
+    ok2 = src.select(["tag"])
+    got = {}
+    bad.lazy_collect(lambda t: got.setdefault("bad", t))
+    ok1.lazy_collect(lambda t: (_ for _ in ()).throw(ValueError("cb bug")))
+    ok2.lazy_collect(lambda t: got.setdefault("ok2", t))
+    before = metrics.counter("resilience.sink_callback_errors")
+    try:
+        with pytest.raises(RuntimeError) as ei:
+            src.execute()
+        assert ei.value is marker
+        assert got.get("ok2") is not None   # sibling sink still fired
+        assert metrics.counter("resilience.sink_callback_errors") > before
+    finally:
+        src.env.lazy_manager.clear()
+
+
+def test_failed_run_leaves_dag_recollectable_without_recompute():
+    """After a branch fails, a second collect() re-plans only the
+    unfinished sub-DAG: the shared upstream does NOT recompute."""
+    calls = {"src": 0, "flaky": 0}
+    lock = threading.Lock()
+
+    class CountingSource(MemSourceBatchOp):
+        def _execute_impl(self):
+            with lock:
+                calls["src"] += 1
+            return super()._execute_impl()
+
+    src = CountingSource([(float(i),) for i in range(16)], "v double")
+
+    def flaky_once(t):
+        with lock:
+            calls["flaky"] += 1
+            n = calls["flaky"]
+        if n == 1:
+            # fatal (not retryable): the run must fail, not retry
+            raise ValueError("transient-looking but fatal")
+        return MTable({"v": np.asarray(t.col("v")) * 2.0})
+
+    good = src.apply_func(
+        lambda t: MTable({"v": np.asarray(t.col("v")) + 1.0}),
+        out_schema="v double")
+    bad = good.apply_func(flaky_once, out_schema="v double")
+    with pytest.raises(ValueError):
+        bad.collect()
+    assert calls["src"] == 1 and good._executed and not bad._executed
+    out = bad.collect()                  # re-plan: only `bad` re-runs
+    assert calls["src"] == 1             # memoized upstream untouched
+    assert calls["flaky"] == 2
+    np.testing.assert_array_equal(
+        np.asarray(out.col("v")), (np.arange(16) + 1.0) * 2.0)
+
+
 def test_serial_fallback_knob(monkeypatch):
     monkeypatch.setenv("ALINK_DAG_SCHEDULER", "off")
     src = TableSourceBatchOp(_table())

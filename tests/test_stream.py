@@ -315,3 +315,49 @@ def test_stream_checkpoint_replay(tmp_path):
     n_before = len(processed)
     run()
     assert len(processed) == n_before + 5  # full replay of all 5 chunks
+
+
+def test_stream_checkpoint_corrupt_journal_degrades_to_full_replay(tmp_path):
+    """Regression: a truncated/corrupt journal (exactly what a crash
+    leaves behind) must read as "no checkpoint" — never crash the
+    restart path — and a stale .tmp from an interrupted ack is cleaned."""
+    from alink_tpu.operator.stream import StreamCheckpoint
+
+    state = str(tmp_path / "job.ckpt")
+    ck = StreamCheckpoint(state)
+    ck.ack(3)
+    assert ck.last_acked() == 3
+
+    # truncated mid-write
+    with open(state, "w") as f:
+        f.write('{"last_ack')
+    assert StreamCheckpoint(state).last_acked() == -1
+    # wrong type in a structurally valid journal
+    with open(state, "w") as f:
+        f.write('{"last_acked": "not-a-number"}')
+    assert StreamCheckpoint(state).last_acked() == -1
+    with open(state, "w") as f:
+        f.write('{"last_acked": null}')
+    assert StreamCheckpoint(state).last_acked() == -1
+    # binary garbage
+    with open(state, "wb") as f:
+        f.write(b"\x00\xff\x13\x37")
+    assert StreamCheckpoint(state).last_acked() == -1
+    # valid JSON but not a dict (legacy/partial writes)
+    for payload in ("[1, 2]", '"x"', "3"):
+        with open(state, "w") as f:
+            f.write(payload)
+        assert StreamCheckpoint(state).last_acked() == -1
+
+    # stale .tmp from a crash between write and rename is removed
+    import os
+
+    with open(state + ".tmp", "w") as f:
+        f.write('{"last_acked": 99}')
+    ck2 = StreamCheckpoint(state)
+    assert ck2.last_acked() == -1
+    assert not os.path.exists(state + ".tmp")
+
+    # and the journal still works after recovery
+    ck2.ack(0)
+    assert ck2.last_acked() == 0
