@@ -23,9 +23,11 @@ from .common import (  # noqa: F401
     FaultSpec,
     MTable,
     Params,
+    RecoverableStreamJob,
     RetryPolicy,
     SparseVector,
     TableSchema,
     is_retryable,
+    run_with_recovery,
     with_retries,
 )

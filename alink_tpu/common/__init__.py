@@ -44,3 +44,15 @@ from .params import (
     ArrayLengthValidator,
     NotNullValidator,
 )
+
+# epoch-based exactly-once stream recovery (imported last: it builds on the
+# filesystem layer, the fault taxonomy, and the retry policy above)
+from .recovery import (
+    CheckpointCoordinator,
+    RecoverableStreamJob,
+    SnapshotStore,
+    TransactionalSink,
+    is_restartable,
+    recovery_summary,
+    run_with_recovery,
+)

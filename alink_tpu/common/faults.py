@@ -26,9 +26,18 @@ Spec grammar (``ALINK_FAULT_SPEC``)::
   same spec + seed replays the exact same fault schedule.
 - ``count=N``  — the first *N* calls at the point fail, then all pass
   (takes precedence over ``rate``).
+- ``match=S``  — only calls whose *label* contains substring *S* are
+  eligible (others pass untouched and consume neither count nor RNG
+  draws). Lets a drill target one deterministic site — e.g.
+  ``recovery:count=1,kinds=crash,match=pre_commit`` kills the job exactly
+  once, between the snapshot manifest and the sink commits.
 - ``kinds``    — ``transient`` (raises :class:`InjectedFaultError`, which
-  the taxonomy classifies retryable) or ``fatal`` (raises
-  :class:`InjectedFatalError`, never retried).
+  the taxonomy classifies retryable), ``fatal`` (raises
+  :class:`InjectedFatalError`, never retried), or ``crash`` (raises
+  :class:`InjectedCrashError` — a process-kill stand-in: NOT retryable by
+  the inner retry layers, so it takes the whole job down, but the
+  supervised restart driver (``common/recovery.py run_with_recovery``)
+  classifies it restartable and resumes from the last epoch snapshot).
 
 Usage::
 
@@ -72,14 +81,29 @@ class InjectedFatalError(AkException):
     code = "AK_INJECTED_FATAL"
 
 
+class InjectedCrashError(AkException):
+    """Synthetic *crash* fault — models the process dying mid-job.
+
+    Deliberately NOT an :class:`AkRetryableException`: in-process retry
+    layers (``with_retries``, the DAG executor) must let it kill the job,
+    exactly as a real SIGKILL would. Only the supervised restart driver
+    (:func:`alink_tpu.common.recovery.run_with_recovery`) treats it as
+    restartable — a fresh job instance resumes from the last snapshot."""
+
+    code = "AK_INJECTED_CRASH"
+
+
 class _Rule:
-    __slots__ = ("rate", "count", "kind", "_rng", "_calls", "_fired")
+    __slots__ = ("rate", "count", "kind", "match", "_rng", "_calls",
+                 "_fired")
 
     def __init__(self, rate: float = 0.0, count: int = 0,
-                 kind: str = "transient", seed: int = 0, point: str = ""):
+                 kind: str = "transient", seed: int = 0, point: str = "",
+                 match: str = ""):
         self.rate = rate
         self.count = count
         self.kind = kind
+        self.match = match
         # per-point stream: independent of call order at *other* points, so
         # adding a branch to a DAG does not reshuffle every fault schedule
         self._rng = Random(seed ^ zlib.crc32(point.encode()))
@@ -136,9 +160,9 @@ class FaultSpec:
                         f"bad fault spec item {item!r} in segment {part!r}")
                 kw[k.strip()] = v.strip()
             kind = kw.get("kinds", kw.get("kind", "transient"))
-            if kind not in ("transient", "fatal"):
+            if kind not in ("transient", "fatal", "crash"):
                 raise AkParseErrorException(
-                    f"fault kind must be transient|fatal, got {kind!r}")
+                    f"fault kind must be transient|fatal|crash, got {kind!r}")
             try:
                 rate = float(kw.get("rate", "0"))
                 count = int(kw.get("count", "0"))
@@ -146,13 +170,16 @@ class FaultSpec:
                 raise AkParseErrorException(
                     f"bad rate/count in fault spec segment {part!r}") from e
             rules[point] = _Rule(rate=rate, count=count, kind=kind,
-                                 seed=seed, point=point)
+                                 seed=seed, point=point,
+                                 match=kw.get("match", ""))
         return cls(rules, seed=seed, source=spec)
 
     def fire(self, point: str, label: str = "") -> None:
         rule = self._rules.get(point)
         if rule is None:
             return
+        if rule.match and rule.match not in (label or ""):
+            return  # non-matching calls consume neither count nor RNG
         with self._lock:
             fire = rule.should_fire()
             kind = rule.kind
@@ -162,6 +189,8 @@ class FaultSpec:
         where = f"{point}:{label}" if label else point
         if kind == "fatal":
             raise InjectedFatalError(f"injected fatal fault at {where}")
+        if kind == "crash":
+            raise InjectedCrashError(f"injected crash at {where}")
         raise InjectedFaultError(f"injected transient fault at {where}")
 
     def __repr__(self):

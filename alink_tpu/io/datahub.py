@@ -37,6 +37,7 @@ class MemoryDatahubService:
     def __init__(self):
         self._topics: Dict[str, List[Tuple]] = {}
         self._guard = threading.Lock()
+        self._txn_epochs: Dict[str, int] = {}
 
     @classmethod
     def named(cls, name: str) -> "MemoryDatahubService":
@@ -61,6 +62,24 @@ class MemoryDatahubService:
     def topic_size(self, topic: str) -> int:
         with self._guard:
             return len(self._topics.get(topic, []))
+
+    # -- transactional put (exactly-once sink commit for the double) ---------
+    def put_records_txn(self, topic: str, records: Sequence[Tuple],
+                        txn_key: str, epoch: int) -> bool:
+        """Atomically append ``records`` AND record ``epoch`` committed for
+        ``txn_key`` under one lock; idempotent for epochs at or below the
+        recorded one (crash-recovery replay re-offers committed epochs)."""
+        with self._guard:
+            if self._txn_epochs.get(txn_key, -1) >= epoch:
+                return False
+            self._topics.setdefault(topic, []).extend(
+                tuple(r) for r in records)
+            self._txn_epochs[txn_key] = int(epoch)
+            return True
+
+    def txn_epoch(self, txn_key: str) -> int:
+        with self._guard:
+            return self._txn_epochs.get(txn_key, -1)
 
 
 class _MemoryDatahubConsumer:

@@ -21,6 +21,7 @@ from __future__ import annotations
 import csv
 import io
 import json
+import threading
 import time
 from typing import Dict, List, Optional, Sequence
 
@@ -65,6 +66,8 @@ class MemoryKafkaBroker:
 
     def __init__(self):
         self._topics: Dict[str, List[bytes]] = {}
+        self._txn_epochs: Dict[str, int] = {}
+        self._txn_lock = threading.Lock()
 
     @classmethod
     def named(cls, name: str) -> "MemoryKafkaBroker":
@@ -76,6 +79,27 @@ class MemoryKafkaBroker:
 
     def produce(self, topic: str, payload: bytes):
         self._topics.setdefault(topic, []).append(bytes(payload))
+
+    # -- transactional produce (the Kafka-transactions analog) ---------------
+    def produce_txn(self, topic: str, payloads: Sequence[bytes],
+                    txn_key: str, epoch: int) -> bool:
+        """Atomically append ``payloads`` AND record ``epoch`` as committed
+        for ``txn_key`` — one lock, so a crash can never land between the
+        data and the commit marker. Idempotent: an epoch at or below the
+        recorded one is a no-op (the exactly-once replay path re-offers
+        committed epochs after a crash). Returns True if appended."""
+        with self._txn_lock:
+            if self._txn_epochs.get(txn_key, -1) >= epoch:
+                return False
+            self._topics.setdefault(topic, []).extend(
+                bytes(p) for p in payloads)
+            self._txn_epochs[txn_key] = int(epoch)
+            return True
+
+    def txn_epoch(self, txn_key: str) -> int:
+        """Last epoch committed under ``txn_key``, or -1."""
+        with self._txn_lock:
+            return self._txn_epochs.get(txn_key, -1)
 
     def consumer(self, topic: str, startup_mode: str = "EARLIEST"
                  ) -> _MemoryConsumer:

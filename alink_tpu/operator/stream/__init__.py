@@ -33,8 +33,13 @@ from .onlinelearning import (
 )
 from .checkpoint import (
     AckCheckpointStreamOp,
+    CheckpointCoordinator,
     CheckpointedSourceStreamOp,
+    RecoverableStreamJob,
+    SnapshotStore,
     StreamCheckpoint,
+    TransactionalSink,
+    run_with_recovery,
 )
 from .sources import (
     AkSinkStreamOp,

@@ -64,6 +64,33 @@ class StreamOperator(WithParams):
     def _stream_impl(self, *inputs: Iterator[MTable]) -> Iterator[MTable]:
         raise NotImplementedError(type(self).__name__)
 
+    # -- operator-state checkpointing (epoch recovery runtime) -------------
+    # Stateful stream ops keep their cross-chunk state on the instance (not
+    # in generator locals) and override these two hooks so the
+    # CheckpointCoordinator (common/recovery.py) can cut a consistent
+    # snapshot at epoch barriers and re-seed a FRESH instance mid-stream on
+    # restart. Contract: state_snapshot() is only called while the
+    # operator's generator is suspended between chunks (the coordinator
+    # quiesces every chain first), and must return a picklable object whose
+    # restore makes the resumed stream byte-identical to an uninterrupted
+    # run; device arrays are materialized to host numpy. state_restore()
+    # is called on a fresh instance BEFORE its generator first runs.
+
+    # Ops that keep cross-chunk state in generator locals WITHOUT the
+    # snapshot hooks set this True: the recovery runtime refuses them at
+    # job-build time (restoring them as stateless would silently break the
+    # exactly-once invariant mid-stream — an error is the honest answer).
+    _stateful_unhooked = False
+
+    def state_snapshot(self) -> Optional[dict]:
+        """Picklable cross-chunk state, or None for stateless ops."""
+        return None
+
+    def state_restore(self, state: dict) -> None:
+        raise AkIllegalOperationException(
+            f"{type(self).__name__} does not support operator-state "
+            "restore (no state_snapshot/state_restore override)")
+
     # -- wiring ------------------------------------------------------------
     def _stream(self) -> Iterator[MTable]:
         """The operator's (shareable) output iterator; tee'd per consumer."""
@@ -85,6 +112,38 @@ class StreamOperator(WithParams):
         t = self.collect()
         print(t.to_display_string(max_rows=n))
         return self
+
+
+class CumulativeEvalStateMixin:
+    """Shared snapshot/restore hooks for cumulative eval streams: a window
+    counter plus per-series row history (series names in ``_eval_series``).
+    History compacts to one array per series at snapshot time — exact
+    cumulative metrics (AUC, macro-F1, R²) need the full score history, no
+    sketch preserves them bit-exactly, so the snapshot is inherently
+    O(rows seen); bound the stream (or window the eval) if the checkpoint
+    tax on a very long run matters more than exact cumulative metrics."""
+
+    _eval_series: tuple = ()
+
+    def _eval_state(self) -> dict:
+        st = getattr(self, "_estate", None)
+        if st is None:
+            st = self._estate = {k: [] for k in self._eval_series}
+            st["window"] = 0
+        return st
+
+    def state_snapshot(self) -> dict:
+        st = self._eval_state()
+        out = {"window": st["window"]}
+        for k in self._eval_series:
+            out[k] = [np.concatenate(st[k])] if st[k] else []
+        return out
+
+    def state_restore(self, state: dict) -> None:
+        st = {"window": state["window"]}
+        for k in self._eval_series:
+            st[k] = list(state[k])
+        self._estate = st
 
 
 class TableSourceStreamOp(StreamOperator):
@@ -136,6 +195,10 @@ class MapStreamOp(StreamOperator):
     _max_inputs = 1
 
     mapper_cls = None
+
+    # the async-dispatch queue carries in-flight batches across chunk
+    # boundaries; until it snapshots, recovery must refuse this op
+    _stateful_unhooked = True
 
     # micro-batches kept in flight when the mapper supports async dispatch
     # (device computes chunk i while chunk i+1's transfer is under way)

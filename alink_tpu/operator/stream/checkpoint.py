@@ -1,45 +1,66 @@
-"""Stream fault tolerance: chunk-offset checkpointing + replay.
+"""Stream fault tolerance: epoch recovery runtime + legacy offset journal.
 
 Capability parity with the reference's streaming resilience (reference:
 operator/stream/StreamOperator.java:220 ``setCheckPointConf`` — Flink
-checkpointing of source offsets + operator state; online-learning jobs
-additionally re-seed from the last emitted model snapshot,
-FtrlTrainStreamOp.java:67).
+checkpointing of source offsets + operator state via asynchronous barrier
+snapshotting, Carbone et al. 2015; online-learning jobs additionally
+re-seed from the last emitted model snapshot, FtrlTrainStreamOp.java:67).
 
-TPU re-design for the micro-batch runtime: fault tolerance splits into the
-same two halves the reference uses —
+TPU re-design for the micro-batch runtime — two tiers:
 
-1. **Source replay** (this module): a :class:`StreamCheckpoint` journals the
-   id of the last chunk that made it through the pipeline (the sink acks).
-   On restart, :class:`CheckpointedSourceStreamOp` skips acked chunks, so a
-   crashed job resumes AT-LEAST-ONCE from the failure point instead of
-   from scratch. Alignment contract: ack counting assumes 1 chunk in → 1
-   chunk out between source and ack point (true for map/model-map/filter
-   chains; ops that merge or fan out chunks need the ack placed upstream
-   of them — same constraint as offset-based commits everywhere).
-   SINGLE-CONSUMER contract: the ack op must feed exactly ONE downstream
-   consumer — the runtime tees iterators per consumer and drains them
-   sequentially, so with several sinks the fastest one would journal
-   chunks the slower sinks have not seen yet (commit-after-one-sink is
-   not exactly-once bookkeeping for the others). Fan out AFTER a single
-   acked pipeline, or give each sink its own checkpoint journal.
-2. **Operator state**: stateful stream ops (FTRL, OnlineFm, windowed eval)
-   already externalize their state as periodic model snapshots; a resumed
-   job warm-starts from the newest snapshot (``FtrlTrainStreamOp(
-   initial_model=...)``), exactly the reference's DirectReader re-seed.
+1. **Epoch recovery runtime** (``common/recovery.py``, re-exported here):
+   the platform's END-TO-END EXACTLY-ONCE tier. A
+   :class:`~alink_tpu.common.recovery.CheckpointCoordinator` cuts the
+   stream into epochs of N source chunks and, at each quiescent barrier,
+   atomically persists a snapshot manifest — source offset, per-operator
+   state blobs (``StreamOperator.state_snapshot()``: FTRL/OnlineFm
+   accumulators, open window buffers, cumulative eval counters), and
+   per-sink committed epochs — then publishes every transactional sink's
+   staged epoch. MULTI-SINK epoch contract: the manifest is one atomic
+   commit point covering ALL sinks; each sink records its own committed
+   epoch (in the target itself when it supports transactions, else a
+   marker file), uncommitted epochs replay idempotently from the staged
+   blob on restart, and the coordinator acks — retains snapshots by —
+   the MINIMUM committed epoch across sinks. Fan-out pipelines therefore
+   checkpoint correctly: a fast sink can never journal past a slow one,
+   which retires the old single-consumer restriction of this module.
+   ``run_with_recovery(job_factory, restart_policy)`` supervises the job:
+   crashes (including the injected ``crash`` fault kind) restart from the
+   latest snapshot, and the recovered run is bit-identical to a
+   fault-free run.
 
-Without a checkpoint the runtime is AT-MOST-ONCE per chunk (a crash loses
+2. **Legacy offset journal** (this module): :class:`StreamCheckpoint` +
+   :class:`CheckpointedSourceStreamOp` + :class:`AckCheckpointStreamOp`
+   journal only the last sink-acked chunk id — AT-LEAST-ONCE source
+   replay with no operator state, still the right tool for a single
+   stateless map/sink chain where replaying a chunk is harmless. The ack
+   op keeps its 1-in-1-out alignment contract and must feed exactly one
+   consumer; anything needing several sinks or stateful operators should
+   use the epoch runtime above instead.
+
+Without either tier the runtime is AT-MOST-ONCE per chunk (a crash loses
 the in-flight chunk) — that default contract is documented here rather
-than hidden."""
+than hidden.
+"""
 
 from __future__ import annotations
 
 import json
 import logging
-from typing import Iterator, Optional
+from typing import Iterator
 
+from ...common.metrics import metrics
 from ...common.mtable import MTable, TableSchema
-from ...common.params import ParamInfo
+# re-exported so stream users find the exactly-once tier where the
+# reference keeps its checkpoint configuration
+from ...common.recovery import (  # noqa: F401
+    CheckpointCoordinator,
+    RecoverableStreamJob,
+    SnapshotStore,
+    TransactionalSink,
+    _durable_write,
+    run_with_recovery,
+)
 from ...io.filesystem import file_open, get_file_system
 from .base import StreamOperator
 
@@ -87,19 +108,34 @@ class StreamCheckpoint:
             return -1
 
     def ack(self, chunk_id: int) -> None:
-        tmp = self.path + ".tmp"
-        with file_open(tmp, "w") as f:
-            json.dump({"last_acked": int(chunk_id)}, f)
-        self._fs.rename(tmp, self.path)
+        """Durably journal ``chunk_id``: the tmp file is flushed AND fsynced
+        before the rename (the shared write-tmp→fsync→rename sequence the
+        snapshot store uses), so an ack that returned survives power loss —
+        rename-without-fsync can leave a zero-length journal on crash,
+        which would silently replay the whole stream."""
+        _durable_write(self._fs, self.path,
+                       json.dumps({"last_acked": int(chunk_id)}).encode())
 
     def reset(self) -> None:
-        self._fs.delete(self.path)
+        """Clear the journal (full replay on next run). Never raises when
+        there is nothing to clear — resetting a job that has not run yet
+        is a no-op, not an error — and also clears a stale ``.tmp``."""
+        for path in (self.path, self.path + ".tmp"):
+            try:
+                if self._fs.exists(path):
+                    self._fs.delete(path)
+            except OSError as e:
+                logger.warning("checkpoint reset could not delete %s: %s",
+                               path, e)
 
 
 class CheckpointedSourceStreamOp(StreamOperator):
     """Wrap any stream source with replay-on-restart: chunks whose ids are
     already acked (by :class:`AckCheckpointStreamOp` downstream) are
-    re-read from the source but NOT re-emitted."""
+    re-read from the source but NOT re-emitted. Each skipped chunk counts
+    in the ``checkpoint.replayed_chunks`` metric and a resume-from-journal
+    in ``checkpoint.restores`` — replay volume is an operational signal
+    (how much work every crash costs), not something to do silently."""
 
     _max_inputs = 0
 
@@ -111,9 +147,13 @@ class CheckpointedSourceStreamOp(StreamOperator):
 
     def _stream_impl(self) -> Iterator[MTable]:
         start = self._checkpoint.last_acked() + 1
+        if start > 0:
+            metrics.incr("checkpoint.restores")
         for i, chunk in enumerate(self._inner._stream()):
             if i < start:
-                continue  # replayed and already processed — skip
+                # replayed and already processed — skip, but count it
+                metrics.incr("checkpoint.replayed_chunks")
+                continue
             yield chunk
 
     def _out_schema(self) -> TableSchema:
@@ -123,7 +163,8 @@ class CheckpointedSourceStreamOp(StreamOperator):
 class AckCheckpointStreamOp(StreamOperator):
     """Pass-through that acknowledges each chunk AFTER downstream-of-source
     processing reached it; place it at the end of the pipeline with ONE
-    consumer (see the module alignment + single-consumer contracts)."""
+    consumer (see the module's legacy-tier contract — multi-sink pipelines
+    belong on the epoch recovery runtime)."""
 
     _min_inputs = 1
     _max_inputs = 1

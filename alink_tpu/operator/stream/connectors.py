@@ -55,7 +55,12 @@ class LookupKvStreamOp(StreamOperator):
 
 
 class KvSinkStreamOp(StreamOperator):
-    """Per-chunk KV writes (reference: RedisSinkStreamOp)."""
+    """Per-chunk KV writes (reference: RedisSinkStreamOp).
+
+    Epoch-transactional under the recovery runtime: KV puts are idempotent
+    (last-writer-wins per key), and the committed-epoch marker is stored in
+    the target store itself, so crash-recovery replay of an uncommitted
+    epoch is exactly-once effectively."""
 
     STORE_URI = ParamInfo("storeUri", str, optional=False)
     KEY_COL = ParamInfo("keyCol", str, optional=False)
@@ -75,6 +80,52 @@ class KvSinkStreamOp(StreamOperator):
 
     def _out_schema(self, in_schema):
         return in_schema
+
+    # -- epoch-transactional sink protocol (common/recovery.py) --------------
+    def txn_sink_id(self) -> str:
+        return f"kv:{self.get(self.STORE_URI)}:{self.get(self.KEY_COL)}"
+
+    def _txn_open(self):
+        return (open_kv_store(self.get(self.STORE_URI)),
+                KvSinkBatchOp(self.get_params().clone()))
+
+    def _txn_commit(self, handle, epoch: int, chunks, txn_key: str) -> str:
+        store, inner = handle
+        maybe_fail("io", label="kv.sink")
+        for t in chunks:
+            inner._write(t, store)
+        # marker lives in the target, keyed by the (job, sink)-scoped
+        # txn_key: replay after a crash between the puts and the marker
+        # just re-puts the same keys (idempotent)
+        store.set(f"__alink_txn__:{txn_key}", {"epoch": int(epoch)})
+        return "target"
+
+    def _txn_committed_epoch(self, handle, txn_key: str):
+        rec = handle[0].get(f"__alink_txn__:{txn_key}")
+        return -1 if not rec else int(rec.get("epoch", -1))
+
+    def _txn_close(self, handle):
+        handle[0].close()
+
+
+class _BusTxnSinkMixin:
+    """Shared memory-vs-wire handle plumbing for bus-style transactional
+    sinks (Kafka, DataHub). Handles are ``(kind, h)`` where ``kind`` is
+    ``"memory"`` (the in-process double, which commits data + epoch marker
+    atomically — the broker-transactions analog) or ``"wire"`` (a real
+    producer without transactions: publish, then the coordinator's marker
+    file records the commit). ``txn_key`` is the (job, sink)-scoped
+    transaction identity supplied by the coordinator — NOT just the sink
+    target, since epoch numbers restart at 0 per job."""
+
+    def _txn_committed_epoch(self, handle, txn_key: str):
+        kind, h = handle
+        return h.txn_epoch(txn_key) if kind == "memory" else None
+
+    def _txn_close(self, handle):
+        kind, h = handle
+        if kind == "wire":
+            h.close()
 
 
 def _decode_with_dead_letter(decode, payloads, exc, source: str):
@@ -199,7 +250,7 @@ class KafkaSourceStreamOp(StreamOperator):
         return TableSchema.parse(self.get(self.SCHEMA_STR))
 
 
-class KafkaSinkStreamOp(StreamOperator):
+class KafkaSinkStreamOp(_BusTxnSinkMixin, StreamOperator):
     """Produce every row of every chunk to a topic (reference:
     KafkaSinkStreamOp.java — dataFormat CSV|JSON)."""
 
@@ -238,6 +289,39 @@ class KafkaSinkStreamOp(StreamOperator):
 
     def _out_schema(self, in_schema: TableSchema) -> TableSchema:
         return in_schema
+
+    # -- epoch-transactional sink protocol (common/recovery.py) --------------
+    # memory:// handling + close via _BusTxnSinkMixin; wire brokers leave
+    # the documented publish→marker window (close it with broker
+    # transactions when the real client is wired)
+    def txn_sink_id(self) -> str:
+        return (f"kafka:{self.get(self.BOOTSTRAP_SERVERS)}"
+                f"/{self.get(self.TOPIC)}")
+
+    def _txn_open(self):
+        from ...io.kafka import MemoryKafkaBroker
+
+        servers = self.get(self.BOOTSTRAP_SERVERS)
+        if servers.startswith("memory://"):
+            return ("memory",
+                    MemoryKafkaBroker.named(servers[len("memory://"):]))
+        return ("wire", _open_producer(servers))
+
+    def _txn_commit(self, handle, epoch: int, chunks, txn_key: str) -> str:
+        kind, h = handle
+        topic = self.get(self.TOPIC)
+        fmt = self.get(self.FORMAT)
+        delim = self.get(self.FIELD_DELIMITER)
+        payloads = [_encode_row(t.names, row, fmt, delim)
+                    for t in chunks for row in t.rows()]
+        maybe_fail("io", label="kafka.sink")
+        if kind == "memory":
+            h.produce_txn(topic, payloads, txn_key, epoch)
+            return "target"
+        for p in payloads:
+            h.send(topic, p)
+        h.flush()
+        return "marker"
 
 
 class DatahubSourceStreamOp(StreamOperator):
@@ -279,7 +363,7 @@ class DatahubSourceStreamOp(StreamOperator):
         return TableSchema.parse(self.get(self.SCHEMA_STR))
 
 
-class DatahubSinkStreamOp(StreamOperator):
+class DatahubSinkStreamOp(_BusTxnSinkMixin, StreamOperator):
     """Put every row of every chunk as a tuple record (reference:
     connector-datahub/.../datastream/sink/DatahubSinkFunction.java +
     DatahubOutputFormat.java — record resolver + batched put)."""
@@ -312,6 +396,32 @@ class DatahubSinkStreamOp(StreamOperator):
 
     def _out_schema(self, in_schema: TableSchema) -> TableSchema:
         return in_schema
+
+    # -- epoch-transactional sink protocol (common/recovery.py) --------------
+    # memory:// handling + close via _BusTxnSinkMixin, like the Kafka twin
+    def txn_sink_id(self) -> str:
+        return f"datahub:{self.get(self.ENDPOINT)}/{self.get(self.TOPIC)}"
+
+    def _txn_open(self):
+        from ...io.datahub import (MemoryDatahubService, open_datahub_producer,
+                                   parse_datahub_uri)
+
+        parsed = parse_datahub_uri(self.get(self.ENDPOINT))
+        if parsed[0] == "memory":
+            return ("memory", MemoryDatahubService.named(parsed[1]))
+        return ("wire", open_datahub_producer(self.get(self.ENDPOINT),
+                                              self.get(self.TOPIC)))
+
+    def _txn_commit(self, handle, epoch: int, chunks, txn_key: str) -> str:
+        kind, h = handle
+        rows = [tuple(r) for t in chunks for r in t.rows()]
+        maybe_fail("io", label="datahub.sink")
+        if kind == "memory":
+            h.put_records_txn(self.get(self.TOPIC), rows, txn_key, epoch)
+            return "target"
+        h.send_rows(rows)
+        h.flush()
+        return "marker"
 
 
 class GenerateFeatureOfWindowStreamOp(StreamOperator):

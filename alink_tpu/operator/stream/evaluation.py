@@ -13,11 +13,16 @@ import numpy as np
 
 from ...common.mtable import AlinkTypes, MTable, TableSchema
 from ...common.params import ParamInfo
-from .base import StreamOperator
+from .base import CumulativeEvalStateMixin, StreamOperator
 
 
-class EvalBinaryClassStreamOp(StreamOperator):
-    """One metrics row per micro-batch (window) + cumulative row."""
+class EvalBinaryClassStreamOp(CumulativeEvalStateMixin, StreamOperator):
+    """One metrics row per micro-batch (window) + cumulative row.
+
+    Cumulative counters live on the instance (CumulativeEvalStateMixin) so
+    epoch snapshots (common/recovery.py) carry them: the post-restart
+    cumulative row keeps covering the whole stream, not just post-crash
+    chunks."""
 
     _min_inputs = 1
     _max_inputs = 1
@@ -26,24 +31,29 @@ class EvalBinaryClassStreamOp(StreamOperator):
     PREDICTION_DETAIL_COL = ParamInfo("predictionDetailCol", str, optional=False)
     POSITIVE_LABEL = ParamInfo("positiveLabelValueString", str)
 
+    _eval_series = ("all_y", "all_s")
+
     def _stream_impl(self, it: Iterator[MTable]) -> Iterator[MTable]:
         label_col = self.get(self.LABEL_COL)
         detail_col = self.get(self.PREDICTION_DETAIL_COL)
         pos = self.get(self.POSITIVE_LABEL)
-        all_y, all_s = [], []
-        for i, chunk in enumerate(it):
+        st = self._eval_state()
+        for chunk in it:
             y_raw = [str(v) for v in chunk.col(label_col)]
             details = [json.loads(str(v)) for v in chunk.col(detail_col)]
             p = pos if pos is not None else sorted(details[0].keys())[-1]
             scores = np.asarray([d.get(p, 0.0) for d in details])
             y = np.asarray([1.0 if v == p else 0.0 for v in y_raw])
-            all_y.append(y)
-            all_s.append(scores)
+            st["all_y"].append(y)
+            st["all_s"].append(scores)
+            i = st["window"]
+            st["window"] += 1
             yield self._metrics_row("window", i, y, scores)
 
-        if all_y:
+        if st["all_y"]:
             yield self._metrics_row(
-                "all", -1, np.concatenate(all_y), np.concatenate(all_s)
+                "all", -1, np.concatenate(st["all_y"]),
+                np.concatenate(st["all_s"])
             )
 
     @staticmethod
@@ -90,6 +100,10 @@ class SummarizerStreamOp(StreamOperator):
     over everything seen so far (reference: operator/stream/statistics/
     SummarizerStreamOp.java — merged TableSummary over windows). The merge
     is the summarizer's (count, sum, sum2, min, max) moment algebra."""
+
+    # cross-chunk state in generator locals, no snapshot hooks yet:
+    # refused by the recovery runtime rather than silently reset
+    _stateful_unhooked = True
 
     SELECTED_COLS = ParamInfo("selectedCols", list)
 

@@ -122,6 +122,11 @@ def _sink_at_stream_end(name: str, batch_cls_name: str, ref: str):
         _min_inputs = 1
         _max_inputs = 1
 
+        # the whole-stream buffer is cross-chunk state: a crash-restart
+        # would write a file holding only post-crash chunks, so the
+        # recovery runtime refuses these sinks
+        _stateful_unhooked = True
+
         def _stream_impl(self, it: Iterator[MTable]) -> Iterator[MTable]:
             from .. import batch as batch_mod
 

@@ -132,6 +132,10 @@ class ModelStreamFileSinkStreamOp(StreamOperator):
     directory (reference: operator/stream/sink/
     ModelStreamFileSinkStreamOp.java)."""
 
+    # appends to the model-stream dir per chunk OUTSIDE the transactional
+    # sink protocol: a crash-replay would double-append snapshots
+    _stateful_unhooked = True
+
     _min_inputs = 1
     _max_inputs = 1
 

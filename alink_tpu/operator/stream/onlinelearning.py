@@ -97,6 +97,62 @@ class FtrlTrainStreamOp(StreamOperator, HasFtrlParams):
         super().__init__(params, **kwargs)
         self._initial_model = initial_model
 
+    # FTRL device state (z, n) and warm-up bookkeeping live on the instance
+    # so epoch snapshots (common/recovery.py) can persist them: a resumed
+    # job restarts mid-stream with the exact accumulators, instead of
+    # re-seeding from the newest emitted model table.
+    def _ftrl_state(self) -> dict:
+        st = getattr(self, "_fstate", None)
+        if st is not None:
+            return st
+        import jax.numpy as jnp
+
+        alpha, beta = self.get(self.ALPHA), self.get(self.BETA)
+        l1, l2 = self.get(self.L_1), self.get(self.L_2)
+        st = {
+            "z": None, "n": None,
+            "labels": None,
+            "meta0": {},
+            "vec_col": self.get(HasVectorCol.VECTOR_COL),
+            # resolved once (first chunk / initial model) and persisted in
+            # every snapshot so predict binds to the same columns
+            "feat_cols": self.get(HasFeatureCols.FEATURE_COLS),
+            "batch_no": 0,
+            "warmup": [],   # chunks buffered until 2 distinct labels arrive
+            "seen_labels": set(),
+        }
+        if self._initial_model is not None:
+            meta0, arrays = table_to_model(self._initial_model)
+            w0 = np.concatenate(
+                [arrays["weights"].reshape(-1),
+                 arrays["intercept"].reshape(-1)]
+            )
+            st["meta0"] = meta0
+            st["labels"] = meta0.get("labels")
+            st["vec_col"] = st["vec_col"] or meta0.get("vectorCol")
+            st["feat_cols"] = st["feat_cols"] or meta0.get("featureCols")
+            # invert the closed form at n=0 so weights(z, 0) == w0
+            st["z"] = jnp.asarray(
+                -(w0 * (beta / alpha + l2)) - np.sign(w0) * l1)
+            st["n"] = jnp.zeros_like(st["z"])
+            st["seen_labels"] = set(st["labels"] or [])
+        self._fstate = st
+        return st
+
+    def state_snapshot(self) -> dict:
+        st = self._ftrl_state()
+        out = dict(st)
+        out["z"] = None if st["z"] is None else np.asarray(st["z"])
+        out["n"] = None if st["n"] is None else np.asarray(st["n"])
+        out["seen_labels"] = set(st["seen_labels"])
+        out["warmup"] = list(st["warmup"])
+        return out
+
+    def state_restore(self, state: dict) -> None:
+        # z/n stay host numpy here; the jitted step accepts them directly
+        # and the values round-trip bit-exactly (float32 both ways)
+        self._fstate = dict(state)
+
     def _stream_impl(self, it: Iterator[MTable]) -> Iterator[MTable]:
         import jax.numpy as jnp
 
@@ -106,93 +162,78 @@ class FtrlTrainStreamOp(StreamOperator, HasFtrlParams):
         label_col = self.get(self.LABEL_COL)
         interval = self.get(self.MODEL_SAVE_INTERVAL)
 
-        z = n = None
-        labels: Optional[list] = None
-        meta0 = {}
-        vec_col = self.get(HasVectorCol.VECTOR_COL)
-        # resolved once (first chunk / initial model) and persisted in every
-        # snapshot so predict binds to the same columns
-        feat_cols = self.get(HasFeatureCols.FEATURE_COLS)
-        if self._initial_model is not None:
-            meta0, arrays = table_to_model(self._initial_model)
-            w0 = np.concatenate(
-                [arrays["weights"].reshape(-1), arrays["intercept"].reshape(-1)]
-            )
-            labels = meta0.get("labels")
-            vec_col = vec_col or meta0.get("vectorCol")
-            feat_cols = feat_cols or meta0.get("featureCols")
-            # invert the closed form at n=0 so weights(z, 0) == w0
-            z = jnp.asarray(-(w0 * (beta / alpha + l2)) - np.sign(w0) * l1)
-            n = jnp.zeros_like(z)
-
-        batch_no = 0
-        warmup: list = []  # chunks buffered until 2 distinct labels arrive
-        seen_labels: set = set(labels or [])
+        st = self._ftrl_state()
         for chunk in it:
             if chunk.num_rows == 0:
                 continue
-            seen_labels.update(np.asarray(chunk.col(label_col)).tolist())
-            if len(seen_labels) > 2:
+            st["seen_labels"].update(
+                np.asarray(chunk.col(label_col)).tolist())
+            if len(st["seen_labels"]) > 2:
                 raise AkIllegalDataException(
                     "FTRL is binary; saw labels "
-                    f"{sorted(map(str, seen_labels))}")
-            if labels is None or len(labels) < 2:
+                    f"{sorted(map(str, st['seen_labels']))}")
+            if st["labels"] is None or len(st["labels"]) < 2:
                 # same warm-up contract as OnlineFm: a label-skewed first
                 # chunk must not train a one-label model
-                if len(seen_labels) < 2:
-                    warmup.append(chunk)
-                    if sum(c.num_rows for c in warmup) > _WARMUP_MAX_ROWS:
+                if len(st["seen_labels"]) < 2:
+                    st["warmup"].append(chunk)
+                    if sum(c.num_rows
+                           for c in st["warmup"]) > _WARMUP_MAX_ROWS:
                         raise AkIllegalDataException(
                             "FTRL warm-up saw only one label in the first "
                             f"{_WARMUP_MAX_ROWS} rows; a binary stream must "
                             "deliver both classes early (or warm-start from "
                             "a batch model carrying the label set)")
                     continue
-                labels = sorted(seen_labels, key=str)
-                if warmup:
-                    chunk = MTable.concat(warmup + [chunk])
-                    warmup = []
-            if vec_col:
+                st["labels"] = sorted(st["seen_labels"], key=str)
+                if st["warmup"]:
+                    chunk = MTable.concat(st["warmup"] + [chunk])
+                    st["warmup"] = []
+            if st["vec_col"]:
                 X = chunk.to_numeric_block(
-                    [vec_col],
+                    [st["vec_col"]],
                     vector_size=self.get(self.VECTOR_SIZE) or None,
                 ).astype(np.float32)
             else:
-                if feat_cols is None:
-                    feat_cols = resolve_feature_cols(
+                if st["feat_cols"] is None:
+                    st["feat_cols"] = resolve_feature_cols(
                         chunk, self, exclude=[label_col]
                     )
-                X = chunk.to_numeric_block(feat_cols).astype(np.float32)
+                X = chunk.to_numeric_block(st["feat_cols"]).astype(np.float32)
             Xb = np.concatenate([X, np.ones((X.shape[0], 1), np.float32)], 1)
             y_raw = np.asarray(chunk.col(label_col)).tolist()
             y = np.asarray(
-                [1.0 if v == labels[0] else 0.0 for v in y_raw], np.float32
+                [1.0 if v == st["labels"][0] else 0.0 for v in y_raw],
+                np.float32
             )
-            if z is None:
+            if st["z"] is None:
                 d = Xb.shape[1]
-                z = jnp.zeros(d)
-                n = jnp.zeros(d)
-            if Xb.shape[1] != z.shape[0]:
+                st["z"] = jnp.zeros(d)
+                st["n"] = jnp.zeros(d)
+            if Xb.shape[1] != st["z"].shape[0]:
                 raise AkIllegalDataException(
-                    f"feature dim {Xb.shape[1] - 1} != model dim {z.shape[0] - 1}"
+                    f"feature dim {Xb.shape[1] - 1} != model dim "
+                    f"{st['z'].shape[0] - 1}"
                 )
-            z, n, w, _ = step(z, n, jnp.asarray(Xb), jnp.asarray(y))
-            batch_no += 1
-            if batch_no % interval == 0 and len(labels) == 2:
+            st["z"], st["n"], w, _ = step(
+                st["z"], st["n"], jnp.asarray(Xb), jnp.asarray(y))
+            st["batch_no"] += 1
+            if st["batch_no"] % interval == 0 and len(st["labels"]) == 2:
                 w_np = np.asarray(w)
                 meta = {
                     "modelName": "LinearModel",
                     "linearModelType": "LR",
-                    "vectorCol": vec_col,
-                    "featureCols": feat_cols,
+                    "vectorCol": st["vec_col"],
+                    "featureCols": st["feat_cols"],
                     "labelCol": label_col,
-                    "labelType": meta0.get("labelType", AlinkTypes.STRING)
+                    "labelType": st["meta0"].get("labelType",
+                                                 AlinkTypes.STRING)
                     if self._initial_model is not None
                     else chunk.schema.type_of(label_col),
-                    "labels": labels,
+                    "labels": st["labels"],
                     "hasIntercept": True,
-                    "dim": int(z.shape[0] - 1),
-                    "batchNo": batch_no,
+                    "dim": int(st["z"].shape[0] - 1),
+                    "batchNo": st["batch_no"],
                 }
                 yield model_to_table(
                     meta,
@@ -286,6 +327,37 @@ class OnlineFmTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols):
     _min_inputs = 1
     _max_inputs = 1
 
+    # AdaGrad state trees + warm-up bookkeeping on the instance, same epoch
+    # snapshot/restore contract as FtrlTrainStreamOp
+    def _fm_state(self) -> dict:
+        st = getattr(self, "_fmstate", None)
+        if st is None:
+            st = self._fmstate = {
+                "state": None,  # (params, accum) jax trees
+                "labels": None, "label_type": None,
+                "batch_no": 0, "warmup": [], "seen_labels": set(),
+                "vec_col": self.get(HasVectorCol.VECTOR_COL),
+                "feat_cols": self.get(HasFeatureCols.FEATURE_COLS),
+                # Generator objects pickle, so the full RNG stream state
+                # survives snapshots: restored draws continue the sequence
+                "rng": np.random.default_rng(self.get(self.RANDOM_SEED)),
+            }
+        return st
+
+    def state_snapshot(self) -> dict:
+        import jax
+
+        st = self._fm_state()
+        out = dict(st)
+        if st["state"] is not None:
+            out["state"] = jax.tree.map(np.asarray, st["state"])
+        out["seen_labels"] = set(st["seen_labels"])
+        out["warmup"] = list(st["warmup"])
+        return out
+
+    def state_restore(self, state: dict) -> None:
+        self._fmstate = dict(state)
+
     def _stream_impl(self, it: Iterator[MTable]) -> Iterator[MTable]:
         import jax
         import jax.numpy as jnp
@@ -297,13 +369,7 @@ class OnlineFmTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols):
         lr = self.get(self.LEARN_RATE)
         interval = self.get(self.MODEL_SAVE_INTERVAL)
         label_col = self.get(self.LABEL_COL)
-        vec_col = self.get(HasVectorCol.VECTOR_COL)
-        feat_cols = self.get(HasFeatureCols.FEATURE_COLS)
-
-        state = None
-        labels: Optional[list] = None
-        label_type = None
-        rng = np.random.default_rng(self.get(self.RANDOM_SEED))
+        st = self._fm_state()
 
         @jax.jit
         def update(params, accum, X, y):
@@ -319,64 +385,66 @@ class OnlineFmTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols):
                 params, g, new_accum)
             return new_params, new_accum
 
-        batch_no = 0
-        warmup: list = []  # chunks buffered until 2 distinct labels arrive
-        seen_labels: set = set()
         for chunk in it:
             if chunk.num_rows == 0:
                 continue
-            if feat_cols is None and not vec_col:
-                feat_cols = resolve_feature_cols(chunk, self,
-                                                 exclude=[label_col])
-            seen_labels.update(np.asarray(chunk.col(label_col)).tolist())
-            if labels is None:
+            if st["feat_cols"] is None and not st["vec_col"]:
+                st["feat_cols"] = resolve_feature_cols(chunk, self,
+                                                       exclude=[label_col])
+            st["seen_labels"].update(
+                np.asarray(chunk.col(label_col)).tolist())
+            if st["labels"] is None:
                 # same warm-up contract as FTRL: a label-skewed first chunk
                 # must not freeze a one-label (or 3+-label) model
-                if len(seen_labels) > 2:
+                if len(st["seen_labels"]) > 2:
                     raise AkIllegalDataException(
-                        f"OnlineFm is binary; saw labels {sorted(map(str, seen_labels))}")
-                if len(seen_labels) < 2:
-                    warmup.append(chunk)
-                    if sum(c.num_rows for c in warmup) > _WARMUP_MAX_ROWS:
+                        "OnlineFm is binary; saw labels "
+                        f"{sorted(map(str, st['seen_labels']))}")
+                if len(st["seen_labels"]) < 2:
+                    st["warmup"].append(chunk)
+                    if sum(c.num_rows
+                           for c in st["warmup"]) > _WARMUP_MAX_ROWS:
                         raise AkIllegalDataException(
                             "OnlineFm warm-up saw only one label in the "
                             f"first {_WARMUP_MAX_ROWS} rows; a binary stream "
                             "must deliver both classes early")
                     continue
-                labels = sorted(seen_labels, key=lambda v: str(v))
-                label_type = chunk.schema.type_of(label_col)
-                if warmup:
-                    chunk = MTable.concat(warmup + [chunk])
-                    warmup = []
+                st["labels"] = sorted(st["seen_labels"],
+                                      key=lambda v: str(v))
+                st["label_type"] = chunk.schema.type_of(label_col)
+                if st["warmup"]:
+                    chunk = MTable.concat(st["warmup"] + [chunk])
+                    st["warmup"] = []
             X = chunk.to_numeric_block(
-                [vec_col] if vec_col else feat_cols,
+                [st["vec_col"]] if st["vec_col"] else st["feat_cols"],
                 dtype=np.float32)
             y_raw = chunk.col(label_col)
-            y = np.where(np.asarray(y_raw) == labels[0], 1.0, -1.0) \
+            y = np.where(np.asarray(y_raw) == st["labels"][0], 1.0, -1.0) \
                 .astype(np.float32)
             d = X.shape[1]
-            if state is None:
+            if st["state"] is None:
                 params = (jnp.asarray(0.0),
                           jnp.zeros(d, jnp.float32),
-                          jnp.asarray(rng.normal(
+                          jnp.asarray(st["rng"].normal(
                               0, self.get(self.INIT_STDEV),
                               (d, kf)).astype(np.float32)))
                 accum = jax.tree.map(
                     lambda p: jnp.full_like(p, 1e-8), params)
-                state = (params, accum)
-            params, accum = state
+                st["state"] = (params, accum)
+            params, accum = st["state"]
             params, accum = update(params, accum, jnp.asarray(X),
                                    jnp.asarray(y))
-            state = (params, accum)
-            batch_no += 1
-            if batch_no % interval == 0:
+            st["state"] = (params, accum)
+            st["batch_no"] += 1
+            if st["batch_no"] % interval == 0:
                 w0, w, V = jax.device_get(params)
                 meta = {
                     "modelName": "FmModel", "fmTask": "binary",
-                    "numFactor": kf, "vectorCol": vec_col,
-                    "featureCols": (list(feat_cols) if feat_cols else None),
-                    "labelCol": label_col, "labelType": label_type,
-                    "labels": labels, "dim": int(d),
+                    "numFactor": kf, "vectorCol": st["vec_col"],
+                    "featureCols": (list(st["feat_cols"])
+                                    if st["feat_cols"] else None),
+                    "labelCol": label_col, "labelType": st["label_type"],
+                    "labels": st["labels"], "dim": int(d),
                 }
                 yield model_to_table(meta, {
                     "w0": np.asarray([w0], np.float32),

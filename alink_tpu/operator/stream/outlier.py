@@ -54,6 +54,10 @@ class EvalOutlierStreamOp(StreamOperator):
     operator/stream/evaluation/EvalOutlierStreamOp.java windowed+cumulative
     statistics)."""
 
+    # cumulative tp/fp/fn/tn in generator locals, no snapshot hooks yet:
+    # refused by the recovery runtime rather than silently reset
+    _stateful_unhooked = True
+
     LABEL_COL = ParamInfo("labelCol", str, optional=False)
     PREDICTION_COL = ParamInfo("predictionCol", str, optional=False)
     OUTLIER_VALUE_STRINGS = ParamInfo("outlierValueStrings", list)

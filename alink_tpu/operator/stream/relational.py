@@ -112,6 +112,11 @@ class SampleStreamOp(StreamOperator):
     """Bernoulli sample per micro-batch (reference:
     operator/stream/dataproc/SampleStreamOp.java)."""
 
+    # the Bernoulli RNG stream is cross-chunk state; restarting it at the
+    # seed mid-stream would sample different rows, so the recovery
+    # runtime refuses it until the RNG state snapshots
+    _stateful_unhooked = True
+
     RATIO = ParamInfo("ratio", float, optional=False,
                       validator=RangeValidator(0.0, 1.0))
     SEED = ParamInfo("randomSeed", int, default=0, aliases=("seed",))

@@ -86,6 +86,12 @@ class CsvSinkStreamOp(StreamOperator):
     """Append every chunk to one CSV file (reference:
     CsvSinkStreamOp.java)."""
 
+    # file-writing pass-through with cross-chunk generator state
+    # (open/truncating handle or full-stream buffer): a crash-restart
+    # would truncate or drop pre-crash output, so the recovery runtime
+    # refuses it until it speaks the _txn_* sink protocol
+    _stateful_unhooked = True
+
     FILE_PATH = ParamInfo("filePath", str, optional=False)
     FIELD_DELIMITER = ParamInfo("fieldDelimiter", str, default=",")
 
@@ -111,6 +117,12 @@ class AkSinkStreamOp(StreamOperator):
     """Collect the stream and land ONE .ak file at the end (reference:
     AkSinkStreamOp.java — the bounded-stream sink)."""
 
+    # file-writing pass-through with cross-chunk generator state
+    # (open/truncating handle or full-stream buffer): a crash-restart
+    # would truncate or drop pre-crash output, so the recovery runtime
+    # refuses it until it speaks the _txn_* sink protocol
+    _stateful_unhooked = True
+
     FILE_PATH = ParamInfo("filePath", str, optional=False)
 
     _min_inputs = 1
@@ -134,6 +146,12 @@ class Export2FileSinkStreamOp(StreamOperator):
     """Each micro-batch rolls into its OWN timestamped part file under a
     directory (reference: Export2FileSinkStreamOp.java — time-rolling file
     export; format ak or csv)."""
+
+    # file-writing pass-through with cross-chunk generator state
+    # (open/truncating handle or full-stream buffer): a crash-restart
+    # would truncate or drop pre-crash output, so the recovery runtime
+    # refuses it until it speaks the _txn_* sink protocol
+    _stateful_unhooked = True
 
     FILE_PATH = ParamInfo("filePath", str, optional=False,
                           desc="output DIRECTORY")
@@ -170,6 +188,12 @@ class Export2FileSinkStreamOp(StreamOperator):
 
 class TsvSinkStreamOp(StreamOperator):
     """(reference: TsvSinkStreamOp.java)"""
+
+    # file-writing pass-through with cross-chunk generator state
+    # (open/truncating handle or full-stream buffer): a crash-restart
+    # would truncate or drop pre-crash output, so the recovery runtime
+    # refuses it until it speaks the _txn_* sink protocol
+    _stateful_unhooked = True
 
     FILE_PATH = ParamInfo("filePath", str, optional=False)
 

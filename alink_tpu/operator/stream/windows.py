@@ -31,7 +31,7 @@ from ...common.exceptions import (
 )
 from ...common.mtable import AlinkTypes, MTable, TableSchema
 from ...common.params import InValidator, MinValidator, ParamInfo
-from .base import StreamOperator
+from .base import CumulativeEvalStateMixin, StreamOperator
 from .onlinelearning import BinaryClassModelFilterStreamOp
 
 __all__ = [
@@ -101,24 +101,47 @@ class _TimeWindowBase(StreamOperator):
                                np.full(out.num_rows, float(start)),
                                AlinkTypes.DOUBLE)
 
+    # open-window buffers live on the instance (not generator locals) so an
+    # epoch snapshot can persist them and a restored job resumes mid-stream
+    # with its windows still open (closed windows were already emitted and
+    # committed, so they are never re-cut).
+    def _win_state(self) -> dict:
+        st = getattr(self, "_wstate", None)
+        if st is None:
+            st = self._wstate = {"buffers": {}, "watermark": -np.inf,
+                                 "schema": None}
+        return st
+
+    def state_snapshot(self) -> dict:
+        st = self._win_state()
+        return {"buffers": {k: list(v) for k, v in st["buffers"].items()},
+                "watermark": st["watermark"], "schema": st["schema"]}
+
+    def state_restore(self, state: dict) -> None:
+        self._wstate = {
+            "buffers": {k: list(v) for k, v in state["buffers"].items()},
+            "watermark": state["watermark"], "schema": state["schema"]}
+
     def _stream_impl(self, it: Iterator[MTable]) -> Iterator[MTable]:
         time_col = self.get(self.TIME_COL)
-        buffers: Dict[float, List[tuple]] = {}
-        schema: Optional[TableSchema] = None
-        watermark = -np.inf
+        st = self._win_state()
+        buffers: Dict[float, List[tuple]] = st["buffers"]
         for chunk in it:
-            schema = chunk.schema
+            st["schema"] = chunk.schema
             times = [_parse_time(v) for v in chunk.col(time_col)]
             for row, ts in zip(chunk.rows(), times):
                 for w in self._windows_of(ts):
                     buffers.setdefault(w, []).append(tuple(row))
-            watermark = max(watermark, max(times, default=watermark))
-            closed = [w for w in buffers if self._window_end(w) <= watermark]
+            st["watermark"] = max(st["watermark"],
+                                  max(times, default=st["watermark"]))
+            closed = [w for w in buffers
+                      if self._window_end(w) <= st["watermark"]]
             for w in sorted(closed):
-                yield self._aggregate(w, buffers.pop(w), schema)
+                yield self._aggregate(w, buffers.pop(w), st["schema"])
         for w in sorted(buffers):  # flush at end-of-stream
-            if buffers[w] and schema is not None:
-                yield self._aggregate(w, buffers[w], schema)
+            rows = buffers.pop(w)  # emitted → off the instance, so the
+            if rows and st["schema"] is not None:  # final snapshot doesn't
+                yield self._aggregate(w, rows, st["schema"])  # re-pickle it
 
 
 class TumbleTimeWindowStreamOp(_TimeWindowBase):
@@ -171,38 +194,62 @@ class SessionTimeWindowStreamOp(StreamOperator):
     _min_inputs = 1
     _max_inputs = 1
 
+    # the open session buffers on the instance for epoch snapshots, same
+    # contract as _TimeWindowBase
+    def _win_state(self) -> dict:
+        st = getattr(self, "_wstate", None)
+        if st is None:
+            st = self._wstate = {"cur": [], "cur_start": None,
+                                 "cur_last": None, "schema": None}
+        return st
+
+    def state_snapshot(self) -> dict:
+        st = self._win_state()
+        return {"cur": list(st["cur"]), "cur_start": st["cur_start"],
+                "cur_last": st["cur_last"], "schema": st["schema"]}
+
+    def state_restore(self, state: dict) -> None:
+        self._wstate = {"cur": list(state["cur"]),
+                        "cur_start": state["cur_start"],
+                        "cur_last": state["cur_last"],
+                        "schema": state["schema"]}
+
     def _stream_impl(self, it: Iterator[MTable]) -> Iterator[MTable]:
         gap = float(self.get(self.SESSION_GAP_TIME))
         time_col = self.get(self.TIME_COL)
         # one open session at a time per whole stream (grouped sessions
         # aggregate inside the session via GROUP_COLS)
-        cur: List[tuple] = []
-        cur_start = None
-        cur_last = None
-        schema: Optional[TableSchema] = None
+        st = self._win_state()
         agg = _TimeWindowBase._aggregate
 
         def flush():
-            if cur and schema is not None:
-                return agg(self, cur_start, list(cur), schema)
+            # clears the emitted session off the instance state, so neither
+            # the mid-stream path nor the final snapshot retains it
+            if st["cur"] and st["schema"] is not None:
+                out = agg(self, st["cur_start"], list(st["cur"]),
+                          st["schema"])
+                st["cur"] = []
+                st["cur_start"] = None
+                return out
             return None
 
         for chunk in it:
-            schema = chunk.schema
+            st["schema"] = chunk.schema
             order = np.argsort([_parse_time(v)
                                 for v in chunk.col(time_col)])
             rows = list(chunk.rows())
             for i in order:
                 ts = _parse_time(chunk.col(time_col)[i])
-                if cur_last is not None and ts - cur_last > gap:
+                if st["cur_last"] is not None and ts - st["cur_last"] > gap:
                     out = flush()
                     if out is not None:
                         yield out
-                    cur = []
-                    cur_start = None
-                cur.append(tuple(rows[i]))
-                cur_start = ts if cur_start is None else cur_start
-                cur_last = ts
+                    st["cur"] = []
+                    st["cur_start"] = None
+                st["cur"].append(tuple(rows[i]))
+                st["cur_start"] = ts if st["cur_start"] is None \
+                    else st["cur_start"]
+                st["cur_last"] = ts
         out = flush()
         if out is not None:
             yield out
@@ -211,6 +258,11 @@ class SessionTimeWindowStreamOp(StreamOperator):
 class WindowGroupByStreamOp(StreamOperator):
     """Unified windowed group-by: windowType TUMBLE/HOP/SESSION (reference:
     operator/stream/sql/WindowGroupByStreamOp.java)."""
+
+    # delegates to an inner window op built inside the generator, so its
+    # buffers are out of snapshot reach — use the concrete window ops in
+    # recoverable jobs
+    _stateful_unhooked = True
 
     WINDOW_TYPE = ParamInfo("windowType", str, default="TUMBLE",
                             validator=InValidator("TUMBLE", "HOP",
@@ -247,6 +299,10 @@ class OverCountWindowStreamOp(StreamOperator):
     """Per-row aggregates over the preceding N rows (rolling buffer across
     micro-batches) (reference: operator/stream/dataproc/
     OverCountWindowStreamOp.java)."""
+
+    # cross-chunk state in generator locals, no snapshot hooks yet:
+    # refused by the recovery runtime rather than silently reset
+    _stateful_unhooked = True
 
     SELECTED_COL = ParamInfo("selectedCol", str, optional=False,
                              aliases=("valueCol",))
@@ -285,6 +341,10 @@ class OverCountWindowStreamOp(StreamOperator):
 class OverTimeWindowStreamOp(StreamOperator):
     """Per-row aggregates over the preceding time span (reference:
     operator/stream/dataproc/OverTimeWindowStreamOp.java)."""
+
+    # cross-chunk state in generator locals, no snapshot hooks yet:
+    # refused by the recovery runtime rather than silently reset
+    _stateful_unhooked = True
 
     SELECTED_COL = ParamInfo("selectedCol", str, optional=False,
                              aliases=("valueCol",))
@@ -327,12 +387,17 @@ class OverTimeWindowStreamOp(StreamOperator):
 # ---------------------------------------------------------------------------
 
 
-class EvalMultiClassStreamOp(StreamOperator):
+class EvalMultiClassStreamOp(CumulativeEvalStateMixin, StreamOperator):
     """Per-window + cumulative multiclass accuracy/macro-F1 (reference:
-    operator/stream/evaluation/EvalMultiClassStreamOp.java)."""
+    operator/stream/evaluation/EvalMultiClassStreamOp.java). Cumulative
+    history lives on the instance via CumulativeEvalStateMixin so epoch
+    snapshots carry it and a restored job's cumulative row covers the
+    WHOLE stream, not just post-restart chunks."""
 
     LABEL_COL = ParamInfo("labelCol", str, optional=False)
     PREDICTION_COL = ParamInfo("predictionCol", str, optional=False)
+
+    _eval_series = ("all_y", "all_p")
 
     _min_inputs = 1
     _max_inputs = 1
@@ -356,32 +421,38 @@ class EvalMultiClassStreamOp(StreamOperator):
         schema = TableSchema(["Statistics", "WindowId", "Data"],
                              [AlinkTypes.STRING, AlinkTypes.LONG,
                               AlinkTypes.STRING])
-        all_y, all_p = [], []
-        for i, chunk in enumerate(it):
+        st = self._eval_state()
+        for chunk in it:
             y = np.asarray([str(v) for v in
                             chunk.col(self.get(self.LABEL_COL))])
             p = np.asarray([str(v) for v in
                             chunk.col(self.get(self.PREDICTION_COL))])
-            all_y.append(y)
-            all_p.append(p)
+            st["all_y"].append(y)
+            st["all_p"].append(p)
+            i = st["window"]
+            st["window"] += 1
             yield MTable.from_rows(
                 [("window", i, self._metrics(y, p))], schema)
-        if all_y:
+        if st["all_y"]:
             yield MTable.from_rows(
-                [("all", -1, self._metrics(np.concatenate(all_y),
-                                           np.concatenate(all_p)))], schema)
+                [("all", -1, self._metrics(np.concatenate(st["all_y"]),
+                                           np.concatenate(st["all_p"])))],
+                schema)
 
 
 class BaseEvalClassStreamOp(EvalMultiClassStreamOp):
     """(reference: operator/stream/evaluation/BaseEvalClassStreamOp.java)"""
 
 
-class EvalRegressionStreamOp(StreamOperator):
+class EvalRegressionStreamOp(CumulativeEvalStateMixin, StreamOperator):
     """Per-window + cumulative MAE/RMSE/R2 (reference:
-    operator/stream/evaluation/EvalRegressionStreamOp.java)."""
+    operator/stream/evaluation/EvalRegressionStreamOp.java). Same
+    snapshot/restore contract as EvalMultiClassStreamOp."""
 
     LABEL_COL = ParamInfo("labelCol", str, optional=False)
     PREDICTION_COL = ParamInfo("predictionCol", str, optional=False)
+
+    _eval_series = ("all_y", "all_p")
 
     _min_inputs = 1
     _max_inputs = 1
@@ -400,24 +471,31 @@ class EvalRegressionStreamOp(StreamOperator):
         schema = TableSchema(["Statistics", "WindowId", "Data"],
                              [AlinkTypes.STRING, AlinkTypes.LONG,
                               AlinkTypes.STRING])
-        all_y, all_p = [], []
-        for i, chunk in enumerate(it):
+        st = self._eval_state()
+        for chunk in it:
             y = np.asarray(chunk.col(self.get(self.LABEL_COL)), np.float64)
             p = np.asarray(chunk.col(self.get(self.PREDICTION_COL)),
                            np.float64)
-            all_y.append(y)
-            all_p.append(p)
+            st["all_y"].append(y)
+            st["all_p"].append(p)
+            i = st["window"]
+            st["window"] += 1
             yield MTable.from_rows(
                 [("window", i, self._metrics(y, p))], schema)
-        if all_y:
+        if st["all_y"]:
             yield MTable.from_rows(
-                [("all", -1, self._metrics(np.concatenate(all_y),
-                                           np.concatenate(all_p)))], schema)
+                [("all", -1, self._metrics(np.concatenate(st["all_y"]),
+                                           np.concatenate(st["all_p"])))],
+                schema)
 
 
 class QuantileStreamOp(StreamOperator):
     """Cumulative quantiles of a column, one row set per micro-batch
     (reference: operator/stream/statistics/QuantileStreamOp.java)."""
+
+    # cross-chunk state in generator locals, no snapshot hooks yet:
+    # refused by the recovery runtime rather than silently reset
+    _stateful_unhooked = True
 
     SELECTED_COL = ParamInfo("selectedCol", str, optional=False)
     QUANTILE_NUM = ParamInfo("quantileNum", int, default=4,
@@ -445,6 +523,10 @@ class HotProductStreamOp(StreamOperator):
     """Cumulative top-N hottest items, re-emitted per micro-batch
     (reference: operator/stream/recommendation/HotProductStreamOp.java)."""
 
+    # cross-chunk state in generator locals, no snapshot hooks yet:
+    # refused by the recovery runtime rather than silently reset
+    _stateful_unhooked = True
+
     SELECTED_COL = ParamInfo("selectedCol", str, optional=False,
                              aliases=("itemCol",))
     TOP_N = ParamInfo("topN", int, default=10, validator=MinValidator(1))
@@ -470,6 +552,10 @@ class WebTrafficIndexStreamOp(StreamOperator):
     """Cumulative PV/UV traffic indexes (reference:
     operator/stream/statistics/WebTrafficIndexStreamOp.java — the
     bitmap/sketch UV estimation collapses to an exact set here)."""
+
+    # cross-chunk state in generator locals, no snapshot hooks yet:
+    # refused by the recovery runtime rather than silently reset
+    _stateful_unhooked = True
 
     SELECTED_COL = ParamInfo("selectedCol", str, optional=False,
                              aliases=("userCol",))
@@ -500,6 +586,10 @@ class StreamingKMeansStreamOp(StreamOperator):
     KMeans model for the initial centroids, assigns each micro-batch, and
     updates centroids with the decay factor (reference:
     operator/stream/clustering/StreamingKMeansStreamOp.java)."""
+
+    # cross-chunk state in generator locals, no snapshot hooks yet:
+    # refused by the recovery runtime rather than silently reset
+    _stateful_unhooked = True
 
     PREDICTION_COL = ParamInfo("predictionCol", str, default="cluster_id")
     HALF_LIFE = ParamInfo("halfLife", float, default=10.0,
@@ -557,6 +647,10 @@ class OnePassClusterStreamOp(StreamOperator):
     """Single-pass threshold clustering: assign to the nearest existing
     center within epsilon, else open a new cluster (reference:
     operator/stream/clustering/OnePassClusterStreamOp.java)."""
+
+    # cross-chunk state in generator locals, no snapshot hooks yet:
+    # refused by the recovery runtime rather than silently reset
+    _stateful_unhooked = True
 
     FEATURE_COLS = ParamInfo("featureCols", list, default=None)
     VECTOR_COL = ParamInfo("vectorCol", str, default=None)

@@ -1,0 +1,593 @@
+"""Exactly-once stream recovery: epoch snapshots, operator-state
+checkpointing, transactional sinks, supervised restart.
+
+Headline CI invariant: a crash-injected supervised run of a stateful
+multi-sink pipeline (FTRL + tumble window + transactional sinks) produces
+sink output bit-identical to the fault-free run, with operator state
+restored mid-stream rather than replayed from chunk 0.
+"""
+
+import numpy as np
+import pytest
+
+from alink_tpu.common import faults
+from alink_tpu.common.exceptions import is_retryable
+from alink_tpu.common.faults import FaultSpec, InjectedCrashError
+from alink_tpu.common.metrics import metrics
+from alink_tpu.common.mtable import MTable
+from alink_tpu.common.recovery import (RecoverableStreamJob, SnapshotStore,
+                                       is_restartable, run_with_recovery)
+from alink_tpu.common.resilience import RetryPolicy
+from alink_tpu.io.datahub import MemoryDatahubService
+from alink_tpu.io.kafka import MemoryKafkaBroker
+from alink_tpu.io.kv import MemoryKvStore
+from alink_tpu.operator.stream import (DatahubSinkStreamOp,
+                                       FtrlTrainStreamOp, KafkaSinkStreamOp,
+                                       KvSinkStreamOp, TableSourceStreamOp)
+from alink_tpu.operator.stream.windows import (HopTimeWindowStreamOp,
+                                               SessionTimeWindowStreamOp,
+                                               TumbleTimeWindowStreamOp)
+
+pytestmark = pytest.mark.recovery
+
+
+# ---------------------------------------------------------------------------
+# snapshot store
+# ---------------------------------------------------------------------------
+
+
+def test_snapshot_store_roundtrip_and_retention(tmp_path):
+    store = SnapshotStore(str(tmp_path / "ck"), keep=2)
+    for e in range(5):
+        store.write_snapshot(e, {"source_offset": (e + 1) * 4},
+                             {"operators": {"op": {"v": e}}, "sinks": {}})
+        store.retain(min_committed_epoch=e)
+    # last keep=2 retained, older pruned
+    assert store.epochs() == [3, 4]
+    epoch, manifest, blob = store.load_latest()
+    assert epoch == 4
+    assert manifest["source_offset"] == 20
+    assert blob["operators"]["op"] == {"v": 4}
+
+
+def test_snapshot_store_skips_crash_debris(tmp_path):
+    """A truncated/corrupt newest snapshot (what a crash mid-write leaves)
+    must fall back to the previous good one, never wedge the restart."""
+    store = SnapshotStore(str(tmp_path / "ck"), keep=3)
+    store.write_snapshot(0, {"source_offset": 4}, {"operators": {"a": 1},
+                                                   "sinks": {}})
+    store.write_snapshot(1, {"source_offset": 8}, {"operators": {"a": 2},
+                                                   "sinks": {}})
+    # corrupt epoch 1's blob (checksum mismatch) — manifest still valid
+    with open(tmp_path / "ck" / "epoch-000000000001.blob", "wb") as f:
+        f.write(b"\x00garbage")
+    epoch, manifest, blob = store.load_latest()
+    assert epoch == 0 and blob["operators"]["a"] == 1
+    # truncated manifest on top of that
+    with open(tmp_path / "ck" / "epoch-000000000000.json", "w") as f:
+        f.write('{"epo')
+    assert store.load_latest() is None
+
+
+def test_sink_marker_roundtrip(tmp_path):
+    store = SnapshotStore(str(tmp_path / "ck"))
+    assert store.sink_marker("kafka:b/t") == -1
+    store.write_sink_marker("kafka:b/t", 7)
+    assert store.sink_marker("kafka:b/t") == 7
+    # distinct sinks get distinct markers
+    store.write_sink_marker("kv:m/x", 3)
+    assert store.sink_marker("kafka:b/t") == 7
+    assert store.sink_marker("kv:m/x") == 3
+
+
+# ---------------------------------------------------------------------------
+# operator state snapshot/restore round trips (satellite: windows)
+# ---------------------------------------------------------------------------
+
+
+def _window_data(n=60):
+    rng = np.random.RandomState(7)
+    return MTable({"ts": np.arange(n, dtype=np.float64),
+                   "v": rng.rand(n)})
+
+
+def _chunks(t, size):
+    return [t.slice(s, min(s + size, t.num_rows))
+            for s in range(0, t.num_rows, size)]
+
+
+def _rows(tables):
+    return [tuple(r) for t in tables for r in t.rows()]
+
+
+class _CrashCut(Exception):
+    pass
+
+
+def _roundtrip_outputs(make_op, chunks, cut):
+    """Run a full uninterrupted stream vs. crash-at-`cut` + state-restore
+    into a FRESH op; return (full, before_cut, after_restore) outputs.
+
+    The snapshot is taken exactly when the operator asks for chunk `cut` —
+    the generator is suspended between chunks, the same quiescent point
+    the CheckpointCoordinator's barrier guarantees — and the generator is
+    then killed abruptly, like a crash (no end-of-stream flush runs)."""
+    full_out = list(make_op()._stream_impl(iter(chunks)))
+
+    op_a = make_op()
+    snap = {}
+
+    def feeder():
+        for i, c in enumerate(chunks):
+            if i == cut:
+                snap["state"] = op_a.state_snapshot()
+                raise _CrashCut()
+            yield c
+
+    before = []
+    try:
+        for out in op_a._stream_impl(feeder()):
+            before.append(out)
+    except _CrashCut:
+        pass
+
+    op_b = make_op()
+    op_b.state_restore(snap["state"])
+    after = list(op_b._stream_impl(iter(chunks[cut:])))
+    return full_out, before, after
+
+
+@pytest.mark.parametrize("make_op,desc", [
+    (lambda: TumbleTimeWindowStreamOp(
+        timeCol="ts", windowTime=13.0,
+        clause="sum(v) as sv, count(*) as c"), "tumble"),
+    (lambda: HopTimeWindowStreamOp(
+        timeCol="ts", windowTime=14.0, hopTime=7.0,
+        clause="sum(v) as sv, count(*) as c"), "hop"),
+])
+def test_window_state_roundtrip(make_op, desc):
+    """Open window buffers survive a crash-restore: the resumed stream
+    emits exactly the windows the uninterrupted run would have emitted
+    after the cut — closed windows are NOT re-emitted, open ones close
+    with their pre-crash rows included."""
+    chunks = _chunks(_window_data(), size=6)
+    full, before, after = _roundtrip_outputs(make_op, chunks, cut=5)
+    assert _rows(before) + _rows(after) == _rows(full)
+    assert len(before) > 0 and len(after) > 0  # cut mid-stream both ways
+    # closed windows not re-emitted: no window_start appears twice
+    starts = [r[-1] for r in _rows(before) + _rows(after)]
+    assert len(starts) == len(set(starts)) or desc == "hop"  # hop overlaps
+
+
+def test_session_window_state_roundtrip():
+    t = MTable({"ts": np.asarray([0, 1, 2, 10, 11, 30, 31, 32, 50, 51],
+                                 np.float64),
+                "v": np.arange(10, dtype=np.float64)})
+    chunks = _chunks(t, 2)
+
+    def make_op():
+        return SessionTimeWindowStreamOp(
+            timeCol="ts", sessionGapTime=5.0,
+            clause="sum(v) as sv, count(*) as c")
+
+    full, before, after = _roundtrip_outputs(make_op, chunks, cut=3)
+    assert _rows(before) + _rows(after) == _rows(full)
+    assert len(after) > 0
+
+
+def test_ftrl_state_roundtrip_bit_identical():
+    """FTRL accumulators (z, n) restore bit-exactly: the resumed stream's
+    model snapshots equal the uninterrupted run's, element for element."""
+    rng = np.random.RandomState(3)
+    n = 120
+    t = MTable({"x0": rng.rand(n), "x1": rng.rand(n),
+                "label": (rng.rand(n) > 0.5).astype(np.int64)})
+    chunks = _chunks(t, 10)
+
+    def make_op():
+        return FtrlTrainStreamOp(featureCols=["x0", "x1"], labelCol="label",
+                                 modelSaveInterval=2)
+
+    full, before, after = _roundtrip_outputs(make_op, chunks, cut=5)
+    assert len(before) + len(after) == len(full)
+    for got, want in zip(before + after, full):
+        for a, b in zip(got.rows(), want.rows()):
+            assert a[0] == b[0] and a[1] == b[1]
+            assert np.asarray(a[2]).tobytes() == np.asarray(b[2]).tobytes()
+
+
+def test_eval_binary_cumulative_state_roundtrip():
+    import json as _json
+
+    from alink_tpu.operator.stream.evaluation import EvalBinaryClassStreamOp
+
+    rng = np.random.RandomState(5)
+    n = 40
+    y = (rng.rand(n) > 0.5).astype(np.int64)
+    s = np.clip(y * 0.6 + rng.rand(n) * 0.4, 0, 1)
+    t = MTable({"label": y.astype(object).astype(str),
+                "detail": np.asarray(
+                    [_json.dumps({"1": float(v), "0": float(1 - v)})
+                     for v in s], object)})
+    chunks = _chunks(t, 5)
+
+    def make_op():
+        return EvalBinaryClassStreamOp(labelCol="label",
+                                       predictionDetailCol="detail",
+                                       positiveLabelValueString="1")
+
+    full, before, after = _roundtrip_outputs(make_op, chunks, cut=4)
+    # the final cumulative 'all' row covers the WHOLE stream, not just the
+    # post-restore chunks, and window ids keep counting from the snapshot
+    assert _rows(before) + _rows(after) == _rows(full)
+
+
+# ---------------------------------------------------------------------------
+# legacy journal satellites
+# ---------------------------------------------------------------------------
+
+
+def test_stream_checkpoint_reset_missing_journal_is_noop(tmp_path):
+    """Satellite: reset() on a never-written (or already-reset) journal
+    must not raise, and clears a stale .tmp too."""
+    from alink_tpu.operator.stream import StreamCheckpoint
+
+    ck = StreamCheckpoint(str(tmp_path / "job.ckpt"))
+    ck.reset()          # nothing on disk — no error
+    ck.ack(4)
+    with open(str(tmp_path / "job.ckpt") + ".tmp", "w") as f:
+        f.write("stale")
+    ck.reset()
+    assert ck.last_acked() == -1
+    import os
+    assert not os.path.exists(str(tmp_path / "job.ckpt") + ".tmp")
+    ck.reset()          # idempotent
+
+
+def test_checkpointed_source_counts_replays_and_restores(tmp_path):
+    """Satellite: replayed-and-skipped chunks and journal restores land in
+    metrics counters instead of happening silently."""
+    from alink_tpu.operator.stream import (AckCheckpointStreamOp,
+                                           CheckpointedSourceStreamOp,
+                                           StreamCheckpoint)
+
+    t = MTable.from_rows([(i,) for i in range(10)], "v long")
+    state = str(tmp_path / "job.ckpt")
+    StreamCheckpoint(state).ack(2)  # 3 chunks already processed
+
+    r0 = metrics.counter("checkpoint.replayed_chunks")
+    s0 = metrics.counter("checkpoint.restores")
+    ck = StreamCheckpoint(state)
+    src = CheckpointedSourceStreamOp(TableSourceStreamOp(t, chunkSize=2), ck)
+    ack = AckCheckpointStreamOp(ck).link_from(src)
+    emitted = [tuple(c.col("v")) for c in ack._stream()]
+    assert emitted == [(6, 7), (8, 9)]
+    assert metrics.counter("checkpoint.replayed_chunks") - r0 == 3
+    assert metrics.counter("checkpoint.restores") - s0 == 1
+
+
+# ---------------------------------------------------------------------------
+# fault taxonomy: the crash kind
+# ---------------------------------------------------------------------------
+
+
+def test_crash_fault_kind_kills_but_is_restartable():
+    spec = FaultSpec.parse("recovery:count=1,kinds=crash")
+    with pytest.raises(InjectedCrashError) as ei:
+        spec.fire("recovery", label="epoch0.pre_commit")
+    # fatal for in-process retry layers, restartable for the supervisor
+    assert not is_retryable(ei.value)
+    assert is_restartable(ei.value)
+    spec.fire("recovery")  # count exhausted — passes
+
+
+def test_fault_match_filters_by_label():
+    spec = FaultSpec.parse("recovery:count=1,kinds=crash,match=pre_commit")
+    spec.fire("recovery", label="chunk0")       # no match — no fire,
+    spec.fire("recovery", label="chunk1")       # no count consumed
+    with pytest.raises(InjectedCrashError):
+        spec.fire("recovery", label="epoch3.pre_commit")
+    spec.fire("recovery", label="epoch4.pre_commit")  # count spent
+
+
+def test_fault_kind_validation():
+    from alink_tpu.common.exceptions import AkParseErrorException
+
+    with pytest.raises(AkParseErrorException):
+        FaultSpec.parse("io:kinds=explode")
+
+
+# ---------------------------------------------------------------------------
+# transactional sinks
+# ---------------------------------------------------------------------------
+
+
+def test_memory_broker_txn_commit_is_idempotent():
+    b = MemoryKafkaBroker.named("txn-idem")
+    assert b.produce_txn("t", [b"a", b"b"], "sink1", epoch=0)
+    assert not b.produce_txn("t", [b"a", b"b"], "sink1", epoch=0)  # replay
+    assert b.produce_txn("t", [b"c"], "sink1", epoch=1)
+    assert not b.produce_txn("t", [b"zzz"], "sink1", epoch=1)
+    assert b._topics["t"] == [b"a", b"b", b"c"]
+    assert b.txn_epoch("sink1") == 1
+    assert b.txn_epoch("other") == -1
+
+
+def test_memory_datahub_txn_commit_is_idempotent():
+    svc = MemoryDatahubService.named("txn-idem")
+    assert svc.put_records_txn("t", [(1, "a")], "s", epoch=0)
+    assert not svc.put_records_txn("t", [(1, "a")], "s", epoch=0)
+    assert svc._topics["t"] == [(1, "a")]
+
+
+def test_job_validation():
+    t = MTable({"v": np.arange(4.0)})
+    src = TableSourceStreamOp(t)
+    sink = KafkaSinkStreamOp(bootstrapServers="memory://val", topic="t")
+    from alink_tpu.common.exceptions import AkIllegalArgumentException
+
+    with pytest.raises(AkIllegalArgumentException):  # no chains
+        RecoverableStreamJob(src, [], checkpoint_dir="/tmp/x")
+    with pytest.raises(AkIllegalArgumentException):  # no sinks
+        RecoverableStreamJob(src, [([], [])], checkpoint_dir="/tmp/x")
+    with pytest.raises(AkIllegalArgumentException):  # duplicate sink target
+        RecoverableStreamJob(
+            src, [([], [sink]),
+                  ([], [KafkaSinkStreamOp(bootstrapServers="memory://val",
+                                          topic="t")])],
+            checkpoint_dir="/tmp/x")
+    with pytest.raises(AkIllegalArgumentException):  # non-txn sink
+        RecoverableStreamJob(src, [([], [src])], checkpoint_dir="/tmp/x")
+    with pytest.raises(AkIllegalArgumentException):  # needs a factory
+        run_with_recovery(
+            RecoverableStreamJob(src, [([], [sink])], checkpoint_dir="/t"))
+
+
+def test_stateful_op_without_hooks_is_rejected():
+    """An op that keeps cross-chunk state in generator locals (no snapshot
+    hooks) must be refused at job-build time: restoring it as stateless
+    would silently break the exactly-once invariant mid-stream."""
+    from alink_tpu.common.exceptions import AkIllegalArgumentException
+    from alink_tpu.operator.stream.windows import QuantileStreamOp
+
+    t = MTable({"v": np.arange(4.0)})
+    sink = KafkaSinkStreamOp(bootstrapServers="memory://unhooked", topic="t")
+    with pytest.raises(AkIllegalArgumentException, match="state_snapshot"):
+        RecoverableStreamJob(
+            TableSourceStreamOp(t),
+            [([QuantileStreamOp(selectedCol="v")], [sink])],
+            checkpoint_dir="/tmp/x")
+
+
+# ---------------------------------------------------------------------------
+# the crash-restart drill (headline invariant)
+# ---------------------------------------------------------------------------
+
+
+def _drill_table(n=200):
+    rng = np.random.RandomState(0)
+    return MTable({"ts": np.arange(n, dtype=np.float64),
+                   "x0": rng.rand(n), "x1": rng.rand(n),
+                   "label": (rng.rand(n) > 0.5).astype(np.int64)})
+
+
+def _drill_job(tag, ckdir, table):
+    """Stateful multi-sink pipeline: tumble window fanning out to TWO
+    transactional sinks (Kafka + KV), plus FTRL feeding DataHub — fan-out
+    at both the source and the sink layer."""
+    win = TumbleTimeWindowStreamOp(timeCol="ts", windowTime=25.0,
+                                   clause="sum(x0) as sx, count(*) as c")
+    ftrl = FtrlTrainStreamOp(featureCols=["x0", "x1"], labelCol="label",
+                             modelSaveInterval=5)
+    ksink = KafkaSinkStreamOp(bootstrapServers=f"memory://drill-{tag}",
+                              topic="w")
+    kvsink = KvSinkStreamOp(storeUri=f"memory://drill-{tag}",
+                            keyCol="window_start")
+    dsink = DatahubSinkStreamOp(endpoint=f"memory://drill-{tag}", topic="m")
+    return RecoverableStreamJob(
+        source=TableSourceStreamOp(table, chunkSize=10),
+        chains=[([win], [ksink, kvsink]), ([ftrl], [dsink])],
+        checkpoint_dir=ckdir, epoch_chunks=3)
+
+
+def _drill_outputs(tag):
+    kafka = list(MemoryKafkaBroker.named(f"drill-{tag}")._topics.get("w", []))
+    models = [tuple(x.tobytes() if isinstance(x, np.ndarray) else x
+                    for x in r)
+              for r in MemoryDatahubService.named(
+                  f"drill-{tag}")._topics.get("m", [])]
+    kv = {k: dict(v) for k, v in MemoryKvStore._named.get(
+        f"drill-{tag}", {}).items() if not k.startswith("__alink_txn__")}
+    return kafka, models, kv
+
+
+def _run_drill(tag, tmp_path, spec=None, seed=3, attempts=10):
+    faults.clear()
+    if spec:
+        faults.install(FaultSpec.parse(spec, seed=seed))
+    try:
+        summary = run_with_recovery(
+            lambda: _drill_job(tag, str(tmp_path / f"ck-{tag}"),
+                               _drill_table()),
+            RetryPolicy(max_attempts=attempts, base_delay=0.001))
+    finally:
+        faults.clear()
+    return summary, _drill_outputs(tag)
+
+
+def test_crash_drill_bit_identical_midstream_crash(tmp_path):
+    """Crash at a mid-stream chunk delivery: the supervised restart resumes
+    from the epoch snapshot (NOT chunk 0) and every sink's final content
+    is bit-identical to the fault-free run."""
+    _, clean = _run_drill("clean", tmp_path)
+    summary, crashed = _run_drill(
+        "c-chunk", tmp_path, "recovery:count=1,kinds=crash,match=chunk13")
+    assert summary["restored"] is True
+    # resumed mid-stream: replayed the 12 pre-snapshot chunks, not all 20
+    assert 0 < summary["replayed_chunks"] < 20
+    assert crashed == clean
+    assert summary["complete"] is True
+
+
+def test_crash_drill_between_manifest_and_commit(tmp_path):
+    """Crash in the 2PC window — manifest durable, sinks not yet published:
+    restart replays the staged epoch idempotently into every sink; output
+    stays bit-identical (no loss, no duplication)."""
+    _, clean = _run_drill("clean2", tmp_path)
+    summary, crashed = _run_drill(
+        "c-commit", tmp_path,
+        "recovery:count=1,kinds=crash,match=epoch2.pre_commit")
+    assert summary["restored"] is True
+    assert summary["sink_replays"] == 3  # all three sinks healed
+    assert crashed == clean
+
+
+def test_crash_drill_pre_snapshot(tmp_path):
+    """Crash right before a snapshot is cut: the epoch replays wholesale
+    from the previous snapshot; committed sink epochs dedupe replay."""
+    _, clean = _run_drill("clean3", tmp_path)
+    summary, crashed = _run_drill(
+        "c-snap", tmp_path,
+        "recovery:count=1,kinds=crash,match=epoch4.pre_snapshot")
+    assert summary["restored"] is True
+    assert crashed == clean
+
+
+def test_crash_drill_repeated_random_crashes(tmp_path):
+    """Seeded random crash schedule (several kills across attempts): the
+    run still converges to bit-identical output under supervision."""
+    _, clean = _run_drill("clean4", tmp_path)
+    # epoch snapshots ratchet progress forward, so attempts shrink as the
+    # job advances; a generous attempt budget keeps the drill robust to
+    # thread-order variation in which tap draws the crash
+    summary, crashed = _run_drill(
+        "c-rand", tmp_path, "recovery:rate=0.04,kinds=crash", seed=4,
+        attempts=40)
+    assert crashed == clean
+    assert summary["complete"] is True
+
+
+def test_fatal_fault_propagates_without_restart(tmp_path):
+    from alink_tpu.common.faults import InjectedFatalError
+
+    calls = []
+
+    def fake_sleep(d):
+        calls.append(d)
+
+    faults.clear()
+    faults.install(FaultSpec.parse("recovery:count=1,kinds=fatal"))
+    try:
+        with pytest.raises(InjectedFatalError):
+            run_with_recovery(
+                lambda: _drill_job("fatal", str(tmp_path / "ck-f"),
+                                   _drill_table()),
+                RetryPolicy(max_attempts=10, base_delay=0.001),
+                sleep=fake_sleep)
+    finally:
+        faults.clear()
+    assert calls == []  # no restart attempted for a non-restartable error
+
+
+def test_completed_job_restart_is_noop(tmp_path):
+    """Re-running a completed job resumes the final snapshot, re-heals
+    sinks if needed, and emits nothing new (no double publish)."""
+    _, first = _run_drill("done", tmp_path)
+    summary2 = run_with_recovery(
+        lambda: _drill_job("done", str(tmp_path / "ck-done"),
+                           _drill_table()),
+        RetryPolicy(max_attempts=3, base_delay=0.001))
+    assert summary2["complete"] is True
+    assert summary2["epochs"] == 0  # nothing re-run
+    assert _drill_outputs("done") == first
+
+
+def test_retries_off_disables_supervised_restarts(tmp_path, monkeypatch):
+    """ALINK_RETRIES=off is the framework-wide fail-fast switch: the
+    supervisor must not restart either — first crash propagates."""
+    monkeypatch.setenv("ALINK_RETRIES", "off")
+    faults.clear()
+    faults.install(FaultSpec.parse("recovery:count=1,kinds=crash,match=chunk3"))
+    try:
+        with pytest.raises(InjectedCrashError):
+            run_with_recovery(
+                lambda: _drill_job("roff", str(tmp_path / "ck-roff"),
+                                   _drill_table()),
+                RetryPolicy(max_attempts=10, base_delay=0.001))
+    finally:
+        faults.clear()
+
+
+def test_txn_markers_are_job_scoped(tmp_path):
+    """Two jobs sharing one broker/topic must not share commit markers:
+    epoch numbers restart at 0 per job, so a target-keyed marker would let
+    job A's committed epochs silently swallow job B's output."""
+    t = MTable({"ts": np.arange(40, dtype=np.float64),
+                "v": np.arange(40, dtype=np.float64)})
+
+    def job(ckdir):
+        win = TumbleTimeWindowStreamOp(timeCol="ts", windowTime=10.0,
+                                       clause="count(*) as c")
+        sink = KafkaSinkStreamOp(bootstrapServers="memory://scoped",
+                                 topic="t")
+        return RecoverableStreamJob(TableSourceStreamOp(t, chunkSize=5),
+                                    [([win], [sink])],
+                                    checkpoint_dir=ckdir, epoch_chunks=2)
+
+    MemoryKafkaBroker.named("scoped")
+    run_with_recovery(lambda: job(str(tmp_path / "job-a")),
+                      RetryPolicy(max_attempts=2, base_delay=0.001))
+    n_after_a = len(MemoryKafkaBroker.named("scoped")._topics.get("t", []))
+    assert n_after_a > 0
+    # a DIFFERENT job (own checkpoint dir) into the same broker/topic:
+    # its epochs 0..N must append, not be deduped against job A's
+    run_with_recovery(lambda: job(str(tmp_path / "job-b")),
+                      RetryPolicy(max_attempts=2, base_delay=0.001))
+    n_after_b = len(MemoryKafkaBroker.named("scoped")._topics.get("t", []))
+    assert n_after_b == 2 * n_after_a
+
+
+def test_epoch_chunks_change_is_fenced(tmp_path):
+    """Resuming a snapshot with a different epoch_chunks would re-deliver
+    chunks the restored state already covers — refused explicitly."""
+    from alink_tpu.common.exceptions import AkIllegalStateException
+
+    t = MTable({"ts": np.arange(40, dtype=np.float64),
+                "v": np.arange(40, dtype=np.float64)})
+
+    def job(k):
+        win = TumbleTimeWindowStreamOp(timeCol="ts", windowTime=10.0,
+                                       clause="count(*) as c")
+        sink = KafkaSinkStreamOp(bootstrapServers="memory://fence",
+                                 topic="t")
+        return RecoverableStreamJob(TableSourceStreamOp(t, chunkSize=5),
+                                    [([win], [sink])],
+                                    checkpoint_dir=str(tmp_path / "ck"),
+                                    epoch_chunks=k)
+
+    MemoryKafkaBroker.named("fence")
+    faults.clear()
+    faults.install(FaultSpec.parse(
+        "recovery:count=1,kinds=crash,match=chunk5"))
+    try:
+        with pytest.raises(InjectedCrashError):
+            from alink_tpu.common.recovery import CheckpointCoordinator
+            CheckpointCoordinator(job(2)).run()
+    finally:
+        faults.clear()
+    with pytest.raises(AkIllegalStateException, match="epoch_chunks"):
+        run_with_recovery(lambda: job(4),
+                          RetryPolicy(max_attempts=2, base_delay=0.001))
+
+
+def test_recovery_summary_counters(tmp_path):
+    from alink_tpu.common.recovery import recovery_summary
+
+    _run_drill("sum", tmp_path,
+               "recovery:count=1,kinds=crash,match=chunk13")
+    out = recovery_summary()
+    assert out.get("recovery.restarts", 0) >= 1
+    assert out.get("recovery.epochs", 0) >= 1
+    assert out.get("checkpoint.restores", 0) >= 1
+    assert out.get("checkpoint.replayed_chunks", 0) >= 1
+    assert "recovery.snapshot_s" in out
