@@ -27,7 +27,9 @@ from .common import (  # noqa: F401
     RetryPolicy,
     SparseVector,
     TableSchema,
+    compile_summary,
     is_retryable,
     run_with_recovery,
+    warmup,
     with_retries,
 )

@@ -14,6 +14,13 @@ from .exceptions import (
     mark_retryable,
 )
 from .faults import FaultSpec
+from .jitcache import (
+    bucket_rows,
+    cached_jit,
+    clear_program_cache,
+    compile_summary,
+    warmup,
+)
 from .resilience import (
     CircuitBreaker,
     DeadLetterBuffer,

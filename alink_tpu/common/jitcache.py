@@ -1,0 +1,587 @@
+"""Shape-stable execution: process-wide program cache, shape bucketing, AOT warmup.
+
+The platform's dominant cost on short jobs is not compute but compilation
+(BENCH r05: kmeans_iris 50.2s cold vs 0.35s warm). Three mechanisms cut the
+compile tax to a once-per-process (or, with the persistent XLA cache,
+once-per-machine) event:
+
+1. **ProgramCache** — jitted kernels are registered once under a key of
+   (kernel id, static config, mesh fingerprint, wire-precision policy) via
+   :func:`cached_jit`. Call sites that used to rebuild ``jax.jit(...)``
+   closures per fit/predict (discarding jax's own trace cache each time)
+   now fetch one long-lived program and let jax's dispatch cache do its
+   job. Loading N copies of the same model compiles once, not N times.
+
+2. **Shape bucketing** — the leading (row) dimension is padded up a bucket
+   ladder (:func:`bucket_rows`, env ``ALINK_SHAPE_BUCKETS``) so a
+   batch-size sweep or a ragged final stream chunk hits one compiled
+   program instead of lowering a fresh program per distinct row count.
+   Bucketing is applied ONLY on row-wise kernels (each output row depends
+   only on its input row), where zero-padding plus slicing the outputs back
+   to the true row count is bit-identical to the unpadded run — no
+   cross-row reduction ever sees the padded tail.
+
+3. **AOT warmup** — :func:`warmup` compiles registered kernels for given
+   (or profiled, env ``ALINK_SHAPE_PROFILE``) shape signatures ahead of
+   time on a background thread, off the serving critical path.
+
+Observability: every first call of a program with a new shape signature is
+counted (``jit.trace`` / ``jit.compile``) and timed (global and per-kernel
+``jitcache.*.compile_s`` timers, plus a ``compile_s`` phase on the active
+executor node trace). :func:`compile_summary` aggregates the lot for the
+BENCH ``compile`` extra.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .metrics import add_node_phase, metrics
+
+# ---------------------------------------------------------------------------
+# Key construction
+# ---------------------------------------------------------------------------
+
+_token_counter = itertools.count(1)
+
+
+def instance_token(obj) -> int:
+    """Unique, GC-safe token for a Python object's lifetime. Used as the
+    cache-key component for kernels whose behavior is determined by mutable
+    instance state that cannot be content-hashed (model arrays): the same
+    instance reuses its program; a new instance gets a fresh entry (unlike
+    ``id()``, tokens are never recycled)."""
+    tok = getattr(obj, "_jitcache_token", None)
+    if tok is None:
+        tok = next(_token_counter)
+        try:
+            obj._jitcache_token = tok
+        except AttributeError:  # __slots__ objects: fall back to identity-free
+            return tok          # one-shot token (no reuse, still correct)
+    return tok
+
+
+class Unkeyable(TypeError):
+    """Raised by :func:`fn_content_key` when a closure captures values that
+    cannot be content-hashed (device arrays, open handles). Callers fall
+    back to :func:`instance_token` or skip caching."""
+
+
+def _freeze(v) -> Any:
+    """Hashable, content-faithful key component for a config value."""
+    import types
+
+    if v is None or isinstance(v, (bool, int, float, str, bytes, type,
+                                   types.CodeType)):
+        return v
+    if isinstance(v, np.generic):
+        # numpy scalars (np.float32 etc.) do not subclass Python scalars;
+        # without this they would demote the caller to the Unkeyable
+        # fallback — a silent per-call rebuild of the whole program
+        return ("nps", v.dtype.str, v.item())
+    if isinstance(v, np.ndarray):
+        a = np.ascontiguousarray(v)
+        import hashlib
+
+        h = hashlib.blake2b(digest_size=12)
+        h.update(a.view(np.uint8).reshape(-1).data if a.dtype != object
+                 else repr(a.tolist()).encode())
+        return ("nd", a.shape, a.dtype.str, h.hexdigest())
+    if isinstance(v, (tuple, list)):
+        return ("seq", tuple(_freeze(x) for x in v))
+    if isinstance(v, dict):
+        return ("map", tuple(sorted((k, _freeze(x)) for k, x in v.items())))
+    if isinstance(v, (frozenset, set)):
+        return ("set", tuple(sorted(map(repr, v))))
+    if callable(v):
+        return fn_content_key(v)
+    raise Unkeyable(f"cannot build a cache key from {type(v).__name__}")
+
+
+def fn_content_key(fn) -> Tuple:
+    """Content key for a plain function or closure: code object + defaults +
+    captured cell values. Two closures built from the same source with the
+    same captured config hash equal — the mechanism that lets per-call
+    rebuilt kernels (objective closures, mapper block kernels) share one
+    compiled program. Raises :class:`Unkeyable` when a cell holds something
+    that cannot be content-hashed."""
+    if fn is None:
+        return ("fn", None)
+    if hasattr(fn, "__wrapped__"):
+        fn = fn.__wrapped__
+    code = getattr(fn, "__code__", None)
+    if code is None:
+        # bound method / callable object: key on the class + instance token
+        f = getattr(fn, "__func__", None)
+        if f is not None:
+            return ("bound", fn_content_key(f),
+                    instance_token(fn.__self__))
+        raise Unkeyable(f"cannot key callable {fn!r}")
+    cells: Tuple = ()
+    if fn.__closure__:
+        vals = []
+        for cell in fn.__closure__:
+            try:
+                vals.append(_freeze(cell.cell_contents))
+            except (Unkeyable, ValueError) as e:
+                raise Unkeyable(str(e))
+        cells = tuple(vals)
+    defaults = tuple(_freeze(d) for d in (fn.__defaults__ or ()))
+    return ("fn", fn.__qualname__, code, defaults, cells)
+
+
+# ---------------------------------------------------------------------------
+# Mesh fingerprinting (shared registry — one representative mesh per
+# structural fingerprint, so equivalent meshes share compiled programs)
+# ---------------------------------------------------------------------------
+
+_mesh_lock = threading.Lock()
+_MESHES: Dict[tuple, Any] = {}
+
+
+def mesh_fingerprint(mesh) -> Optional[tuple]:
+    """Structural mesh key (axis names, shape, device ids). Registers the
+    mesh as the representative for its fingerprint; compiled kernels close
+    over the representative, so fresh-mesh-per-job services do not grow the
+    program cache unboundedly."""
+    if mesh is None:
+        return None
+    k = (
+        tuple(mesh.axis_names),
+        tuple(int(s) for s in mesh.devices.shape),
+        tuple(getattr(d, "id", i) for i, d in enumerate(mesh.devices.flat)),
+    )
+    with _mesh_lock:
+        _MESHES.setdefault(k, mesh)
+    return k
+
+
+def mesh_for(fingerprint: tuple):
+    with _mesh_lock:
+        return _MESHES[fingerprint]
+
+
+# ---------------------------------------------------------------------------
+# Shape bucketing
+# ---------------------------------------------------------------------------
+
+_BUCKETS_ENV = "ALINK_SHAPE_BUCKETS"
+_LINEAR_HEAD = 64       # below this, buckets are multiples of _LINEAR_STEP
+_LINEAR_STEP = 8
+
+
+def _parse_buckets() -> "str | List[int]":
+    raw = os.environ.get(_BUCKETS_ENV, "").strip().lower()
+    if raw in ("", "pow2"):
+        return "pow2"
+    if raw in ("off", "0", "none"):
+        return "off"
+    try:
+        ladder = sorted({int(x) for x in raw.split(",") if x.strip()})
+        if ladder and all(s > 0 for s in ladder):
+            return ladder
+    except ValueError:
+        pass
+    return "pow2"  # malformed knob must not crash a running job
+
+
+def bucket_rows(n: int) -> int:
+    """Bucketed row count for ``n``: the padded leading dimension every
+    kernel compiled through the bucketing helpers sees.
+
+    Default ladder ("pow2 with a linear head"): multiples of 8 up to 64,
+    then the next power of two — a batch-size sweep from 1..10k compiles
+    ~16 programs instead of one per distinct size. ``ALINK_SHAPE_BUCKETS``
+    overrides: ``off`` disables bucketing, or a comma list (``64,512,4096``)
+    gives an explicit ladder (sizes beyond the last round up to a multiple
+    of the last rung)."""
+    n = int(n)
+    spec = _parse_buckets()
+    if spec == "off" or n < 0:
+        return n
+    if isinstance(spec, list):
+        for s in spec:
+            if n <= s:
+                return s
+        last = spec[-1]
+        return ((n + last - 1) // last) * last
+    # pow2 with linear head
+    if n <= _LINEAR_HEAD:
+        return max(_LINEAR_STEP,
+                   ((n + _LINEAR_STEP - 1) // _LINEAR_STEP) * _LINEAR_STEP)
+    return 1 << (n - 1).bit_length()
+
+
+def bucketing_enabled() -> bool:
+    return _parse_buckets() != "off"
+
+
+def floor_bucket_rows(n: int) -> int:
+    """Largest ladder rung <= ``n`` (``n`` itself when bucketing is off or
+    ``n`` sits below the smallest rung). Streaming paths size their full
+    micro-batches with this so steady chunks ship with ZERO padding and only
+    the ragged tail pads up to a (smaller) bucket."""
+    n = int(n)
+    spec = _parse_buckets()
+    if spec == "off" or n <= 0:
+        return n
+    if isinstance(spec, list):
+        best = None
+        for s in spec:
+            if s <= n:
+                best = s
+        return best if best is not None else n
+    if n < _LINEAR_STEP:
+        return n
+    if n <= _LINEAR_HEAD:
+        return (n // _LINEAR_STEP) * _LINEAR_STEP
+    return 1 << (n.bit_length() - 1)
+
+
+def pad_rows(arr: np.ndarray, target: int) -> np.ndarray:
+    """Zero-pad ``arr`` along dim0 to ``target`` rows (no-op if already
+    there). Zeros are the bit-parity-safe filler for row-wise kernels: the
+    padded rows produce garbage rows that the caller slices off; real rows
+    are untouched."""
+    n = arr.shape[0]
+    if target == n:
+        return arr
+    pad_width = [(0, target - n)] + [(0, 0)] * (arr.ndim - 1)
+    return np.pad(arr, pad_width)
+
+
+def device_constants(*arrays):
+    """``jax.device_put`` model parameters once at load time. Mappers pass
+    these as program ARGUMENTS (so models share one compiled program), but a
+    host numpy argument would re-cross the wire on every predict call —
+    staging them once keeps the per-call cost at zero, like the baked-in
+    constants they replaced."""
+    import jax
+
+    return tuple(jax.device_put(np.asarray(a)) for a in arrays)
+
+
+def call_row_bucketed(prog: Callable, row_args: Sequence[np.ndarray],
+                      const_args: Sequence[Any] = ()):
+    """Run a ROW-WISE program over bucket-padded inputs and slice every
+    output back to the true row count.
+
+    Contract: every ``row_args`` array is row-aligned on dim0 and every
+    output of ``prog`` is row-aligned on dim0 (no cross-row reductions).
+    Under that contract the result is bit-identical to the unpadded call —
+    each output row is a function of its input row alone. ``const_args``
+    pass through unpadded (weights, centroids)."""
+    n = int(row_args[0].shape[0])
+    m = bucket_rows(n)
+    if m != n:
+        row_args = [pad_rows(np.asarray(a), m) for a in row_args]
+    out = prog(*row_args, *const_args)
+    if m == n:
+        return out
+
+    def trim(x):
+        return x[:n] if getattr(x, "ndim", 0) >= 1 and x.shape[0] == m else x
+
+    if isinstance(out, tuple):
+        return tuple(trim(o) for o in out)
+    if isinstance(out, list):
+        return [trim(o) for o in out]
+    return trim(out)
+
+
+# ---------------------------------------------------------------------------
+# Shape signatures + profile recording
+# ---------------------------------------------------------------------------
+
+def _leaf_sig(x) -> tuple:
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is not None and dtype is not None:
+        return ("a", tuple(int(s) for s in shape), np.dtype(dtype).str)
+    try:
+        hash(x)
+        return ("s", type(x).__name__, x)
+    except TypeError:
+        return ("s", type(x).__name__, repr(x))
+
+
+def args_signature(args: Sequence[Any]) -> tuple:
+    import jax
+
+    return tuple(_leaf_sig(leaf) for leaf in jax.tree_util.tree_leaves(args))
+
+
+_profile_lock = threading.Lock()
+
+
+def _record_profile(kernel_id: str, sig: tuple) -> None:
+    path = os.environ.get("ALINK_SHAPE_PROFILE")
+    if not path:
+        return
+    arrs = [[list(s[1]), s[2]] for s in sig if s[0] == "a"]
+    try:
+        with _profile_lock, open(path, "a") as f:
+            f.write(json.dumps({"kernel": kernel_id, "args": arrs}) + "\n")
+    except OSError:
+        metrics.incr("jit.profile_write_errors")
+
+
+def load_shape_profile(path: Optional[str] = None) -> List[Tuple[str, list]]:
+    """Parse an ``ALINK_SHAPE_PROFILE`` jsonl into warmup specs
+    ``[(kernel_id, [(shape, dtype), ...]), ...]`` (deduplicated, order
+    preserved; malformed lines skipped)."""
+    path = path or os.environ.get("ALINK_SHAPE_PROFILE")
+    specs: List[Tuple[str, list]] = []
+    seen = set()
+    if not path or not os.path.exists(path):
+        return specs
+    with open(path) as f:
+        for line in f:
+            try:
+                rec = json.loads(line)
+                args = [(tuple(s), d) for s, d in rec["args"]]
+                key = (rec["kernel"], tuple(args))
+            except (ValueError, KeyError, TypeError):
+                continue
+            if key not in seen:
+                seen.add(key)
+                specs.append((rec["kernel"], args))
+    return specs
+
+
+# ---------------------------------------------------------------------------
+# The program cache
+# ---------------------------------------------------------------------------
+
+class CachedProgram:
+    """One long-lived jitted program plus per-shape-signature accounting.
+
+    ``__call__`` delegates to the underlying jitted function; the first call
+    with a new signature is counted as a trace+compile event and timed (the
+    timing includes the first execution — on a warm persistent XLA cache
+    that is dominated by trace + cache load, cold by the backend compile)."""
+
+    __slots__ = ("kernel_id", "key", "jit_fn", "_sigs", "_lock")
+
+    def __init__(self, kernel_id: str, key: tuple, jit_fn: Callable):
+        self.kernel_id = kernel_id
+        self.key = key
+        self.jit_fn = jit_fn
+        self._sigs: set = set()
+        self._lock = threading.Lock()
+
+    def seen_signatures(self) -> int:
+        with self._lock:
+            return len(self._sigs)
+
+    def _note_sig(self, sig: tuple) -> bool:
+        with self._lock:
+            if sig in self._sigs:
+                return False
+            self._sigs.add(sig)
+            return True
+
+    def __call__(self, *args):
+        sig = args_signature(args)
+        if not self._note_sig(sig):
+            metrics.incr("jit.program_calls")
+            return self.jit_fn(*args)
+        metrics.incr("jit.trace")
+        metrics.incr("jit.compile")
+        _record_profile(self.kernel_id, sig)
+        t0 = time.perf_counter()
+        try:
+            return self.jit_fn(*args)
+        finally:
+            dt = time.perf_counter() - t0
+            metrics.add_time("jitcache.compile_s", dt)
+            metrics.add_time(f"jitcache.{self.kernel_id}.compile_s", dt)
+            metrics.record_bounded("jit.compile_event", 512,
+                                   kernel=self.kernel_id,
+                                   ms=round(dt * 1e3, 3))
+            add_node_phase("compile_s", dt)
+
+    def lower(self, *args):
+        return self.jit_fn.lower(*args)
+
+    def ensure_compiled(self, arg_sigs: Iterable[Tuple[tuple, str]]) -> bool:
+        """AOT-warm this program for array arguments of the given
+        (shape, dtype) list by executing it once on zeros — this populates
+        jax's real dispatch cache (an ``.lower().compile()`` would not), so
+        the first production call performs zero new traces. Returns True if
+        a compile happened, False if the signature was already warm."""
+        zeros = [np.zeros(s, np.dtype(d)) for s, d in arg_sigs]
+        sig = args_signature(zeros)
+        with self._lock:
+            if sig in self._sigs:
+                return False
+        metrics.incr("jit.warmup_compile")
+        self(*zeros)
+        return True
+
+
+_lock = threading.RLock()
+_PROGRAMS: "OrderedDict[tuple, CachedProgram]" = OrderedDict()
+_DEFAULT_MAX_PROGRAMS = 256
+
+
+def _max_programs() -> int:
+    """LRU bound on cached programs (env ``ALINK_PROGRAM_CACHE_SIZE``, 0 =
+    unbounded). The cache replaced per-call throwaway jit closures and
+    size-bounded lru_caches; without a bound a long-running tuning sweep
+    (one optimizer entry per hyper-parameter combination) would pin every
+    compiled executable for process lifetime."""
+    raw = os.environ.get("ALINK_PROGRAM_CACHE_SIZE")
+    try:
+        return _DEFAULT_MAX_PROGRAMS if not raw else int(raw)
+    except ValueError:
+        return _DEFAULT_MAX_PROGRAMS
+
+
+def _policy_component() -> str:
+    # the wire-precision policy decides the dtype staged inputs arrive in;
+    # keyed so a mid-process policy flip cannot alias programs traced for a
+    # different input dtype contract (the raw policy string — not the probed
+    # auto-slow/fast answer — is enough: auto's downcast is restored to the
+    # caller dtype before any kernel sees it)
+    try:
+        from .staging import wire_precision
+
+        return wire_precision()
+    except Exception:
+        return "auto"
+
+
+def cached_jit(kernel_id: str, builder: Callable, *static,
+               mesh=None, key_extra: Any = None) -> CachedProgram:
+    """Fetch-or-build the process-wide program for ``kernel_id`` + config.
+
+    ``builder(*static)`` (or ``builder(mesh, *static)`` when a mesh is
+    given) must return the ready-to-call jitted function; it runs only on a
+    cache miss. ``static`` values and ``key_extra`` are content-frozen into
+    the key (np arrays by digest, closures by code + captured values).
+    Raises :class:`Unkeyable` if a component cannot be frozen — callers that
+    can tolerate a per-call rebuild should catch it and fall back."""
+    key = (kernel_id, tuple(_freeze(s) for s in static),
+           _freeze(key_extra), mesh_fingerprint(mesh), _policy_component())
+    with _lock:
+        prog = _PROGRAMS.get(key)
+        if prog is not None:
+            _PROGRAMS.move_to_end(key)
+            metrics.incr("jit.program_hit")
+            return prog
+        metrics.incr("jit.program_miss")
+        jit_fn = builder(mesh, *static) if mesh is not None else \
+            builder(*static)
+        prog = _PROGRAMS[key] = CachedProgram(kernel_id, key, jit_fn)
+        cap = _max_programs()
+        while cap > 0 and len(_PROGRAMS) > cap:
+            _PROGRAMS.popitem(last=False)   # LRU: callers holding a
+            metrics.incr("jit.program_evictions")  # reference keep it alive
+        return prog
+
+
+def programs(kernel_id: Optional[str] = None) -> List[CachedProgram]:
+    with _lock:
+        ps = list(_PROGRAMS.values())
+    if kernel_id is not None:
+        ps = [p for p in ps if p.kernel_id == kernel_id]
+    return ps
+
+
+def clear_program_cache() -> None:
+    """Drop every cached program (tests / hot-reload). The next use rebuilds
+    and re-traces; jax-level caches attached to the dropped closures are
+    garbage-collected with them."""
+    with _lock:
+        _PROGRAMS.clear()
+
+
+def clear_kernel(kernel_id: str) -> int:
+    """Drop every cached program registered under ``kernel_id`` (tests that
+    rebuild kernels after flipping build-time flags). Returns the number of
+    programs dropped."""
+    with _lock:
+        doomed = [k for k, p in _PROGRAMS.items() if p.kernel_id == kernel_id]
+        for k in doomed:
+            del _PROGRAMS[k]
+        return len(doomed)
+
+
+def compile_summary() -> Dict[str, Any]:
+    """Aggregate compile observability: program counts, jit.* counters, the
+    program-cache hit rate, and per-kernel signature counts + compile-time
+    stats. Feeds the BENCH ``compile`` extra."""
+    with _lock:
+        progs = list(_PROGRAMS.values())
+    counters = metrics.counters("jit.")
+    hits = counters.get("jit.program_hit", 0)
+    misses = counters.get("jit.program_miss", 0)
+    kernels: Dict[str, Dict[str, Any]] = {}
+    for p in progs:
+        d = kernels.setdefault(p.kernel_id, {"programs": 0, "signatures": 0})
+        d["programs"] += 1
+        d["signatures"] += p.seen_signatures()
+    for kid, d in kernels.items():
+        stats = metrics.timer_stats(f"jitcache.{kid}.compile_s")
+        if stats:
+            d["compile"] = stats
+    return {
+        "programs": len(progs),
+        "counters": counters,
+        "hit_rate": round(hits / (hits + misses), 4) if hits + misses else None,
+        "kernels": kernels,
+    }
+
+
+# ---------------------------------------------------------------------------
+# AOT warmup
+# ---------------------------------------------------------------------------
+
+def _run_warmup(specs: List[Tuple[str, list]], result: dict) -> None:
+    compiled = errors = 0
+    for kernel_id, arg_sigs in specs:
+        for prog in programs(kernel_id):
+            try:
+                if prog.ensure_compiled(arg_sigs):
+                    compiled += 1
+            except Exception:
+                errors += 1
+                metrics.incr("jit.warmup_errors")
+    result.update(compiled=compiled, errors=errors, specs=len(specs))
+
+
+def warmup(specs: Optional[Iterable] = None, *, block: bool = False):
+    """AOT-compile registered kernels ahead of the first real call.
+
+    ``specs``: iterable of ``(kernel_id, [(shape, dtype), ...])``; ``None``
+    loads the shape profile recorded under ``ALINK_SHAPE_PROFILE``. Only
+    kernels already registered in this process (their ``cached_jit`` call
+    has run — e.g. a model mapper was loaded) are warmable; unknown ids are
+    skipped silently. By default the compiles run on a daemon thread (off
+    the serving critical path) and the started thread is returned with a
+    ``.result`` dict it fills; ``block=True`` runs inline and returns the
+    dict ``{"compiled": n, "errors": e, "specs": s}``."""
+    if specs is None:
+        specs = load_shape_profile()
+    norm: List[Tuple[str, list]] = []
+    for item in specs:
+        kid, sigs = item
+        norm.append((kid, [(tuple(s), str(d)) for s, d in sigs]))
+    result: dict = {}
+    if block:
+        _run_warmup(norm, result)
+        return result
+    th = threading.Thread(target=_run_warmup, args=(norm, result),
+                          name="alink-warmup", daemon=True)
+    th.result = result  # type: ignore[attr-defined]
+    th.start()
+    return th

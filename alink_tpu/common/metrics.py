@@ -161,7 +161,7 @@ def executor_phase_summary() -> Dict[str, Any]:
                            {"count": 0, "wall_s": 0.0})
         d["count"] += 1
         d["wall_s"] = round(d["wall_s"] + rec.get("wall_s", 0.0), 6)
-        for k in ("transfer_s", "compute_s"):
+        for k in ("transfer_s", "compute_s", "compile_s"):
             if k in rec:
                 d[k] = round(d.get(k, 0.0) + rec[k], 6)
     return out

@@ -23,6 +23,10 @@ on device, so compute keeps fp32 accumulation. Controlled by
 Env overrides: ``ALINK_WIRE_PRECISION``, ``ALINK_STAGING_CACHE_BYTES``
 (0 disables the cache), ``ALINK_ASSUME_SLOW_WIRE`` (1/0 forces the
 slow-tunnel gate instead of probing).
+
+Cache sizing: the default cap is min(2 GiB, ~12% of detected device HBM)
+— see :func:`_device_default_cap` — so the cache never silently pins a
+large fraction of a small accelerator's memory.
 """
 
 from __future__ import annotations
@@ -37,6 +41,36 @@ import numpy as np
 
 _WIRE_THRESHOLD_BYTES = 4 * 1024 * 1024
 _DEFAULT_MAX_BYTES = 2 * 1024 * 1024 * 1024
+_HBM_FRACTION = 0.12
+_hbm_cap_lock = threading.Lock()
+_hbm_cap: "int | None" = None
+
+
+def _device_default_cap() -> int:
+    """Default cache cap sized to the accelerator: min(2 GiB, ~12% of device
+    HBM). A flat 2 GiB silently pins an eighth of a 16 GB v5e — and would be
+    a third of an 8 GB part; small devices get a proportionally small cache.
+    Falls back to the flat default when the backend exposes no memory stats
+    (CPU, older plugins). Probed once; ``ALINK_STAGING_CACHE_BYTES`` and
+    ``set_max_bytes`` still override."""
+    global _hbm_cap
+    cap = _hbm_cap
+    if cap is not None:
+        return cap
+    with _hbm_cap_lock:
+        if _hbm_cap is None:
+            cap = _DEFAULT_MAX_BYTES
+            try:
+                import jax
+
+                stats = jax.local_devices()[0].memory_stats()
+                limit = (stats or {}).get("bytes_limit")
+                if limit:
+                    cap = min(cap, int(limit * _HBM_FRACTION))
+            except Exception:
+                pass
+            _hbm_cap = cap
+        return _hbm_cap
 
 
 class _Stats:
@@ -84,7 +118,8 @@ class StagingCache:
                 return int(env)
             except ValueError:
                 pass
-        return self._max_bytes if self._max_bytes is not None else _DEFAULT_MAX_BYTES
+        return (self._max_bytes if self._max_bytes is not None
+                else _device_default_cap())
 
     def set_max_bytes(self, n: int) -> None:
         with self._lock:

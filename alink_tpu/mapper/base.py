@@ -258,13 +258,41 @@ class BlockKernelMapper(Mapper, HasReservedCols):
         return run_kernel_chain(t, [(self, self.kernel(t.schema))])
 
 
+def _chain_cache_key(specs) -> tuple:
+    """Content key for a kernel chain: each kernel by code + captured config
+    (two chains built from the same mapper classes with the same params hash
+    equal and share ONE compiled program; numpy captures are digested, so a
+    swapped model array changes the key). Kernels that capture state the key
+    machinery cannot content-hash (device arrays, ``self``) fall back to a
+    per-mapper instance token — the same instance reuses its program across
+    calls, which assumes the captured state is not mutated in place (model
+    hot-swap goes through ``ModelMapper.create_new``, a fresh instance)."""
+    from ..common.jitcache import Unkeyable, fn_content_key, instance_token
+
+    parts = []
+    for m, (in_cols, out_cols, out_types, fn) in specs:
+        try:
+            fkey = fn_content_key(fn)
+        except Unkeyable:
+            fkey = ("tok", instance_token(m))
+        parts.append((type(m).__qualname__, fkey, tuple(in_cols),
+                      tuple(out_cols), tuple(out_types)))
+    return tuple(parts)
+
+
 def run_kernel_chain(t: MTable, specs) -> MTable:
     """Execute ``specs`` — [(mapper, (in_cols, out_cols, out_types, fn))] —
     as ONE jitted program over one staged input block: stage the union of
     required source columns once, thread columns between kernels on device,
-    fetch the surviving outputs in a single device→host transfer."""
+    fetch the surviving outputs in a single device→host transfer. The jitted
+    program is cached process-wide (common/jitcache.py) and the block rows
+    are bucket-padded, so steady-state predict over varying batch sizes
+    performs zero new traces; kernels are row-wise by the ``block_kernel``
+    contract, so the sliced result is bit-identical to the unpadded run."""
     import jax
     import jax.numpy as jnp
+
+    from ..common.jitcache import bucket_rows, cached_jit, pad_rows
 
     host_needed: List[str] = []
     produced: set = set()
@@ -308,7 +336,12 @@ def run_kernel_chain(t: MTable, specs) -> MTable:
     if n == 0:
         out_block = np.zeros((0, len(final_produced)), np.float32)
     else:
-        out_block = np.asarray(jax.jit(run)(block))
+        prog = cached_jit(
+            "mapper.kernel_chain", lambda: jax.jit(run),
+            key_extra=(_chain_cache_key(specs), tuple(host_needed),
+                       tuple(final_produced)))
+        out_block = np.asarray(
+            prog(pad_rows(block, bucket_rows(n))))[:n]
 
     cols: Dict[str, Any] = {}
     for name in schema.names:

@@ -147,12 +147,33 @@ class NaiveBayesTrainBatchOp(ModelTrainOpMixin, BatchOperator, HasVectorCol,
         return model_to_table(meta, arrays)
 
 
+def _build_nb_score(mtype: str):
+    """Naive-Bayes scoring kernels with the model factors as ARGUMENTS —
+    shared through the ProgramCache, one compile per (model type, shape
+    bucket) across every model load (the three forms all reduce to matmuls
+    against precomputed (a, b, c) factors)."""
+    import jax
+    import jax.numpy as jnp
+
+    if mtype == "GAUSSIAN":
+        def score(X, a, b, c):
+            return -(X * X) @ a + X @ b + c
+    elif mtype == "MULTINOMIAL":
+        def score(X, a, b, c):
+            return X @ a + c
+    else:  # BERNOULLI
+        def score(X, a, b, c):
+            Xb = (X > 0).astype(jnp.float32)
+            return Xb @ a + c
+
+    return jax.jit(score)
+
+
 class NaiveBayesModelMapper(RichModelMapper):
     """(reference: operator/common/classification/NaiveBayesModelMapper.java)"""
 
     def load_model(self, model: MTable):
-        import jax
-        import jax.numpy as jnp
+        from ...common.jitcache import cached_jit
 
         self.meta, arrays = table_to_model(model)
         mtype = self.meta["modelType"]
@@ -163,25 +184,26 @@ class NaiveBayesModelMapper(RichModelMapper):
             # -0.5·x²·(1/var) + x·(mu/var) − 0.5·(mu²/var + log 2πvar)
             a = (1.0 / (2.0 * var)).T
             b = (mu / var).T
-            c = -0.5 * (mu * mu / var + np.log(2.0 * np.pi * var)).sum(1) + prior
-
-            def score(X):
-                return -(X * X) @ a + X @ b + c
-
+            c = (-0.5 * (mu * mu / var + np.log(2.0 * np.pi * var)).sum(1)
+                 + prior)
         elif mtype == "MULTINOMIAL":
             theta, prior = arrays["theta"], arrays["prior"]
-
-            def score(X):
-                return X @ theta.T + prior
-
+            a, b, c = theta.T, np.zeros((1, 1), np.float32), prior
         else:  # BERNOULLI
-            logp, log1mp, prior = arrays["logp"], arrays["log1mp"], arrays["prior"]
+            logp, log1mp, prior = (arrays["logp"], arrays["log1mp"],
+                                   arrays["prior"])
+            a = (logp - log1mp).T
+            b = np.zeros((1, 1), np.float32)
+            c = log1mp.sum(1) + prior
+        # staged to device ONCE — arguments to a shared program, without a
+        # per-predict host→device re-transfer of the model factors
+        from ...common.jitcache import device_constants
 
-            def score(X):
-                Xb = (X > 0).astype(jnp.float32)
-                return Xb @ (logp - log1mp).T + log1mp.sum(1) + prior
-
-        self._score_jit = jax.jit(score)
+        self._score_factors = device_constants(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            np.asarray(c, np.float32))
+        self._score_jit = cached_jit("naivebayes.score", _build_nb_score,
+                                     mtype)
         return self
 
     def _pred_type(self) -> str:
@@ -190,11 +212,14 @@ class NaiveBayesModelMapper(RichModelMapper):
     def predict_proba_block(self, t: MTable):
         import jax
 
+        from ...common.jitcache import call_row_bucketed
+
         X = get_feature_block(
             t, merge_feature_params(self.get_params(), self.meta),
             vector_size=self.meta["dim"],
         ).astype(np.float32)
-        s = np.asarray(jax.device_get(self._score_jit(X)))
+        s = np.asarray(jax.device_get(call_row_bucketed(
+            self._score_jit, (X,), self._score_factors)))
         return softmax_np(s)
 
     def predict_block(self, t: MTable):
@@ -250,6 +275,29 @@ class KnnTrainBatchOp(ModelTrainOpMixin, BatchOperator, HasVectorCol,
                                      "y": y.astype(np.int32)})
 
 
+def _build_knn_classify(k_neighbors: int, num_labels: int, cosine: bool):
+    """Top-k vote kernel with the training block as an ARGUMENT, shared
+    through the ProgramCache across model loads with the same (k, labels,
+    metric) config."""
+    import jax
+    import jax.numpy as jnp
+
+    def knn(Q, X, y):
+        if cosine:
+            Qn = Q / jnp.maximum(jnp.linalg.norm(Q, axis=1, keepdims=True),
+                                 1e-12)
+            Xn = X / jnp.maximum(jnp.linalg.norm(X, axis=1, keepdims=True),
+                                 1e-12)
+            d = 1.0 - Qn @ Xn.T
+        else:
+            d = pairwise_sq_dists(Q, X)
+        neg_d, idx = jax.lax.top_k(-d, k_neighbors)
+        votes = jax.nn.one_hot(y[idx], num_labels).sum(axis=1)
+        return votes, -neg_d
+
+    return jax.jit(knn)
+
+
 class KnnModelMapper(RichModelMapper):
     """Blocked brute-force top-k on device (reference:
     operator/common/classification/KnnMapper.java — per-row priority queue)."""
@@ -257,8 +305,7 @@ class KnnModelMapper(RichModelMapper):
     K = ParamInfo("k", int, default=10, validator=MinValidator(1))
 
     def load_model(self, model: MTable):
-        import jax
-        import jax.numpy as jnp
+        from ...common.jitcache import cached_jit
 
         self.meta, arrays = table_to_model(model)
         self.X_train = arrays["X"]
@@ -266,19 +313,12 @@ class KnnModelMapper(RichModelMapper):
         k_neighbors = min(self.get(self.K), self.X_train.shape[0])
         num_labels = len(self.meta["labels"])
         cosine = self.meta.get("distanceType") == "COSINE"
+        from ...common.jitcache import device_constants
 
-        def knn(Q, X, y):
-            if cosine:
-                Qn = Q / jnp.maximum(jnp.linalg.norm(Q, axis=1, keepdims=True), 1e-12)
-                Xn = X / jnp.maximum(jnp.linalg.norm(X, axis=1, keepdims=True), 1e-12)
-                d = 1.0 - Qn @ Xn.T
-            else:
-                d = pairwise_sq_dists(Q, X)
-            neg_d, idx = jax.lax.top_k(-d, k_neighbors)
-            votes = jax.nn.one_hot(y[idx], num_labels).sum(axis=1)
-            return votes, -neg_d
-
-        self._knn_jit = jax.jit(knn)
+        self._train_dev = device_constants(self.X_train, self.y_train)
+        self._knn_jit = cached_jit("knn.classify", _build_knn_classify,
+                                   int(k_neighbors), int(num_labels),
+                                   bool(cosine))
         return self
 
     def _pred_type(self) -> str:
@@ -287,11 +327,15 @@ class KnnModelMapper(RichModelMapper):
     def predict_proba_block(self, t: MTable):
         import jax
 
+        from ...common.jitcache import call_row_bucketed
+
         Q = get_feature_block(
             t, merge_feature_params(self.get_params(), self.meta),
             vector_size=self.meta["dim"],
         ).astype(np.float32)
-        votes, _ = jax.device_get(self._knn_jit(Q, self.X_train, self.y_train))
+        # per-query top-k is row-wise over Q — bucketing is bit-parity safe
+        votes, _ = jax.device_get(call_row_bucketed(
+            self._knn_jit, (Q,), self._train_dev))
         votes = np.asarray(votes)
         return votes / votes.sum(axis=1, keepdims=True)
 
@@ -336,35 +380,44 @@ class KnnRegTrainBatchOp(ModelTrainOpMixin, BatchOperator, HasVectorCol,
                                      "y": y})
 
 
+def _build_knn_reg(k: int, cosine: bool):
+    import jax
+    import jax.numpy as jnp
+
+    def knn(Q, X, y):
+        if cosine:
+            Qn = Q / jnp.maximum(jnp.linalg.norm(Q, axis=1, keepdims=True),
+                                 1e-12)
+            Xn = X / jnp.maximum(jnp.linalg.norm(X, axis=1, keepdims=True),
+                                 1e-12)
+            d = 1.0 - Qn @ Xn.T
+        else:
+            d = pairwise_sq_dists(Q, X)
+        neg_d, idx = jax.lax.top_k(-d, k)
+        w = 1.0 / (jnp.sqrt(jnp.maximum(-neg_d, 0.0)) + 1e-6)
+        return (w * y[idx]).sum(1) / w.sum(1)
+
+    return jax.jit(knn)
+
+
 class KnnRegModelMapper(RichModelMapper):
     """Inverse-distance-weighted mean of the k nearest targets."""
 
     K = ParamInfo("k", int, default=10, validator=MinValidator(1))
 
     def load_model(self, model: MTable):
-        import jax
-        import jax.numpy as jnp
+        from ...common.jitcache import cached_jit
 
         self.meta, arrays = table_to_model(model)
         self.X_train = arrays["X"]
         self.y_train = arrays["y"].astype(np.float32)
         k = min(self.get(self.K), self.X_train.shape[0])
         cosine = self.meta.get("distanceType") == "COSINE"
+        from ...common.jitcache import device_constants
 
-        def knn(Q, X, y):
-            if cosine:
-                Qn = Q / jnp.maximum(jnp.linalg.norm(Q, axis=1, keepdims=True),
-                                     1e-12)
-                Xn = X / jnp.maximum(jnp.linalg.norm(X, axis=1, keepdims=True),
-                                     1e-12)
-                d = 1.0 - Qn @ Xn.T
-            else:
-                d = pairwise_sq_dists(Q, X)
-            neg_d, idx = jax.lax.top_k(-d, k)
-            w = 1.0 / (jnp.sqrt(jnp.maximum(-neg_d, 0.0)) + 1e-6)
-            return (w * y[idx]).sum(1) / w.sum(1)
-
-        self._knn_jit = jax.jit(knn)
+        self._train_dev = device_constants(self.X_train, self.y_train)
+        self._knn_jit = cached_jit("knn.regress", _build_knn_reg,
+                                   int(k), bool(cosine))
         return self
 
     def _pred_type(self) -> str:
@@ -373,12 +426,14 @@ class KnnRegModelMapper(RichModelMapper):
     def predict_block(self, t: MTable):
         import jax
 
+        from ...common.jitcache import call_row_bucketed
+
         Q = get_feature_block(
             t, merge_feature_params(self.get_params(), self.meta),
             vector_size=self.meta["dim"],
         ).astype(np.float32)
-        pred = np.asarray(jax.device_get(
-            self._knn_jit(Q, self.X_train, self.y_train)))
+        pred = np.asarray(jax.device_get(call_row_bucketed(
+            self._knn_jit, (Q,), self._train_dev)))
         return pred.astype(np.float64), AlinkTypes.DOUBLE, None
 
 
@@ -489,15 +544,27 @@ class FmRegressorTrainBatchOp(BaseFmTrainBatchOp):
     fm_task = "regression"
 
 
+def _build_fm_score():
+    import jax
+
+    return jax.jit(lambda X, w0, w, V: w0[0] + X @ w + fm_pairwise(X, V))
+
+
 class FmModelMapper(RichModelMapper):
     """(reference: operator/common/fm/FmModelMapper.java)"""
 
     def load_model(self, model: MTable):
-        import jax
+        from ...common.jitcache import cached_jit
+
+        from ...common.jitcache import device_constants
 
         self.meta, arrays = table_to_model(model)
-        w0, w, V = arrays["w0"], arrays["w"], arrays["V"]
-        self._score_jit = jax.jit(lambda X: w0[0] + X @ w + fm_pairwise(X, V))
+        self._fm_params = device_constants(
+            arrays["w0"].astype(np.float32), arrays["w"].astype(np.float32),
+            arrays["V"].astype(np.float32))
+        # one process-wide FM scoring program (parameters as arguments):
+        # every FM model load — batch predict or stream hot-swap — shares it
+        self._score_jit = cached_jit("fm.score", _build_fm_score)
         return self
 
     def _pred_type(self) -> str:
@@ -508,11 +575,14 @@ class FmModelMapper(RichModelMapper):
     def _scores(self, t: MTable) -> np.ndarray:
         import jax
 
+        from ...common.jitcache import call_row_bucketed
+
         X = get_feature_block(
             t, merge_feature_params(self.get_params(), self.meta),
             vector_size=self.meta["dim"],
         ).astype(np.float32)
-        return np.asarray(jax.device_get(self._score_jit(X)))
+        return np.asarray(jax.device_get(call_row_bucketed(
+            self._score_jit, (X,), self._fm_params)))
 
     def predict_proba_block(self, t: MTable):
         if self.meta["fmTask"] == "regression":
@@ -604,16 +674,24 @@ class MultilayerPerceptronTrainBatchOp(ModelTrainOpMixin, BatchOperator,
         return model_to_table(meta, {"weights": res.weights.astype(np.float32)})
 
 
+def _build_mlp_score(sizes: tuple):
+    import jax
+
+    return jax.jit(lambda X, w: mlp_forward(list(sizes), w, X))
+
+
 class MlpModelMapper(RichModelMapper):
     """(reference: operator/common/classification/ann/MlpcModelMapper.java)"""
 
     def load_model(self, model: MTable):
-        import jax
+        from ...common.jitcache import cached_jit
+
+        from ...common.jitcache import device_constants
 
         self.meta, arrays = table_to_model(model)
-        w = arrays["weights"]
-        sizes = [int(s) for s in self.meta["layerSizes"]]
-        self._score_jit = jax.jit(lambda X: mlp_forward(sizes, w, X))
+        (self._mlp_w,) = device_constants(arrays["weights"].astype(np.float32))
+        sizes = tuple(int(s) for s in self.meta["layerSizes"])
+        self._score_jit = cached_jit("mlp.score", _build_mlp_score, sizes)
         return self
 
     def _pred_type(self) -> str:
@@ -622,11 +700,14 @@ class MlpModelMapper(RichModelMapper):
     def predict_proba_block(self, t: MTable):
         import jax
 
+        from ...common.jitcache import call_row_bucketed
+
         X = get_feature_block(
             t, merge_feature_params(self.get_params(), self.meta),
             vector_size=self.meta["dim"],
         ).astype(np.float32)
-        logits = np.asarray(jax.device_get(self._score_jit(X)))
+        logits = np.asarray(jax.device_get(call_row_bucketed(
+            self._score_jit, (X,), (self._mlp_w,))))
         return softmax_np(logits)
 
     def predict_block(self, t: MTable):

@@ -100,22 +100,16 @@ def _haversine_dists(Xl, c):
         jnp.sqrt(jnp.clip(h, 0.0, 1.0)))
 
 
-def _lloyd(mesh, X: np.ndarray, k: int, max_iter: int, tol: float,
-           metric, seed: int):
-    """The compiled Lloyd loop. Returns (centroids, num_iters, inertia).
-    ``metric``: "EUCLIDEAN" | "COSINE" | "HAVERSINE" (bool accepted for the
-    legacy cosine flag)."""
+def _build_lloyd(mesh, k: int, max_iter: int, tol: float, metric: str):
+    """Build the jitted Lloyd program for one (mesh, k, max_iter, tol,
+    metric) config — registered once in the process-wide ProgramCache
+    (common/jitcache.py) so repeated fits reuse one traced program instead
+    of rebuilding the ``jax.jit(jax.shard_map(...))`` closure per call."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
 
-    if isinstance(metric, bool):
-        metric = "COSINE" if metric else "EUCLIDEAN"
     cosine = metric == "COSINE"
-    if cosine:
-        X = X / np.maximum(np.linalg.norm(X, axis=1, keepdims=True), 1e-12)
-    init = _kmeanspp_init(X, k, seed)
-    Xs, mask = shard_rows(mesh, X, with_mask=True)
     axis = AXIS_DATA
 
     def body(Xl, maskl, c0):
@@ -175,12 +169,32 @@ def _lloyd(mesh, X: np.ndarray, k: int, max_iter: int, tol: float,
         )
         return c, i, inertia
 
-    f = jax.jit(
+    return jax.jit(
         jax.shard_map(
             body, mesh=mesh, in_specs=(P(axis), P(axis), P()), out_specs=P(),
             check_vma=False,
         )
     )
+
+
+def _lloyd(mesh, X: np.ndarray, k: int, max_iter: int, tol: float,
+           metric, seed: int):
+    """The compiled Lloyd loop. Returns (centroids, num_iters, inertia).
+    ``metric``: "EUCLIDEAN" | "COSINE" | "HAVERSINE" (bool accepted for the
+    legacy cosine flag)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ...common.jitcache import cached_jit
+
+    if isinstance(metric, bool):
+        metric = "COSINE" if metric else "EUCLIDEAN"
+    if metric == "COSINE":
+        X = X / np.maximum(np.linalg.norm(X, axis=1, keepdims=True), 1e-12)
+    init = _kmeanspp_init(X, k, seed)
+    Xs, mask = shard_rows(mesh, X, with_mask=True)
+    f = cached_jit("kmeans.lloyd", _build_lloyd,
+                   int(k), int(max_iter), float(tol), metric, mesh=mesh)
     c, iters, inertia = jax.device_get(f(Xs, mask, jnp.asarray(init)))
     return np.asarray(c), int(iters), float(inertia)
 
@@ -224,30 +238,43 @@ class KMeansTrainBatchOp(ModelTrainOpMixin, BatchOperator, HasKMeansParams):
         return model_to_table(meta, {"centroids": c})
 
 
+def _build_assign(metric: str):
+    """Centroid-assignment kernel shared through the ProgramCache: centroids
+    ride as an ARGUMENT (not a baked-in constant), so loading N copies of
+    the same model — or N different models with the same metric — compiles
+    once, not N times."""
+    import jax
+    import jax.numpy as jnp
+
+    def assign(X, c):
+        if metric == "COSINE":
+            Xn = X / jnp.maximum(jnp.linalg.norm(X, axis=1, keepdims=True),
+                                 1e-12)
+            cn = c / jnp.maximum(jnp.linalg.norm(c, axis=1, keepdims=True),
+                                 1e-12)
+            d = 1.0 - Xn @ cn.T
+        elif metric == "HAVERSINE":
+            d = _haversine_dists(X, c)
+        else:
+            d = pairwise_sq_dists(X, c)
+        return jnp.argmin(d, axis=1), d
+
+    return jax.jit(assign)
+
+
 class KMeansModelMapper(RichModelMapper):
     """(reference: operator/common/clustering/kmeans/KMeansModelMapper.java)"""
 
     def load_model(self, model: MTable):
-        import jax
-        import jax.numpy as jnp
+        from ...common.jitcache import cached_jit, device_constants
 
         self.meta, arrays = table_to_model(model)
         self.centroids = arrays["centroids"].astype(np.float32)
+        (self._centroids_dev,) = device_constants(self.centroids)
         metric = self.meta.get("distanceType", "EUCLIDEAN")
-
-        def assign(X, c):
-            if metric == "COSINE":
-                Xn = X / jnp.maximum(jnp.linalg.norm(X, axis=1, keepdims=True), 1e-12)
-                cn = c / jnp.maximum(jnp.linalg.norm(c, axis=1, keepdims=True), 1e-12)
-                d = 1.0 - Xn @ cn.T
-            elif metric == "HAVERSINE":
-                d = _haversine_dists(X, c)
-            else:
-                d = pairwise_sq_dists(X, c)
-            return jnp.argmin(d, axis=1), d
-
-        # compile once at model load; reused across every predict call
-        self._assign_jit = jax.jit(assign)
+        # fetched from the process-wide ProgramCache: one compile per
+        # (metric, shape bucket) across every model load in the process
+        self._assign_jit = cached_jit("kmeans.assign", _build_assign, metric)
         return self
 
     def _pred_type(self) -> str:
@@ -256,13 +283,19 @@ class KMeansModelMapper(RichModelMapper):
     def predict_block(self, t: MTable):
         import jax
 
+        from ...common.jitcache import call_row_bucketed
         from ...mapper import merge_feature_params
 
         X = get_feature_block(
             t, merge_feature_params(self.get_params(), self.meta),
             vector_size=self.meta["dim"],
         ).astype(np.float32)
-        a, d = jax.device_get(self._assign_jit(X, self.centroids))
+        # row-bucketed: a batch-size sweep or ragged stream chunk reuses one
+        # compiled program; argmin/distances are row-wise, so the padded run
+        # is bit-identical to the unpadded one after the slice
+        a, d = call_row_bucketed(self._assign_jit, (X,),
+                                 (self._centroids_dev,))
+        a, d = jax.device_get((a, d))
         detail = None
         if self.get(HasPredictionDetailCol.PREDICTION_DETAIL_COL):
             detail = np.asarray(

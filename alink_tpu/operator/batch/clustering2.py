@@ -60,22 +60,18 @@ from .utils import ModelMapBatchOp, ModelTrainOpMixin
 # Gaussian mixture
 # ---------------------------------------------------------------------------
 
-def _gmm_fit(mesh, X: np.ndarray, k: int, max_iter: int, tol: float,
-             seed: int, reg: float = 1e-6):
+def _build_gmm_em(mesh, max_iter: int, tol: float, reg: float):
+    """Jitted full-covariance EM loop, registered once per (mesh, iteration
+    config) in the ProgramCache — k and d arrive via the argument shapes, so
+    every GMM fit on the same mesh shares one program per shape bucket."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
 
-    n, d = X.shape
-    centers = _kmeanspp_init(X, k, seed)
-    w0 = np.full((k,), 1.0 / k, np.float32)
-    mu0 = centers.astype(np.float32)
-    var0 = float(X.var(axis=0).mean()) + reg
-    cov0 = np.tile(np.eye(d, dtype=np.float32) * var0, (k, 1, 1))
-    Xs, mask = shard_rows(mesh, X.astype(np.float32), with_mask=True)
     axis = AXIS_DATA
 
     def body(Xl, maskl, w0, mu0, cov0):
+        d = Xl.shape[1]
         eye = jnp.eye(d, dtype=Xl.dtype)
 
         def log_prob(mu, cov):
@@ -117,10 +113,28 @@ def _gmm_fit(mesh, X: np.ndarray, k: int, max_iter: int, tol: float,
         i, w, mu, cov, ll, _ = jax.lax.while_loop(cond, step, carry)
         return w, mu, cov, ll, i
 
-    f = jax.jit(jax.shard_map(
+    return jax.jit(jax.shard_map(
         body, mesh=mesh,
         in_specs=(P(axis), P(axis), P(), P(), P()), out_specs=P(),
         check_vma=False))
+
+
+def _gmm_fit(mesh, X: np.ndarray, k: int, max_iter: int, tol: float,
+             seed: int, reg: float = 1e-6):
+    import jax
+    import jax.numpy as jnp
+
+    from ...common.jitcache import cached_jit
+
+    n, d = X.shape
+    centers = _kmeanspp_init(X, k, seed)
+    w0 = np.full((k,), 1.0 / k, np.float32)
+    mu0 = centers.astype(np.float32)
+    var0 = float(X.var(axis=0).mean()) + reg
+    cov0 = np.tile(np.eye(d, dtype=np.float32) * var0, (k, 1, 1))
+    Xs, mask = shard_rows(mesh, X.astype(np.float32), with_mask=True)
+    f = cached_jit("gmm.em", _build_gmm_em,
+                   int(max_iter), float(tol), float(reg), mesh=mesh)
     w, mu, cov, ll, iters = jax.device_get(
         f(Xs, mask, jnp.asarray(w0), jnp.asarray(mu0), jnp.asarray(cov0)))
     return (np.asarray(w), np.asarray(mu), np.asarray(cov), float(ll),
@@ -162,31 +176,45 @@ class GmmTrainBatchOp(ModelTrainOpMixin, BatchOperator, HasVectorCol,
         return model_to_table(meta, {"weights": w, "means": mu, "covs": cov})
 
 
+def _build_gmm_posterior():
+    """Posterior-responsibility kernel with the mixture parameters as
+    ARGUMENTS, so all GMM model loads share one ProgramCache entry per
+    shape bucket (the per-load closure used to bake w/mu/cov in as
+    constants — N loads, N compiles)."""
+    import jax
+    import jax.numpy as jnp
+
+    def posterior(X, w, mu, cov):
+        d = X.shape[1]
+        eye = jnp.eye(d, dtype=jnp.float32) * 1e-6
+
+        def log_prob(m, c):
+            L = jnp.linalg.cholesky(c + eye)
+            sol = jax.scipy.linalg.solve_triangular(L, (X - m).T, lower=True)
+            maha = (sol * sol).sum(0)
+            logdet = 2.0 * jnp.log(jnp.diag(L)).sum()
+            return -0.5 * (maha + logdet + d * jnp.log(2.0 * jnp.pi))
+
+        lp = jax.vmap(log_prob)(mu, cov).T + jnp.log(w)[None, :]
+        lp = lp - jax.scipy.special.logsumexp(lp, axis=1, keepdims=True)
+        return jnp.exp(lp)
+
+    return jax.jit(posterior)
+
+
 class GmmModelMapper(RichModelMapper):
     """(reference: common/clustering/GmmModelMapper.java)"""
 
     def load_model(self, model: MTable):
-        import jax
-        import jax.numpy as jnp
+        from ...common.jitcache import cached_jit, device_constants
 
         self.meta, arrays = table_to_model(model)
-        w, mu, cov = arrays["weights"], arrays["means"], arrays["covs"]
-        d = mu.shape[1]
-        eye = np.eye(d, dtype=np.float32) * 1e-6
-
-        def posterior(X):
-            def log_prob(m, c):
-                L = jnp.linalg.cholesky(c + eye)
-                sol = jax.scipy.linalg.solve_triangular(L, (X - m).T, lower=True)
-                maha = (sol * sol).sum(0)
-                logdet = 2.0 * jnp.log(jnp.diag(L)).sum()
-                return -0.5 * (maha + logdet + d * jnp.log(2.0 * jnp.pi))
-
-            lp = jax.vmap(log_prob)(mu, cov).T + jnp.log(w)[None, :]
-            lp = lp - jax.scipy.special.logsumexp(lp, axis=1, keepdims=True)
-            return jnp.exp(lp)
-
-        self._post_jit = jax.jit(posterior)
+        # staged once: program arguments, not per-predict wire traffic
+        self._w, self._mu, self._cov = device_constants(
+            arrays["weights"].astype(np.float32),
+            arrays["means"].astype(np.float32),
+            arrays["covs"].astype(np.float32))
+        self._post_jit = cached_jit("gmm.posterior", _build_gmm_posterior)
         return self
 
     def _pred_type(self) -> str:
@@ -195,10 +223,14 @@ class GmmModelMapper(RichModelMapper):
     def predict_block(self, t: MTable):
         import jax
 
+        from ...common.jitcache import call_row_bucketed
+
         X = get_feature_block(
             t, merge_feature_params(self.get_params(), self.meta),
             vector_size=self.meta["dim"]).astype(np.float32)
-        P = np.asarray(jax.device_get(self._post_jit(X)))
+        # per-row posteriors are row-wise: bucketing is bit-parity safe
+        P = np.asarray(jax.device_get(call_row_bucketed(
+            self._post_jit, (X,), (self._w, self._mu, self._cov))))
         pred = P.argmax(axis=1).astype(np.int64)
         detail = None
         if self.get(HasPredictionDetailCol.PREDICTION_DETAIL_COL):

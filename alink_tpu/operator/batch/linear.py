@@ -338,6 +338,12 @@ class SoftmaxTrainBatchOp(BaseLinearModelTrainBatchOp):
     linear_model_type = "Softmax"
 
 
+def _build_linear_score():
+    import jax
+
+    return jax.jit(lambda X, w, b: X @ w + b)
+
+
 class LinearModelMapper(RichModelMapper):
     """(reference: operator/common/linear/LinearModelMapper.java +
     SoftmaxModelMapper.java)"""
@@ -349,13 +355,15 @@ class LinearModelMapper(RichModelMapper):
     STREAM_CHUNK_BYTES = 4 * 1024 * 1024
 
     def load_model(self, model: MTable):
-        import jax
+        from ...common.jitcache import cached_jit, device_constants
 
         self.meta, arrays = table_to_model(model)
-        self.weights = arrays["weights"]
-        self.intercept = arrays["intercept"]
-        # compile the scoring kernel once; reused across every predict call
-        self._score_jit = jax.jit(lambda X, w, b: X @ w + b)
+        self.weights = arrays["weights"]      # host copies: sparse path +
+        self.intercept = arrays["intercept"]  # ndim checks stay numpy
+        self._wb_dev = device_constants(self.weights, self.intercept)
+        # one process-wide scoring program (weights ride as arguments):
+        # every linear model load shares it, per shape bucket
+        self._score_jit = cached_jit("linear.score", _build_linear_score)
         return self
 
     def _pred_type(self) -> str:
@@ -385,6 +393,8 @@ class LinearModelMapper(RichModelMapper):
                 else:
                     s = (blk.val[..., None] * w[blk.idx]).sum(axis=1)
                 return s + self.intercept
+        from ...common.jitcache import (bucket_rows, floor_bucket_rows,
+                                        pad_rows)
         from ...common.staging import stage_replicated
 
         X = get_feature_block(
@@ -399,23 +409,32 @@ class LinearModelMapper(RichModelMapper):
             from ...common.streaming import iter_row_chunks, stream_map
 
             wire_is_slow()  # resolve the gate before transfers contend
-            rows = max(1, self.STREAM_CHUNK_BYTES // max(X.strides[0], 1))
+            # chunk rows sit ON the bucket ladder so full chunks ship with
+            # zero padding; only the ragged tail pads up (to a smaller
+            # bucket), hitting an already-compiled program instead of
+            # lowering a fresh per-tail-size one
+            rows = floor_bucket_rows(
+                max(1, self.STREAM_CHUNK_BYTES // max(X.strides[0], 1)))
             parts = [
-                np.asarray(s)
-                for _, s in stream_map(
-                    lambda xd: self._score_jit(
-                        xd, self.weights, self.intercept),
+                np.asarray(s)[:nv]
+                for nv, s in stream_map(
+                    lambda xd: self._score_jit(xd, *self._wb_dev),
                     iter_row_chunks([X], rows),
-                    put=lambda arrs: [stage_replicated(a) for a in arrs],
+                    put=lambda arrs: [
+                        stage_replicated(
+                            pad_rows(a, bucket_rows(a.shape[0])))
+                        for a in arrs],
                 )
             ]
             return np.concatenate(parts, axis=0)
         # content-cached device staging: re-predicting the same table does
-        # not re-push the feature block host->device
-        Xd = stage_replicated(X)
-        return np.asarray(
-            jax.device_get(self._score_jit(Xd, self.weights, self.intercept))
-        )
+        # not re-push the feature block host->device. The block is padded to
+        # its row bucket first (X @ w + b is row-wise, so slicing the padded
+        # scores back to n is bit-identical to the unpadded run).
+        n = X.shape[0]
+        Xd = stage_replicated(pad_rows(X, bucket_rows(n)))
+        return np.asarray(jax.device_get(
+            self._score_jit(Xd, *self._wb_dev)))[:n]
 
     def predict_proba_block(self, t: MTable):
         mtype = self.meta["linearModelType"]

@@ -15,7 +15,6 @@ models.
 
 from __future__ import annotations
 
-import functools
 from typing import Iterator, List, Optional
 
 import numpy as np
@@ -40,8 +39,7 @@ from .base import ModelMapStreamOp, StreamOperator
 _WARMUP_MAX_ROWS = 100_000
 
 
-@functools.lru_cache(maxsize=8)
-def _ftrl_step_fn(alpha: float, beta: float, l1: float, l2: float):
+def _build_ftrl_step(alpha: float, beta: float, l1: float, l2: float):
     import jax
     import jax.numpy as jnp
 
@@ -70,6 +68,16 @@ def _ftrl_step_fn(alpha: float, beta: float, l1: float, l2: float):
         return z, n, weights(z, n), preds
 
     return step
+
+
+def _ftrl_step_fn(alpha: float, beta: float, l1: float, l2: float):
+    """Process-wide cached FTRL micro-batch program (common/jitcache.py):
+    every train stream with the same hyper-parameters shares one compiled
+    step per (dim, bucketed chunk) shape."""
+    from ...common.jitcache import cached_jit
+
+    return cached_jit("ftrl.step", _build_ftrl_step,
+                      float(alpha), float(beta), float(l1), float(l2))
 
 
 class HasFtrlParams(HasVectorCol, HasFeatureCols):
@@ -166,6 +174,11 @@ class FtrlTrainStreamOp(StreamOperator, HasFtrlParams):
         for chunk in it:
             if chunk.num_rows == 0:
                 continue
+            # the stream's steady shape is the RAW incoming chunk size,
+            # recorded before any warm-up merge below can inflate it —
+            # otherwise every post-warm-up chunk would read as "short" and
+            # pay the padding scan tax forever
+            st.setdefault("chunk_rows", chunk.num_rows)
             st["seen_labels"].update(
                 np.asarray(chunk.col(label_col)).tolist())
             if len(st["seen_labels"]) > 2:
@@ -215,8 +228,29 @@ class FtrlTrainStreamOp(StreamOperator, HasFtrlParams):
                     f"feature dim {Xb.shape[1] - 1} != model dim "
                     f"{st['z'].shape[0] - 1}"
                 )
+            # Ragged chunks are bucket-padded with zero rows: a zero row's
+            # FTRL update is exactly a no-op (g = 0 ⇒ σ = 0 ⇒ z, n
+            # unchanged, bit for bit), so the accumulators — and every model
+            # snapshot — are identical to the unpadded run while the final
+            # short chunk reuses an already-compiled program. The FIRST
+            # chunk's size is taken as the stream's steady shape and never
+            # padded (the step is a sequential per-row scan — padding every
+            # chunk of an off-ladder steady size would be pure wasted scan
+            # work); short tails pad to min(bucket, steady) so they ride
+            # the steady program whenever the ladder overshoots it.
+            from ...common.jitcache import bucket_rows, pad_rows
+
+            n_rows = Xb.shape[0]
+            steady = st.get("chunk_rows") or n_rows
+            if n_rows == steady:
+                m = n_rows
+            elif n_rows < steady:
+                m = min(bucket_rows(n_rows), steady)
+            else:
+                m = bucket_rows(n_rows)
             st["z"], st["n"], w, _ = step(
-                st["z"], st["n"], jnp.asarray(Xb), jnp.asarray(y))
+                st["z"], st["n"], jnp.asarray(pad_rows(Xb, m)),
+                jnp.asarray(pad_rows(y, m)))
             st["batch_no"] += 1
             if st["batch_no"] % interval == 0 and len(st["labels"]) == 2:
                 w_np = np.asarray(w)
@@ -311,6 +345,29 @@ class BinaryClassModelFilterStreamOp(StreamOperator):
                 yield pending
 
 
+def _build_fm_update(lr: float):
+    import jax
+    import jax.numpy as jnp
+
+    from ...optim import fm_pairwise
+
+    @jax.jit
+    def update(params, accum, X, y):
+        def loss(p):
+            w0, w, V = p
+            s = w0 + X @ w + fm_pairwise(X, V)
+            return jnp.logaddexp(0.0, -y * s).mean()
+
+        g = jax.grad(loss)(params)
+        new_accum = jax.tree.map(lambda a, gg: a + gg * gg, accum, g)
+        new_params = jax.tree.map(
+            lambda p, gg, a: p - lr * gg / jnp.sqrt(a + 1e-8),
+            params, g, new_accum)
+        return new_params, new_accum
+
+    return update
+
+
 class OnlineFmTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols):
     """Streaming factorization machine (binary) with AdaGrad updates; emits
     FmModel snapshot tables servable by FmPredict (reference:
@@ -362,8 +419,8 @@ class OnlineFmTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols):
         import jax
         import jax.numpy as jnp
 
+        from ...common.jitcache import cached_jit
         from ...common.model import model_to_table
-        from ...optim import fm_pairwise
 
         kf = self.get(self.NUM_FACTOR)
         lr = self.get(self.LEARN_RATE)
@@ -371,19 +428,12 @@ class OnlineFmTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols):
         label_col = self.get(self.LABEL_COL)
         st = self._fm_state()
 
-        @jax.jit
-        def update(params, accum, X, y):
-            def loss(p):
-                w0, w, V = p
-                s = w0 + X @ w + fm_pairwise(X, V)
-                return jnp.logaddexp(0.0, -y * s).mean()
-
-            g = jax.grad(loss)(params)
-            new_accum = jax.tree.map(lambda a, gg: a + gg * gg, accum, g)
-            new_params = jax.tree.map(
-                lambda p, gg, a: p - lr * gg / jnp.sqrt(a + 1e-8),
-                params, g, new_accum)
-            return new_params, new_accum
+        # cached process-wide: re-running the stream (restarts, tests) or a
+        # second OnlineFm job with the same learn rate reuses the traced
+        # program instead of rebuilding a fresh @jax.jit per _stream_impl.
+        # No row bucketing here: the loss is a row MEAN, so padding would
+        # change the gradient — the chunk shapes key jax's own cache.
+        update = cached_jit("onlinefm.update", _build_fm_update, float(lr))
 
         for chunk in it:
             if chunk.num_rows == 0:
@@ -462,6 +512,23 @@ class OnlineFmPredictStreamOp(ModelMapStreamOp, HasPredictionCol,
     mapper_cls = _FmMapper
 
 
+def _build_ol_update(lr: float, squared: bool):
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def update(w, X, y):
+        def loss(w):
+            s = X @ w[:-1] + w[-1]
+            if squared:
+                return 0.5 * ((s - y) ** 2).mean()
+            return jnp.logaddexp(0.0, -y * s).mean()
+
+        return w - lr * jax.grad(loss)(w)
+
+    return update
+
+
 class OnlineLearningStreamOp(StreamOperator):
     """Generic online refinement of a batch-trained LinearModel: per-chunk
     SGD on the matching loss (logistic for classifiers, squared for
@@ -495,15 +562,10 @@ class OnlineLearningStreamOp(StreamOperator):
         vec_col = meta.get("vectorCol")
         labels = meta.get("labels")
 
-        @jax.jit
-        def update(w, X, y):
-            def loss(w):
-                s = X @ w[:-1] + w[-1]
-                if mtype in ("LinearReg", "SVR"):
-                    return 0.5 * ((s - y) ** 2).mean()
-                return jnp.logaddexp(0.0, -y * s).mean()
+        from ...common.jitcache import cached_jit
 
-            return w - lr * jax.grad(loss)(w)
+        update = cached_jit("onlinelearning.update", _build_ol_update,
+                            float(lr), mtype in ("LinearReg", "SVR"))
 
         batch_no = 0
         for chunk in data_it:

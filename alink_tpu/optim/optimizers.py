@@ -300,15 +300,37 @@ def optimize(
         )
         return w, loss, jnp.linalg.norm(g), k
 
-    f = jax.jit(
-        jax.shard_map(
-            body,
-            mesh=mesh,
-            in_specs=(P(axis), P(axis), P(axis), P(axis), P()),
-            out_specs=P(),
-            check_vma=False,
+    def _build(mesh):
+        return jax.jit(
+            jax.shard_map(
+                body,
+                mesh=mesh,
+                in_specs=(P(axis), P(axis), P(axis), P(axis), P()),
+                out_specs=P(),
+                check_vma=False,
+            )
         )
-    )
+
+    # the whole-loop program is cached process-wide: the key captures every
+    # value the trace closes over (method/iteration config, penalties — l2
+    # may be a per-parameter vector — and the objective closures by code +
+    # captured config), so two fits of the same model family reuse ONE
+    # traced program instead of rebuilding the jit closure per call.
+    from ..common.jitcache import Unkeyable, cached_jit, fn_content_key
+
+    try:
+        key_extra = (
+            method, int(max_iter), float(tol), float(learning_rate),
+            int(history), int(num_search_step), int(batch_size), sparse,
+            l1, l2, int(obj.num_params),
+            fn_content_key(obj.local_loss), fn_content_key(obj.global_term),
+        )
+        f = cached_jit("optim." + method, _build, mesh=mesh,
+                       key_extra=key_extra)
+    except Unkeyable:
+        # objective closes over unhashable state (device arrays): fall back
+        # to the per-call build — correctness first, reuse where possible
+        f = _build(mesh)
     if _lower_only:
         # introspection hook (weak-scaling tests): the lowered-but-unrun
         # program, so callers can compile() and read cost_analysis()

@@ -17,7 +17,6 @@ feature -1 — samples route left and both children inherit its statistics.
 
 from __future__ import annotations
 
-import functools
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
@@ -70,22 +69,18 @@ def _route(bins, node, feat, thr):
     return node * 2 + (1 - go_left.astype(jnp.int32))
 
 
-@functools.lru_cache(maxsize=64)
-def _level_fn(mesh_key, num_nodes: int, num_bins: int, l2: float,
-              min_samples: float, min_gain: float):
-    """Build + cache the jitted level kernel for a given node count."""
+def _build_level_fn(mesh, num_nodes: int, num_bins: int, l2: float,
+                    min_samples: float, min_gain: float,
+                    pallas_on: bool, interp: bool):
+    """Build the jitted level kernel for a given node count."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
 
-    mesh = _MESHES[mesh_key]
+    from .pallas_hist import pallas_histogram
+
     axis = AXIS_DATA
     L, B = num_nodes, num_bins
-
-    from .pallas_hist import interpret_mode, pallas_histogram, use_pallas_hist
-
-    pallas_on = use_pallas_hist()
-    interp = interpret_mode()
 
     def body(bins, g, h, c, node, fmask):
         bins = bins.astype(jnp.int32)  # may arrive uint8 (tunnel savings)
@@ -124,13 +119,35 @@ def _level_fn(mesh_key, num_nodes: int, num_bins: int, l2: float,
     )
 
 
-@functools.lru_cache(maxsize=16)
-def _leaf_fn(mesh_key, num_leaves: int, l2: float):
+def _level_fn(mesh, num_nodes: int, num_bins: int, l2: float,
+              min_samples: float, min_gain: float):
+    """Process-wide cached level kernel (common/jitcache.py). The pallas
+    flags enter the key, so flipping them builds a distinct program rather
+    than reusing a kernel that captured the old flag at build time."""
+    from ..common.jitcache import cached_jit
+    from .pallas_hist import interpret_mode, use_pallas_hist
+
+    return cached_jit("tree.level", _build_level_fn,
+                      int(num_nodes), int(num_bins), float(l2),
+                      float(min_samples), float(min_gain),
+                      bool(use_pallas_hist()), bool(interpret_mode()),
+                      mesh=mesh)
+
+
+def _clear_level_cache():
+    from ..common.jitcache import clear_kernel
+
+    clear_kernel("tree.level")
+
+
+_level_fn.cache_clear = _clear_level_cache  # back-compat with the lru era
+
+
+def _build_leaf_fn(mesh, num_leaves: int, l2: float):
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
 
-    mesh = _MESHES[mesh_key]
     axis = AXIS_DATA
 
     def body(g, h, node):
@@ -150,25 +167,25 @@ def _leaf_fn(mesh_key, num_leaves: int, l2: float):
     )
 
 
+def _leaf_fn(mesh, num_leaves: int, l2: float):
+    from ..common.jitcache import cached_jit
+
+    return cached_jit("tree.leaf", _build_leaf_fn,
+                      int(num_leaves), float(l2), mesh=mesh)
+
+
 # Kernels are cached by a structural mesh fingerprint (axes, shape, device
 # ids) so equivalent meshes share compiles and fresh-mesh-per-job services
-# don't grow the cache unboundedly; the registry keeps one representative
-# mesh per fingerprint (the compiled kernels close over it anyway).
-_MESHES: Dict[tuple, object] = {}
-
-
+# don't grow the cache unboundedly — the registry now lives in
+# common/jitcache.py (one representative mesh per fingerprint, shared by
+# every kernel family in the process). ``_mesh_key`` stays as an alias.
 def _mesh_key(mesh) -> tuple:
-    k = (
-        tuple(mesh.axis_names),
-        tuple(int(s) for s in mesh.devices.shape),
-        tuple(d.id for d in mesh.devices.flat),
-    )
-    _MESHES.setdefault(k, mesh)
-    return k
+    from ..common.jitcache import mesh_fingerprint
+
+    return mesh_fingerprint(mesh)
 
 
-@functools.lru_cache(maxsize=16)
-def _predict_fn(depth: int):
+def _build_predict_fn(depth: int):
     import jax
     import jax.numpy as jnp
 
@@ -195,6 +212,12 @@ def _predict_fn(depth: int):
     return run
 
 
+def _predict_fn(depth: int):
+    from ..common.jitcache import cached_jit
+
+    return cached_jit("tree.predict", _build_predict_fn, int(depth))
+
+
 # ---------------------------------------------------------------------------
 # ensemble container
 # ---------------------------------------------------------------------------
@@ -218,19 +241,18 @@ class TreeEnsemble:
     def raw_predict(self, X: np.ndarray) -> np.ndarray:
         """(n, K) raw scores — sum of leaf values + base. The jitted traversal
         takes the tree arrays as arguments (not constants) and is cached per
-        depth, so repeat predicts and different ensembles share one compile."""
-        import jax.numpy as jnp
+        depth, so repeat predicts and different ensembles share one compile;
+        rows are bucket-padded (tree routing is row-wise, so the sliced
+        result is bit-identical) so batch-size sweeps reuse one program."""
+        from ..common.jitcache import call_row_bucketed, device_constants
 
         run = _predict_fn(self.depth)
-        return np.asarray(
-            run(
-                jnp.asarray(X, jnp.float32),
-                jnp.asarray(self.feats),
-                jnp.asarray(self.thrs),
-                jnp.asarray(self.leaves),
-                jnp.asarray(self.base_score),
-            )
-        )
+        dev = getattr(self, "_dev_arrays", None)
+        if dev is None:  # tree arrays staged once per ensemble, not per call
+            dev = self._dev_arrays = device_constants(
+                self.feats, self.thrs, self.leaves, self.base_score)
+        return np.asarray(call_row_bucketed(
+            run, (np.asarray(X, np.float32),), dev))
 
     def to_arrays(self) -> Dict[str, np.ndarray]:
         return {
@@ -283,7 +305,6 @@ def _grow_tree(bins_s, g_s, h_s, c_s, mesh, edges, depth, num_bins, l2,
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    mk = _mesh_key(mesh)
     node = jax.device_put(
         np.zeros(n_local, np.int32), NamedSharding(mesh, P(AXIS_DATA))
     )
@@ -293,7 +314,7 @@ def _grow_tree(bins_s, g_s, h_s, c_s, mesh, edges, depth, num_bins, l2,
 
     for level in range(depth):
         L = 2 ** level
-        fn = _level_fn(mk, L, num_bins, float(l2), float(min_samples),
+        fn = _level_fn(mesh, L, num_bins, float(l2), float(min_samples),
                        float(min_gain))
         feat, thr, node = fn(bins_s, g_s, h_s, c_s, node, fmask_j)
         feat = np.asarray(feat)
@@ -362,10 +383,9 @@ def _pad_rows(arr, dp):
 _HIST_ONEHOT_BUDGET_ELEMS = 128 * 1024 * 1024
 
 
-@functools.lru_cache(maxsize=32)
-def _gbdt_train_fn(mesh_key, task: str, num_trees: int, depth: int,
-                   num_bins: int, K: int, subsample_on: bool,
-                   colsample_on: bool, d: int, num_chunks: int):
+def _build_gbdt_train_fn(mesh, task: str, num_trees: int, depth: int,
+                         num_bins: int, K: int, subsample_on: bool,
+                         colsample_on: bool, d: int, num_chunks: int):
     """ONE compiled program for the whole boosting run: a ``lax.fori_loop``
     over trees inside one ``shard_map`` — gradients, histograms (+psum),
     split search, sample routing, leaf values and score updates all stay on
@@ -376,7 +396,6 @@ def _gbdt_train_fn(mesh_key, task: str, num_trees: int, depth: int,
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
 
-    mesh = _MESHES[mesh_key]
     axis = AXIS_DATA
     B = num_bins
     HEAP = 2 ** depth - 1
@@ -533,6 +552,17 @@ def _gbdt_train_fn(mesh_key, task: str, num_trees: int, depth: int,
     )
 
 
+def _gbdt_train_fn(mesh, task: str, num_trees: int, depth: int,
+                   num_bins: int, K: int, subsample_on: bool,
+                   colsample_on: bool, d: int, num_chunks: int):
+    from ..common.jitcache import cached_jit
+
+    return cached_jit("gbdt.train", _build_gbdt_train_fn,
+                      task, int(num_trees), int(depth), int(num_bins),
+                      int(K), bool(subsample_on), bool(colsample_on),
+                      int(d), int(num_chunks), mesh=mesh)
+
+
 def train_gbdt(
     X: np.ndarray,
     y: np.ndarray,
@@ -611,7 +641,7 @@ def train_gbdt(
     t_staged = _time.perf_counter()
 
     fn = _gbdt_train_fn(
-        _mesh_key(mesh), task, int(num_trees), int(depth), int(num_bins),
+        mesh, task, int(num_trees), int(depth), int(num_bins),
         K, subsample < 1.0, colsample < 1.0, d, int(num_chunks))
     key = jax.random.PRNGKey(seed)
     hp = jnp.asarray([learning_rate, l2, min_samples, min_gain,
@@ -714,9 +744,8 @@ def _split_search_impurity(hk, fmask, min_samples, min_gain, criterion):
     return feat, thr
 
 
-@functools.lru_cache(maxsize=32)
-def _impurity_tree_fn(mesh_key, depth: int, num_bins: int, K: int, d: int,
-                      criterion: str, num_chunks: int):
+def _build_impurity_tree_fn(mesh, depth: int, num_bins: int, K: int, d: int,
+                            criterion: str, num_chunks: int):
     """ONE compiled program growing a whole impurity-criterion tree:
     per-class count histograms as MXU matmuls (one-hot node x one-hot class
     against the bins one-hot), psum across the data axis, impurity split
@@ -727,7 +756,6 @@ def _impurity_tree_fn(mesh_key, depth: int, num_bins: int, K: int, d: int,
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
 
-    mesh = _MESHES[mesh_key]
     axis = AXIS_DATA
     B = num_bins
     HEAP = 2 ** depth - 1
@@ -813,6 +841,24 @@ def _impurity_tree_fn(mesh_key, depth: int, num_bins: int, K: int, d: int,
     )
 
 
+def _impurity_tree_fn(mesh, depth: int, num_bins: int, K: int, d: int,
+                      criterion: str, num_chunks: int):
+    from ..common.jitcache import cached_jit
+
+    return cached_jit("tree.impurity", _build_impurity_tree_fn,
+                      int(depth), int(num_bins), int(K), int(d),
+                      criterion, int(num_chunks), mesh=mesh)
+
+
+def _clear_impurity_cache():
+    from ..common.jitcache import clear_kernel
+
+    clear_kernel("tree.impurity")
+
+
+_impurity_tree_fn.cache_clear = _clear_impurity_cache
+
+
 def train_tree_impurity(
     X: np.ndarray,
     y: np.ndarray,
@@ -866,7 +912,7 @@ def train_tree_impurity(
     W = (_pad_rows(np.eye(K, dtype=np.float32)[np.asarray(y, int)],
                    dp * num_chunks) * w_pad[:, None])
 
-    fn = _impurity_tree_fn(_mesh_key(mesh), int(depth), int(num_bins), K, d,
+    fn = _impurity_tree_fn(mesh, int(depth), int(num_bins), K, d,
                            criterion, int(num_chunks))
     hp = jnp.asarray([min_samples, min_gain], jnp.float32)
     fh, th, probs = fn(_shard(mesh, bins_pad), _shard(mesh, W),
@@ -969,7 +1015,7 @@ def train_forest(
                 bins_s, g_s, h_s, c_s, mesh, edges, depth, num_bins,
                 1e-9, min_samples, min_gain, fmask, n_pad,
             )
-            lf = _leaf_fn(_mesh_key(mesh), leaf_count, 1e-9)
+            lf = _leaf_fn(mesh, leaf_count, 1e-9)
             leaf_vals = np.asarray(lf(g_s, h_s, node)) / num_trees
             feats[t] = fh
             thrs[t] = th

@@ -777,6 +777,81 @@ def bench_recovery(rows=50_000):
     }
 
 
+def bench_compile():
+    """Shape-stable execution layer (common/jitcache.py): the compile-tax
+    readout tracked across BENCH rounds. Runs the kmeans_iris pipeline and a
+    digits-sized softmax predict twice each — cold wall includes trace +
+    compile (or persistent-cache load), warm is pure cache-hit reuse — and
+    reports the per-workload trace/compile counts plus the process-wide
+    program-cache hit rate. The steady-state contract the tests enforce
+    (zero new traces on a warm second run) shows up here as
+    ``*_warm_compiles == 0``."""
+    from alink_tpu.common.jitcache import compile_summary
+    from alink_tpu.common.metrics import metrics
+    from alink_tpu.common.mtable import MTable
+    from alink_tpu.operator.batch import (SoftmaxPredictBatchOp,
+                                          SoftmaxTrainBatchOp)
+    from alink_tpu.operator.batch.base import (CsvSourceBatchOp,
+                                               TableSourceBatchOp)
+    from alink_tpu.pipeline import KMeans, Pipeline
+
+    def counted(fn):
+        c0 = metrics.counter("jit.compile")
+        t0 = time.perf_counter()
+        fn()
+        return (round(time.perf_counter() - t0, 3),
+                metrics.counter("jit.compile") - c0)
+
+    def cold_warm(fn):
+        cold_s, cold_c = counted(fn)
+        warm_s, warm_c = counted(fn)
+        return {"cold_wall_s": cold_s, "warm_wall_s": warm_s,
+                "cold_compiles": cold_c, "warm_compiles": warm_c}
+
+    out = {}
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "data", "iris.csv")
+    iris = CsvSourceBatchOp(
+        filePath=path,
+        schemaStr="sl double, sw double, pl double, pw double, species string")
+
+    def kmeans_fit():
+        pipe = Pipeline(KMeans(k=3, maxIter=50,
+                               featureCols=["sl", "sw", "pl", "pw"],
+                               predictionCol="pred"))
+        pipe.fit(iris).transform(iris).collect()
+
+    dpath = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "data", "digits.csv")
+    dcols = [f"p{i}" for i in range(64)]
+    schema = ", ".join(f"{c} double" for c in dcols) + ", label long"
+    digits = CsvSourceBatchOp(filePath=dpath, schemaStr=schema).collect()
+
+    def softmax_fit():
+        m = SoftmaxTrainBatchOp(
+            featureCols=dcols, labelCol="label", maxIter=30,
+        ).link_from(TableSourceBatchOp(digits))
+        SoftmaxPredictBatchOp().link_from(
+            m, TableSourceBatchOp(digits)).collect()
+
+    for name, fn in (("kmeans_iris", kmeans_fit),
+                     ("softmax_mnist", softmax_fit)):
+        try:  # one failing workload must not sink the whole extra
+            out[name] = cold_warm(fn)
+        except Exception as e:
+            out[name] = {"error": f"{type(e).__name__}: {e}"[:200]}
+
+    summary = compile_summary()
+    out["program_cache"] = {
+        "programs": summary["programs"],
+        "hit_rate": summary["hit_rate"],
+        "traces": summary["counters"].get("jit.trace", 0),
+        "compiles": summary["counters"].get("jit.compile", 0),
+        "compile_s": (metrics.timer_stats("jitcache.compile_s")
+                      or {}).get("total_s")}
+    return out
+
+
 def main():
     extras = {}
     for name, fn in (
@@ -790,6 +865,7 @@ def main():
         ("executor", bench_executor),
         ("resilience", bench_resilience),
         ("recovery", bench_recovery),
+        ("compile", bench_compile),
     ):
         try:
             extras[name] = fn()
