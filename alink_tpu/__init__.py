@@ -28,8 +28,11 @@ from .common import (  # noqa: F401
     SparseVector,
     TableSchema,
     compile_summary,
+    export_prometheus,
     is_retryable,
+    job_report,
     run_with_recovery,
+    trace_span,
     warmup,
     with_retries,
 )

@@ -14,6 +14,11 @@ from .exceptions import (
     mark_retryable,
 )
 from .faults import FaultSpec
+# NOTE: the `metrics` global recorder is deliberately NOT re-exported here —
+# `from .metrics import metrics` would shadow the submodule attribute and
+# break `alink_tpu.common.metrics.<member>` access
+from .metrics import export_prometheus, timed
+from .tracing import job_report, trace_span, tracer
 from .jitcache import (
     bucket_rows,
     cached_jit,

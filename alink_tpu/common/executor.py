@@ -62,6 +62,7 @@ from typing import Any, Dict, List, Optional, Sequence
 from .faults import maybe_fail
 from .metrics import metrics, node_phase_context
 from .resilience import RetryPolicy, retries_enabled, with_retries
+from .tracing import attach_context, capture_context, trace_span
 
 _DAG_THREAD_PREFIX = "alink-dag"
 _TRACE_LIMIT = 4096  # ring bound on trace series: long-lived processes
@@ -290,11 +291,27 @@ def _run_unit_resilient(unit: _Unit) -> Dict[str, Any]:
     return state
 
 
-def _run_unit(unit: _Unit, record: bool):
+def _run_unit(unit: _Unit, record: bool, ctx=None):
     phases: Dict[str, Any] = {}
+    state = {"defused": False, "attempts": 0}
     t0 = time.perf_counter()
-    with node_phase_context(phases):
-        state = _run_unit_resilient(unit)
+    with attach_context(ctx):
+        # one span per scheduled unit: a fused chain is ONE span with a
+        # `fused` mark (it ran as one program), parented to the dag.run
+        # root even though this executes on an alink-dag pool thread
+        with trace_span(unit.label(),
+                        fused=len(unit.ops) if unit.fused else None) as sp:
+            try:
+                with node_phase_context(phases):
+                    state = _run_unit_resilient(unit)
+            finally:
+                if sp is not None:
+                    sp.phases.update({k: v for k, v in phases.items()
+                                      if isinstance(v, (int, float))})
+                    if state["defused"]:
+                        sp.outcome = sp.outcome or "defused"
+                    if state["attempts"] > 1:
+                        sp.attrs["attempts"] = state["attempts"]
     if record:
         wall = time.perf_counter() - t0
         rec = {"op": unit.label(), "wall_s": round(wall, 6)}
@@ -308,6 +325,7 @@ def _run_unit(unit: _Unit, record: bool):
             rec[k] = round(v, 6) if isinstance(v, float) else v
         metrics.record_bounded("executor.node", _TRACE_LIMIT, **rec)
         metrics.add_time("executor.node_wall", wall)
+        metrics.observe("executor.node_s", wall)
 
 
 def run_dag(env, roots: Sequence[Any], record: bool = True) -> None:
@@ -333,13 +351,26 @@ def run_dag(env, roots: Sequence[Any], record: bool = True) -> None:
         return
 
     nodes = _collect_pending(roots)
-    if len(nodes) <= 1:
+    if not nodes:        # everything memoized: pure reads, no trace noise
         for r in roots:
             r._evaluate()
         return
+    if len(nodes) == 1:
+        with trace_span("dag.run", mode="serial", nodes=1):
+            for r in roots:
+                r._evaluate()
+        return
 
     units = _plan_units(nodes, roots)
-    t_start = time.perf_counter()
+    with trace_span("dag.run", nodes=len(nodes), units=len(units)):
+        _run_scheduled(env, roots, units, nodes, record)
+
+
+def _run_scheduled(env, roots: Sequence[Any], units: List[_Unit],
+                   nodes: List[Any], record: bool) -> None:
+    ctx = capture_context()   # units run on alink-dag pool threads; the
+    t_start = time.perf_counter()  # captured context keeps their spans
+                                   # parented to this run's root span
     ready = [u for u in units if u.indegree == 0]
     remaining = len(units)
     futures: Dict[Any, _Unit] = {}
@@ -358,7 +389,7 @@ def run_dag(env, roots: Sequence[Any], record: bool = True) -> None:
             try:
                 while ready:
                     u = ready[-1]
-                    futures[pool.submit(_run_unit, u, record)] = u
+                    futures[pool.submit(_run_unit, u, record, ctx)] = u
                     ready.pop()
             except BaseException as exc:
                 # pool broke (shutdown/exhaustion), not the unit itself:

@@ -404,6 +404,7 @@ class CachedProgram:
             dt = time.perf_counter() - t0
             metrics.add_time("jitcache.compile_s", dt)
             metrics.add_time(f"jitcache.{self.kernel_id}.compile_s", dt)
+            metrics.observe("jit.compile_s", dt)
             metrics.record_bounded("jit.compile_event", 512,
                                    kernel=self.kernel_id,
                                    ms=round(dt * 1e3, 3))

@@ -12,54 +12,172 @@ Usage:
     with timed("gbdt.train"):
         ...
     metrics.record("bert.step", step=i, loss=l, samples_per_sec=sps)
+    metrics.observe("stream.chunk_s", dt)   # fixed-bucket histogram
     with profile_trace("/tmp/trace"):   # Perfetto trace via jax.profiler
         train()
     metrics.summary()                   # {'gbdt.train': {...}, ...}
+    metrics.export_prometheus()         # text exposition for GET /metrics
+
+Thread-safety: the executor pool, transfer streams, and recovery chains all
+record concurrently, so EVERY mutation of series/timers/histograms happens
+under ``_data_lock`` (counters keep their own ``_counter_lock`` — they are
+hit from signal paths that must never contend with bulk recording).
 """
 
 from __future__ import annotations
 
+import bisect
 import contextlib
 import json
 import logging
+import re
 import threading
 import time
 from collections import defaultdict
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Sequence
 
 logger = logging.getLogger("alink_tpu.metrics")
 
+# Fixed histogram ladder (seconds): µs-scale dispatches up to minute-scale
+# epochs. Fixed buckets keep observe() O(log n), lock-cheap, and make every
+# exported histogram mergeable across processes (same `le` edges).
+DEFAULT_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+
+
+class _Histogram:
+    """Fixed-bucket histogram: per-bucket counts plus count/sum/min/max.
+    Quantiles are estimated by linear interpolation inside the bucket the
+    target rank falls in (the Prometheus client convention)."""
+
+    __slots__ = ("buckets", "counts", "count", "sum", "min", "max")
+
+    def __init__(self, buckets: Sequence[float] = DEFAULT_BUCKETS):
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        self.counts = [0] * (len(self.buckets) + 1)  # [-1] is +Inf
+        self.count = 0
+        self.sum = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        self.counts[bisect.bisect_left(self.buckets, v)] += 1
+        self.count += 1
+        self.sum += v
+        self.min = v if self.min is None else min(self.min, v)
+        self.max = v if self.max is None else max(self.max, v)
+
+    def quantile(self, q: float) -> Optional[float]:
+        if not self.count:
+            return None
+        target = q * self.count
+        cum = 0.0
+        lo = 0.0
+        for i, edge in enumerate(self.buckets):
+            nxt = cum + self.counts[i]
+            if nxt >= target:
+                frac = (target - cum) / max(self.counts[i], 1)
+                est = lo + frac * (edge - lo)
+                return min(max(est, self.min), self.max)
+            cum = nxt
+            lo = edge
+        return self.max  # rank lands in the +Inf bucket
+
+    def stats(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "count": self.count,
+            "sum": round(self.sum, 6),
+            "min": self.min,
+            "max": self.max,
+            "mean": round(self.sum / self.count, 6) if self.count else None,
+        }
+        for q, label in ((0.5, "p50"), (0.9, "p90"), (0.99, "p99")):
+            v = self.quantile(q)
+            out[label] = round(v, 6) if v is not None else None
+        return out
+
+    def snapshot(self) -> "_Histogram":
+        h = _Histogram(self.buckets)
+        h.counts = list(self.counts)
+        h.count, h.sum, h.min, h.max = (self.count, self.sum,
+                                        self.min, self.max)
+        return h
+
+
+def _prom_name(name: str, *, seconds: bool = False) -> str:
+    """Stable ``alink_`` exposition name: dots/dashes to underscores,
+    ``*_s`` second-suffixed sources become ``*_seconds``."""
+    if seconds and name.endswith("_s"):
+        name = name[:-2]
+    s = re.sub(r"[^a-zA-Z0-9_]", "_", name)
+    if s and s[0].isdigit():
+        s = "_" + s
+    return "alink_" + s + ("_seconds" if seconds else "")
+
+
+def _prom_float(v: float) -> str:
+    return repr(round(float(v), 9))
+
 
 class StepMetrics:
-    """In-process metric streams: named series of {step, **values} dicts plus
-    aggregated timers and monotonic counters. One global instance
-    (``metrics``) serves the whole session; algorithms record cheaply,
-    callers read ``series``/``counters``/``summary``."""
+    """In-process metric streams: named series of {step, **values} dicts,
+    aggregated timers, fixed-bucket histograms, and monotonic counters. One
+    global instance (``metrics``) serves the whole session; algorithms
+    record cheaply, callers read ``series``/``counters``/``histogram``/
+    ``summary`` or export the lot as Prometheus text exposition."""
 
     def __init__(self):
         self._series: Dict[str, List[Dict[str, Any]]] = defaultdict(list)
         self._timers: Dict[str, List[float]] = defaultdict(list)
+        self._hists: Dict[str, _Histogram] = {}
         self._counters: Dict[str, int] = defaultdict(int)
         self._counter_lock = threading.Lock()
+        # one lock for series+timers+histograms: executor pool threads,
+        # transfer streams, and recovery chains record concurrently, and
+        # list.append / del-slice / defaultdict-materialize interleavings
+        # without it silently lose or duplicate records
+        self._data_lock = threading.Lock()
         self.enabled = True
 
     def record(self, name: str, **values):
         if self.enabled:
-            self._series[name].append(dict(values))
+            with self._data_lock:
+                self._series[name].append(dict(values))
 
     def record_bounded(self, name: str, limit: int, **values):
         """record() with a ring bound — high-frequency series (the executor
         emits per-node records on every collect/execute) must not grow
         without bound in long-lived serving processes."""
         if self.enabled:
-            s = self._series[name]
-            s.append(dict(values))
-            if len(s) > limit:
-                del s[: len(s) - limit]
+            with self._data_lock:
+                s = self._series[name]
+                s.append(dict(values))
+                if len(s) > limit:
+                    del s[: len(s) - limit]
 
     def add_time(self, name: str, seconds: float):
         if self.enabled:
-            self._timers[name].append(seconds)
+            with self._data_lock:
+                self._timers[name].append(seconds)
+
+    def observe(self, name: str, value: float,
+                buckets: Optional[Sequence[float]] = None):
+        """Record ``value`` into the fixed-bucket histogram ``name``
+        (created on first observe; ``buckets`` only applies then). Unlike
+        timers — which keep every sample — a histogram is O(buckets)
+        memory forever, which is what latency *distributions* on hot paths
+        (per-node wall, transfer seconds, chunk latency) need in a
+        long-lived serving process."""
+        if self.enabled:
+            with self._data_lock:
+                h = self._hists.get(name)
+                if h is None:
+                    h = self._hists[name] = _Histogram(
+                        buckets or DEFAULT_BUCKETS)
+                h.observe(value)
 
     def incr(self, name: str, n: int = 1):
         """Monotonic event counter (retries, dead-letter drops, defusions).
@@ -79,27 +197,49 @@ class StepMetrics:
                     if k.startswith(prefix)}
 
     def series(self, name: str) -> List[Dict[str, Any]]:
-        return list(self._series.get(name, []))
+        with self._data_lock:
+            return list(self._series.get(name, []))
 
     def last(self, name: str) -> Optional[Dict[str, Any]]:
-        s = self._series.get(name)
-        return dict(s[-1]) if s else None
+        with self._data_lock:
+            s = self._series.get(name)
+            return dict(s[-1]) if s else None
 
     def timer_stats(self, name: str) -> Optional[Dict[str, float]]:
-        ts = self._timers.get(name)
+        with self._data_lock:
+            ts = list(self._timers.get(name) or ())
         if not ts:
             return None
         return {"count": len(ts), "total_s": sum(ts),
                 "mean_s": sum(ts) / len(ts), "max_s": max(ts)}
 
+    def histogram(self, name: str) -> Optional[Dict[str, Any]]:
+        """count/sum/min/max/mean plus p50/p90/p99 estimates for one
+        histogram, or None if it was never observed."""
+        with self._data_lock:
+            h = self._hists.get(name)
+            h = h.snapshot() if h is not None else None
+        return h.stats() if h is not None else None
+
+    def histogram_names(self) -> List[str]:
+        with self._data_lock:
+            return sorted(self._hists)
+
     def summary(self) -> Dict[str, Any]:
         out: Dict[str, Any] = {}
-        for name in self._timers:
+        with self._data_lock:
+            timer_names = list(self._timers)
+            series_snap = {n: (len(s), s[-1] if s else None)
+                           for n, s in self._series.items()}
+            hist_snap = {n: h.snapshot() for n, h in self._hists.items()}
+        for name in timer_names:
             out[name] = self.timer_stats(name)
-        for name, s in self._series.items():
+        for name, (points, last) in series_snap.items():
             out.setdefault(name, {})
-            out[name] = {**(out[name] or {}), "points": len(s),
-                         "last": s[-1] if s else None}
+            out[name] = {**(out[name] or {}), "points": points, "last": last}
+        for name, h in hist_snap.items():
+            out.setdefault(name, {})
+            out[name] = {**(out[name] or {}), "histogram": h.stats()}
         for name, v in self.counters().items():
             out.setdefault(name, {})
             out[name] = {**(out[name] or {}), "count": v}
@@ -108,14 +248,75 @@ class StepMetrics:
     def to_json(self) -> str:
         return json.dumps(self.summary(), default=str)
 
+    def export_prometheus(self) -> str:
+        """Text exposition (Prometheus format 0.0.4) of every counter
+        (``alink_*_total``), timer (``alink_*_seconds`` count+sum summary),
+        and histogram (``alink_*_seconds`` with cumulative ``le`` buckets).
+        Names are stable ``alink_``-prefixed translations of the in-process
+        dotted names; a name claimed by an earlier family is skipped rather
+        than emitted twice (exposition must not repeat a metric)."""
+        lines: List[str] = []
+        seen: set = set()
+
+        for name, v in sorted(self.counters().items()):
+            m = _prom_name(name) + "_total"
+            if m in seen:
+                continue
+            seen.add(m)
+            lines.append(f"# TYPE {m} counter")
+            lines.append(f"{m} {v}")
+
+        with self._data_lock:
+            timers = {n: (len(ts), sum(ts))
+                      for n, ts in self._timers.items() if ts}
+            hists = {n: h.snapshot() for n, h in self._hists.items()}
+
+        for name, h in sorted(hists.items()):
+            m = _prom_name(name, seconds=True)
+            if m in seen:
+                continue
+            seen.add(m)
+            lines.append(f"# TYPE {m} histogram")
+            cum = 0
+            for edge, c in zip(h.buckets, h.counts):
+                cum += c
+                lines.append(
+                    f'{m}_bucket{{le="{_prom_float(edge)}"}} {cum}')
+            cum += h.counts[-1]
+            lines.append(f'{m}_bucket{{le="+Inf"}} {cum}')
+            lines.append(f"{m}_sum {_prom_float(h.sum)}")
+            lines.append(f"{m}_count {cum}")
+
+        for name, (count, total) in sorted(timers.items()):
+            m = _prom_name(name, seconds=True)
+            if m in seen:
+                continue
+            seen.add(m)
+            lines.append(f"# TYPE {m} summary")
+            lines.append(f"{m}_count {count}")
+            lines.append(f"{m}_sum {_prom_float(total)}")
+        return "\n".join(lines) + "\n"
+
     def reset(self):
-        self._series.clear()
-        self._timers.clear()
+        global _drop_logged
+        with self._data_lock:
+            self._series.clear()
+            self._timers.clear()
+            self._hists.clear()
         with self._counter_lock:
             self._counters.clear()
+        # re-arm the first-drop debug log: after a reset the operator is
+        # looking at a fresh window and the next drop is news again
+        _drop_logged = False
 
 
 metrics = StepMetrics()
+
+
+def export_prometheus() -> str:
+    """Module-level convenience over the global recorder — the function the
+    package root exports and ``GET /metrics`` serves."""
+    return metrics.export_prometheus()
 
 
 # ---------------------------------------------------------------------------
@@ -153,17 +354,20 @@ def executor_trace() -> List[Dict[str, Any]]:
 
 
 def executor_phase_summary() -> Dict[str, Any]:
-    """Aggregate the executor trace per op class: count, total wall, and the
-    transfer/compute split where nodes reported one."""
+    """Aggregate the executor trace per op class: count, total wall, and
+    every ``*_s`` phase nodes reported (transfer/compute/compile today;
+    any phase a new layer adds shows up without editing this summary)."""
     out: Dict[str, Dict[str, float]] = {}
     for rec in executor_trace():
         d = out.setdefault(rec.get("op", "?"),
                            {"count": 0, "wall_s": 0.0})
         d["count"] += 1
         d["wall_s"] = round(d["wall_s"] + rec.get("wall_s", 0.0), 6)
-        for k in ("transfer_s", "compute_s", "compile_s"):
-            if k in rec:
-                d[k] = round(d.get(k, 0.0) + rec[k], 6)
+        for k, v in rec.items():
+            if (k != "wall_s" and k.endswith("_s")
+                    and isinstance(v, (int, float))
+                    and not isinstance(v, bool)):
+                d[k] = round(d.get(k, 0.0) + v, 6)
     return out
 
 

@@ -68,6 +68,7 @@ from .exceptions import (AkIllegalArgumentException, AkIllegalStateException,
 from .faults import InjectedCrashError, maybe_fail
 from .metrics import metrics
 from .resilience import RetryPolicy, retries_enabled, with_retries
+from .tracing import attach_context, capture_context, trace_span
 
 logger = logging.getLogger("alink_tpu.recovery")
 
@@ -587,31 +588,40 @@ class CheckpointCoordinator:
 
     # -- epoch cut -----------------------------------------------------------
     def _cut_epoch(self, epoch: int, next_offset: int, final: bool) -> None:
-        t0 = time.perf_counter()
-        maybe_fail("recovery", label=f"epoch{epoch}.pre_snapshot")
-        op_states: Dict[str, Any] = {}
-        for key, op in self.job.iter_ops():
-            snap = op.state_snapshot()
-            if snap is not None:
-                op_states[key] = snap
-        sinks = self.job.all_sinks()
-        staged = {s.sink_id: s.staged() for s in sinks}
-        manifest = {
-            "source_offset": int(next_offset),
-            "epoch_chunks": self.job.epoch_chunks,
-            "complete": bool(final),
-            "sinks": {s.sink_id: {"committed": s.committed_epoch(self.store)}
-                      for s in sinks},
-        }
-        self.store.write_snapshot(epoch, manifest,
-                                  {"operators": op_states, "sinks": staged})
-        metrics.add_time("recovery.snapshot_s", time.perf_counter() - t0)
-        maybe_fail("recovery", label=f"epoch{epoch}.pre_commit")
-        t1 = time.perf_counter()
-        for s in sinks:
-            s.commit(epoch, s.staged(), self.store)
-            s.clear_staged()
-        metrics.add_time("recovery.commit_s", time.perf_counter() - t1)
+        with trace_span("recovery.epoch", epoch=epoch) as sp:
+            t0 = time.perf_counter()
+            maybe_fail("recovery", label=f"epoch{epoch}.pre_snapshot")
+            op_states: Dict[str, Any] = {}
+            for key, op in self.job.iter_ops():
+                snap = op.state_snapshot()
+                if snap is not None:
+                    op_states[key] = snap
+            sinks = self.job.all_sinks()
+            staged = {s.sink_id: s.staged() for s in sinks}
+            manifest = {
+                "source_offset": int(next_offset),
+                "epoch_chunks": self.job.epoch_chunks,
+                "complete": bool(final),
+                "sinks": {s.sink_id:
+                          {"committed": s.committed_epoch(self.store)}
+                          for s in sinks},
+            }
+            self.store.write_snapshot(
+                epoch, manifest, {"operators": op_states, "sinks": staged})
+            dt_snap = time.perf_counter() - t0
+            metrics.add_time("recovery.snapshot_s", dt_snap)
+            metrics.observe("recovery.snapshot_epoch_s", dt_snap)
+            maybe_fail("recovery", label=f"epoch{epoch}.pre_commit")
+            t1 = time.perf_counter()
+            for s in sinks:
+                s.commit(epoch, s.staged(), self.store)
+                s.clear_staged()
+            dt_commit = time.perf_counter() - t1
+            metrics.add_time("recovery.commit_s", dt_commit)
+            metrics.observe("recovery.commit_epoch_s", dt_commit)
+            if sp is not None:
+                sp.phases["snapshot_s"] = dt_snap
+                sp.phases["commit_s"] = dt_commit
         # every sink just committed `epoch`, so the min committed epoch —
         # the coordinator's ack floor — IS `epoch`; re-probing each sink's
         # marker here would be a redundant durable-store round per epoch
@@ -624,7 +634,13 @@ class CheckpointCoordinator:
         # epoch probes), so handle cleanup must cover it too — a failed
         # restore attempt under the supervisor must not leak wire producers
         try:
-            return self._run_inner()
+            with trace_span("recovery.run",
+                            checkpoint_dir=self.job.checkpoint_dir) as sp:
+                out = self._run_inner()
+                if sp is not None:
+                    sp.attrs["epochs"] = out.get("epochs")
+                    sp.attrs["restored"] = out.get("restored")
+                return out
         finally:
             for s in self.job.all_sinks():
                 s.close()
@@ -646,12 +662,13 @@ class CheckpointCoordinator:
                                      n_consumers=len(job.chains),
                                      skip_before=start_offset)
         threads: List[threading.Thread] = []
-        for ci, (ops, sinks) in enumerate(job.chains):
+        ctx = capture_context()  # chain spans parent to recovery.run even
+        for ci, (ops, sinks) in enumerate(job.chains):  # on their threads
             it: Iterator = self._consume(reader, ci, start_offset)
             for op in ops:
                 it = op._stream_impl(it)
             t = threading.Thread(
-                target=self._run_chain, args=(reader, ci, it, sinks),
+                target=self._run_chain, args=(reader, ci, it, sinks, ctx),
                 name=f"alink-recovery-chain{ci}", daemon=True)
             threads.append(t)
         for t in threads:
@@ -696,11 +713,17 @@ class CheckpointCoordinator:
 
     @staticmethod
     def _run_chain(reader: _SharedSourceReader, cid: int, it: Iterator,
-                   sinks: Sequence[TransactionalSink]) -> None:
+                   sinks: Sequence[TransactionalSink], ctx=None) -> None:
         try:
-            for out in it:
-                for s in sinks:
-                    s.stage(out)
+            with attach_context(ctx):
+                with trace_span(f"recovery.chain{cid}") as sp:
+                    n = 0
+                    for out in it:
+                        n += 1
+                        for s in sinks:
+                            s.stage(out)
+                    if sp is not None:
+                        sp.attrs["chunks_out"] = n
         except BaseException as exc:
             reader.fail(exc)
         finally:

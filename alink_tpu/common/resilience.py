@@ -149,6 +149,11 @@ def with_retries(
             metrics.incr("resilience.retries")
             if counter:
                 metrics.incr(counter)
+            # the active trace span (the DAG unit, transfer batch, or
+            # recovery epoch this call ran under) reads as `retried`
+            from .tracing import note_retry
+
+            note_retry()
             metrics.record_bounded(
                 "resilience.retry", _RETRY_TRACE_LIMIT, call=name,
                 attempt=attempt, error=type(exc).__name__,

@@ -34,10 +34,13 @@ from __future__ import annotations
 import hashlib
 import os
 import threading
+import time
 from collections import OrderedDict
 from typing import Any, Optional, Tuple
 
 import numpy as np
+
+from .metrics import metrics
 
 _WIRE_THRESHOLD_BYTES = 4 * 1024 * 1024
 _DEFAULT_MAX_BYTES = 2 * 1024 * 1024 * 1024
@@ -341,6 +344,7 @@ def stage_sharded(
     if hit is not None:
         out, _ = hit
     else:
+        t0 = time.perf_counter()
         padded = arr
         if pad_rows_to != n:
             pad_width = [(0, pad_rows_to - n)] + [(0, 0)] * (arr.ndim - 1)
@@ -351,6 +355,9 @@ def stage_sharded(
             dev = dev.astype(padded.dtype)  # restore the caller's dtype
         _cache.note_wire(sent=wire.nbytes,
                          saved=padded.nbytes - wire.nbytes if downcast else 0)
+        # host-side staging cost (pad + wire cast + transfer dispatch);
+        # device_put is async, so the on-wire tail is not in this number
+        metrics.observe("staging.transfer_s", time.perf_counter() - t0)
         out = dev
         _cache.put(key, (out, out.nbytes), out.nbytes)
 
@@ -387,6 +394,7 @@ def stage_replicated(arr: np.ndarray, mesh=None):
     hit = _cache.get(key)
     if hit is not None:
         return hit[0]
+    t0 = time.perf_counter()
     wire, downcast = _wire_cast(arr)
     dev = jax.device_put(wire, sharding) if sharding is not None else \
         jax.device_put(wire)
@@ -394,5 +402,6 @@ def stage_replicated(arr: np.ndarray, mesh=None):
         dev = dev.astype(arr.dtype)  # restore the caller's dtype
     _cache.note_wire(sent=wire.nbytes,
                      saved=arr.nbytes - wire.nbytes if downcast else 0)
+    metrics.observe("staging.transfer_s", time.perf_counter() - t0)
     _cache.put(key, (dev, dev.nbytes), dev.nbytes)
     return dev

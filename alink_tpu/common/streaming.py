@@ -109,8 +109,9 @@ def stream_map(
     ``device_put`` is idempotent; the ``transfer`` fault-injection point
     fires before every attempt."""
     from .faults import maybe_fail
-    from .metrics import add_node_phase
+    from .metrics import add_node_phase, metrics
     from .resilience import with_retries
+    from .tracing import attach_context, capture_context
 
     if use_cache == "auto":
         from .staging import wire_is_slow
@@ -123,6 +124,10 @@ def stream_map(
     depth = stream_depth(DEFAULT_DEPTH) if depth is None else max(1, depth)
     split = max(1, int(split))
     pool = transfer_pool()
+    # transfers run on shared alink-h2d threads: carry the caller's trace
+    # context across the handoff so a retried transfer marks the caller's
+    # span (the DAG unit / stream op) `retried`, not an orphan
+    tctx = capture_context()
 
     def timed_put(arrays):
         def attempt():
@@ -130,8 +135,9 @@ def stream_map(
             return put(arrays)
 
         t0 = time.perf_counter()
-        devs = with_retries(attempt, name="h2d.transfer",
-                            counter="resilience.transfer_retries")
+        with attach_context(tctx):
+            devs = with_retries(attempt, name="h2d.transfer",
+                                counter="resilience.transfer_retries")
         return devs, t0, time.perf_counter()
 
     def submit(arrays):
@@ -183,12 +189,14 @@ def stream_map(
         meta, handle = inflight.popleft()
         devs, dt_put = gather(handle)
         add_node_phase("transfer_s", dt_put)
+        metrics.observe("stream.transfer_s", dt_put)
         if phases is not None:
             phases["transfer_s"] = phases.get("transfer_s", 0.0) + dt_put
         t0 = time.perf_counter()
         out = fn(*devs)
         dt_fn = time.perf_counter() - t0
         add_node_phase("compute_s", dt_fn)
+        metrics.observe("stream.compute_s", dt_fn)
         if phases is not None:
             phases["compute_s"] = phases.get("compute_s", 0.0) + dt_fn
             phases["batches"] = phases.get("batches", 0) + 1

@@ -1,0 +1,377 @@
+"""Job-scoped span tracing — the Dapper-style correlation layer.
+
+Four PRs of runtime work left the platform with strong but *island* signals:
+per-node executor phase records, ``jit.*`` compile counters,
+``resilience_summary()``, checkpoint epochs. None of them answer the one
+question an operator actually asks: *what did THIS job run spend its time
+on, and where?* This module adds the missing correlation key — a trace id —
+and the span tree under it:
+
+- :func:`trace_span` — context-managed span: trace id / span id / parent id,
+  wall time, per-phase seconds (compile/transfer/compute, fed by the same
+  ``node_phase_context`` plumbing the executor already uses), and an outcome
+  (``ok`` / ``retried`` / ``failed`` / ``defused``). Spans nest through a
+  thread-local; :func:`capture_context` + :func:`attach_context` carry the
+  parent across explicit thread handoffs (the ``alink-dag`` executor pool,
+  ``alink-h2d`` transfer streams, recovery chain threads), so a span started
+  on a worker thread still parents correctly.
+- :class:`Tracer` — process-wide finished-span sink: a bounded in-memory
+  ring (``ALINK_TRACE_RING``, default 4096 spans) plus an optional append-
+  only JSONL event log (``ALINK_TRACE_LOG=<path>``; one JSON object per
+  finished span, crash-greppable).
+- :func:`job_report` — one dict per job run: the span tree (one span per
+  scheduled DAG unit, fused chains as ONE span with a ``fused`` mark), the
+  compile/transfer/compute split, retries absorbed, outcome counts, and the
+  program-/staging-cache hit rates active during the run.
+
+Everything is gated behind ``ALINK_TRACING`` (default **on**; ``off``
+restores zero-span execution). The gate is read per span open, so a test or
+a latency-critical section can flip it at runtime. Tracing NEVER changes
+results — the bit-parity contract is CI-pinned in
+``tests/test_observability.py`` and the measured overhead budget (<3% wall
+on kmeans_iris) is tracked by the BENCH ``observability`` extra.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import json
+import os
+import threading
+import time
+import uuid
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from .env import env_flag, env_int
+from .metrics import metrics
+
+_RING_DEFAULT = 4096
+
+_span_ids = itertools.count(1)
+
+
+def tracing_enabled() -> bool:
+    """``ALINK_TRACING=off`` disables span recording entirely (the
+    histogram/counter layer in ``common/metrics.py`` stays on — it predates
+    tracing and other readouts depend on it)."""
+    return env_flag("ALINK_TRACING", default=True)
+
+
+class Span:
+    """One traced unit of work. Mutable while open; callers may set
+    ``outcome`` explicitly (``defused``), add ``phases`` seconds, or attach
+    ``attrs``; everything else is filled by the tracer."""
+
+    __slots__ = ("trace_id", "span_id", "parent_id", "name", "t_start",
+                 "start_perf", "wall_s", "phases", "outcome", "retries",
+                 "attrs", "thread", "error")
+
+    def __init__(self, trace_id: str, span_id: str, parent_id: Optional[str],
+                 name: str, attrs: Dict[str, Any]):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.name = name
+        self.t_start = time.time()
+        self.start_perf = time.perf_counter()
+        self.wall_s: float = 0.0
+        self.phases: Dict[str, float] = {}
+        self.outcome: Optional[str] = None
+        self.retries = 0
+        self.attrs = attrs
+        self.thread = threading.current_thread().name
+        self.error: Optional[str] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "name": self.name,
+            "t_start": round(self.t_start, 6),
+            "start_perf": self.start_perf,
+            "wall_s": round(self.wall_s, 6),
+            "outcome": self.outcome,
+            "thread": self.thread,
+        }
+        if self.phases:
+            d["phases"] = {k: round(v, 6) if isinstance(v, float) else v
+                           for k, v in self.phases.items()}
+        if self.retries:
+            d["retries"] = self.retries
+        if self.attrs:
+            d["attrs"] = self.attrs
+        if self.error:
+            d["error"] = self.error
+        return d
+
+
+_ctx = threading.local()
+
+
+def current_span() -> Optional[Span]:
+    return getattr(_ctx, "span", None)
+
+
+def capture_context() -> Optional[Span]:
+    """The active span — the token a thread handoff carries so work on the
+    other thread parents correctly AND feeds the span's retry accounting
+    (:func:`note_retry` on a transfer thread must mark the owning span).
+    None when no span is open (or tracing is off): attaching None is a
+    no-op."""
+    return current_span()
+
+
+@contextlib.contextmanager
+def attach_context(token: Optional[Span]):
+    """Install a captured span as this thread's span parent for the
+    duration (executor pool workers, transfer streams, recovery chains).
+    Restores the previous context on exit — pool threads are reused."""
+    if token is None:
+        yield
+        return
+    prev = getattr(_ctx, "span", None)
+    _ctx.span = token
+    try:
+        yield
+    finally:
+        _ctx.span = prev
+
+
+class Tracer:
+    """Process-wide finished-span sink: bounded ring + optional JSONL log."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=max(16, env_int(
+            "ALINK_TRACE_RING", _RING_DEFAULT)))
+        self._log_lock = threading.Lock()
+        self._log_path: Optional[str] = None
+        self._log_file = None
+
+    # -- span lifecycle ------------------------------------------------------
+    def start(self, name: str, **attrs) -> Span:
+        parent = current_span()
+        if parent is None:
+            trace_id = uuid.uuid4().hex[:16]
+            parent_id = None
+        else:
+            trace_id = parent.trace_id
+            parent_id = parent.span_id
+        span_id = f"{next(_span_ids):x}"
+        return Span(trace_id, span_id, parent_id, name,
+                    {k: v for k, v in attrs.items() if v is not None})
+
+    def finish(self, span: Span) -> None:
+        span.wall_s = time.perf_counter() - span.start_perf
+        if span.outcome is None:
+            span.outcome = "retried" if span.retries else "ok"
+        metrics.incr("trace.spans")
+        metrics.observe("trace.span_s", span.wall_s)
+        with self._lock:
+            self._ring.append(span.to_dict())
+        self._log(span)
+
+    def _log(self, span: Span) -> None:
+        path = os.environ.get("ALINK_TRACE_LOG")
+        if not path:
+            return
+        rec = span.to_dict()
+        rec.pop("start_perf", None)  # process-local; meaningless in a file
+        try:
+            with self._log_lock:
+                if self._log_file is None or self._log_path != path:
+                    if self._log_file is not None:
+                        self._log_file.close()
+                    self._log_file = open(path, "a")
+                    self._log_path = path
+                self._log_file.write(json.dumps(rec, default=str) + "\n")
+                self._log_file.flush()
+        except OSError:
+            metrics.incr("trace.log_errors")
+
+    # -- readouts ------------------------------------------------------------
+    def spans(self, trace_id: Optional[str] = None) -> List[Dict[str, Any]]:
+        """Finished spans (dicts), oldest first; filtered to one trace when
+        ``trace_id`` is given."""
+        with self._lock:
+            out = list(self._ring)
+        if trace_id is not None:
+            out = [s for s in out if s["trace_id"] == trace_id]
+        return out
+
+    def last_trace_id(self) -> Optional[str]:
+        """Trace id of the most recently finished ROOT span (a root is a
+        span with no parent — one per job run)."""
+        with self._lock:
+            for s in reversed(self._ring):
+                if s["parent_id"] is None:
+                    return s["trace_id"]
+        return None
+
+    def traces(self, limit: int = 50) -> List[Dict[str, Any]]:
+        """Most-recent-first summaries of the traces still in the ring:
+        trace id, root span name, wall, span count, worst outcome."""
+        with self._lock:
+            spans = list(self._ring)
+        by_trace: Dict[str, List[Dict[str, Any]]] = {}
+        order: List[str] = []
+        for s in spans:
+            if s["trace_id"] not in by_trace:
+                order.append(s["trace_id"])
+            by_trace.setdefault(s["trace_id"], []).append(s)
+        out = []
+        for tid in reversed(order):
+            ss = by_trace[tid]
+            root = next((s for s in ss if s["parent_id"] is None), None)
+            bad = next((s["outcome"] for s in ss
+                        if s["outcome"] == "failed"), None)
+            out.append({
+                "trace_id": tid,
+                "root": root["name"] if root else ss[0]["name"],
+                "t_start": (root or ss[0])["t_start"],
+                "wall_s": (root or ss[0])["wall_s"],
+                "spans": len(ss),
+                "outcome": bad or (root["outcome"] if root else "ok"),
+            })
+            if len(out) >= limit:
+                break
+        return out
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring = deque(maxlen=max(16, env_int(
+                "ALINK_TRACE_RING", _RING_DEFAULT)))
+        with self._log_lock:
+            if self._log_file is not None:
+                self._log_file.close()
+                self._log_file = None
+                self._log_path = None
+
+
+tracer = Tracer()
+
+
+@contextlib.contextmanager
+def trace_span(name: str, **attrs):
+    """Open a span around a block::
+
+        with trace_span("kmeans.fit", rows=n) as sp:
+            ...
+
+    Yields the open :class:`Span` (set ``sp.outcome``/``sp.phases``/
+    ``sp.attrs`` freely) or ``None`` when tracing is off — callers must
+    guard attribute access with ``if sp is not None``. An exception marks
+    the span ``failed`` (error type + message recorded) and propagates
+    unchanged. Spans opened on the same thread nest automatically; use
+    :func:`capture_context`/:func:`attach_context` across threads."""
+    if not tracing_enabled():
+        yield None
+        return
+    span = tracer.start(name, **attrs)
+    prev = getattr(_ctx, "span", None)
+    _ctx.span = span
+    try:
+        yield span
+    except BaseException as e:
+        span.outcome = "failed"
+        span.error = f"{type(e).__name__}: {e}"[:200]
+        raise
+    finally:
+        _ctx.span = prev
+        tracer.finish(span)
+
+
+def note_retry() -> None:
+    """Called by the resilience layer on every retry sleep: bumps the
+    active span's retry count so the span's outcome reads ``retried`` even
+    though the call ultimately succeeded. No-op outside a span."""
+    sp = current_span()
+    if sp is not None:
+        sp.retries += 1
+
+
+# ---------------------------------------------------------------------------
+# Job report
+# ---------------------------------------------------------------------------
+
+
+def _span_tree(spans: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    by_id = {s["span_id"]: dict(s, children=[]) for s in spans}
+    roots: List[Dict[str, Any]] = []
+    for s in by_id.values():
+        parent = by_id.get(s["parent_id"]) if s["parent_id"] else None
+        if parent is not None:
+            parent["children"].append(s)
+        else:
+            roots.append(s)
+    base = min((s["start_perf"] for s in by_id.values()), default=0.0)
+    for s in by_id.values():
+        s["rel_start_s"] = round(s.pop("start_perf") - base, 6)
+        s["children"].sort(key=lambda c: c["rel_start_s"])
+    roots.sort(key=lambda c: c["rel_start_s"])
+    return roots
+
+
+def job_report(trace_id: Optional[str] = None) -> Dict[str, Any]:
+    """One dict per job run: the DAG-shaped span tree plus the aggregate
+    split an operator wants first.
+
+    ``trace_id=None`` reports the most recently finished root span's trace.
+    Returns ``{"error": ...}`` when the trace is unknown (or tracing was
+    off), never raises — this feeds an HTTP endpoint."""
+    if trace_id is None:
+        trace_id = tracer.last_trace_id()
+        if trace_id is None:
+            return {"error": "no traces recorded "
+                             "(is ALINK_TRACING off?)"}
+    spans = tracer.spans(trace_id)
+    if not spans:
+        return {"error": f"unknown trace {trace_id!r}"}
+    totals: Dict[str, float] = {}
+    outcomes: Dict[str, int] = {}
+    retries = 0
+    for s in spans:
+        outcomes[s["outcome"]] = outcomes.get(s["outcome"], 0) + 1
+        retries += s.get("retries", 0)
+        for k, v in (s.get("phases") or {}).items():
+            if k.endswith("_s") and isinstance(v, (int, float)):
+                totals[k] = round(totals.get(k, 0.0) + v, 6)
+    tree = _span_tree(spans)
+    root = tree[0] if tree else None
+    caches: Dict[str, Any] = {}
+    try:
+        from .jitcache import compile_summary
+
+        cs = compile_summary()
+        caches["programs"] = {"hit_rate": cs["hit_rate"],
+                              "cached": cs["programs"]}
+    except Exception:
+        pass
+    try:
+        from .staging import staging_cache_stats
+
+        st = staging_cache_stats()
+        hits, misses = st.get("hits", 0), st.get("misses", 0)
+        caches["staging"] = {
+            "hit_rate": round(hits / (hits + misses), 4)
+            if hits + misses else None,
+            "wire_bytes_sent": st.get("wire_bytes_sent"),
+        }
+    except Exception:
+        pass
+    return {
+        "trace_id": trace_id,
+        "root": None if root is None else
+        {"name": root["name"], "wall_s": root["wall_s"],
+         "outcome": root["outcome"]},
+        "spans": [{k: v for k, v in s.items() if k != "start_perf"}
+                  for s in spans],
+        "tree": tree,
+        "totals": totals,
+        "retries": retries,
+        "outcomes": outcomes,
+        "caches": caches,
+    }

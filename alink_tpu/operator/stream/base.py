@@ -15,6 +15,7 @@ each micro-batch is one jit launch instead of a per-row hot loop.
 from __future__ import annotations
 
 import itertools
+import time
 from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence
 
 import numpy as np
@@ -24,8 +25,10 @@ from ...common.exceptions import (
     AkIllegalOperationException,
     AkIllegalStateException,
 )
+from ...common.metrics import metrics
 from ...common.mtable import MTable, TableSchema
 from ...common.params import ParamInfo, WithParams
+from ...common.tracing import trace_span
 
 
 class StreamOperator(WithParams):
@@ -102,11 +105,26 @@ class StreamOperator(WithParams):
 
     # -- results -----------------------------------------------------------
     def collect(self) -> MTable:
-        """Run the stream to exhaustion and concatenate all micro-batches."""
-        chunks = list(self._stream())
-        if not chunks:
-            raise AkIllegalStateException("stream produced no data")
-        return MTable.concat(chunks)
+        """Run the stream to exhaustion and concatenate all micro-batches.
+
+        Each chunk's end-to-end latency (source pull through this
+        operator's transform) lands in the ``stream.chunk_s`` histogram;
+        the whole drain is one ``stream.collect`` span."""
+        chunks = []
+        with trace_span("stream.collect",
+                        op=type(self).__name__) as sp:
+            t_prev = time.perf_counter()
+            for chunk in self._stream():
+                now = time.perf_counter()
+                metrics.observe("stream.chunk_s", now - t_prev)
+                t_prev = now
+                chunks.append(chunk)
+            if sp is not None:
+                sp.attrs["chunks"] = len(chunks)
+            if not chunks:       # inside the span: a failed collect must
+                raise AkIllegalStateException(  # not record an ok span
+                    "stream produced no data")
+            return MTable.concat(chunks)
 
     def print(self, n: int = 20) -> "StreamOperator":
         t = self.collect()

@@ -17,7 +17,9 @@ import numpy as np
 
 from ..common.catalog import list_operators, op_info
 from ..common.exceptions import AkIllegalArgumentException
+from ..common.metrics import metrics
 from ..common.mtable import MTable
+from ..common.tracing import job_report, trace_span, tracer
 
 
 # -- op registry --------------------------------------------------------------
@@ -77,8 +79,23 @@ def run_experiment(exp: dict) -> Dict[str, dict]:
     """Execute an experiment {nodes: [{id, op, params}], edges: [{src, dst,
     dstPort?}]} and return per-node output payloads (table head + schema).
 
+    The whole run is ONE trace (root span ``webui.run_experiment``): every
+    node's ``collect()`` parents its DAG spans under it, so
+    ``job_report(results["__trace_id__"])`` — or the UI's Traces panel —
+    shows the experiment as a single waterfall. The trace id rides the
+    result dict under the reserved ``__trace_id__`` key (None when
+    ``ALINK_TRACING=off``).
+
     ``MemSourceBatchOp`` nodes take ``rows`` + ``schemaStr`` params inline
     (the WebUI's data-entry node)."""
+    with trace_span("webui.run_experiment",
+                    experiment=exp.get("name")) as sp:
+        results = _run_experiment_inner(exp)
+    results["__trace_id__"] = sp.trace_id if sp is not None else None
+    return results
+
+
+def _run_experiment_inner(exp: dict) -> Dict[str, dict]:
     nodes = {n["id"]: n for n in exp.get("nodes", [])}
     edges = exp.get("edges", [])
     idx = op_index()
@@ -236,9 +253,12 @@ class _Handler(BaseHTTPRequestHandler):
 
     # -- helpers --
     def _send_json(self, obj, code: int = 200):
-        data = json.dumps(obj).encode("utf-8")
+        self._send_text(json.dumps(obj), "application/json", code)
+
+    def _send_text(self, text: str, ctype: str, code: int = 200):
+        data = text.encode("utf-8")
         self.send_response(code)
-        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(data)))
         self.end_headers()
         self.wfile.write(data)
@@ -258,6 +278,12 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             if not parts or parts == ["index.html"]:
                 return self._static("index.html")
+            if parts == ["metrics"]:
+                # Prometheus text exposition of the live process metrics —
+                # point a scraper at a serving WebUI and it just works
+                return self._send_text(
+                    metrics.export_prometheus(),
+                    "text/plain; version=0.0.4; charset=utf-8")
             if parts[0] == "api":
                 return self._api_get(parts[1:])
             return self._static("/".join(parts))
@@ -277,8 +303,10 @@ class _Handler(BaseHTTPRequestHandler):
                     if exp is None:
                         return self._send_json(
                             {"error": "no such experiment"}, 404)
+                    results = run_experiment(exp)
+                    trace_id = results.pop("__trace_id__", None)
                     return self._send_json(
-                        {"results": run_experiment(exp)})
+                        {"results": results, "trace_id": trace_id})
             self._send_json({"error": "not found"}, 404)
         except BrokenPipeError:
             pass
@@ -324,6 +352,13 @@ class _Handler(BaseHTTPRequestHandler):
             if cls is None:
                 return self._send_json({"error": "unknown op"}, 404)
             return self._send_json(op_info(cls))
+        if parts == ["traces"]:
+            return self._send_json({"traces": tracer.traces()})
+        if len(parts) == 2 and parts[0] == "traces":
+            rep = job_report(parts[1])
+            if "error" in rep:
+                return self._send_json(rep, 404)
+            return self._send_json(rep)
         if parts == ["experiments"]:
             return self._send_json({"experiments": self.store.list()})
         if len(parts) == 2 and parts[0] == "experiments":

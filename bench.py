@@ -852,6 +852,139 @@ def bench_compile():
     return out
 
 
+def bench_observability(repeats=3):
+    """Unified tracing & telemetry layer (common/tracing.py + the metrics
+    histogram/Prometheus export): run kmeans_iris with ALINK_TRACING=off vs
+    on and report the overhead delta (budget: <3% wall, README-documented),
+    the tracing-on vs -off bit-parity of predictions, the exported-metric
+    counts by family, and the span count of the run's job_report."""
+    from alink_tpu.common.metrics import metrics
+    from alink_tpu.common.tracing import job_report
+    from alink_tpu.operator.batch.base import CsvSourceBatchOp
+    from alink_tpu.pipeline import KMeans, Pipeline
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "data", "iris.csv")
+    src = CsvSourceBatchOp(
+        filePath=path,
+        schemaStr="sl double, sw double, pl double, pw double, species string")
+
+    def kmeans_once():
+        pipe = Pipeline(KMeans(
+            k=3, maxIter=50, featureCols=["sl", "sw", "pl", "pw"],
+            predictionCol="pred"))
+        out = pipe.fit(src).transform(src).collect()
+        return np.asarray(out.col("pred"))
+
+    def mapper_dag_once():
+        # fallback workload when kmeans cannot run (e.g. a container whose
+        # jax dropped shard_map): the same executor + jit surface without a
+        # mesh — branches on the DAG pool plus a fused block-kernel chain
+        from alink_tpu.common.mtable import AlinkTypes, MTable
+        from alink_tpu.mapper.base import BlockKernelMapper
+        from alink_tpu.operator.batch import TableSourceBatchOp
+        from alink_tpu.operator.batch.utils import MapBatchOp
+
+        def affine(col, out_col, a, b):
+            class _M(BlockKernelMapper):
+                def kernel(self, schema):
+                    return ([col], [out_col], [AlinkTypes.DOUBLE],
+                            lambda X: X * a + b)
+
+            class _Op(MapBatchOp):
+                mapper_cls = _M
+
+            return _Op()
+
+        rng = np.random.RandomState(0)
+        t_src = TableSourceBatchOp(
+            MTable({"x": rng.rand(2_000_000), "y": rng.rand(2_000_000)}))
+        t_src.apply_func(
+            lambda m: MTable({"y": np.asarray(m.col("y")) * 2.0}),
+            out_schema="y double").lazy_collect(lambda m: None)
+        chain = affine("x", "x1", 2.0, 1.0).link_from(t_src)
+        chain = affine("x1", "x2", 0.5, -3.0).link_from(chain)
+        chain = affine("x2", "x3", 4.0, 0.25).link_from(chain)
+        return np.asarray(chain.collect().col("x3"))
+
+    workload, run_once = "kmeans_iris", kmeans_once
+    try:
+        run_once()  # compile / program-cache warm, outside both windows
+    except Exception:
+        workload, run_once = "mapper_dag", mapper_dag_once
+        run_once()
+
+    # interleave off/on repetitions (min per flag): a block of off-runs
+    # followed by a block of on-runs would charge allocator/page-cache
+    # drift between the blocks to tracing
+    walls = {"off": [], "on": []}
+    outs = {}
+    prev = os.environ.get("ALINK_TRACING")
+    try:
+        for _ in range(repeats):
+            for flag in ("off", "on"):
+                os.environ["ALINK_TRACING"] = flag
+                t0 = time.perf_counter()
+                outs[flag] = run_once()
+                walls[flag].append(time.perf_counter() - t0)
+    finally:
+        if prev is None:
+            os.environ.pop("ALINK_TRACING", None)
+        else:
+            os.environ["ALINK_TRACING"] = prev
+    off_wall, on_wall = min(walls["off"]), min(walls["on"])
+
+    parity = bool(np.array_equal(outs["off"], outs["on"]))
+    report = job_report()  # the last traced run — BEFORE the span
+    # microbenchmark below floods the ring with its own root spans
+
+    # deterministic per-span microbenchmark: the end-to-end delta above
+    # rides a shared container's noise floor (±5% on a 70ms workload); the
+    # direct cost of one open+close is the stable number the <3% budget is
+    # audited against (a job traces O(nodes) spans, so spans_per_job *
+    # span_cost / wall is the true tax)
+    from alink_tpu.common.tracing import trace_span
+
+    os.environ["ALINK_TRACING"] = "on"
+    try:
+        for _ in range(100):
+            with trace_span("bench.warm"):
+                pass
+        t0 = time.perf_counter()
+        for _ in range(2000):
+            with trace_span("bench.span"):
+                pass
+        span_us = (time.perf_counter() - t0) / 2000 * 1e6
+    finally:
+        if prev is None:
+            os.environ.pop("ALINK_TRACING", None)
+        else:
+            os.environ["ALINK_TRACING"] = prev
+    overhead = on_wall / off_wall - 1.0 if off_wall > 0 else None
+    kinds: dict = {}
+    for line in metrics.export_prometheus().splitlines():
+        if line.startswith("# TYPE"):
+            kinds[line.rsplit(" ", 1)[-1]] = \
+                kinds.get(line.rsplit(" ", 1)[-1], 0) + 1
+    return {
+        "workload": workload,
+        "tracing_off_wall_s": round(off_wall, 4),
+        "tracing_on_wall_s": round(on_wall, 4),
+        "overhead_pct": round(overhead * 100, 2)
+        if overhead is not None else None,
+        "within_3pct_budget": overhead is not None and overhead < 0.03,
+        "span_cost_us": round(span_us, 2),
+        "bit_parity_on_vs_off": parity,
+        "exported_metrics": {"total": sum(kinds.values()), **kinds},
+        "job_report": {
+            "trace_id": report.get("trace_id"),
+            "spans": len(report.get("spans", [])),
+            "totals": report.get("totals"),
+            "outcomes": report.get("outcomes"),
+        },
+    }
+
+
 def main():
     extras = {}
     for name, fn in (
@@ -866,6 +999,7 @@ def main():
         ("resilience", bench_resilience),
         ("recovery", bench_recovery),
         ("compile", bench_compile),
+        ("observability", bench_observability),
     ):
         try:
             extras[name] = fn()

@@ -2,6 +2,7 @@
 (reference: webui/server ServerApplication.java + controllers)."""
 
 import json
+import urllib.error
 import urllib.request
 
 import pytest
@@ -126,6 +127,7 @@ def test_canvas_multiport_dag():
         ],
     }
     results = run_experiment(exp)
+    results.pop("__trace_id__", None)  # reserved key, not a node result
     assert all(r["status"] == "ok" for r in results.values()), results
     tbl = results["n3"]["table"]
     assert [c["name"] for c in tbl["schema"]] == ["x", "y", "cluster"]
@@ -133,6 +135,41 @@ def test_canvas_multiport_dag():
     assert clusters[0] == clusters[1] == clusters[4]
     assert clusters[2] == clusters[3] == clusters[5]
     assert clusters[0] != clusters[2]
+
+
+@pytest.mark.observability
+def test_metrics_endpoint_and_traces(server, monkeypatch):
+    """GET /metrics serves Prometheus text exposition; a run returns its
+    trace id and /api/traces/<id> reports the experiment's span tree."""
+    import re
+
+    monkeypatch.setenv("ALINK_TRACING", "on")
+    eid = _req(server.port, "/api/experiments", "POST", THREE_NODE_DAG)["id"]
+    out = _req(server.port, f"/api/experiments/{eid}/run", "POST")
+    assert out["results"]["sql"]["status"] == "ok"
+    tid = out["trace_id"]
+    assert tid
+
+    traces = _req(server.port, "/api/traces")["traces"]
+    assert any(t["trace_id"] == tid for t in traces)
+    rep = _req(server.port, f"/api/traces/{tid}")
+    assert rep["root"]["name"] == "webui.run_experiment"
+    assert all(s["trace_id"] == tid for s in rep["spans"])
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _req(server.port, "/api/traces/deadbeef00000000")
+    assert ei.value.code == 404
+
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/metrics", timeout=30) as r:
+        ctype = r.headers.get("Content-Type", "")
+        text = r.read().decode()
+    assert ctype.startswith("text/plain")
+    body = [l for l in text.splitlines() if l and not l.startswith("#")]
+    assert body and all(
+        re.match(r'^alink_[a-zA-Z0-9_]+(\{le="[^"]+"\})? \S+$', l)
+        for l in body), body[:5]
+    assert any("_bucket{le=" in l for l in body)   # >= one histogram
+    assert any(l.startswith("alink_trace_spans_total") for l in body)
 
 
 def test_canvas_page_has_ports_and_forms(server):
