@@ -8,7 +8,9 @@ from .exceptions import (
     AkUnsupportedOperationException,
     AkExecutionErrorException,
     AkCircuitOpenException,
+    AkDeadlineExceededException,
     AkRetryableException,
+    AkServingOverloadException,
     AkPreconditions,
     is_retryable,
     mark_retryable,

@@ -84,6 +84,22 @@ class AkCircuitOpenException(AkRetryableException):
     code = "AK_CIRCUIT_OPEN"
 
 
+class AkServingOverloadException(AkRetryableException):
+    """The serving tier shed this request at admission: the target model's
+    bounded queue is past its high-water mark. Retryable by contract —
+    the client should back off and resubmit (HTTP surface: 429)."""
+
+    code = "AK_SERVING_OVERLOAD"
+
+
+class AkDeadlineExceededException(AkException):
+    """The caller's deadline expired before the work completed. NOT
+    retryable — the budget is spent; resubmitting with a fresh deadline is
+    a caller decision (HTTP surface: 504)."""
+
+    code = "AK_DEADLINE_EXCEEDED"
+
+
 # OSError subclasses that signal a *state* problem, not a transient one —
 # retrying "file not found" only burns the deadline budget
 _NON_TRANSIENT_OS = (

@@ -248,6 +248,17 @@ class CircuitBreaker:
             return b
 
     @classmethod
+    def replace_endpoint(cls, key: str, **kwargs) -> "CircuitBreaker":
+        """Install a FRESH breaker under ``key`` and return it. For
+        endpoints whose backing resource was replaced (a hot-swapped
+        serving model): the new resource must not inherit the retired
+        one's failure history, and callers still holding the old breaker
+        object keep feeding it in isolation."""
+        with cls._registry_lock:
+            b = cls._registry[key] = cls(name=key, **kwargs)
+            return b
+
+    @classmethod
     def reset_all(cls) -> None:
         with cls._registry_lock:
             cls._registry.clear()

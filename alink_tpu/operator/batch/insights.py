@@ -257,16 +257,30 @@ def _seasonality(vals: np.ndarray):
 
 class AutoDiscoveryBatchOp(BatchOperator, HasSelectedCols):
     """(reference: common/insights/AutoDiscovery.java:19 ``find(data,
-    limitedSeconds)``; detector taxonomy InsightType.java)"""
+    limitedSeconds)``; detector taxonomy InsightType.java)
+
+    **Time-budget contract** (``timeLimitSeconds``): discovery is
+    best-effort under the budget. Every mining stage (column quality,
+    correlations, subject mining, subspace drill-down, 2-D clustering)
+    checks the deadline between units of work and stops early when it is
+    exhausted — the op then RETURNS the findings ranked so far instead of
+    silently overrunning. An exhausted budget is observable: the
+    ``insights.time_budget_exhausted`` counter is bumped once per run that
+    was cut short. The return value is always a valid findings table (at
+    worst empty, with the standard schema)."""
 
     TOP_N = ParamInfo("topN", int, default=20)
-    TIME_LIMIT_SECONDS = ParamInfo("timeLimitSeconds", float, default=30.0)
+    TIME_LIMIT_SECONDS = ParamInfo(
+        "timeLimitSeconds", float, default=30.0,
+        desc="wall budget for discovery; on exhaustion the findings "
+             "collected so far are ranked and returned (best-effort)")
 
     _min_inputs = 1
     _max_inputs = 1
 
     def _execute_impl(self, t: MTable) -> MTable:
         deadline = time.monotonic() + float(self.get(self.TIME_LIMIT_SECONDS))
+        self._budget_hit = False
         findings: List[Tuple[str, str, float, str, str]] = []
         cols = list(self.get(HasSelectedCols.SELECTED_COLS) or t.names)
         numeric = [c for c in cols
@@ -280,8 +294,8 @@ class AutoDiscoveryBatchOp(BatchOperator, HasSelectedCols):
         cat_arrays: Dict[str, np.ndarray] = {
             c: np.asarray(t.col(c), object).astype(str) for c in categorical}
 
-        self._column_findings(findings, num_arrays, cat_arrays, n)
-        self._correlations(findings, t, numeric)
+        self._column_findings(findings, num_arrays, cat_arrays, n, deadline)
+        self._correlations(findings, t, numeric, deadline)
 
         # breakdown subjects in the full space (impact 1.0), then within the
         # highest-impact subspaces (reference: AutoDiscovery.find — the
@@ -294,7 +308,7 @@ class AutoDiscoveryBatchOp(BatchOperator, HasSelectedCols):
 
         for sub_col, sub_val, impact in self._top_subspaces(
                 cat_arrays, num_arrays, n):
-            if time.monotonic() > deadline:
+            if self._expired(deadline):
                 break
             sel = cat_arrays[sub_col] == sub_val
             sub_cats = {c: v[sel] for c, v in cat_arrays.items()
@@ -309,6 +323,11 @@ class AutoDiscoveryBatchOp(BatchOperator, HasSelectedCols):
 
         self._clustering_2d(findings, num_arrays, deadline)
 
+        if self._budget_hit:  # only runs that actually truncated work count
+            from ...common.metrics import metrics
+
+            metrics.incr("insights.time_budget_exhausted")
+
         findings = self._rank(findings)[: self.get(self.TOP_N)]
         if not findings:
             return MTable(
@@ -317,12 +336,22 @@ class AutoDiscoveryBatchOp(BatchOperator, HasSelectedCols):
                  for k in _INSIGHT_SCHEMA.names}, _INSIGHT_SCHEMA)
         return MTable.from_rows(findings, _INSIGHT_SCHEMA)
 
+    def _expired(self, deadline) -> bool:
+        """Deadline probe every mining stage calls between units of work;
+        remembers that the budget ran out for the end-of-run counter."""
+        if time.monotonic() > deadline:
+            self._budget_hit = True
+            return True
+        return False
+
     # -- column-quality + stat findings ------------------------------------
-    def _column_findings(self, findings, num_arrays, cat_arrays, n):
+    def _column_findings(self, findings, num_arrays, cat_arrays, n, deadline):
         """missing/constant/outlier/dominant + basic-stat + distribution
         (reference: StatInsight + DistributionUtil; AutoDiscovery.basicStat
         — AutoDiscovery.java:127-142)."""
         for c, arr in num_arrays.items():
+            if self._expired(deadline):
+                return
             miss = float(np.isnan(arr).mean())
             if miss > 0.05:
                 findings.append(_finding(
@@ -360,6 +389,8 @@ class AutoDiscoveryBatchOp(BatchOperator, HasSelectedCols):
                     f"excess kurtosis={kurt:.2f})", skew=skew, kurtosis=kurt))
 
         for c, vals_str in cat_arrays.items():
+            if self._expired(deadline):
+                return
             vals, counts = np.unique(vals_str, return_counts=True)
             top_frac = float(counts.max() / n)
             if len(vals) > 1 and top_frac > 0.8:
@@ -370,10 +401,10 @@ class AutoDiscoveryBatchOp(BatchOperator, HasSelectedCols):
                     value=str(vals[counts.argmax()]), fraction=top_frac))
 
     # -- raw-column correlation + cross-measure ----------------------------
-    def _correlations(self, findings, t, numeric):
+    def _correlations(self, findings, t, numeric, deadline):
         """(reference: CorrelationInsight.java — pairwise Pearson over raw
         measures)."""
-        if len(numeric) < 2:
+        if len(numeric) < 2 or self._expired(deadline):
             return
         X = t.to_numeric_block(numeric, dtype=np.float64)
         ok_rows = ~np.isnan(X).any(axis=1)
@@ -398,7 +429,7 @@ class AutoDiscoveryBatchOp(BatchOperator, HasSelectedCols):
         AutoDiscovery.findInSingleSubspace — AutoDiscovery.java:144-251)."""
         prefix = f"[{subspace}] " if subspace else ""
         for bd in breakdowns:
-            if time.monotonic() > deadline:
+            if self._expired(deadline):
                 return
             seg_vals_np, seg_inv = np.unique(cat_arrays[bd],
                                              return_inverse=True)
@@ -564,7 +595,7 @@ class AutoDiscoveryBatchOp(BatchOperator, HasSelectedCols):
                 if np.isfinite(v).all() and v.std() > 0]
         pairs = [(a, b) for i, a in enumerate(cols) for b in cols[i + 1:]]
         for a, b in pairs[:max_pairs]:
-            if time.monotonic() > deadline:
+            if self._expired(deadline):
                 return
             X = np.stack([num_arrays[a], num_arrays[b]], 1)
             X = (X - X.mean(0)) / X.std(0)

@@ -1,0 +1,639 @@
+"""Concurrent request router + dynamic micro-batcher over LocalPredictor.
+
+One :class:`ModelServer` owns N loaded models. Each model gets:
+
+- a **bounded two-lane queue** (normal + priority) with admission control:
+  past the high-water mark new requests are shed with
+  :class:`~alink_tpu.common.exceptions.AkServingOverloadException`
+  (``shed_policy="reject"``) or the oldest queued normal-lane request is
+  dropped to admit the new one (``shed_policy="oldest"``);
+- a **batcher thread** that coalesces waiting requests into micro-batches of
+  up to ``max_batch_rows`` rows (snapped onto the ``bucket_rows`` ladder, so
+  full batches ship with zero padding), flushing a partial batch once the
+  oldest queued request has waited ``flush_deadline_s``. Ragged batches pad
+  up the ladder inside the row-wise kernels — after :meth:`ModelServer.load`
+  warmup, sustained mixed-size load performs **zero new traces**;
+- a **circuit breaker** (shared ``serving:<model>`` endpoint registry entry):
+  consecutive batch failures open it and queued requests degrade to fast
+  :class:`~alink_tpu.common.exceptions.AkCircuitOpenException` rejects until
+  the reset timeout half-opens it for a probe batch;
+- **per-request deadlines**: a request whose deadline expires while queued
+  completes with :class:`AkDeadlineExceededException` instead of occupying
+  batch rows.
+
+Instrumentation (all exported at ``GET /metrics``): ``serving.request`` /
+``serving.batch`` spans, ``serving.queue_s`` / ``serving.request_s`` /
+``serving.batch_rows`` histograms (p50/p90/p99), and ``serving.*`` counters
+(accepted / shed / completed / errors / deadline_expired / breaker_rejected).
+
+Results are **bit-identical** to serial ``LocalPredictor`` predicts: batching
+only changes the leading dimension of row-wise kernels, which the bucketing
+contract (``common/jitcache.py``) already pins as parity-safe.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, replace
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..common.env import env_float, env_int
+from ..common.exceptions import (
+    AkCircuitOpenException,
+    AkDeadlineExceededException,
+    AkIllegalArgumentException,
+    AkIllegalStateException,
+    AkServingOverloadException,
+)
+from ..common.jitcache import bucket_rows
+from ..common.metrics import metrics
+from ..common.mtable import MTable, TableSchema
+from ..common.resilience import CircuitBreaker
+from ..common.tracing import trace_span
+from ..pipeline.local_predictor import LocalPredictor
+from ..pipeline.pipeline import PipelineModel
+
+_ROW_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0,
+                512.0, 1024.0, 2048.0, 4096.0)
+
+
+def _schema_zero_rows(schema: TableSchema) -> Optional[List[tuple]]:
+    """One zero/empty sample row derived from a primitive-typed input
+    schema (the default AOT-warmup input when the caller provides none).
+    Returns None when any column type cannot be synthesized — vector/
+    tensor/mtable inputs need real sample rows."""
+    from ..common.mtable import AlinkTypes
+
+    row = []
+    for tp in schema.types:
+        if AlinkTypes.is_numeric(tp):  # numeric incl. BOOLEAN
+            row.append(0)
+        elif tp == AlinkTypes.STRING:
+            row.append("")
+        else:
+            return None
+    return [tuple(row)]
+
+
+def serving_bucket_ladder(max_rows: int) -> List[int]:
+    """Every bucket rung a batch of 1..max_rows can pad to — the shape set
+    :meth:`ModelServer.load` warms so no production batch size traces."""
+    rungs = sorted({bucket_rows(n) for n in range(1, max(int(max_rows), 1) + 1)})
+    return rungs
+
+
+@dataclass(frozen=True)
+class ServingConfig:
+    """Per-model serving knobs (env defaults: ``ALINK_SERVING_*``).
+
+    - ``queue_depth`` — bounded queue high-water mark; requests past it shed.
+    - ``max_batch_rows`` — micro-batch row cap; snapped UP onto the
+      ``bucket_rows`` ladder at load so full batches ship unpadded.
+    - ``flush_deadline_s`` — max time the oldest queued request waits for a
+      fuller batch before a partial batch flushes.
+    - ``default_timeout_s`` — synchronous ``predict`` wait budget.
+    - ``shed_policy`` — ``"reject"`` (shed the arriving request) or
+      ``"oldest"`` (drop the oldest queued normal-lane request instead).
+    - ``breaker_threshold`` / ``breaker_reset_s`` — consecutive batch
+      failures that open the model's circuit, and the half-open probe delay.
+    """
+
+    queue_depth: int = 256
+    max_batch_rows: int = 64
+    flush_deadline_s: float = 0.005
+    default_timeout_s: float = 30.0
+    shed_policy: str = "reject"
+    breaker_threshold: int = 5
+    breaker_reset_s: float = 30.0
+
+    @classmethod
+    def default(cls) -> "ServingConfig":
+        shed = os.environ.get("ALINK_SERVING_SHED_POLICY", "reject").lower()
+        return cls(
+            queue_depth=max(1, env_int("ALINK_SERVING_QUEUE_DEPTH", 256)),
+            max_batch_rows=max(1, env_int("ALINK_SERVING_MAX_BATCH_ROWS", 64)),
+            flush_deadline_s=env_float("ALINK_SERVING_FLUSH_DEADLINE_S",
+                                       0.005),
+            default_timeout_s=env_float("ALINK_SERVING_TIMEOUT_S", 30.0),
+            shed_policy=shed if shed in ("reject", "oldest") else "reject",
+            breaker_threshold=max(
+                1, env_int("ALINK_SERVING_BREAKER_THRESHOLD", 5)),
+            breaker_reset_s=env_float("ALINK_SERVING_BREAKER_RESET_S", 30.0),
+        )
+
+
+class PredictFuture:
+    """Completion handle for one submitted request. ``result(timeout)``
+    blocks for the row tuple or raises the request's failure; ``done()`` is
+    a non-blocking poll."""
+
+    __slots__ = ("_event", "_row", "_error", "enqueued_at", "deadline",
+                 "priority")
+
+    def __init__(self, deadline: Optional[float], priority: bool):
+        self._event = threading.Event()
+        self._row: Optional[Tuple] = None
+        self._error: Optional[BaseException] = None
+        self.enqueued_at = time.perf_counter()
+        self.deadline = deadline          # absolute monotonic, or None
+        self.priority = priority
+
+    def _complete(self, row: Optional[Tuple], error: Optional[BaseException]):
+        self._row = row
+        self._error = error
+        self._event.set()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> Tuple:
+        if not self._event.wait(timeout):
+            raise AkDeadlineExceededException(
+                f"predict result not ready within {timeout}s")
+        if self._error is not None:
+            raise self._error
+        return self._row
+
+
+class _Request:
+    __slots__ = ("row", "future")
+
+    def __init__(self, row: Sequence, future: PredictFuture):
+        self.row = tuple(row)
+        self.future = future
+
+
+class _ModelEntry:
+    """One loaded model: predictor + two-lane bounded queue + batcher."""
+
+    def __init__(self, name: str, predictor: LocalPredictor,
+                 config: ServingConfig):
+        self.name = name
+        self.predictor = predictor
+        # snap the batch cap onto the ladder: full batches ship unpadded
+        self.config = replace(config,
+                              max_batch_rows=bucket_rows(config.max_batch_rows))
+        # a FRESH registry breaker per load: a hot-swapped model must not
+        # inherit (or keep feeding, while the old entry drains) the retired
+        # entry's failure history, and reload config takes effect
+        self.breaker = CircuitBreaker.replace_endpoint(
+            f"serving:{name}", failure_threshold=config.breaker_threshold,
+            reset_timeout=config.breaker_reset_s)
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._high: deque = deque()
+        self._normal: deque = deque()
+        self._draining = False
+        # stats (under _lock)
+        self.accepted = 0
+        self.shed = 0
+        self.completed = 0
+        self.errors = 0
+        self.bad_rows = 0
+        self.expired = 0
+        self.breaker_rejected = 0
+        self.batches = 0
+        self.rows_total = 0
+        self.loaded_at = time.time()
+        self._thread = threading.Thread(
+            target=self._batcher, name=f"alink-serving-{name}", daemon=True)
+        self._thread.start()
+
+    # -- admission -----------------------------------------------------------
+    def submit(self, row: Sequence, *, priority: bool = False,
+               deadline_s: Optional[float] = None) -> PredictFuture:
+        deadline = (time.perf_counter() + deadline_s
+                    if deadline_s is not None else None)
+        fut = PredictFuture(deadline, priority)
+        req = _Request(row, fut)
+        shed_req: Optional[_Request] = None
+        with self._cond:
+            if self._draining:
+                raise AkIllegalStateException(
+                    f"model {self.name!r} is unloaded")
+            depth = len(self._high) + len(self._normal)
+            if depth >= self.config.queue_depth:
+                if self.config.shed_policy == "oldest" and self._normal:
+                    shed_req = self._normal.popleft()
+                else:
+                    self.shed += 1
+                    metrics.incr("serving.shed")
+                    raise AkServingOverloadException(
+                        f"model {self.name!r} queue full "
+                        f"({depth}/{self.config.queue_depth}); shed")
+                self.shed += 1
+                metrics.incr("serving.shed")
+            (self._high if priority else self._normal).append(req)
+            self.accepted += 1
+            metrics.incr("serving.accepted")
+            self._cond.notify()
+        if shed_req is not None:
+            shed_req.future._complete(None, AkServingOverloadException(
+                f"model {self.name!r} queue full; dropped for a newer "
+                f"request (shed_policy=oldest)"))
+        return fut
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._high) + len(self._normal)
+
+    # -- batching ------------------------------------------------------------
+    def _oldest_enqueued(self) -> Optional[float]:
+        heads = [q[0].future.enqueued_at for q in (self._high, self._normal)
+                 if q]
+        return min(heads) if heads else None
+
+    def _pop_batch_locked(self) -> List[_Request]:
+        batch: List[_Request] = []
+        cap = self.config.max_batch_rows
+        while len(batch) < cap and (self._high or self._normal):
+            q = self._high if self._high else self._normal
+            batch.append(q.popleft())
+        return batch
+
+    def _batcher(self) -> None:
+        while True:
+            with self._cond:
+                while not (self._high or self._normal):
+                    if self._draining:
+                        return
+                    self._cond.wait(0.1)
+                # let the batch fill until the oldest waiter's flush deadline
+                flush_at = (self._oldest_enqueued()
+                            + self.config.flush_deadline_s)
+                while (len(self._high) + len(self._normal)
+                       < self.config.max_batch_rows):
+                    rem = flush_at - time.perf_counter()
+                    if rem <= 0 or self._draining:
+                        break
+                    self._cond.wait(rem)
+                batch = self._pop_batch_locked()
+                self.batches += 1
+            try:
+                self._run_batch(batch)
+            except BaseException as e:
+                # the batcher is the model's ONLY service thread: an escape
+                # from any unguarded edge must fail the batch, not kill the
+                # thread (which would silently hang all future requests)
+                metrics.incr("serving.batcher_errors")
+                for req in batch:
+                    if not req.future.done():
+                        self._finish(req, None, e)
+
+    def _run_batch(self, batch: List[_Request]) -> None:
+        now = time.perf_counter()
+        live: List[_Request] = []
+        for req in batch:
+            fut = req.future
+            metrics.observe("serving.queue_s", now - fut.enqueued_at)
+            if fut.deadline is not None and now > fut.deadline:
+                with self._lock:
+                    self.expired += 1
+                metrics.incr("serving.deadline_expired")
+                self._finish(req, None, AkDeadlineExceededException(
+                    f"request deadline expired after "
+                    f"{now - fut.enqueued_at:.3f}s in queue"))
+                continue
+            live.append(req)
+        if not live:
+            return
+        try:
+            self.breaker.before_call()
+        except AkCircuitOpenException as e:
+            with self._lock:
+                self.breaker_rejected += len(live)
+            metrics.incr("serving.breaker_rejected", len(live))
+            for req in live:
+                self._finish(req, None, e)
+            return
+        live, t = self._build_batch_table(live)
+        if not live:
+            self.breaker.release_probe()  # no health verdict this round
+            return
+        n = len(live)
+        metrics.observe("serving.batch_rows", float(n), buckets=_ROW_BUCKETS)
+        try:
+            with trace_span("serving.batch", model=self.name, rows=n):
+                out = self.predictor.predict_table(t)
+                if out.num_rows != n:
+                    raise AkIllegalStateException(
+                        f"model {self.name!r} returned {out.num_rows} rows "
+                        f"for a {n}-row batch; serving requires row-wise "
+                        f"pipelines (one output row per input row)")
+        except BaseException as e:
+            # every EXECUTION failure feeds the breaker: a model failing
+            # batch after batch is unhealthy regardless of error taxonomy,
+            # and degradation to fast rejects is the graceful mode.
+            # (Malformed rows were already rejected per-request above and
+            # never reach here — one bad client cannot open the circuit.)
+            self.breaker.record_failure()
+            with self._lock:
+                self.errors += n
+            metrics.incr("serving.errors", n)
+            for req in live:
+                self._finish(req, None, e)
+            return
+        self.breaker.record_success()
+        with self._lock:
+            self.completed += n
+            self.rows_total += n
+        metrics.incr("serving.completed", n)
+        for i, req in enumerate(live):
+            self._finish(req, out.get_row(i), None)
+
+    def _build_batch_table(self, live: List[_Request]
+                           ) -> Tuple[List[_Request], Optional[MTable]]:
+        """Coalesce rows into one MTable. Rows that cannot build against the
+        input schema are CALLER errors: each is rejected individually (the
+        rest of the batch proceeds) and none of them feed the breaker — a
+        bad client must not co-fail innocent requests or 503 a healthy
+        model."""
+        try:
+            return live, MTable.from_rows([r.row for r in live],
+                                          self.predictor.input_schema)
+        except Exception:
+            good: List[_Request] = []
+            for req in live:
+                try:
+                    MTable.from_rows([req.row], self.predictor.input_schema)
+                    good.append(req)
+                except Exception as e:
+                    with self._lock:
+                        self.bad_rows += 1
+                    metrics.incr("serving.bad_rows")
+                    self._finish(req, None, AkIllegalArgumentException(
+                        f"row does not fit input schema: {e}"))
+            if not good:
+                return [], None
+            return good, MTable.from_rows([r.row for r in good],
+                                          self.predictor.input_schema)
+
+    def _finish(self, req: _Request, row: Optional[Tuple],
+                error: Optional[BaseException]) -> None:
+        metrics.observe("serving.request_s",
+                        time.perf_counter() - req.future.enqueued_at)
+        req.future._complete(row, error)
+
+    # -- lifecycle -----------------------------------------------------------
+    def shutdown(self, drain: bool = True) -> None:
+        """Stop admitting; the batcher finishes queued work (``drain=True``)
+        or fails it fast, then exits."""
+        with self._cond:
+            self._draining = True
+            if not drain:
+                doomed = list(self._high) + list(self._normal)
+                self._high.clear()
+                self._normal.clear()
+            else:
+                doomed = []
+            self._cond.notify_all()
+        for req in doomed:
+            req.future._complete(None, AkIllegalStateException(
+                f"model {self.name!r} unloaded"))
+        self._thread.join(timeout=30.0)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            d = {
+                "model": self.name,
+                "queued": len(self._high) + len(self._normal),
+                "queue_depth": self.config.queue_depth,
+                "max_batch_rows": self.config.max_batch_rows,
+                "accepted": self.accepted,
+                "completed": self.completed,
+                "shed": self.shed,
+                "errors": self.errors,
+                "bad_rows": self.bad_rows,
+                "deadline_expired": self.expired,
+                "breaker_rejected": self.breaker_rejected,
+                "batches": self.batches,
+                "rows": self.rows_total,
+                "breaker_open": self.breaker.is_open,
+                "loaded_at": self.loaded_at,
+            }
+        d["batch_fill"] = (
+            round(d["rows"] / (d["batches"] * d["max_batch_rows"]), 4)
+            if d["batches"] else None)
+        return d
+
+
+class ModelServer:
+    """The serving front end: load/warmup/evict models, route requests.
+
+    ::
+
+        server = ModelServer()
+        server.load("iris", "/models/iris.ak", "f0 double, f1 double, ...",
+                    warmup_rows=[[5.1, 3.5, 1.4, 0.2]])
+        row = server.predict("iris", [5.1, 3.5, 1.4, 0.2])   # sync
+        fut = server.submit("iris", [6.2, 2.9, 4.3, 1.3])    # async
+        ...
+        fut.result(timeout=1.0)
+        server.unload("iris")
+    """
+
+    def __init__(self, config: Optional[ServingConfig] = None):
+        self._config = config or ServingConfig.default()
+        self._lock = threading.Lock()
+        self._entries: Dict[str, _ModelEntry] = {}
+
+    # -- model lifecycle -----------------------------------------------------
+    def load(self, name: str, model: "PipelineModel | LocalPredictor | str",
+             input_schema: "TableSchema | str | None" = None, *,
+             config: Optional[ServingConfig] = None,
+             warmup_rows: Optional[Sequence[Sequence]] = None) -> Dict[str, Any]:
+        """Load (or hot-swap) ``name``. ``model`` is a PipelineModel, a saved
+        ``.ak`` path, or a ready LocalPredictor. ``warmup_rows`` (sample
+        input rows) drives AOT warmup: every bucket rung up to
+        ``max_batch_rows`` is predicted once before the model starts taking
+        traffic, so steady-state load performs zero new traces. Hot-swap is
+        safe: the old entry keeps serving until the new one (warmup
+        included) is ready, then drains and retires."""
+        cfg = config or self._config
+        if isinstance(model, LocalPredictor):
+            predictor = model
+        else:
+            if input_schema is None:
+                raise AkIllegalArgumentException(
+                    "input_schema is required when loading from a "
+                    "PipelineModel or path")
+            predictor = LocalPredictor(model, input_schema)
+        warm = {"rungs": 0, "rows": 0}
+        synthesized = False
+        if not warmup_rows:
+            # the zero-traces-before-traffic contract must not silently
+            # evaporate when the caller omits sample rows: synthesize a
+            # zero/empty row from the input schema (primitive columns only
+            # — exotic input types need real sample rows)
+            warmup_rows = _schema_zero_rows(predictor.input_schema)
+            synthesized = warmup_rows is not None
+        if warmup_rows:
+            try:
+                warm = self._warmup(predictor, warmup_rows,
+                                    bucket_rows(cfg.max_batch_rows))
+            except Exception:
+                if not synthesized:
+                    raise  # caller-provided rows failing is a load error
+                # a pipeline that chokes on the synthetic row falls back to
+                # warming lazily on first traffic — counted, not fatal
+                metrics.incr("serving.warmup_errors")
+        else:
+            metrics.incr("serving.warmup_skipped")
+        entry = _ModelEntry(name, predictor, cfg)
+        with self._lock:
+            old = self._entries.get(name)
+            self._entries[name] = entry
+        if old is not None:
+            old.shutdown(drain=True)
+        metrics.incr("serving.models_loaded")
+        return {"model": name, "warmup": warm,
+                "max_batch_rows": entry.config.max_batch_rows}
+
+    @staticmethod
+    def _warmup(predictor: LocalPredictor,
+                rows: Sequence[Sequence], max_rows: int) -> Dict[str, int]:
+        """Predict once at every ladder rung <= the batch cap (tiling the
+        sample rows), populating jax's dispatch cache for every batch shape
+        the batcher can emit (the PR 4 warmup contract, driven through the
+        real predict path so staging/fusion caches warm too)."""
+        base = [tuple(r) for r in rows]
+        total = 0
+        rungs = serving_bucket_ladder(max_rows)
+        with trace_span("serving.warmup", rungs=len(rungs)):
+            for rung in rungs:
+                tiled = (base * (rung // len(base) + 1))[:rung]
+                predictor.predict_table(
+                    MTable.from_rows(tiled, predictor.input_schema))
+                total += rung
+        metrics.incr("serving.warmup_rungs", len(rungs))
+        return {"rungs": len(rungs), "rows": total}
+
+    def unload(self, name: str, drain: bool = True) -> bool:
+        with self._lock:
+            entry = self._entries.pop(name, None)
+        if entry is None:
+            return False
+        entry.shutdown(drain=drain)
+        metrics.incr("serving.models_unloaded")
+        return True
+
+    def close(self) -> None:
+        with self._lock:
+            entries = list(self._entries.values())
+            self._entries.clear()
+        for e in entries:
+            e.shutdown(drain=True)
+
+    def models(self) -> List[str]:
+        with self._lock:
+            return sorted(self._entries)
+
+    def _entry(self, name: str) -> _ModelEntry:
+        with self._lock:
+            entry = self._entries.get(name)
+        if entry is None:
+            raise AkIllegalArgumentException(f"no model loaded as {name!r}")
+        return entry
+
+    # -- request path --------------------------------------------------------
+    def submit(self, name: str, row: Sequence, *, priority: bool = False,
+               deadline_s: Optional[float] = None) -> PredictFuture:
+        """Enqueue one request; returns a :class:`PredictFuture`. Raises
+        :class:`AkServingOverloadException` immediately when shed."""
+        # hot-swap race: a resolved entry may start draining between the
+        # lookup and the submit — re-resolve and route to its replacement
+        # instead of surfacing "unloaded" for a model that is still served
+        for _ in range(8):
+            try:
+                return self._entry(name).submit(row, priority=priority,
+                                                deadline_s=deadline_s)
+            except AkIllegalStateException:
+                continue
+        return self._entry(name).submit(row, priority=priority,
+                                        deadline_s=deadline_s)
+
+    def predict(self, name: str, row: Sequence, *,
+                timeout: Optional[float] = None,
+                priority: bool = False) -> Tuple:
+        """Synchronous predict: submit + wait, traced as one
+        ``serving.request`` span."""
+        budget = timeout if timeout is not None else \
+            self._entry(name).config.default_timeout_s
+        with trace_span("serving.request", model=name):
+            fut = self.submit(name, row, priority=priority,
+                              deadline_s=budget)
+            return fut.result(budget)
+
+    def predict_many(self, name: str, rows: Sequence[Sequence], *,
+                     timeout: Optional[float] = None,
+                     priority: bool = False) -> List[Tuple]:
+        """Submit a row set as individual requests (they coalesce in the
+        batcher with everyone else's traffic) and wait for all. All-or-
+        nothing: if any row sheds, the already-accepted rows are drained
+        (their results read and discarded — no orphaned futures occupying
+        the queue) before the overload error propagates."""
+        budget = timeout if timeout is not None else \
+            self._entry(name).config.default_timeout_s
+        futs: List[PredictFuture] = []
+        try:
+            for r in rows:
+                futs.append(self.submit(name, r, priority=priority,
+                                        deadline_s=budget))
+        except AkServingOverloadException:
+            for f in futs:
+                try:
+                    f.result(budget)
+                except Exception:
+                    pass
+            raise
+        return [f.result(budget) for f in futs]
+
+    # -- readouts ------------------------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            entries = list(self._entries.values())
+        return {
+            "models": [e.stats() for e in entries],
+            "histograms": {
+                h: metrics.histogram(h)
+                for h in ("serving.request_s", "serving.queue_s",
+                          "serving.batch_rows")
+                if metrics.histogram(h) is not None
+            },
+            "counters": metrics.counters("serving."),
+        }
+
+
+# ---------------------------------------------------------------------------
+# Process-wide default server (the WebUI's serving surface)
+# ---------------------------------------------------------------------------
+
+_default_lock = threading.Lock()
+_default_server: Optional[ModelServer] = None
+
+
+def default_server() -> ModelServer:
+    """The process-wide :class:`ModelServer` the WebUI endpoints route to."""
+    global _default_server
+    with _default_lock:
+        if _default_server is None:
+            _default_server = ModelServer()
+        return _default_server
+
+
+def serving_summary(server: Optional[ModelServer] = None) -> Dict[str, Any]:
+    """One-call readout (the BENCH ``serving`` extra reads through this):
+    per-model stats, latency histograms, ``serving.*`` counters, and the
+    jit trace/compile counters active during the serving window. Reads the
+    given server, defaulting to the process-wide one (empty stats if none
+    was ever created)."""
+    if server is None:
+        server = _default_server
+    out = server.stats() if server is not None else \
+        {"models": [], "histograms": {}, "counters": metrics.counters("serving.")}
+    out["jit"] = {k: v for k, v in metrics.counters("jit.").items()
+                  if k in ("jit.trace", "jit.compile")}
+    return out

@@ -16,7 +16,12 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from ..common.catalog import list_operators, op_info
-from ..common.exceptions import AkIllegalArgumentException
+from ..common.exceptions import (
+    AkCircuitOpenException,
+    AkDeadlineExceededException,
+    AkIllegalArgumentException,
+    AkServingOverloadException,
+)
 from ..common.metrics import metrics
 from ..common.mtable import MTable
 from ..common.tracing import job_report, trace_span, tracer
@@ -250,6 +255,15 @@ _STATIC_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 class _Handler(BaseHTTPRequestHandler):
     server_version = "AlinkTpuWebUI/1.0"
     store: ExperimentStore = None  # set by WebUIServer
+    model_server = None            # set by WebUIServer (ModelServer)
+
+    @classmethod
+    def _serving(cls):
+        if cls.model_server is None:
+            from ..serving import default_server
+
+            cls.model_server = default_server()
+        return cls.model_server
 
     # -- helpers --
     def _send_json(self, obj, code: int = 200):
@@ -295,6 +309,8 @@ class _Handler(BaseHTTPRequestHandler):
     def do_POST(self):
         parts = [p for p in self.path.split("?")[0].split("/") if p]
         try:
+            if parts[:2] == ["api", "serving"]:
+                return self._serving_post(parts[2:])
             if parts[:2] == ["api", "experiments"]:
                 if len(parts) == 2:
                     return self._send_json(self.store.create(self._body()))
@@ -329,6 +345,11 @@ class _Handler(BaseHTTPRequestHandler):
     def do_DELETE(self):
         parts = [p for p in self.path.split("?")[0].split("/") if p]
         try:
+            if (parts[:3] == ["api", "serving", "models"]
+                    and len(parts) == 4):
+                if self._serving().unload(parts[3]):
+                    return self._send_json({"unloaded": parts[3]})
+                return self._send_json({"error": "no such model"}, 404)
             if parts[:2] == ["api", "experiments"] and len(parts) == 3:
                 if self.store.delete(parts[2]):
                     return self._send_json({"deleted": parts[2]})
@@ -366,6 +387,59 @@ class _Handler(BaseHTTPRequestHandler):
             if exp is None:
                 return self._send_json({"error": "no such experiment"}, 404)
             return self._send_json(exp)
+        if parts == ["serving"]:
+            return self._send_json(self._serving().stats())
+        return self._send_json({"error": "not found"}, 404)
+
+    # -- serving endpoints --
+    def _serving_post(self, parts: List[str]):
+        """POST /api/serving/models — load (or hot-swap) a saved pipeline;
+        POST /api/serving/predict/<name> — synchronous predict of one row
+        ({"row": [...]}) or a row set ({"rows": [[...], ...]}).
+
+        Overload/degradation map onto transport codes: shed → 429, breaker
+        open → 503, deadline expired → 504."""
+        srv = self._serving()
+        try:
+            if parts == ["models"]:
+                body = self._body()
+                if not body.get("name") or not body.get("path"):
+                    return self._send_json(
+                        {"error": "body requires 'name' and 'path'"}, 400)
+                out = srv.load(
+                    body["name"], body["path"],
+                    body.get("inputSchema"),
+                    warmup_rows=body.get("warmupRows"))
+                return self._send_json(out)
+            if len(parts) == 2 and parts[0] == "predict":
+                body = self._body()
+                if "row" not in body and "rows" not in body:
+                    return self._send_json(
+                        {"error": "body requires 'row' or 'rows'"}, 400)
+                timeout = body.get("timeoutS")
+                priority = bool(body.get("priority", False))
+                if "rows" in body:
+                    rows = srv.predict_many(parts[1], body["rows"],
+                                            timeout=timeout,
+                                            priority=priority)
+                    return self._send_json(
+                        {"rows": [[_json_cell(v) for v in r] for r in rows]})
+                row = srv.predict(parts[1], body["row"], timeout=timeout,
+                                  priority=priority)
+                return self._send_json(
+                    {"row": [_json_cell(v) for v in row]})
+        except AkServingOverloadException as e:
+            return self._send_json({"error": str(e)}, 429)
+        except AkCircuitOpenException as e:
+            return self._send_json({"error": str(e)}, 503)
+        except AkDeadlineExceededException as e:
+            return self._send_json({"error": str(e)}, 504)
+        except AkIllegalArgumentException as e:
+            # unknown model / schema-mismatched rows / bad load args —
+            # caller errors by class contract. Anything else escapes to the
+            # outer 500 handler (a model-internal KeyError is NOT a 400).
+            return self._send_json(
+                {"error": f"{type(e).__name__}: {e}"}, 400)
         return self._send_json({"error": "not found"}, 404)
 
     def _static(self, rel: str):
@@ -390,9 +464,11 @@ class WebUIServer:
     ``start(background=True)`` serves from a daemon thread (tests)."""
 
     def __init__(self, port: int = 8765, host: str = "127.0.0.1",
-                 store: Optional[ExperimentStore] = None):
+                 store: Optional[ExperimentStore] = None,
+                 model_server=None):
         handler = type("BoundHandler", (_Handler,),
-                       {"store": store or ExperimentStore()})
+                       {"store": store or ExperimentStore(),
+                        "model_server": model_server})
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.port = self.httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None

@@ -23,6 +23,9 @@ the reference itself publishes no numbers ("published": {}).
 - bert_text_quality: held-out accuracy of the BERT text-classify op on a
   structured sentiment task (the learning-signal check).
 - bert_mfu: achieved TFLOPs/chip + MFU for the primary metric.
+- serving: online serving tier drill — sustained concurrent clients against
+  one loaded model (rows/s, batch-fill ratio, request p50/p90/p99, jit trace
+  delta after warmup) plus a past-capacity load-shedding probe.
 """
 
 from __future__ import annotations
@@ -852,6 +855,99 @@ def bench_compile():
     return out
 
 
+def bench_serving(clients=8, rows_per_client=400):
+    """Online serving tier (alink_tpu/serving): sustained concurrent-client
+    drill against one loaded pipeline model. ``clients`` threads submit
+    single-row predict requests as fast as completions allow; the router
+    coalesces them into bucket-ladder micro-batches. Reports rows/s,
+    batch-fill ratio, request-latency p50/p90/p99, the jit trace delta over
+    the sustained window (target: 0 after load-time warmup), and a
+    past-capacity shed probe (bounded queue, counted rejections)."""
+    import threading
+
+    from alink_tpu.common.metrics import metrics
+    from alink_tpu.common.mtable import MTable
+    from alink_tpu.pipeline import (NaiveBayes, Pipeline, StandardScaler,
+                                    VectorAssembler)
+    from alink_tpu.serving import (AkServingOverloadException, ModelServer,
+                                   ServingConfig, serving_summary)
+
+    rng = np.random.RandomState(0)
+    X = np.concatenate([rng.normal(c, 0.4, size=(200, 4))
+                        for c in [(0, 0, 0, 0), (2, 2, 2, 2)]])
+    y = np.repeat(["neg", "pos"], 200)
+    feats = ["f0", "f1", "f2", "f3"]
+    t = MTable({f"f{i}": X[:, i] for i in range(4)}).with_column("label", y)
+    model = Pipeline(
+        StandardScaler(selectedCols=feats),
+        VectorAssembler(selectedCols=feats, outputCol="vec"),
+        NaiveBayes(vectorCol="vec", labelCol="label", predictionCol="pred"),
+    ).fit(t)
+    schema = "f0 double, f1 double, f2 double, f3 double"
+
+    srv = ModelServer(ServingConfig(queue_depth=512, max_batch_rows=64,
+                                    flush_deadline_s=0.002))
+    try:
+        t_load0 = time.perf_counter()
+        load_info = srv.load("bench", model, schema,
+                             warmup_rows=[tuple(X[0])])
+        load_s = time.perf_counter() - t_load0
+
+        traces0 = metrics.counter("jit.trace")
+        rows = [tuple(r) for r in X]
+
+        def client(cid):
+            for i in range(rows_per_client):
+                srv.predict("bench", rows[(cid * 131 + i * 7) % len(rows)],
+                            timeout=120)
+
+        threads = [threading.Thread(target=client, args=(c,))
+                   for c in range(clients)]
+        t0 = time.perf_counter()
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        wall = time.perf_counter() - t0
+        traces_delta = metrics.counter("jit.trace") - traces0
+        stats = serving_summary(srv)
+        mstat = stats["models"][0]
+        req_hist = stats["histograms"].get("serving.request_s") or {}
+
+        # saturation probe: flood far past the queue bound with async
+        # submits; shed must be counted and accepted work must complete
+        srv2 = ModelServer(ServingConfig(queue_depth=32, max_batch_rows=32,
+                                         flush_deadline_s=0.05))
+        srv2.load("sat", model, schema, warmup_rows=[tuple(X[0])])
+        futs, shed = [], 0
+        for i in range(2000):
+            try:
+                futs.append(srv2.submit("sat", rows[i % len(rows)]))
+            except AkServingOverloadException:
+                shed += 1
+        completed = sum(1 for f in futs if f.result(120) is not None)
+        srv2.close()
+
+        total = clients * rows_per_client
+        return {
+            "clients": clients,
+            "rows": total,
+            "rows_per_sec": round(total / wall, 1),
+            "load_s": round(load_s, 3),
+            "warmup": load_info["warmup"],
+            "batch_fill": mstat["batch_fill"],
+            "batches": mstat["batches"],
+            "request_p50_ms": round((req_hist.get("p50") or 0) * 1e3, 3),
+            "request_p90_ms": round((req_hist.get("p90") or 0) * 1e3, 3),
+            "request_p99_ms": round((req_hist.get("p99") or 0) * 1e3, 3),
+            "traces_during_drill": traces_delta,  # sustained window; 0 = contract held
+            "saturation": {"submitted": 2000, "shed": shed,
+                           "accepted_completed": completed},
+        }
+    finally:
+        srv.close()
+
+
 def bench_observability(repeats=3):
     """Unified tracing & telemetry layer (common/tracing.py + the metrics
     histogram/Prometheus export): run kmeans_iris with ALINK_TRACING=off vs
@@ -1000,6 +1096,7 @@ def main():
         ("recovery", bench_recovery),
         ("compile", bench_compile),
         ("observability", bench_observability),
+        ("serving", bench_serving),
     ):
         try:
             extras[name] = fn()

@@ -174,3 +174,35 @@ def test_time_limit_respected():
     t0 = _t.monotonic()
     _discover(MTable(cols), timeLimitSeconds=0.001, topN=5)
     assert _t.monotonic() - t0 < 10.0
+
+
+def test_time_budget_best_effort_contract():
+    """An exhausted budget returns the findings collected SO FAR — a valid
+    findings table (standard schema, ranked) instead of a silent overrun —
+    and the cut-short run is observable via the
+    ``insights.time_budget_exhausted`` counter."""
+    from alink_tpu.common.metrics import metrics
+
+    rng = np.random.default_rng(7)
+    cols = {f"c{i}": rng.standard_normal(500) for i in range(12)}
+    cols["seg"] = np.asarray(
+        [f"s{i % 8}" for i in range(500)], object)
+    t = MTable(cols)
+
+    # zero budget: every deadline-guarded stage stops immediately; the op
+    # still returns a well-formed (possibly empty) findings table, fast
+    c0 = metrics.counter("insights.time_budget_exhausted")
+    import time as _t
+
+    t0 = _t.monotonic()
+    out = _discover(t, timeLimitSeconds=0.0, topN=20)
+    assert _t.monotonic() - t0 < 5.0
+    assert out.names == ["type", "columns", "score", "description", "detail"]
+    assert metrics.counter("insights.time_budget_exhausted") == c0 + 1
+
+    # generous budget on the same table: findings ARE discovered and the
+    # exhaustion counter does not move — the budget only bites when spent
+    c1 = metrics.counter("insights.time_budget_exhausted")
+    full = _discover(t, timeLimitSeconds=60.0, topN=20)
+    assert full.num_rows > out.num_rows
+    assert metrics.counter("insights.time_budget_exhausted") == c1
