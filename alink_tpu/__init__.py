@@ -31,6 +31,8 @@ from .common import (  # noqa: F401
     export_prometheus,
     is_retryable,
     job_report,
+    profile_summary,
+    program_costs,
     run_with_recovery,
     trace_span,
     warmup,

@@ -20,6 +20,7 @@ from .faults import FaultSpec
 # `from .metrics import metrics` would shadow the submodule attribute and
 # break `alink_tpu.common.metrics.<member>` access
 from .metrics import export_prometheus, timed
+from .profiling import profile_summary, program_costs
 from .tracing import job_report, trace_span, tracer
 from .jitcache import (
     bucket_rows,

@@ -61,6 +61,7 @@ from typing import Any, Dict, List, Optional, Sequence
 
 from .faults import maybe_fail
 from .metrics import metrics, node_phase_context
+from .profiling import sample_device_memory
 from .resilience import RetryPolicy, retries_enabled, with_retries
 from .tracing import attach_context, capture_context, trace_span
 
@@ -312,9 +313,14 @@ def _run_unit(unit: _Unit, record: bool, ctx=None):
                         sp.outcome = sp.outcome or "defused"
                     if state["attempts"] > 1:
                         sp.attrs["attempts"] = state["attempts"]
+    # HBM watermark at the node boundary (performance observatory): a
+    # cheap latched no-op on backends without memory_stats (CPU)
+    hbm_bytes = sample_device_memory()
     if record:
         wall = time.perf_counter() - t0
         rec = {"op": unit.label(), "wall_s": round(wall, 6)}
+        if hbm_bytes is not None:
+            rec["hbm_bytes"] = hbm_bytes
         if unit.fused:
             rec["fused"] = len(unit.ops)
         if state["attempts"] > 1:

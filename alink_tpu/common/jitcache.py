@@ -44,6 +44,7 @@ from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tupl
 
 import numpy as np
 
+from . import profiling as _profiling
 from .metrics import add_node_phase, metrics
 
 # ---------------------------------------------------------------------------
@@ -393,13 +394,25 @@ class CachedProgram:
         sig = args_signature(args)
         if not self._note_sig(sig):
             metrics.incr("jit.program_calls")
-            return self.jit_fn(*args)
+            if not _profiling.profiling_enabled():
+                return self.jit_fn(*args)
+            # warm-call exec accounting: joins the static XLA cost captured
+            # at trace time into achieved-FLOP/s / roofline readouts
+            t0 = time.perf_counter()
+            out = self.jit_fn(*args)
+            if _profiling.sync_enabled():
+                import jax
+
+                jax.block_until_ready(out)
+            _profiling.note_exec(self, sig, time.perf_counter() - t0,
+                                 args, out)
+            return out
         metrics.incr("jit.trace")
         metrics.incr("jit.compile")
         _record_profile(self.kernel_id, sig)
         t0 = time.perf_counter()
         try:
-            return self.jit_fn(*args)
+            out = self.jit_fn(*args)
         finally:
             dt = time.perf_counter() - t0
             metrics.add_time("jitcache.compile_s", dt)
@@ -409,6 +422,8 @@ class CachedProgram:
                                    kernel=self.kernel_id,
                                    ms=round(dt * 1e3, 3))
             add_node_phase("compile_s", dt)
+        _profiling.note_compiled(self, sig, args, out, dt)
+        return out
 
     def lower(self, *args):
         return self.jit_fn.lower(*args)
@@ -535,6 +550,14 @@ def compile_summary() -> Dict[str, Any]:
         stats = metrics.timer_stats(f"jitcache.{kid}.compile_s")
         if stats:
             d["compile"] = stats
+    try:
+        # join the performance observatory's static costs: per-kernel
+        # FLOPs / bytes accessed / peak HBM next to the compile stats
+        for kid, cost in _profiling.costs_by_kernel().items():
+            if kid in kernels:
+                kernels[kid]["cost"] = cost
+    except Exception:
+        pass
     return {
         "programs": len(progs),
         "counters": counters,

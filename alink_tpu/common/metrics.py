@@ -122,6 +122,10 @@ def _prom_float(v: float) -> str:
     return repr(round(float(v), 9))
 
 
+def _prom_label_value(v: str) -> str:
+    return str(v).replace("\\", r"\\").replace('"', r'\"').replace("\n", r"\n")
+
+
 class StepMetrics:
     """In-process metric streams: named series of {step, **values} dicts,
     aggregated timers, fixed-bucket histograms, and monotonic counters. One
@@ -133,6 +137,8 @@ class StepMetrics:
         self._series: Dict[str, List[Dict[str, Any]]] = defaultdict(list)
         self._timers: Dict[str, List[float]] = defaultdict(list)
         self._hists: Dict[str, _Histogram] = {}
+        self._gauges: Dict[str, Dict[tuple, float]] = {}
+        self._export_hooks: List[Any] = []
         self._counters: Dict[str, int] = defaultdict(int)
         self._counter_lock = threading.Lock()
         # one lock for series+timers+histograms: executor pool threads,
@@ -178,6 +184,28 @@ class StepMetrics:
                     h = self._hists[name] = _Histogram(
                         buckets or DEFAULT_BUCKETS)
                 h.observe(value)
+
+    def set_gauge(self, name: str, value: float, **labels):
+        """Last-write-wins gauge, optionally labeled (one series per label
+        set). Gauges are for readout surfaces that recompute a current
+        value — per-kernel cost figures, watermarks — where a counter or
+        timer history would be the wrong shape."""
+        key = tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+        with self._data_lock:
+            self._gauges.setdefault(name, {})[key] = float(value)
+
+    def gauge(self, name: str, **labels) -> Optional[float]:
+        key = tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+        with self._data_lock:
+            return self._gauges.get(name, {}).get(key)
+
+    def register_export_hook(self, fn):
+        """Register a callable invoked at the top of every
+        ``export_prometheus()`` — the mechanism for a subsystem to refresh
+        its gauges exactly when a scraper looks. Hook failures are counted
+        (``metrics.dropped``), never raised into the exposition."""
+        if fn not in self._export_hooks:
+            self._export_hooks.append(fn)
 
     def incr(self, name: str, n: int = 1):
         """Monotonic event counter (retries, dead-letter drops, defusions).
@@ -255,6 +283,12 @@ class StepMetrics:
         Names are stable ``alink_``-prefixed translations of the in-process
         dotted names; a name claimed by an earlier family is skipped rather
         than emitted twice (exposition must not repeat a metric)."""
+        for hook in list(self._export_hooks):
+            try:
+                hook()
+            except Exception as e:
+                _count_drop("export_hook", e)
+
         lines: List[str] = []
         seen: set = set()
 
@@ -270,6 +304,19 @@ class StepMetrics:
             timers = {n: (len(ts), sum(ts))
                       for n, ts in self._timers.items() if ts}
             hists = {n: h.snapshot() for n, h in self._hists.items()}
+            gauges = {n: dict(vals) for n, vals in self._gauges.items()}
+
+        for name, vals in sorted(gauges.items()):
+            m = _prom_name(name)
+            if m in seen:
+                continue
+            seen.add(m)
+            lines.append(f"# TYPE {m} gauge")
+            for lkey, v in sorted(vals.items()):
+                lbl = ("{" + ",".join(
+                    f'{k}="{_prom_label_value(x)}"' for k, x in lkey) + "}"
+                    if lkey else "")
+                lines.append(f"{m}{lbl} {_prom_float(v)}")
 
         for name, h in sorted(hists.items()):
             m = _prom_name(name, seconds=True)
@@ -303,6 +350,7 @@ class StepMetrics:
             self._series.clear()
             self._timers.clear()
             self._hists.clear()
+            self._gauges.clear()
         with self._counter_lock:
             self._counters.clear()
         # re-arm the first-drop debug log: after a reset the operator is

@@ -44,7 +44,7 @@ import uuid
 from collections import deque
 from typing import Any, Dict, List, Optional
 
-from .env import env_flag, env_int
+from .env import env_flag, env_float, env_int
 from .metrics import metrics
 
 _RING_DEFAULT = 4096
@@ -150,6 +150,8 @@ class Tracer:
         self._log_lock = threading.Lock()
         self._log_path: Optional[str] = None
         self._log_file = None
+        self._log_bytes = 0
+        self._log_rotated = False
 
     # -- span lifecycle ------------------------------------------------------
     def start(self, name: str, **attrs) -> Span:
@@ -174,12 +176,21 @@ class Tracer:
             self._ring.append(span.to_dict())
         self._log(span)
 
+    @staticmethod
+    def _max_log_bytes() -> int:
+        """``ALINK_TRACE_LOG_MAX_MB`` caps the JSONL event log. 0 / unset =
+        unbounded (the pre-cap behavior)."""
+        mb = env_float("ALINK_TRACE_LOG_MAX_MB", 0.0) or 0.0
+        return int(mb * 1024 * 1024) if mb > 0 else 0
+
     def _log(self, span: Span) -> None:
         path = os.environ.get("ALINK_TRACE_LOG")
         if not path:
             return
         rec = span.to_dict()
         rec.pop("start_perf", None)  # process-local; meaningless in a file
+        line = json.dumps(rec, default=str) + "\n"
+        nbytes = len(line.encode("utf-8"))
         try:
             with self._log_lock:
                 if self._log_file is None or self._log_path != path:
@@ -187,8 +198,29 @@ class Tracer:
                         self._log_file.close()
                     self._log_file = open(path, "a")
                     self._log_path = path
-                self._log_file.write(json.dumps(rec, default=str) + "\n")
+                    self._log_rotated = False
+                    try:
+                        self._log_bytes = os.path.getsize(path)
+                    except OSError:
+                        self._log_bytes = 0
+                cap = self._max_log_bytes()
+                if cap and self._log_bytes + nbytes > cap:
+                    # rotate ONCE per path: keep a .1 of the filled log and
+                    # start fresh; when the fresh file fills too, drop (and
+                    # count) further events — a long-lived serving process
+                    # must never grow the log without bound
+                    if self._log_rotated:
+                        metrics.incr("trace.log_dropped")
+                        return
+                    self._log_file.close()
+                    os.replace(path, path + ".1")
+                    self._log_file = open(path, "w")
+                    self._log_bytes = 0
+                    self._log_rotated = True
+                    metrics.incr("trace.log_rotated")
+                self._log_file.write(line)
                 self._log_file.flush()
+                self._log_bytes += nbytes
         except OSError:
             metrics.incr("trace.log_errors")
 
@@ -249,6 +281,8 @@ class Tracer:
                 self._log_file.close()
                 self._log_file = None
                 self._log_path = None
+            self._log_bytes = 0
+            self._log_rotated = False
 
 
 tracer = Tracer()
@@ -362,8 +396,18 @@ def job_report(trace_id: Optional[str] = None) -> Dict[str, Any]:
         }
     except Exception:
         pass
+    profile: Dict[str, Any] = {}
+    try:
+        # the performance observatory's per-kernel cost/roofline table —
+        # the static "what should this have cost" side of the span tree
+        from .profiling import profile_summary
+
+        profile = profile_summary(top=12)
+    except Exception:
+        pass
     return {
         "trace_id": trace_id,
+        "profile": profile,
         "root": None if root is None else
         {"name": root["name"], "wall_s": root["wall_s"],
          "outcome": root["outcome"]},

@@ -373,6 +373,13 @@ class _Handler(BaseHTTPRequestHandler):
             if cls is None:
                 return self._send_json({"error": "unknown op"}, 404)
             return self._send_json(op_info(cls))
+        if parts == ["profile"]:
+            # performance observatory: per-kernel XLA cost + roofline
+            # verdicts joined with measured exec timings (resolves any
+            # pending captures — one lower() per new program, amortized)
+            from ..common.profiling import profile_summary
+
+            return self._send_json(profile_summary())
         if parts == ["traces"]:
             return self._send_json({"traces": tracer.traces()})
         if len(parts) == 2 and parts[0] == "traces":

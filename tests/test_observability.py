@@ -150,8 +150,9 @@ def test_reset_rearms_first_drop_log():
 
 _PROM_LINE = (
     r"^[a-zA-Z_:][a-zA-Z0-9_:]*"          # metric name
-    r"(\{le=\"[^\"]+\"\})?"               # optional le label
-    r" [-+]?[0-9.eE+\-]+$"                # value
+    r"(\{[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"]*\""   # optional label set (le on
+    r"(,[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"]*\")*\})?"  # histograms, kernel on
+    r" [-+]?[0-9.eE+\-]+$"                # profile gauges) + value
 )
 
 
@@ -384,6 +385,40 @@ def test_trace_jsonl_log(tmp_path, monkeypatch):
     assert child["parent_id"] == parent["span_id"]
     assert parent["attrs"] == {"tag": 7}
     assert all("start_perf" not in r for r in recs)
+
+
+@pytest.mark.observability
+def test_trace_log_rotates_once_then_drops(tmp_path, monkeypatch):
+    """ALINK_TRACE_LOG_MAX_MB bounds the JSONL event log: at the cap the
+    log rotates ONCE to <path>.1 and restarts, and when the fresh file
+    fills too, further events are dropped and counted — a long-lived
+    process can never grow the log without bound."""
+    from alink_tpu.common.tracing import trace_span, tracer
+
+    log = tmp_path / "trace.jsonl"
+    monkeypatch.setenv("ALINK_TRACING", "on")
+    monkeypatch.setenv("ALINK_TRACE_LOG", str(log))
+    monkeypatch.setenv("ALINK_TRACE_LOG_MAX_MB", "0.001")  # ~1 KiB cap
+    rot0 = metrics.counter("trace.log_rotated")
+    drop0 = metrics.counter("trace.log_dropped")
+    try:
+        for i in range(60):  # ~200B/span: fills the cap several times over
+            with trace_span("obs.rotated", i=i, pad="x" * 120):
+                pass
+        rotated = metrics.counter("trace.log_rotated") - rot0
+        dropped = metrics.counter("trace.log_dropped") - drop0
+        assert rotated == 1                       # rotate-once, not a churn
+        assert dropped > 0                        # overflow is counted
+        assert (tmp_path / "trace.jsonl.1").exists()
+        cap = 0.001 * 1024 * 1024
+        assert log.stat().st_size <= cap + 400    # bounded (±1 record slack)
+        assert (tmp_path / "trace.jsonl.1").stat().st_size <= cap + 400
+        # every surviving line is intact JSON (rotation never tears a record)
+        for p in (log, tmp_path / "trace.jsonl.1"):
+            for line in p.read_text().strip().splitlines():
+                json.loads(line)
+    finally:
+        tracer.clear()  # release the handle + reset rotation state
 
 
 @pytest.mark.observability

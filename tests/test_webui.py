@@ -165,8 +165,10 @@ def test_metrics_endpoint_and_traces(server, monkeypatch):
         text = r.read().decode()
     assert ctype.startswith("text/plain")
     body = [l for l in text.splitlines() if l and not l.startswith("#")]
+    label = r'[a-zA-Z_][a-zA-Z0-9_]*="[^"]*"'
     assert body and all(
-        re.match(r'^alink_[a-zA-Z0-9_]+(\{le="[^"]+"\})? \S+$', l)
+        re.match(r'^alink_[a-zA-Z0-9_]+(\{%s(,%s)*\})? \S+$' % (label, label),
+                 l)
         for l in body), body[:5]
     assert any("_bucket{le=" in l for l in body)   # >= one histogram
     assert any(l.startswith("alink_trace_spans_total") for l in body)
