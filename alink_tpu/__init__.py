@@ -38,3 +38,4 @@ from .common import (  # noqa: F401
     warmup,
     with_retries,
 )
+from .analysis import validate_plan  # noqa: E402,F401

@@ -1,0 +1,31 @@
+"""Static analysis layer: plan-time DAG validation + alink-lint.
+
+Two engines over one diagnostic model (:mod:`.diagnostics`):
+
+- :func:`validate_plan` — pre-flight schema/dtype/recompile/snapshot/fusion
+  checks over deferred operator DAGs and pipelines, wired into
+  ``execute()``/``collect()``/``Pipeline.fit()`` behind
+  ``ALINK_VALIDATE_PLAN=off|warn|error`` (default off);
+- ``python -m alink_tpu.analysis.lint`` — AST invariant rules over the
+  framework's own source with a committed ratchet baseline.
+
+See docs/analysis.md for the rule reference (ALK0xx = lint,
+ALK1xx = plan).
+"""
+
+from .diagnostics import INFO, ERROR, RULES, WARNING, Diagnostic, Report  # noqa: F401
+from .plancheck import (  # noqa: F401
+    last_plan_report,
+    preflight,
+    suppress_preflight,
+    validate_plan,
+    validation_mode,
+)
+
+
+def run_lint(paths=None, rel_base=None):
+    """Lint framework source (lazy import — pulls :mod:`ast` machinery only
+    when actually linting)."""
+    from .lint import run_lint as _run
+
+    return _run(paths, rel_base=rel_base)

@@ -1,0 +1,496 @@
+"""alink-lint — AST-based invariant checker over the framework's own source.
+
+The codebase carries invariants that plain review keeps missing (every PR
+since PR 1 notes the ``jax.shard_map`` drift; PR 2 built env-knob parsers
+that new modules bypass). This linter turns them into machine-checked rules
+in the spirit of compiler-level validation (TVM Relay's type checker, XLA's
+pre-lowering shape inference — PAPERS.md):
+
+- **ALK001** direct ``jax.jit``/``pjit`` calls outside
+  ``common/jitcache.ProgramCache`` — allowed inside ``_build*`` builder
+  functions and inside ``cached_jit(...)`` call arguments (the repo's
+  builder idiom), and inside ``common/jitcache.py`` itself;
+- **ALK002** any ``jax.shard_map`` reference (removed from the installed
+  JAX — the ROADMAP Open item 3 drift inventory; ``--shard-map-inventory``
+  emits the machine-readable work-list);
+- **ALK003** raw ``os.environ`` *reads* (``.get``/subscript-load/``in``)
+  outside ``common/env.py`` — writes (``setdefault``, assignment, ``del``)
+  are allowed, knob *parsing* is what must be centralized;
+- **ALK004** mutation of a module-level dict outside a ``with *lock*:``
+  block in threaded modules (executor, metrics, serving, ...);
+- **ALK005** bare ``except:``, or a broad ``except (Base)Exception:`` whose
+  body only passes — swallowed failures with no counter or log.
+
+(**ALK000** parse-error, error severity, marks a file ``ast.parse`` rejects —
+no other rule could run on it.)
+
+Findings carry stable rule ids + file:line + fix hints. A committed
+suppression baseline (per-rule, per-file counts — robust to line drift)
+lets the gate start green and ratchet: ``--check`` fails only when a file's
+count for a rule GROWS past the baseline.
+
+CLI::
+
+    python -m alink_tpu.analysis.lint            # report findings
+    python -m alink_tpu.analysis.lint --check    # exit 1 on non-baselined
+    python -m alink_tpu.analysis.lint --write-baseline
+    python -m alink_tpu.analysis.lint --shard-map-inventory docs/...json
+    python -m alink_tpu.analysis.lint --rules    # print the rule table
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import sys
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+from .diagnostics import RULES, Diagnostic, Report
+
+# package root (…/alink_tpu) — the default scan target; relpaths in
+# findings/baseline are taken against its PARENT so they read
+# "alink_tpu/tree/grow.py" exactly as the repo sees them
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "lint_baseline.json")
+
+# modules where module-level dicts are hit from worker threads (DAG pool,
+# transfer streams, serving batchers, recovery chains) — the ALK004 scope
+_THREADED_MODULES = (
+    "common/executor.py", "common/metrics.py", "common/jitcache.py",
+    "common/staging.py", "common/streaming.py", "common/tracing.py",
+    "common/recovery.py", "common/resilience.py", "common/profiling.py",
+    "common/faults.py", "serving/router.py", "analysis/plancheck.py",
+)
+
+# the knob-parser module itself — the one place raw environ reads belong
+_ENV_MODULE = "common/env.py"
+_JITCACHE_MODULE = "common/jitcache.py"
+
+_MUTATORS = ("update", "setdefault", "pop", "popitem", "clear")
+
+
+def _dotted(node: ast.AST) -> str:
+    """Best-effort dotted name of an expression ('jax.jit', 'os.environ')."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _dotted(node.value)
+        return f"{base}.{node.attr}" if base else node.attr
+    if isinstance(node, ast.Call):
+        return _dotted(node.func)
+    return ""
+
+
+def _is_environ(node: ast.AST) -> bool:
+    d = _dotted(node)
+    return d in ("os.environ", "environ")
+
+
+def _lock_like(expr: ast.AST) -> bool:
+    return "lock" in _dotted(expr).lower()
+
+
+class _FileLinter(ast.NodeVisitor):
+    def __init__(self, relpath: str, tree: ast.Module):
+        self.relpath = relpath
+        self.findings: List[Diagnostic] = []
+        self.func_stack: List[str] = []
+        self.lock_depth = 0
+        self.cached_jit_depth = 0
+        self.is_env_module = relpath.endswith(_ENV_MODULE)
+        self.is_jitcache = relpath.endswith(_JITCACHE_MODULE)
+        self.threaded = any(relpath.endswith(m) for m in _THREADED_MODULES)
+        self.shared_dicts = self._module_dicts(tree) if self.threaded else set()
+
+    @staticmethod
+    def _module_dicts(tree: ast.Module) -> set:
+        """Names bound at module level to dict-like containers."""
+        names: set = set()
+        for stmt in tree.body:
+            targets: List[ast.expr] = []
+            value: Optional[ast.expr] = None
+            if isinstance(stmt, ast.Assign):
+                targets, value = stmt.targets, stmt.value
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                targets, value = [stmt.target], stmt.value
+            if value is None:
+                continue
+            is_dict = isinstance(value, ast.Dict) or (
+                isinstance(value, ast.Call)
+                and _dotted(value.func).split(".")[-1]
+                in ("dict", "OrderedDict", "defaultdict"))
+            if not is_dict:
+                continue
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+        return names
+
+    # -- finding helper ----------------------------------------------------
+    def _add(self, rule: str, node: ast.AST, message: str, hint: str = ""):
+        self.findings.append(Diagnostic(
+            rule, message, hint=hint, path=self.relpath,
+            line=getattr(node, "lineno", 0)))
+
+    # -- context tracking --------------------------------------------------
+    def visit_FunctionDef(self, node):
+        self.func_stack.append(node.name)
+        self.generic_visit(node)
+        self.func_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_With(self, node: ast.With):
+        locked = any(_lock_like(item.context_expr) for item in node.items)
+        self.lock_depth += 1 if locked else 0
+        self.generic_visit(node)
+        self.lock_depth -= 1 if locked else 0
+
+    def _in_builder(self) -> bool:
+        return any(f.startswith("_build") for f in self.func_stack)
+
+    # -- ALK001/ALK002/ALK003 calls & attributes ---------------------------
+    def visit_Call(self, node: ast.Call):
+        # only direct Name/Attribute callees: `jax.jit(f)(x)` is one direct
+        # jit call, not two (the outer call invokes the returned function)
+        d = _dotted(node.func) \
+            if isinstance(node.func, (ast.Name, ast.Attribute)) else ""
+        tail = d.split(".")[-1]
+        if tail == "cached_jit":
+            # jit built inside a cached_jit(...) argument (the inline
+            # `lambda: jax.jit(run)` idiom) registers with the ProgramCache
+            self.cached_jit_depth += 1
+            self.generic_visit(node)
+            self.cached_jit_depth -= 1
+            return
+        if d in ("jax.jit", "pjit", "jax.pjit", "pjit.pjit",
+                 "jax.experimental.pjit.pjit") \
+                and not self.is_jitcache and not self._in_builder() \
+                and not self.cached_jit_depth:
+            self._add(
+                "ALK001", node,
+                f"direct {d}() call outside a ProgramCache builder — the "
+                "compiled program is rebuilt (and jax's dispatch cache "
+                "discarded) every time this code path re-runs",
+                hint="wrap in a _build*() builder registered via "
+                     "common/jitcache.cached_jit")
+        if tail == "get" and isinstance(node.func, ast.Attribute) \
+                and _is_environ(node.func.value) and not self.is_env_module:
+            self._add(
+                "ALK003", node,
+                "raw os.environ.get() — knob parsing bypasses "
+                "common/env.py (malformed values crash instead of "
+                "falling back)",
+                hint="use env_int/env_float/env_flag/env_str from "
+                     "alink_tpu.common.env")
+        if d in ("os.getenv", "getenv") and not self.is_env_module:
+            self._add(
+                "ALK003", node,
+                "raw os.getenv() — knob parsing bypasses common/env.py "
+                "(malformed values crash instead of falling back)",
+                hint="use env_int/env_float/env_flag/env_str from "
+                     "alink_tpu.common.env")
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute):
+        if node.attr == "shard_map" and _dotted(node.value) == "jax":
+            self._add(
+                "ALK002", node,
+                "jax.shard_map call site — the installed JAX removed "
+                "jax.shard_map; this path fails at trace time "
+                "(ROADMAP Open item 3)",
+                hint="migrate to the current sharding API / a compat shim")
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom):
+        if node.module and "shard_map" in node.module:
+            self._add(
+                "ALK002", node,
+                f"import from {node.module} — shard_map drift",
+                hint="migrate to the current sharding API / a compat shim")
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node: ast.Subscript):
+        if _is_environ(node.value) and isinstance(node.ctx, ast.Load) \
+                and not self.is_env_module:
+            self._add(
+                "ALK003", node,
+                "raw os.environ[...] read outside common/env.py",
+                hint="use env_int/env_float/env_flag/env_str from "
+                     "alink_tpu.common.env")
+        self._check_shared_mutation(node)
+        self.generic_visit(node)
+
+    def visit_Compare(self, node: ast.Compare):
+        if any(isinstance(op, (ast.In, ast.NotIn)) for op in node.ops) \
+                and any(_is_environ(c) for c in node.comparators) \
+                and not self.is_env_module:
+            self._add(
+                "ALK003", node,
+                "membership probe on os.environ outside common/env.py",
+                hint="use env_str(name, None) is not None, or an env_* "
+                     "helper with a default")
+        self.generic_visit(node)
+
+    # -- ALK004 shared-dict mutation ---------------------------------------
+    def _check_shared_mutation(self, node: ast.Subscript):
+        if not self.shared_dicts or self.lock_depth or not self.func_stack:
+            return
+        if isinstance(node.ctx, (ast.Store, ast.Del)) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id in self.shared_dicts:
+            self._add(
+                "ALK004", node,
+                f"module-level dict {node.value.id!r} mutated outside a "
+                "lock in a threaded module",
+                hint="take the module's lock (with _lock:) around the "
+                     "mutation, or make the structure thread-confined")
+
+    def visit_Expr(self, node: ast.Expr):
+        if self.shared_dicts and not self.lock_depth and self.func_stack \
+                and isinstance(node.value, ast.Call) \
+                and isinstance(node.value.func, ast.Attribute) \
+                and node.value.func.attr in _MUTATORS \
+                and isinstance(node.value.func.value, ast.Name) \
+                and node.value.func.value.id in self.shared_dicts:
+            self._add(
+                "ALK004", node,
+                f"module-level dict {node.value.func.value.id!r}."
+                f"{node.value.func.attr}() outside a lock in a threaded "
+                "module",
+                hint="take the module's lock around the mutation")
+        self.generic_visit(node)
+
+    # -- ALK005 except swallows --------------------------------------------
+    def visit_ExceptHandler(self, node: ast.ExceptHandler):
+        if node.type is None:
+            self._add(
+                "ALK005", node,
+                "bare except: catches SystemExit/KeyboardInterrupt too",
+                hint="catch Exception (or a narrower class) and count/log "
+                     "the failure")
+        else:
+            broad = _dotted(node.type) in ("Exception", "BaseException")
+            only_pass = all(
+                isinstance(s, ast.Pass)
+                or (isinstance(s, ast.Expr)
+                    and isinstance(s.value, ast.Constant))
+                for s in node.body)
+            if broad and only_pass:
+                self._add(
+                    "ALK005", node,
+                    f"except {_dotted(node.type)}: pass — the failure "
+                    "vanishes without a counter or log",
+                    hint="count it (metrics.incr) or log at debug; "
+                         "narrow the exception class where possible")
+        self.generic_visit(node)
+
+
+# ---------------------------------------------------------------------------
+# Running the linter
+# ---------------------------------------------------------------------------
+
+
+def iter_python_files(root: str) -> List[str]:
+    out: List[str] = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames
+                             if d not in ("__pycache__", ".git"))
+        for f in sorted(filenames):
+            if f.endswith(".py"):
+                out.append(os.path.join(dirpath, f))
+    return out
+
+
+def lint_file(path: str, rel_base: Optional[str] = None) -> List[Diagnostic]:
+    rel_base = rel_base or os.path.dirname(_PKG_DIR)
+    rel = os.path.relpath(os.path.abspath(path), rel_base).replace(
+        os.sep, "/")
+    with open(path, "r", encoding="utf-8") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        d = Diagnostic("ALK000", f"file does not parse: {e}", path=rel,
+                       line=e.lineno or 0, severity="error")
+        return [d]
+    linter = _FileLinter(rel, tree)
+    linter.visit(tree)
+    return linter.findings
+
+
+def run_lint(paths: Optional[Sequence[str]] = None,
+             rel_base: Optional[str] = None) -> Report:
+    """Lint ``paths`` (files or directories; default: the installed
+    alink_tpu package) and return one Report. Counts land in the
+    ``analysis.lint_*`` metrics so drift is observable at ``/metrics``."""
+    from ..common.metrics import metrics
+
+    targets: List[str] = []
+    for p in (paths or [_PKG_DIR]):
+        if os.path.isdir(p):
+            targets.extend(iter_python_files(p))
+        else:
+            targets.append(p)
+    report = Report(engine="lint", target=f"{len(targets)} files")
+    for path in targets:
+        report.extend(lint_file(path, rel_base=rel_base))
+    metrics.incr("analysis.lint_runs")
+    metrics.incr("analysis.lint_findings", len(report.diagnostics))
+    for rule, n in report.by_rule().items():
+        metrics.incr(f"analysis.rule.{rule}", n)
+    return report
+
+
+# ---------------------------------------------------------------------------
+# Suppression baseline (per-rule, per-file counts — a ratchet)
+# ---------------------------------------------------------------------------
+
+
+def baseline_counts(report: Report) -> Dict[str, Dict[str, int]]:
+    counts: Dict[str, Dict[str, int]] = {}
+    for d in report.diagnostics:
+        counts.setdefault(d.rule, {})
+        counts[d.rule][d.path] = counts[d.rule].get(d.path, 0) + 1
+    return {r: dict(sorted(files.items()))
+            for r, files in sorted(counts.items())}
+
+
+def load_baseline(path: str = DEFAULT_BASELINE) -> Dict[str, Dict[str, int]]:
+    if not os.path.exists(path):
+        return {}
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            blob = json.load(f)
+        return blob.get("counts", {})
+    except (OSError, ValueError):
+        return {}
+
+
+def write_baseline(report: Report, path: str = DEFAULT_BASELINE) -> None:
+    blob = {
+        "comment": "alink-lint suppression baseline: per-rule per-file "
+                   "finding counts. --check fails only when a count GROWS; "
+                   "shrink it by fixing findings then --write-baseline.",
+        "counts": baseline_counts(report),
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(blob, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def check_against_baseline(
+        report: Report,
+        baseline: Dict[str, Dict[str, int]]) -> List[Tuple[str, str, int, int]]:
+    """Regressions vs the baseline: (rule, file, found, allowed) for every
+    (rule, file) whose finding count exceeds its baselined allowance."""
+    regressions: List[Tuple[str, str, int, int]] = []
+    for rule, files in baseline_counts(report).items():
+        for path, n in files.items():
+            allowed = int(baseline.get(rule, {}).get(path, 0))
+            if n > allowed:
+                regressions.append((rule, path, n, allowed))
+    return regressions
+
+
+# ---------------------------------------------------------------------------
+# shard_map drift inventory (ROADMAP Open item 3 work-list)
+# ---------------------------------------------------------------------------
+
+
+def shard_map_inventory(report: Optional[Report] = None) -> Dict[str, Any]:
+    """Machine-readable inventory of every ``jax.shard_map`` call site the
+    ALK002 rule finds — the migration work-list for ROADMAP Open item 3."""
+    report = report or run_lint()
+    modules: Dict[str, Dict[str, Any]] = {}
+    for d in report.diagnostics:
+        if d.rule != "ALK002":
+            continue
+        m = modules.setdefault(d.path, {"count": 0, "lines": []})
+        m["count"] += 1
+        m["lines"].append(d.line)
+    for m in modules.values():
+        m["lines"].sort()
+    total = sum(m["count"] for m in modules.values())
+    return {
+        "generated_by": "python -m alink_tpu.analysis.lint "
+                        "--shard-map-inventory",
+        "rule": "ALK002",
+        "roadmap_item": 3,
+        "total_call_sites": total,
+        "modules": dict(sorted(modules.items())),
+    }
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m alink_tpu.analysis.lint",
+        description="alink-lint: framework invariant checker")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: the alink_tpu "
+                         "package)")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 on findings not covered by the baseline")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline JSON path")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write the current findings as the new baseline")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--shard-map-inventory", metavar="OUT.json",
+                    help="write the ALK002 drift inventory and exit")
+    ap.add_argument("--rules", action="store_true",
+                    help="print the rule table and exit")
+    args = ap.parse_args(argv)
+
+    if args.rules:
+        for rid, (title, sev, desc) in sorted(RULES.items()):
+            print(f"{rid}  {title:28s} [{sev}] {desc}")
+        return 0
+
+    report = run_lint(args.paths or None)
+
+    if args.shard_map_inventory:
+        inv = shard_map_inventory(report)
+        with open(args.shard_map_inventory, "w", encoding="utf-8") as f:
+            json.dump(inv, f, indent=2)
+            f.write("\n")
+        print(f"wrote {inv['total_call_sites']} shard_map call sites in "
+              f"{len(inv['modules'])} modules to "
+              f"{args.shard_map_inventory}")
+        return 0
+
+    if args.write_baseline:
+        write_baseline(report, args.baseline)
+        print(f"baseline written: {args.baseline} "
+              f"({len(report.diagnostics)} findings suppressed)")
+        return 0
+
+    if args.format == "json":
+        print(json.dumps(report.to_dict(), indent=2))
+    else:
+        print(report.render())
+
+    if args.check:
+        regressions = check_against_baseline(
+            report, load_baseline(args.baseline))
+        if regressions:
+            print("\nnon-baselined findings (fix them or refresh the "
+                  "baseline deliberately):")
+            for rule, path, n, allowed in regressions:
+                print(f"  {rule} {path}: {n} found, {allowed} baselined")
+            return 1
+        print("\nlint check: OK (all findings baselined)")
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover — CLI entry
+    sys.exit(main())

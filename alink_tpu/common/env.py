@@ -43,6 +43,25 @@ def env_float(name: str, default: "float | None") -> "float | None":
         return default
 
 
+def env_str(name: str, default: "str | None" = None) -> "str | None":
+    """String env knob: the raw value when set and non-empty, else the
+    default (empty/whitespace counts as unset — an exported-but-blank knob
+    must behave like an absent one)."""
+    raw = os.environ.get(name)
+    if raw is None or raw.strip() == "":
+        return default
+    return raw
+
+
+def env_raw(name: str) -> "str | None":
+    """The value exactly as set (blank included); ``None`` only when absent.
+    For topology knobs (NUM_PROCESSES, PROCESS_ID) where an exported-but-
+    blank value — e.g. an unexpanded ``${WORLD_SIZE}`` in a launcher
+    manifest — must fail loudly downstream rather than read as unset and
+    silently degrade a multi-host job to single-process."""
+    return os.environ.get(name)
+
+
 def env_flag(name: str, default: bool = False) -> bool:
     """Boolean env knob: "0"/"off"/"false"/"no" are false, anything else
     present is true, absent is the default."""

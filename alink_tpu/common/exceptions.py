@@ -92,6 +92,22 @@ class AkServingOverloadException(AkRetryableException):
     code = "AK_SERVING_OVERLOAD"
 
 
+class AkPlanValidationException(AkIllegalOperationException):
+    """The pre-flight plan validator (``ALINK_VALIDATE_PLAN=error``) found
+    error-severity diagnostics: the deferred DAG would fail (or silently
+    misbehave) once a kernel traces. ``.report`` carries the structured
+    :class:`~alink_tpu.analysis.diagnostics.Report`."""
+
+    code = "AK_PLAN_VALIDATION"
+
+    def __init__(self, report):
+        self.report = report
+        errors = report.errors() if hasattr(report, "errors") else []
+        summary = "; ".join(str(d) for d in errors[:5]) or str(report)
+        super().__init__(
+            f"plan validation failed ({len(errors)} error(s)): {summary}")
+
+
 class AkDeadlineExceededException(AkException):
     """The caller's deadline expired before the work completed. NOT
     retryable — the budget is spent; resubmitting with a fresh deadline is

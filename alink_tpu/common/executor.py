@@ -53,12 +53,12 @@ Knobs (env):
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 from concurrent.futures import FIRST_COMPLETED, wait
 from typing import Any, Dict, List, Optional, Sequence
 
+from .env import env_int, env_str
 from .faults import maybe_fail
 from .metrics import metrics, node_phase_context
 from .profiling import sample_device_memory
@@ -71,12 +71,13 @@ _TRACE_LIMIT = 4096  # ring bound on trace series: long-lived processes
 
 
 def scheduler_enabled() -> bool:
-    return os.environ.get("ALINK_DAG_SCHEDULER", "").lower() not in (
+    return (env_str("ALINK_DAG_SCHEDULER", "") or "").lower() not in (
         "off", "0", "serial")
 
 
 def fusion_enabled() -> bool:
-    return os.environ.get("ALINK_DAG_FUSION", "1").lower() not in ("0", "off")
+    return (env_str("ALINK_DAG_FUSION", "1") or "1").lower() not in (
+        "0", "off")
 
 
 def _in_dag_worker() -> bool:
@@ -244,10 +245,7 @@ def _plan_units(nodes: List[Any], roots: Sequence[Any]) -> List[_Unit]:
 
 
 def _dag_pool_size(env) -> int:
-    try:
-        n = int(os.environ.get("ALINK_DAG_POOL_SIZE", "0"))
-    except ValueError:
-        n = 0
+    n = env_int("ALINK_DAG_POOL_SIZE", 0)
     if n > 0:
         return n
     return max(2, min(8, env.parallelism))

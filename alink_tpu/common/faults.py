@@ -58,13 +58,12 @@ pressure it actually absorbed.
 
 from __future__ import annotations
 
-import os
 import threading
 import zlib
 from random import Random
 from typing import Dict, Optional
 
-from .env import env_int
+from .env import env_int, env_str
 from .exceptions import AkException, AkRetryableException
 from .metrics import metrics
 
@@ -228,13 +227,13 @@ def active() -> Optional[FaultSpec]:
     # lock-free fast path: the tap sits on hot paths (every H2D transfer
     # submission, every DAG unit attempt, every connector poll) and must
     # not serialize transfer threads on a global mutex when injection is
-    # off. Reading `_installed` and probing os.environ are plain dict
+    # off. Reading `_installed` and probing the env knob are plain dict
     # lookups; the lock is only taken once a spec is actually configured.
     spec = _installed
     if spec is not None:
         return spec
-    env = os.environ.get("ALINK_FAULT_SPEC")
-    if not env or not env.strip():
+    env = env_str("ALINK_FAULT_SPEC")
+    if env is None:
         return None
     env = env.strip()
     seed = env_int("ALINK_FAULT_SEED", 0)

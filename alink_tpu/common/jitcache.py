@@ -45,6 +45,7 @@ from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tupl
 import numpy as np
 
 from . import profiling as _profiling
+from .env import env_int, env_str
 from .metrics import add_node_phase, metrics
 
 # ---------------------------------------------------------------------------
@@ -180,7 +181,7 @@ _LINEAR_STEP = 8
 
 
 def _parse_buckets() -> "str | List[int]":
-    raw = os.environ.get(_BUCKETS_ENV, "").strip().lower()
+    raw = (env_str(_BUCKETS_ENV, "") or "").strip().lower()
     if raw in ("", "pow2"):
         return "pow2"
     if raw in ("off", "0", "none"):
@@ -324,7 +325,7 @@ _profile_lock = threading.Lock()
 
 
 def _record_profile(kernel_id: str, sig: tuple) -> None:
-    path = os.environ.get("ALINK_SHAPE_PROFILE")
+    path = env_str("ALINK_SHAPE_PROFILE")
     if not path:
         return
     arrs = [[list(s[1]), s[2]] for s in sig if s[0] == "a"]
@@ -339,7 +340,7 @@ def load_shape_profile(path: Optional[str] = None) -> List[Tuple[str, list]]:
     """Parse an ``ALINK_SHAPE_PROFILE`` jsonl into warmup specs
     ``[(kernel_id, [(shape, dtype), ...]), ...]`` (deduplicated, order
     preserved; malformed lines skipped)."""
-    path = path or os.environ.get("ALINK_SHAPE_PROFILE")
+    path = path or env_str("ALINK_SHAPE_PROFILE")
     specs: List[Tuple[str, list]] = []
     seen = set()
     if not path or not os.path.exists(path):
@@ -455,11 +456,7 @@ def _max_programs() -> int:
     size-bounded lru_caches; without a bound a long-running tuning sweep
     (one optimizer entry per hyper-parameter combination) would pin every
     compiled executable for process lifetime."""
-    raw = os.environ.get("ALINK_PROGRAM_CACHE_SIZE")
-    try:
-        return _DEFAULT_MAX_PROGRAMS if not raw else int(raw)
-    except ValueError:
-        return _DEFAULT_MAX_PROGRAMS
+    return env_int("ALINK_PROGRAM_CACHE_SIZE", _DEFAULT_MAX_PROGRAMS)
 
 
 def _policy_component() -> str:

@@ -47,7 +47,6 @@ CI-pinned in ``tests/test_profiling.py``.
 
 from __future__ import annotations
 
-import os
 import sys
 import threading
 import time
@@ -56,7 +55,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from .env import env_flag, env_float, env_int
+from .env import env_flag, env_float, env_int, env_str
 from .metrics import metrics
 
 # ---------------------------------------------------------------------------
@@ -71,7 +70,7 @@ def profiling_mode() -> str:
     memory), ``deep`` (eager capture at compile time + exact
     ``memory_analysis()``), or ``off``. Unrecognized values degrade to the
     nearest boolean reading (config typos must not crash a job)."""
-    raw = (os.environ.get("ALINK_PROFILING") or "on").strip().lower()
+    raw = (env_str("ALINK_PROFILING", "on") or "on").strip().lower()
     if raw in _MODES:
         return raw
     return "off" if raw in ("0", "false", "no", "none", "") else "on"

@@ -358,6 +358,14 @@ class RecoverableStreamJob:
         self.checkpoint_dir = checkpoint_dir
         self.epoch_chunks = max(1, int(epoch_chunks))
         self.keep_snapshots = keep_snapshots
+        # opt-in pre-flight with recovery escalation: under
+        # ALINK_VALIDATE_PLAN, missing-snapshot-hook (ALK104) reads as an
+        # ERROR here — the structured report lands before the hard
+        # per-op refusals below raise their first bare message
+        from ..analysis import preflight
+
+        preflight([source] + [op for ops, _ in chains for op in ops],
+                  where="recovery.build", recovery=True)
         self.chains: List[Tuple[List[Any], List[TransactionalSink]]] = []
         seen_ops: set = set()
         seen_sinks: set = set()

@@ -32,7 +32,6 @@ large fraction of a small accelerator's memory.
 from __future__ import annotations
 
 import hashlib
-import os
 import threading
 import time
 from collections import OrderedDict
@@ -40,6 +39,7 @@ from typing import Any, Optional, Tuple
 
 import numpy as np
 
+from .env import env_int, env_raw, env_str
 from .metrics import metrics
 
 _WIRE_THRESHOLD_BYTES = 4 * 1024 * 1024
@@ -115,12 +115,12 @@ class StagingCache:
     # -- config ------------------------------------------------------------
     @property
     def max_bytes(self) -> int:
-        env = os.environ.get("ALINK_STAGING_CACHE_BYTES")
-        if env is not None:
+        raw = env_raw("ALINK_STAGING_CACHE_BYTES")
+        if raw is not None:
             try:
-                return int(env)
+                return int(raw)  # any <= 0 disables the cache
             except ValueError:
-                pass
+                pass  # malformed tuning knob: fall back, never crash
         return (self._max_bytes if self._max_bytes is not None
                 else _device_default_cap())
 
@@ -216,8 +216,8 @@ def wire_is_slow() -> bool:
     < ~64 MB/s counts as slow — PCIe-class wires measure in GB/s, the axon
     tunnel in single-digit MB/s). The answer gates the ``auto`` bf16 wire
     policy and content-cache use inside streaming."""
-    env = os.environ.get("ALINK_ASSUME_SLOW_WIRE")
-    if env is not None and env != "":
+    env = env_str("ALINK_ASSUME_SLOW_WIRE")
+    if env is not None:
         return env.lower() in ("1", "true", "yes")
     if _wire_probe["slow"] is None:
         # single-flight: concurrent transfer threads must not each run a
@@ -247,7 +247,7 @@ def wire_is_slow() -> bool:
 
 
 def wire_precision() -> str:
-    env = os.environ.get("ALINK_WIRE_PRECISION")
+    env = env_str("ALINK_WIRE_PRECISION")
     if env:
         return env.lower()
     from .env import AlinkGlobalConfiguration

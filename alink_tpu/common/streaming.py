@@ -29,12 +29,13 @@ costs nothing — and bypass the digest overhead on fast local wires.
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Iterable, Iterator, Optional, Sequence, Tuple
+
+from .env import env_int
 
 DEFAULT_DEPTH = 2
 
@@ -43,17 +44,11 @@ _pool: Optional[ThreadPoolExecutor] = None
 
 
 def stream_depth(default: int = DEFAULT_DEPTH) -> int:
-    try:
-        return max(1, int(os.environ.get("ALINK_STREAM_DEPTH", default)))
-    except ValueError:
-        return default
+    return max(1, env_int("ALINK_STREAM_DEPTH", default))
 
 
 def _num_streams() -> int:
-    try:
-        return max(1, int(os.environ.get("ALINK_H2D_STREAMS", "4")))
-    except ValueError:
-        return 4
+    return max(1, env_int("ALINK_H2D_STREAMS", 4))
 
 
 def transfer_pool() -> ThreadPoolExecutor:

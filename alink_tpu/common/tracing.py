@@ -44,7 +44,7 @@ import uuid
 from collections import deque
 from typing import Any, Dict, List, Optional
 
-from .env import env_flag, env_float, env_int
+from .env import env_flag, env_float, env_int, env_str
 from .metrics import metrics
 
 _RING_DEFAULT = 4096
@@ -184,7 +184,7 @@ class Tracer:
         return int(mb * 1024 * 1024) if mb > 0 else 0
 
     def _log(self, span: Span) -> None:
-        path = os.environ.get("ALINK_TRACE_LOG")
+        path = env_str("ALINK_TRACE_LOG")
         if not path:
             return
         rec = span.to_dict()
@@ -405,9 +405,18 @@ def job_report(trace_id: Optional[str] = None) -> Dict[str, Any]:
         profile = profile_summary(top=12)
     except Exception:
         pass
+    try:
+        # last pre-flight plan-validation report (None when the validator
+        # never ran — ALINK_VALIDATE_PLAN=off)
+        from ..analysis import last_plan_report
+
+        analysis: Optional[Dict[str, Any]] = last_plan_report()
+    except Exception:
+        analysis = None
     return {
         "trace_id": trace_id,
         "profile": profile,
+        "analysis": analysis,
         "root": None if root is None else
         {"name": root["name"], "wall_s": root["wall_s"],
          "outcome": root["outcome"]},

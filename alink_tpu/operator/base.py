@@ -125,8 +125,17 @@ class AlgoOperator(WithParams):
 
         mgr = self.env.lazy_manager
         pending = list(mgr.pending_ops())
+        roots = list(extra_roots) + pending
+        if roots:
+            # opt-in pre-flight (ALINK_VALIDATE_PLAN=warn|error): propagate
+            # static schemas over the whole deferred DAG before any kernel
+            # traces; `error` raises on error-severity diagnostics, `warn`
+            # logs + counts them and never changes results
+            from ..analysis import preflight
+
+            preflight(roots, where="execute")
         try:
-            run_dag(self.env, list(extra_roots) + pending)
+            run_dag(self.env, roots)
         except BaseException:
             # graceful degradation on a failed run: sinks whose branches
             # DID complete still fire and clear, while failed branches stay

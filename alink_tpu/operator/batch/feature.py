@@ -52,6 +52,10 @@ class VectorAssemblerBatchOp(MapBatchOp, HasSelectedCols, HasOutputCol,
                              HasReservedCols):
     mapper_cls = VectorAssemblerMapper
 
+    # plan validator (alink_tpu/analysis): assembled columns must be
+    # numeric or vector — a STRING here fails inside to_numeric_block
+    _plan_col_requirements = {"selectedCols": "numvec"}
+
 
 class StandardScalerTrainBatchOp(ModelTrainOpMixin, BatchOperator, HasSelectedCols):
     """(reference: StandardScalerTrainBatchOp.java) — one distributed moment
@@ -62,6 +66,9 @@ class StandardScalerTrainBatchOp(ModelTrainOpMixin, BatchOperator, HasSelectedCo
 
     _min_inputs = 1
     _max_inputs = 1
+
+    # plan validator: selected columns feed the moment kernel — numeric only
+    _plan_col_requirements = {"selectedCols": "numeric"}
 
     def _execute_impl(self, t: MTable) -> MTable:
         cols = list(self.get(HasSelectedCols.SELECTED_COLS) or
@@ -130,6 +137,8 @@ class MinMaxScalerTrainBatchOp(ModelTrainOpMixin, BatchOperator, HasSelectedCols
 
     _min_inputs = 1
     _max_inputs = 1
+
+    _plan_col_requirements = {"selectedCols": "numeric"}
 
     def _execute_impl(self, t: MTable) -> MTable:
         cols = list(self.get(HasSelectedCols.SELECTED_COLS) or

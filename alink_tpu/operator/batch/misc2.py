@@ -240,6 +240,9 @@ class BaseSinkBatchOp(BatchOperator):
 
     _min_inputs = 1
     _max_inputs = 1
+    # the plan validator must never zero-row-probe a sink's _execute_impl
+    # (it performs the write); sinks pass their input schema through
+    _plan_passthrough = True
 
 
 class BaseSqlApiBatchOp(BatchOperator):

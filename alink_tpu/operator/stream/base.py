@@ -110,6 +110,9 @@ class StreamOperator(WithParams):
         Each chunk's end-to-end latency (source pull through this
         operator's transform) lands in the ``stream.chunk_s`` histogram;
         the whole drain is one ``stream.collect`` span."""
+        from ...analysis import preflight
+
+        preflight(self, where="stream.collect")
         chunks = []
         with trace_span("stream.collect",
                         op=type(self).__name__) as sp:

@@ -15,8 +15,9 @@ where jax autodetects them from the metadata server).
 
 from __future__ import annotations
 
-import os
 from typing import Optional
+
+from ..common.env import env_raw
 
 _initialized = False
 
@@ -35,14 +36,18 @@ def init_multi_host(
     global _initialized
     import jax
 
-    coordinator_address = coordinator_address or os.environ.get(
+    coordinator_address = coordinator_address or env_raw(
         "COORDINATOR_ADDRESS")
-    num_processes = num_processes if num_processes is not None else (
-        int(os.environ["NUM_PROCESSES"])
-        if "NUM_PROCESSES" in os.environ else None)
-    process_id = process_id if process_id is not None else (
-        int(os.environ["PROCESS_ID"])
-        if "PROCESS_ID" in os.environ else None)
+    # topology knobs fail LOUDLY on malformed values (unlike tuning knobs):
+    # a typo'd — or exported-but-blank — NUM_PROCESSES silently falling
+    # back would leave this host running single-process while its peers
+    # block at the coordinator
+    if num_processes is None:
+        raw = env_raw("NUM_PROCESSES")
+        num_processes = int(raw) if raw is not None else None
+    if process_id is None:
+        raw = env_raw("PROCESS_ID")
+        process_id = int(raw) if raw is not None else None
 
     should_init = (coordinator_address is not None
                    or (num_processes or 0) > 1)

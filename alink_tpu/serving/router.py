@@ -33,14 +33,13 @@ contract (``common/jitcache.py``) already pins as parity-safe.
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 from collections import deque
 from dataclasses import dataclass, replace
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
-from ..common.env import env_float, env_int
+from ..common.env import env_float, env_int, env_str
 from ..common.exceptions import (
     AkCircuitOpenException,
     AkDeadlineExceededException,
@@ -111,7 +110,8 @@ class ServingConfig:
 
     @classmethod
     def default(cls) -> "ServingConfig":
-        shed = os.environ.get("ALINK_SERVING_SHED_POLICY", "reject").lower()
+        shed = (env_str("ALINK_SERVING_SHED_POLICY", "reject")
+                or "reject").lower()
         return cls(
             queue_depth=max(1, env_int("ALINK_SERVING_QUEUE_DEPTH", 256)),
             max_batch_rows=max(1, env_int("ALINK_SERVING_MAX_BATCH_ROWS", 64)),

@@ -15,8 +15,9 @@ program on the 8-virtual-device CPU mesh.
 
 from __future__ import annotations
 
-import os
 from functools import partial
+
+from ..common.env import env_str
 
 import numpy as np
 
@@ -36,9 +37,10 @@ def use_pallas_hist() -> bool:
     ALINK_GBDT_PALLAS=1/0."""
     import jax
 
-    flag = os.environ.get("ALINK_GBDT_PALLAS")
+    flag = env_str("ALINK_GBDT_PALLAS")
     if flag is not None:
-        return flag not in ("0", "false", "")
+        # same falsey convention as env_flag; blank counts as unset (above)
+        return flag.strip().lower() not in ("0", "off", "false", "no")
     # axon = the tunneled TPU platform; both compile the real Mosaic kernel
     return jax.default_backend() in ("tpu", "axon")
 

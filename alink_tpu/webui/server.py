@@ -380,6 +380,24 @@ class _Handler(BaseHTTPRequestHandler):
             from ..common.profiling import profile_summary
 
             return self._send_json(profile_summary())
+        if parts == ["analysis"]:
+            # static-analysis panel: the last pre-flight plan report, the
+            # analysis.* counters, and the rule table
+            from ..analysis import RULES, last_plan_report, validation_mode
+
+            return self._send_json({
+                "mode": validation_mode(),
+                "plan": last_plan_report(),
+                "counters": metrics.counters("analysis."),
+                "rules": {rid: {"title": t, "severity": s, "description": d}
+                          for rid, (t, s, d) in sorted(RULES.items())},
+            })
+        if parts == ["analysis", "lint"]:
+            # run alink-lint over the installed package on demand (a few
+            # hundred ms of AST walking; nothing executes)
+            from ..analysis import run_lint
+
+            return self._send_json(run_lint().to_dict())
         if parts == ["traces"]:
             return self._send_json({"traces": tracer.traces()})
         if len(parts) == 2 and parts[0] == "traces":

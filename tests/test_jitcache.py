@@ -586,6 +586,12 @@ def test_staging_cap_env_override_wins(monkeypatch):
 
     monkeypatch.setenv("ALINK_STAGING_CACHE_BYTES", "12345")
     assert StagingCache().max_bytes == 12345
+    # an explicit negative value disables the cache (max_bytes <= 0 is the
+    # put() no-op path) — it must NOT fall back to the device default
+    monkeypatch.setenv("ALINK_STAGING_CACHE_BYTES", "-1")
+    assert StagingCache().max_bytes == -1
+    monkeypatch.setenv("ALINK_STAGING_CACHE_BYTES", "bogus")
+    assert StagingCache(max_bytes=777).max_bytes == 777
     monkeypatch.delenv("ALINK_STAGING_CACHE_BYTES")
     assert StagingCache(max_bytes=777).max_bytes == 777
 
