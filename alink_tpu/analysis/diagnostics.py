@@ -37,8 +37,9 @@ RULES: Dict[str, tuple] = {
                "builders — per-call rebuilt programs defeat the process-wide "
                "compile cache"),
     "ALK002": ("shard-map-drift", WARNING,
-               "jax.shard_map usage — removed from the installed JAX; the "
-               "call site fails at import/trace time (ROADMAP Open item 3)"),
+               "direct jax.shard_map usage — import the version-compat shim "
+               "instead (alink_tpu/parallel/shardmap.py normalizes the "
+               "check_vma/check_rep and axis_names/auto API drift)"),
     "ALK003": ("raw-environ", WARNING,
                "direct os.environ read bypassing the common/env.py knob "
                "parsers (env_int/env_float/env_flag/env_str) — malformed "

@@ -10,9 +10,12 @@ pre-lowering shape inference — PAPERS.md):
   ``common/jitcache.ProgramCache`` — allowed inside ``_build*`` builder
   functions and inside ``cached_jit(...)`` call arguments (the repo's
   builder idiom), and inside ``common/jitcache.py`` itself;
-- **ALK002** any ``jax.shard_map`` reference (removed from the installed
-  JAX — the ROADMAP Open item 3 drift inventory; ``--shard-map-inventory``
-  emits the machine-readable work-list);
+- **ALK002** any direct ``jax.shard_map`` /
+  ``jax.experimental.shard_map`` reference outside
+  ``parallel/shardmap.py`` — the version-compat shim is the one sanctioned
+  import (``from alink_tpu.parallel.shardmap import shard_map``); the
+  migration retired the drift, so the baseline pins this rule at zero and
+  ``--shard-map-inventory`` must stay empty;
 - **ALK003** raw ``os.environ`` *reads* (``.get``/subscript-load/``in``)
   outside ``common/env.py`` — writes (``setdefault``, assignment, ``del``)
   are allowed, knob *parsing* is what must be centralized;
@@ -67,6 +70,7 @@ _THREADED_MODULES = (
 # the knob-parser module itself — the one place raw environ reads belong
 _ENV_MODULE = "common/env.py"
 _JITCACHE_MODULE = "common/jitcache.py"
+_SHARDMAP_SHIM = "parallel/shardmap.py"
 
 _MUTATORS = ("update", "setdefault", "pop", "popitem", "clear")
 
@@ -101,6 +105,7 @@ class _FileLinter(ast.NodeVisitor):
         self.cached_jit_depth = 0
         self.is_env_module = relpath.endswith(_ENV_MODULE)
         self.is_jitcache = relpath.endswith(_JITCACHE_MODULE)
+        self.is_shardmap_shim = relpath.endswith(_SHARDMAP_SHIM)
         self.threaded = any(relpath.endswith(m) for m in _THREADED_MODULES)
         self.shared_dicts = self._module_dicts(tree) if self.threaded else set()
 
@@ -195,21 +200,43 @@ class _FileLinter(ast.NodeVisitor):
         self.generic_visit(node)
 
     def visit_Attribute(self, node: ast.Attribute):
-        if node.attr == "shard_map" and _dotted(node.value) == "jax":
+        # flag `jax.shard_map` and `jax.experimental.shard_map` at the
+        # INNERMOST matching attribute only, so the full
+        # `jax.experimental.shard_map.shard_map(...)` chain reports once
+        if node.attr == "shard_map" \
+                and _dotted(node.value) in ("jax", "jax.experimental") \
+                and not self.is_shardmap_shim:
             self._add(
                 "ALK002", node,
-                "jax.shard_map call site — the installed JAX removed "
-                "jax.shard_map; this path fails at trace time "
-                "(ROADMAP Open item 3)",
-                hint="migrate to the current sharding API / a compat shim")
+                f"direct {_dotted(node)} reference — bypasses the version-"
+                "compat shim and fails at trace time on JAX versions "
+                "without it",
+                hint="from alink_tpu.parallel.shardmap import shard_map "
+                     "(the one sanctioned import)")
+        self.generic_visit(node)
+
+    def visit_Import(self, node: ast.Import):
+        for alias in node.names:
+            if "shard_map" in alias.name and not self.is_shardmap_shim:
+                self._add(
+                    "ALK002", node,
+                    f"import {alias.name} — shard_map drift",
+                    hint="from alink_tpu.parallel.shardmap import "
+                         "shard_map (the one sanctioned import)")
         self.generic_visit(node)
 
     def visit_ImportFrom(self, node: ast.ImportFrom):
-        if node.module and "shard_map" in node.module:
+        mod = node.module or ""
+        drift = "shard_map" in mod or (
+            mod.startswith("jax")
+            and any("shard_map" in a.name for a in node.names))
+        if drift and not self.is_shardmap_shim:
+            names = ", ".join(a.name for a in node.names)
             self._add(
                 "ALK002", node,
-                f"import from {node.module} — shard_map drift",
-                hint="migrate to the current sharding API / a compat shim")
+                f"from {mod} import {names} — shard_map drift",
+                hint="from alink_tpu.parallel.shardmap import shard_map "
+                     "(the one sanctioned import)")
         self.generic_visit(node)
 
     def visit_Subscript(self, node: ast.Subscript):

@@ -47,6 +47,7 @@ CI-pinned in ``tests/test_profiling.py``.
 
 from __future__ import annotations
 
+import re
 import sys
 import threading
 import time
@@ -120,6 +121,102 @@ def xla_cost_analysis(stage) -> Dict[str, float]:
         if isinstance(v, (int, float)) and v >= 0:
             out[dst] = float(v)
     return out
+
+
+_DTYPE_BYTES = {"pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2,
+                "bf16": 2, "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8,
+                "f64": 8, "c64": 8, "c128": 16}
+
+_SHAPE_RE = re.compile(r"(pred|bf16|[fsuc]\d+)\[([\d,]*)\]")
+_COLLECTIVE_RE = re.compile(
+    r"=\s+(\([^)]*\)|\S+)\s+"
+    r"(all-to-all|all-gather|all-reduce|reduce-scatter|collective-permute)"
+    r"(?:-start)?\(")
+_COMPUTATION_RE = re.compile(r"^(?:ENTRY\s+)?%(\S+)\s+\(.*\)\s+->.*\{")
+_CALLEE_RE = re.compile(
+    r"(?:calls|to_apply|body|condition|true_computation|false_computation)="
+    r"%(\S+?)[,)\s]|branch_computations=\{([^}]*)\}")
+
+
+def _shape_bytes(shape_str: str) -> int:
+    total = 0
+    for dtype, dims in _SHAPE_RE.findall(shape_str):
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * _DTYPE_BYTES.get(dtype, 4)
+    return total
+
+
+def collective_bytes(compiled, include_conditional: bool = False) -> int:
+    """Per-device bytes moved by collectives (all-to-all / all-gather /
+    all-reduce / reduce-scatter / collective-permute) in a ``Compiled``'s
+    HLO, summed over result shapes.
+
+    ``include_conditional=False`` (default) skips computations reachable
+    only through ``conditional`` branches — i.e. reports the steady-state
+    wire cost, excluding rarely-taken fallbacks (the APS bucket-overflow
+    path) that XLA compiles in but a normal step never executes. Returns 0
+    when the backend exposes no HLO text."""
+    try:
+        hlo = compiled.as_text()
+    except Exception:
+        return 0
+
+    # split into computation blocks; record each block's collective bytes,
+    # its callees, and the roots referenced from `conditional` instructions
+    per_comp: Dict[str, int] = {}
+    callees: Dict[str, list] = {}
+    cond_roots: list = []
+    name = ""
+    entry = ""
+    for line in hlo.splitlines():
+        header = _COMPUTATION_RE.match(line)
+        if header:
+            name = header.group(1)
+            if line.startswith("ENTRY"):
+                entry = name
+            per_comp.setdefault(name, 0)
+            callees.setdefault(name, [])
+            continue
+        refs = []
+        for single, branches in _CALLEE_RE.findall(line):
+            if single:
+                refs.append(single)
+            refs.extend(b.strip().lstrip("%")
+                        for b in branches.split(",") if b.strip())
+        if " conditional(" in line:
+            cond_roots.extend(refs)
+        elif name:
+            callees[name].extend(refs)
+        m = _COLLECTIVE_RE.search(line)
+        if m and name:
+            per_comp[name] += _shape_bytes(m.group(1))
+
+    excluded: set = set()
+    if not include_conditional:
+        # a computation is steady-state if the entry reaches it WITHOUT
+        # passing through a conditional branch edge (cond-branch refs are
+        # kept out of `callees` above); only computations reachable
+        # exclusively via conditionals are excluded — one XLA CSE'd
+        # between a fallback branch and the steady path still counts
+        steady: set = set()
+        stack = [entry] if entry else []
+        while stack:
+            c = stack.pop()
+            if c in steady:
+                continue
+            steady.add(c)
+            stack.extend(callees.get(c, []))
+        stack = [c for c in cond_roots if c not in steady]
+        while stack:
+            c = stack.pop()
+            if c in excluded or c in steady:
+                continue
+            excluded.add(c)
+            stack.extend(x for x in callees.get(c, []) if x not in steady)
+    return sum(b for comp, b in per_comp.items() if comp not in excluded)
 
 
 # ---------------------------------------------------------------------------

@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 
 from ..parallel.mesh import AXIS_SEQ
+from ..parallel.shardmap import axis_size, pvary, shard_map
 
 _NEG_INF = -1e30
 
@@ -129,7 +130,7 @@ def blockwise_attention(
 
 def _ring_body(q, k, v, mask, axis_name: str, causal: bool):
     """Manual kernel: local q against the rotating ring of k/v shards."""
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     my = jax.lax.axis_index(axis_name)
     b, sq, h, d = q.shape
     scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
@@ -138,7 +139,7 @@ def _ring_body(q, k, v, mask, axis_name: str, causal: bool):
     # initial accumulators must carry the same varying-over-seq type as the
     # loop outputs (check_vma-tracked), hence pvary
     def _varying(x):
-        return jax.lax.pcast(x, axis_name, to="varying")
+        return pvary(x, axis_name)
 
     o0 = _varying(jnp.zeros((b, sq, h, d), jnp.float32))
     m0 = _varying(jnp.full((b, h, sq), _NEG_INF, jnp.float32))
@@ -192,7 +193,7 @@ def ring_attention(
 
     qkv_spec = P(None, axis, None, None)
     if mask is not None:
-        f = jax.shard_map(
+        f = shard_map(
             functools.partial(_ring_body, axis_name=axis, causal=causal),
             mesh=mesh,
             in_specs=(qkv_spec, qkv_spec, qkv_spec, P(None, axis)),
@@ -200,7 +201,7 @@ def ring_attention(
             axis_names={axis},
         )
         return f(q, k, v, mask)
-    f = jax.shard_map(
+    f = shard_map(
         functools.partial(_ring_body, mask=None, axis_name=axis, causal=causal),
         mesh=mesh,
         in_specs=(qkv_spec, qkv_spec, qkv_spec),

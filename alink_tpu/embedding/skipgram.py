@@ -21,6 +21,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..parallel.mesh import AXIS_DATA, default_mesh
+from ..parallel.shardmap import shard_map
 
 
 @dataclass
@@ -175,7 +176,7 @@ def train_skipgram(
         return w_in, w_out
 
     f = jax.jit(
-        jax.shard_map(
+        shard_map(
             body, mesh=mesh, in_specs=(P(AXIS_DATA), P(), P()),
             out_specs=P(), check_vma=False,
         )
@@ -278,7 +279,7 @@ def train_skipgram_sharded(
         return jax.lax.fori_loop(0, total_steps, step, (win_l, wout_l))
 
     f = jax.jit(
-        jax.shard_map(
+        shard_map(
             body, mesh=mesh,
             in_specs=(P(AXIS_MODEL), P(AXIS_MODEL), P(AXIS_MODEL)),
             out_specs=(P(AXIS_MODEL), P(AXIS_MODEL)),

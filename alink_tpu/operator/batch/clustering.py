@@ -21,6 +21,7 @@ from typing import Optional
 import numpy as np
 
 from ...common.exceptions import AkIllegalDataException
+from ...parallel.shardmap import shard_map
 from ...common.linalg import pairwise_sq_dists
 from ...common.model import model_to_table, table_to_model
 from ...common.mtable import AlinkTypes, MTable
@@ -104,7 +105,7 @@ def _build_lloyd(mesh, k: int, max_iter: int, tol: float, metric: str):
     """Build the jitted Lloyd program for one (mesh, k, max_iter, tol,
     metric) config — registered once in the process-wide ProgramCache
     (common/jitcache.py) so repeated fits reuse one traced program instead
-    of rebuilding the ``jax.jit(jax.shard_map(...))`` closure per call."""
+    of rebuilding the ``jax.jit(shard_map(...))`` closure per call."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
@@ -170,7 +171,7 @@ def _build_lloyd(mesh, k: int, max_iter: int, tol: float, metric: str):
         return c, i, inertia
 
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             body, mesh=mesh, in_specs=(P(axis), P(axis), P()), out_specs=P(),
             check_vma=False,
         )

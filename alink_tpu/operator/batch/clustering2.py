@@ -31,6 +31,7 @@ from typing import List
 import numpy as np
 
 from ...common.exceptions import AkIllegalDataException
+from ...parallel.shardmap import shard_map
 from ...common.linalg import pairwise_sq_dists
 from ...common.model import model_to_table, table_to_model
 from ...common.mtable import AlinkTypes, MTable, TableSchema
@@ -113,7 +114,7 @@ def _build_gmm_em(mesh, max_iter: int, tol: float, reg: float):
         i, w, mu, cov, ll, _ = jax.lax.while_loop(cond, step, carry)
         return w, mu, cov, ll, i
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         body, mesh=mesh,
         in_specs=(P(axis), P(axis), P(), P(), P()), out_specs=P(),
         check_vma=False))

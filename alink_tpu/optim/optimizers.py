@@ -25,6 +25,7 @@ import numpy as np
 
 from ..parallel.mesh import AXIS_DATA, default_mesh
 from ..parallel.comqueue import shard_rows
+from ..parallel.shardmap import shard_map
 from .objfunc import ObjFunc
 
 
@@ -302,7 +303,7 @@ def optimize(
 
     def _build(mesh):
         return jax.jit(
-            jax.shard_map(
+            shard_map(
                 body,
                 mesh=mesh,
                 in_specs=(P(axis), P(axis), P(axis), P(axis), P()),

@@ -14,6 +14,7 @@ the given axis name bound.
 from __future__ import annotations
 
 from .mesh import AXIS_DATA
+from .shardmap import axis_size
 
 
 def all_reduce(x, op: str = "sum", axis: str = AXIS_DATA):
@@ -61,6 +62,6 @@ def ppermute_ring(x, axis: str = AXIS_DATA, shift: int = 1):
     exchanges over ICI neighbours."""
     import jax
 
-    n = jax.lax.axis_size(axis)
+    n = axis_size(axis)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return jax.lax.ppermute(x, axis, perm)

@@ -36,6 +36,7 @@ from typing import Any, Callable, Dict, List, Optional
 import numpy as np
 
 from .mesh import AXIS_DATA, pad_to_multiple
+from .shardmap import shard_map
 
 
 class ComContext:
@@ -227,7 +228,7 @@ class IterativeComQueue:
             return state
 
         f = jax.jit(
-            jax.shard_map(
+            shard_map(
                 body, mesh=mesh, in_specs=(P(axis), P()), out_specs=P(),
                 check_vma=False,
             )
@@ -261,7 +262,7 @@ class IterativeComQueue:
             return state, done
 
         step_fn = jax.jit(
-            jax.shard_map(
+            shard_map(
                 superstep,
                 mesh=mesh,
                 in_specs=(P(), P(), P(axis)),
@@ -284,7 +285,7 @@ class IterativeComQueue:
                 return close(ctx, state, data)
 
             close_fn = jax.jit(
-                jax.shard_map(
+                shard_map(
                     close_body, mesh=mesh, in_specs=(P(), P(axis)), out_specs=P(),
                     check_vma=False,
                 )

@@ -24,6 +24,7 @@ from typing import Dict, Optional, Tuple
 import numpy as np
 
 from ..parallel.mesh import AXIS_DATA, default_mesh, pad_to_multiple
+from ..parallel.shardmap import shard_map
 
 
 @dataclass
@@ -77,7 +78,7 @@ def _half_sweep_fn(mesh, k: int, lam: float, implicit: bool, alpha: float):
         return jnp.where(cnt[:, None] > 0, sol, 0.0)
 
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             body, mesh=mesh,
             in_specs=(P(axis), P(axis), P(axis), P(axis), P()),
             out_specs=P(axis), check_vma=False,

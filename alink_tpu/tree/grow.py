@@ -23,6 +23,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..parallel.mesh import AXIS_DATA, default_mesh
+from ..parallel.shardmap import shard_map
 from .binning import apply_bins, quantile_bins
 
 
@@ -109,7 +110,7 @@ def _build_level_fn(mesh, num_nodes: int, num_bins: int, l2: float,
         return feat, thr, _route(bins, node, feat, thr)
 
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             body,
             mesh=mesh,
             in_specs=(P(axis), P(axis), P(axis), P(axis), P(axis), P()),
@@ -160,7 +161,7 @@ def _build_leaf_fn(mesh, num_leaves: int, l2: float):
         return -sg / (sh + l2)
 
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             body, mesh=mesh, in_specs=(P(axis), P(axis), P(axis)),
             out_specs=P(), check_vma=False,
         )
@@ -542,7 +543,7 @@ def _build_gbdt_train_fn(mesh, task: str, num_trees: int, depth: int,
         return feats, thrs, leaves
 
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             body, mesh=mesh,
             in_specs=(P(AXIS_DATA), P(AXIS_DATA), P(AXIS_DATA), P(), P(),
                       P()),
@@ -832,7 +833,7 @@ def _build_impurity_tree_fn(mesh, depth: int, num_bins: int, K: int, d: int,
         return feats_acc, thrs_acc, probs
 
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             body, mesh=mesh,
             in_specs=(P(AXIS_DATA), P(AXIS_DATA), P(), P()),
             out_specs=(P(), P(), P()),

@@ -29,6 +29,10 @@ the reference itself publishes no numbers ("published": {}).
 - profiling: performance observatory drill — per-kernel XLA cost/roofline
   table, profiling off-vs-on overhead delta + bit-parity, benchstats perf
   gate smoke (same-config no-change; synthetic 20% slowdown flagged).
+- aps: pod-scale sparse-embedding exchange — owner-routed pull/push rows/s
+  on the sharded-skipgram pattern, per-device comm-bytes-per-step at M=1
+  vs the full model axis (the regression-gated O(B·D) claim), and a
+  perf_gate verdict of routed vs the legacy all-gather step.
 
 ``python bench.py --compare OLD.json NEW.json`` runs the variance-hardened
 regression gate over two BENCH round files instead of benchmarking (exit
@@ -1221,6 +1225,104 @@ def bench_profiling(repeats=3, rows=300_000):
     }
 
 
+def bench_aps(steps=20):
+    """Pod-scale sparse-embedding exchange (parallel/aps.py): owner-routed
+    pull/push on the sharded-skipgram exchange pattern — rows/s through a
+    full pull→push cycle on the largest mesh, the per-device
+    comm-bytes-per-step accounting behind the O(B·D) claim (routed bytes
+    stay ~flat as the model axis grows; the legacy all-gather reference
+    grows linearly), and a benchstats perf_gate verdict of the routed step
+    against the all-gather step on identical inputs."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from alink_tpu.common.benchstats import perf_gate
+    from alink_tpu.common.profiling import collective_bytes
+    from alink_tpu.parallel.aps import (ShardedEmbedding, model_mesh, pull,
+                                        pull_allgather, push, push_allgather)
+    from alink_tpu.parallel.mesh import AXIS_MODEL
+    from alink_tpu.parallel.shardmap import shard_map
+
+    M = len(jax.devices())
+    rows, D, B = 2048, 64, 1024     # per-shard rows / dim / per-device batch
+
+    def build(m, routed, op):
+        mesh = model_mesh(m)
+        V = rows * m
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, V, size=(m, B)).astype(np.int32)
+        grads = rng.normal(size=(m, B, D)).astype(np.float32)
+        table = ShardedEmbedding(mesh, V, D)
+        _pull = pull if routed else pull_allgather
+        _push = push if routed else push_allgather
+
+        def body(tl, i, g):
+            if op in ("pull", "cycle"):
+                v = _pull(tl, i[0], AXIS_MODEL, rows)
+                if op == "pull":
+                    return v
+            g_eff = g[0] + v if op == "cycle" else g[0]
+            return _push(tl, i[0], g_eff, AXIS_MODEL, rows, 1e-3)
+
+        f = jax.jit(shard_map(body, mesh=mesh, in_specs=(P(AXIS_MODEL),) * 3,
+                              out_specs=P(AXIS_MODEL), check_vma=False))
+        args = (table.array,
+                jax.device_put(ids, NamedSharding(mesh, P(AXIS_MODEL))),
+                jax.device_put(grads, NamedSharding(mesh, P(AXIS_MODEL))))
+        return f, args
+
+    # -- throughput: routed pull→push cycle on the full mesh ---------------
+    f, args = build(M, True, "cycle")
+    f(*args).block_until_ready()                       # compile outside
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = f(*args)
+    out.block_until_ready()
+    rows_per_s = M * B * steps / (time.perf_counter() - t0)
+
+    # -- per-device comm bytes per step, M=1 vs the full mesh --------------
+    m_values = sorted({1, min(2, M), M})
+    comm = {}
+    for op in ("pull", "push"):
+        for m in m_values:
+            rf, ra = build(m, True, op)
+            comm[f"{op}_routed_bytes_m{m}"] = collective_bytes(
+                rf.lower(*ra).compile())
+        gf, ga = build(M, False, op)
+        comm[f"{op}_gather_bytes_m{M}"] = collective_bytes(
+            gf.lower(*ga).compile())
+
+    # fractional growth of routed bytes from the smallest multi-device mesh
+    # to the full mesh: ~0 when per-device comm is O(B·D); an O(M·B·D)
+    # regression reads ~(M/2 - 1). Named *_overhead so the round-over-round
+    # bench gate treats lower-as-better and flags growth.
+    m_small = min((m for m in m_values if m >= 2), default=M)
+    scaling = {}
+    for op in ("pull", "push"):
+        small = comm[f"{op}_routed_bytes_m{m_small}"]
+        big = comm[f"{op}_routed_bytes_m{M}"]
+        scaling[f"{op}_comm_scaling_overhead"] = (
+            round(big / small - 1.0, 4) if small else 0.0)
+
+    # -- routed vs all-gather wall time on identical inputs ----------------
+    gf, ga = build(M, False, "cycle")
+    gf(*ga).block_until_ready()
+    gate = perf_gate(lambda: gf(*ga).block_until_ready(),
+                     lambda: f(*args).block_until_ready(), repeats=7)
+
+    return {
+        "model_axis": M,
+        "rows_per_s": round(rows_per_s, 1),
+        "batch_per_device": B,
+        "dim": D,
+        **comm,
+        **scaling,
+        "routed_vs_gather_wall_verdict": gate["verdict"],
+        "routed_vs_gather_wall_delta_pct": gate["delta_pct"],
+    }
+
+
 def main(argv=None):
     import argparse
 
@@ -1260,6 +1362,7 @@ def main(argv=None):
         ("observability", bench_observability),
         ("profiling", bench_profiling),
         ("serving", bench_serving),
+        ("aps", bench_aps),
     ):
         try:
             extras[name] = fn()

@@ -449,6 +449,45 @@ def test_lint_shard_map_alk002(tmp_path):
     assert [d.rule for d in diags] == ["ALK002"]
 
 
+def test_lint_alk002_catches_experimental_bypasses(tmp_path):
+    """The ban covers every way of reaching shard_map without the shim —
+    the full experimental attribute chain (reported ONCE), the module
+    import, and the from-import — not just the `jax.shard_map` spelling."""
+    for src in (
+        """
+        import jax
+
+        def f(fn, mesh):
+            return jax.experimental.shard_map.shard_map(fn, mesh=mesh,
+                                                        in_specs=None,
+                                                        out_specs=None)
+        """,
+        """
+        import jax.experimental.shard_map
+        """,
+        """
+        from jax.experimental import shard_map as sm
+        """,
+        """
+        from jax.experimental.shard_map import shard_map
+        """,
+    ):
+        diags = _lint_src(tmp_path, "mod.py", src)
+        assert [d.rule for d in diags] == ["ALK002"], src
+
+
+def test_lint_alk002_exempts_the_shim_itself(tmp_path):
+    """parallel/shardmap.py IS the sanctioned owner of the legacy import."""
+    diags = _lint_src(tmp_path, "parallel/shardmap.py", """
+        from jax.experimental import shard_map as _legacy
+
+        def f():
+            import jax
+            return jax.experimental.shard_map.shard_map
+    """)
+    assert diags == []
+
+
 def test_lint_raw_environ_alk003(tmp_path):
     diags = _lint_src(tmp_path, "mod.py", """
         import os
@@ -565,15 +604,26 @@ def test_baseline_is_a_ratchet():
         ("ALK003", "alink_tpu/a.py", 3, 2)]
 
 
-def test_shard_map_inventory_committed_file_is_fresh():
+def test_shard_map_inventory_committed_file_is_fresh_and_empty():
     """docs/shard_map_inventory.json (the ROADMAP Open item 3 work-list)
-    must match what the ALK002 rule finds in the current source."""
+    must match what the ALK002 rule finds in the current source — and the
+    migration to ``parallel/shardmap.py`` retired every call site, so the
+    ratchet is now a ban: the inventory pins ZERO direct uses."""
     path = os.path.join(REPO_ROOT, "docs", "shard_map_inventory.json")
     with open(path) as f:
         committed = json.load(f)
     live = shard_map_inventory()
-    assert committed["modules"] == live["modules"]
-    assert committed["total_call_sites"] == live["total_call_sites"] > 0
+    assert committed["modules"] == live["modules"] == {}
+    assert committed["total_call_sites"] == live["total_call_sites"] == 0
+
+
+def test_alk002_absent_from_baseline():
+    """The suppression baseline carries no ALK002 budget — any new direct
+    ``jax.shard_map`` / ``experimental.shard_map`` use fails ``--check``."""
+    with open(os.path.join(
+            REPO_ROOT, "alink_tpu", "analysis", "lint_baseline.json")) as f:
+        baseline = json.load(f)
+    assert "ALK002" not in baseline["counts"]
 
 
 def test_rule_table_complete():

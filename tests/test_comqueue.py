@@ -140,6 +140,7 @@ def test_collectives_standalone(mesh):
     from jax.sharding import PartitionSpec as P
 
     from alink_tpu.parallel import broadcast_from, reduce_scatter, ppermute_ring
+    from alink_tpu.parallel.shardmap import shard_map
 
     def body(x):
         # reduce_scatter: each of 8 workers gets its slice of the summed vector
@@ -152,8 +153,8 @@ def test_collectives_standalone(mesh):
     x = np.tile(np.arange(8, dtype=np.float32), (8, 1))
     xs = jax.device_put(x, jax.NamedSharding(mesh, P("data")))
     rs, bc, ring = jax.jit(
-        jax.shard_map(body, mesh=mesh, in_specs=P("data"), out_specs=P("data"),
-                      check_vma=False)
+        shard_map(body, mesh=mesh, in_specs=P("data"), out_specs=P("data"),
+                  check_vma=False)
     )(xs)
     # summed vector = 8*[0..7]; scatter slice i = 8*i
     np.testing.assert_allclose(np.asarray(rs).ravel(), 8.0 * np.arange(8))

@@ -479,10 +479,6 @@ def test_shape_profile_records_and_drives_warmup(tmp_path, monkeypatch):
 # ---------------------------------------------------------------------------
 
 def test_second_identical_pipeline_fit_zero_traces():
-    import jax
-
-    if not hasattr(jax, "shard_map"):
-        pytest.skip("jax.shard_map unavailable in this environment")
     from alink_tpu.operator.batch.base import TableSourceBatchOp
     from alink_tpu.pipeline import KMeans, Pipeline
 
