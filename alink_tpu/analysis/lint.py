@@ -74,6 +74,11 @@ _SHARDMAP_SHIM = "parallel/shardmap.py"
 
 _MUTATORS = ("update", "setdefault", "pop", "popitem", "clear")
 
+# every spelling of "build me a compiled program" ALK001 polices — the call
+# form, the bare-decorator form, and the functools.partial decorator form
+_JIT_NAMES = ("jax.jit", "pjit", "jax.pjit", "pjit.pjit",
+              "jax.experimental.pjit.pjit")
+
 
 def _dotted(node: ast.AST) -> str:
     """Best-effort dotted name of an expression ('jax.jit', 'os.environ')."""
@@ -103,6 +108,7 @@ class _FileLinter(ast.NodeVisitor):
         self.func_stack: List[str] = []
         self.lock_depth = 0
         self.cached_jit_depth = 0
+        self._decorator_handled: set = set()
         self.is_env_module = relpath.endswith(_ENV_MODULE)
         self.is_jitcache = relpath.endswith(_JITCACHE_MODULE)
         self.is_shardmap_shim = relpath.endswith(_SHARDMAP_SHIM)
@@ -141,6 +147,55 @@ class _FileLinter(ast.NodeVisitor):
 
     # -- context tracking --------------------------------------------------
     def visit_FunctionDef(self, node):
+        # Decorators apply in the ENCLOSING scope, so every jit decorator
+        # form — bare `@jax.jit`, call `@jax.jit(...)`, and
+        # `@partial(jax.jit, ...)` — is judged BEFORE this function's name
+        # lands on the stack: a jit-decorated `_build_x` is itself a
+        # compiled program, not a builder. Handled Call decorators are
+        # remembered so visit_Call (which sees them during generic_visit,
+        # with the name pushed) never re-judges them in the wrong scope.
+        exempt = self.is_jitcache or self._in_builder() \
+            or bool(self.cached_jit_depth)
+        for dec in node.decorator_list:
+            if isinstance(dec, (ast.Name, ast.Attribute)) \
+                    and _dotted(dec) in _JIT_NAMES:
+                if not exempt:
+                    self._add(
+                        "ALK001", dec,
+                        f"direct @{_dotted(dec)} decorator outside a "
+                        "ProgramCache builder — the compiled program is "
+                        "rebuilt (and jax's dispatch cache discarded) every "
+                        "time this code path re-runs",
+                        hint="wrap in a _build*() builder registered via "
+                             "common/jitcache.cached_jit")
+            elif isinstance(dec, ast.Call) and \
+                    isinstance(dec.func, (ast.Name, ast.Attribute)):
+                d = _dotted(dec.func)
+                if d in _JIT_NAMES:
+                    self._decorator_handled.add(id(dec))
+                    if not exempt:
+                        self._add(
+                            "ALK001", dec,
+                            f"direct {d}() call outside a ProgramCache "
+                            "builder — the compiled program is rebuilt (and "
+                            "jax's dispatch cache discarded) every time "
+                            "this code path re-runs",
+                            hint="wrap in a _build*() builder registered "
+                                 "via common/jitcache.cached_jit")
+                elif d.split(".")[-1] == "partial" and dec.args \
+                        and isinstance(dec.args[0],
+                                       (ast.Name, ast.Attribute)) \
+                        and _dotted(dec.args[0]) in _JIT_NAMES:
+                    self._decorator_handled.add(id(dec))
+                    if not exempt:
+                        self._add(
+                            "ALK001", dec,
+                            f"partial({_dotted(dec.args[0])}, ...) outside "
+                            "a ProgramCache builder — the compiled program "
+                            "is rebuilt (and jax's dispatch cache "
+                            "discarded) every time this code path re-runs",
+                            hint="wrap in a _build*() builder registered "
+                                 "via common/jitcache.cached_jit")
         self.func_stack.append(node.name)
         self.generic_visit(node)
         self.func_stack.pop()
@@ -158,6 +213,10 @@ class _FileLinter(ast.NodeVisitor):
 
     # -- ALK001/ALK002/ALK003 calls & attributes ---------------------------
     def visit_Call(self, node: ast.Call):
+        if id(node) in self._decorator_handled:
+            # already judged (in the enclosing scope) by visit_FunctionDef
+            self.generic_visit(node)
+            return
         # only direct Name/Attribute callees: `jax.jit(f)(x)` is one direct
         # jit call, not two (the outer call invokes the returned function)
         d = _dotted(node.func) \
@@ -170,8 +229,7 @@ class _FileLinter(ast.NodeVisitor):
             self.generic_visit(node)
             self.cached_jit_depth -= 1
             return
-        if d in ("jax.jit", "pjit", "jax.pjit", "pjit.pjit",
-                 "jax.experimental.pjit.pjit") \
+        if d in _JIT_NAMES \
                 and not self.is_jitcache and not self._in_builder() \
                 and not self.cached_jit_depth:
             self._add(
@@ -179,6 +237,21 @@ class _FileLinter(ast.NodeVisitor):
                 f"direct {d}() call outside a ProgramCache builder — the "
                 "compiled program is rebuilt (and jax's dispatch cache "
                 "discarded) every time this code path re-runs",
+                hint="wrap in a _build*() builder registered via "
+                     "common/jitcache.cached_jit")
+        if tail == "partial" and node.args \
+                and isinstance(node.args[0], (ast.Name, ast.Attribute)) \
+                and _dotted(node.args[0]) in _JIT_NAMES \
+                and not self.is_jitcache and not self._in_builder() \
+                and not self.cached_jit_depth:
+            # `@partial(jax.jit, donate_argnums=...)` — the decorator form
+            # jit-with-options takes; same rebuild-per-run failure mode
+            self._add(
+                "ALK001", node,
+                f"partial({_dotted(node.args[0])}, ...) outside a "
+                "ProgramCache builder — the compiled program is rebuilt "
+                "(and jax's dispatch cache discarded) every time this code "
+                "path re-runs",
                 hint="wrap in a _build*() builder registered via "
                      "common/jitcache.cached_jit")
         if tail == "get" and isinstance(node.func, ast.Attribute) \

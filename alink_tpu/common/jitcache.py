@@ -30,6 +30,16 @@ counted (``jit.trace`` / ``jit.compile``) and timed (global and per-kernel
 ``jitcache.*.compile_s`` timers, plus a ``compile_s`` phase on the active
 executor node trace). :func:`compile_summary` aggregates the lot for the
 BENCH ``compile`` extra.
+
+Buffer donation: builders may return programs built with
+``jax.jit(..., donate_argnums=...)`` (the DL train/MLM steps do — params
+and optimizer state update in place on device). The cache is donation-safe
+by construction: the shape signature is computed BEFORE dispatch, the
+profiling hooks only ever read leaf metadata (shape/dtype/tree structure,
+never buffer contents) from arguments that the call may have consumed, and
+:meth:`CachedProgram.ensure_compiled` warms on fresh host zeros that were
+never committed device buffers. Callers keep the usual donation contract:
+rebind to the returned state and never re-use a donated tree.
 """
 
 from __future__ import annotations

@@ -14,13 +14,18 @@ Public surface:
 - :mod:`modules`   — flax models: TransformerEncoder (BERT family), KerasSequential
 - :mod:`attention` — full + ring (sequence-parallel) attention
 - :mod:`sharding`  — parameter partition rules over the (data, model, seq) mesh
-- :mod:`train`     — optax train loop with micro-batching, eval, checkpoints
+- :mod:`train`     — async device-fed optax train loop (ProgramCache step,
+  donated buffers, bucketed batches), eval, checkpoints
+- :mod:`pretrain`  — in-framework MLM pretraining producing HF-layout checkpoints
 - :mod:`tokenizer` — WordPiece-style tokenizer with corpus-built vocab
+- :mod:`data`      — loaders for the shipped real-text corpora
 """
 
 from .attention import (blockwise_attention, full_attention,
                         ring_attention)
+from .data import load_reviews, load_sst2, sst2_split
 from .modules import BertConfig, TransformerEncoder, KerasSequential, parse_layers
+from .pretrain import pretrain_and_save, pretrain_mlm
 from .sharding import param_shardings, make_dl_mesh
 from .train import TrainConfig, train_model, predict_model
 from .tokenizer import Tokenizer
@@ -38,5 +43,10 @@ __all__ = [
     "TrainConfig",
     "train_model",
     "predict_model",
+    "pretrain_mlm",
+    "pretrain_and_save",
+    "load_reviews",
+    "load_sst2",
+    "sst2_split",
     "Tokenizer",
 ]

@@ -1,0 +1,72 @@
+"""Shipped real-text corpora for the BERT pretrain→finetune story.
+
+The repo ships three small real-text artifacts (the zero-egress stand-ins
+for the reference's downloadable BERT resources, BertResources.java):
+
+- ``data/reviews_unlabeled.txt`` — 4.4k unlabeled review sentences, the
+  MLM pretraining corpus;
+- ``data/sst2_mini.csv`` — ~500 labeled sentiment rows (``text,label``
+  with quoted commas), the fine-tune + holdout task;
+- ``data/bert_tiny_sst/`` — a staged HF-layout checkpoint directory
+  (config.json + model.safetensors + vocab.txt) for ingest tests.
+
+These loaders are the one sanctioned way to read them: bench, tests and
+examples all consume the same splits, so "real-text holdout accuracy"
+means the same rows everywhere.
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+_DATA_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "data")
+
+
+def data_path(name: str) -> str:
+    """Absolute path of a shipped ``data/`` artifact."""
+    return os.path.join(_DATA_DIR, name)
+
+
+def load_reviews(path: Optional[str] = None,
+                 limit: Optional[int] = None) -> List[str]:
+    """The unlabeled review sentences (one per line, blank lines dropped)."""
+    path = path or data_path("reviews_unlabeled.txt")
+    with open(path, encoding="utf-8") as f:
+        texts = [line.strip() for line in f]
+    texts = [t for t in texts if t]
+    return texts[:limit] if limit else texts
+
+
+def load_sst2(path: Optional[str] = None) -> Tuple[List[str], np.ndarray]:
+    """The labeled sentiment rows as ``(texts, labels)`` — csv with quoted
+    commas, label in {0, 1}."""
+    path = path or data_path("sst2_mini.csv")
+    texts: List[str] = []
+    labels: List[int] = []
+    with open(path, encoding="utf-8", newline="") as f:
+        for row in csv.reader(f):
+            if len(row) != 2 or not row[1].strip().lstrip("-").isdigit():
+                continue  # malformed line must not sink the loader
+            texts.append(row[0])
+            labels.append(int(row[1]))
+    return texts, np.asarray(labels, np.int64)
+
+
+def sst2_split(seed: int = 0, holdout: float = 0.2,
+               path: Optional[str] = None):
+    """Deterministic train/holdout split of the sst2 rows:
+    ``(train_texts, train_y, hold_texts, hold_y)`` — the split bench and
+    tests both report against."""
+    texts, y = load_sst2(path)
+    rng = np.random.default_rng(seed)
+    perm = rng.permutation(len(texts))
+    n_hold = max(1, int(len(texts) * holdout))
+    hold, train = perm[:n_hold], perm[n_hold:]
+    return ([texts[i] for i in train], y[train],
+            [texts[i] for i in hold], y[hold])

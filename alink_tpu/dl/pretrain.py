@@ -5,14 +5,32 @@ BERT ops consume checkpoints produced by upstream MLM pretraining
 (reference: core/src/main/java/com/alibaba/alink/common/dl/
 BaseEasyTransferTrainBatchOp.java + BertResources.java — the ops download
 google-research checkpoints; pretraining itself lives outside the Java
-code). Here pretraining is in-framework: one jitted MLM step over the
-TransformerEncoder, BERT's 80/10/10 masking, and a tied-embedding output
-head (logits = states @ tok_emb.T, the original BERT weight tying) — so a
-user can produce, save (HF layout via ``save_bert_checkpoint``) and re-ingest
-domain checkpoints without leaving the framework."""
+code). Here pretraining is in-framework: one ProgramCache-resident MLM step
+over the TransformerEncoder, BERT's 80/10/10 masking, and a tied-embedding
+output head (logits = states @ tok_emb.T, the original BERT weight tying) —
+so a user can produce, save (HF layout via ``save_bert_checkpoint``) and
+re-ingest domain checkpoints without leaving the framework.
+
+Hot-path contract (mirrors dl/train.py):
+
+- the MLM step lives in the process-wide ProgramCache with donated
+  params/opt_state buffers — repeated pretrains of the same config share
+  one compiled program;
+- masking + batch assembly run on the shared transfer pool under the
+  ``feed="async"`` default, double-buffered ahead of compute; masking is
+  seeded per ``(seed, epoch, step)``, so async and sync feeds are
+  bit-identical and a resumed run replays the exact remaining schedule;
+- ragged tail batches pad by repeating the last row with the selection
+  mask cleared (exact: unselected rows contribute zero MLM loss), so the
+  steady loop performs zero retraces;
+- ``checkpoint_dir`` wires :class:`~alink_tpu.dl.checkpoint.
+  TrainCheckpointManager` underneath: per-epoch saves, crash-resume from
+  the latest epoch.
+"""
 
 from __future__ import annotations
 
+from functools import partial
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -37,6 +55,40 @@ def _mask_tokens(ids: np.ndarray, attn: np.ndarray, mask_id: int,
     return masked, sel
 
 
+def _mlm_step_program(model, tx, cfg: BertConfig, learning_rate: float):
+    """The jitted MLM step, resident in the ProgramCache: identical configs
+    (architecture + lr) share one compiled program across pretrain runs."""
+    from ..common.jitcache import cached_jit
+
+    def _build_mlm_step():
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def step(params, opt_state, masked, attn, targets, sel):
+            def loss(p):
+                states = model.apply({"params": p["params"]}, masked, attn,
+                                     return_sequence=True)
+                emb = p["params"]["tok_emb"]["embedding"].astype(jnp.float32)
+                logits = states @ emb.T  # tied-embedding MLM head
+                ll = optax.softmax_cross_entropy_with_integer_labels(
+                    logits, targets)
+                w = sel.astype(jnp.float32)
+                return (ll * w).sum() / jnp.maximum(w.sum(), 1.0)
+
+            l, g = jax.value_and_grad(loss)(params)
+            updates, opt_state2 = tx.update(g["params"], opt_state,
+                                            params["params"])
+            new_p = optax.apply_updates(params["params"], updates)
+            return {"params": new_p}, opt_state2, l
+
+        return step
+
+    return cached_jit("dl.mlm_step", _build_mlm_step,
+                      key_extra=(repr(cfg), float(learning_rate)))
+
+
 def pretrain_mlm(
     texts: Sequence[str],
     *,
@@ -52,14 +104,21 @@ def pretrain_mlm(
     mask_prob: float = 0.15,
     seed: int = 0,
     tokenizer: Optional[Tokenizer] = None,
+    feed: str = "async",
+    checkpoint_dir: Optional[str] = None,
+    resume: bool = True,
 ) -> Tuple[BertConfig, dict, Tokenizer, List[float]]:
     """MLM-pretrain a tiny BERT on raw texts. Returns
     ``(cfg, params, tokenizer, loss_history)`` — params fit
     ``save_bert_checkpoint`` and the fine-tune ``checkpointFilePath`` path.
-    """
+
+    ``feed="async"`` masks/assembles batches on the transfer pool ahead of
+    compute (bit-identical to ``"sync"``); ``checkpoint_dir`` enables
+    per-epoch checkpointing with crash-resume."""
     import jax
-    import jax.numpy as jnp
     import optax
+
+    from .train import _feed, _pad_tail
 
     tok = tokenizer or Tokenizer.build(list(texts), vocab_size=vocab_size)
     cfg = BertConfig(
@@ -77,39 +136,65 @@ def pretrain_mlm(
     params = model.init(jax.random.PRNGKey(seed), ids[:1], attn[:1])
     tx = optax.adamw(learning_rate, weight_decay=0.01)
     opt_state = tx.init(params["params"])
+    step_prog = _mlm_step_program(model, tx, cfg, learning_rate)
 
-    @jax.jit
-    def step(params, opt_state, masked, attn, targets, sel):
-        def loss(p):
-            states = model.apply({"params": p["params"]}, masked, attn,
-                                 return_sequence=True)
-            emb = p["params"]["tok_emb"]["embedding"].astype(jnp.float32)
-            logits = states @ emb.T  # tied-embedding MLM head
-            ll = optax.softmax_cross_entropy_with_integer_labels(
-                logits, targets)
-            w = sel.astype(jnp.float32)
-            return (ll * w).sum() / jnp.maximum(w.sum(), 1.0)
+    ckpt = None
+    start_epoch = 0
+    if checkpoint_dir:
+        from .checkpoint import TrainCheckpointManager
 
-        l, g = jax.value_and_grad(loss)(params)
-        updates, opt_state2 = tx.update(g["params"], opt_state,
-                                        params["params"])
-        new_p = optax.apply_updates(params["params"], updates)
-        return {"params": new_p}, opt_state2, l
+        ckpt = TrainCheckpointManager(checkpoint_dir)
+        if resume:
+            restored = ckpt.restore_latest(jax.device_get(params),
+                                           jax.device_get(opt_state))
+            if restored is not None:
+                r_params, r_opt, extra = restored
+                # back onto the device: the donated step consumes committed
+                # device buffers, not the host trees orbax returns
+                params = jax.device_put(r_params)
+                opt_state = jax.device_put(r_opt)
+                start_epoch = int(extra.get("epoch", -1)) + 1
 
-    rng = np.random.default_rng(seed)
     n = ids.shape[0]
+    bs = min(batch_size, n)
+    steps_per_epoch = -(-n // bs)
+
+    def place(arrs):
+        devs = [jax.device_put(np.asarray(a)) for a in arrs]
+        jax.block_until_ready(devs)
+        return devs
+
     history: List[float] = []
-    for ep in range(epochs):
-        order = rng.permutation(n)
-        ep_losses = []
-        for s in range(0, n, batch_size):
-            idx = order[s:s + batch_size]
+    for ep in range(start_epoch, epochs):
+        # per-(seed, epoch[, step]) generators: deterministic regardless of
+        # feeder-thread scheduling, and a resumed run replays the exact
+        # remaining epochs
+        order = np.random.default_rng((seed, ep)).permutation(n)
+
+        def build(s, _order=order, _ep=ep):
+            idx = _order[s * bs:(s + 1) * bs]
+            r = np.random.default_rng((seed, _ep, s + 1))
             masked, sel = _mask_tokens(
-                ids[idx], attn[idx], mask_id, tok.vocab_size, rng, mask_prob)
-            params, opt_state, l = step(
-                params, opt_state, masked, attn[idx], ids[idx], sel)
-            ep_losses.append(float(l))
-        history.append(float(np.mean(ep_losses)))
+                ids[idx], attn[idx], mask_id, tok.vocab_size, r, mask_prob)
+            arrs = [masked, attn[idx], ids[idx]]
+            if len(idx) < bs:
+                # tail pads by repeating the last row with selection cleared
+                # — unselected rows add exactly zero MLM loss, and the tail
+                # reuses the full-batch program (zero retraces)
+                arrs = _pad_tail(arrs, bs)
+                sel = np.concatenate(
+                    [sel, np.zeros((bs - len(idx),) + sel.shape[1:], bool)])
+            return arrs + [sel]
+
+        ep_losses = []
+        for s, devs in _feed(build, place, steps_per_epoch, mode=feed):
+            params, opt_state, l = step_prog(
+                params, opt_state, devs[0], devs[1], devs[2], devs[3])
+            ep_losses.append(l)   # device scalar; sync once per epoch
+        history.append(float(np.mean([float(x) for x in ep_losses])))
+        if ckpt is not None:
+            ckpt.save(ep, jax.device_get(params), jax.device_get(opt_state),
+                      {"epoch": ep, "step": (ep + 1) * steps_per_epoch})
     return cfg, jax.device_get(params), tok, history
 
 
@@ -123,7 +208,7 @@ def pretrain_and_save(texts: Sequence[str], out_dir: str, **kw) -> dict:
     return {
         "path": out_dir,
         "vocab_size": tok.vocab_size,
-        "initial_loss": round(history[0], 4),
-        "final_loss": round(history[-1], 4),
+        "initial_loss": round(history[0], 4) if history else None,
+        "final_loss": round(history[-1], 4) if history else None,
         "epochs": len(history),
     }

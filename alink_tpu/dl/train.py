@@ -2,12 +2,35 @@
 
 Capability parity (reference: core/src/main/python/akdl/akdl/engine/train.py:16-40
 TrainSpec/EvalSpec + chief SavedModel export at :34-39; early stopping
-akdl/engine/early_stopping.py; dataset from mmap-queue TFRecords engine/inputs.py).
+akdl/engine/early_stopping.py; dataset from mmap-queue TFRecords engine/inputs.py
+— the flink-ai-extended data plane that keeps the trainer fed without host
+stalls).
 
-TPU re-design: one jit-compiled train step (loss + grad + optax update),
-donated optimizer/param buffers, batches sharded over the mesh's data axis
-(and seq axis for ring attention), eval on a held-out slice, optional
-best-metric early stopping. No processes, no queues, no TFRecord hop.
+TPU re-design: one ProgramCache-resident train step (loss + grad + optax
+update) with donated optimizer/param buffers, batches sharded over the mesh's
+data axis (and seq axis for ring attention), eval on a held-out slice,
+optional best-metric early stopping. No processes, no queues, no TFRecord hop.
+
+Steady-state execution contract (the BERT hot path):
+
+- **One compiled program per (model config, optimizer config, loss) job
+  family** — :func:`make_train_step` registers the step with
+  :mod:`alink_tpu.common.jitcache` instead of rebuilding ``jax.jit`` per
+  call, so N fine-tune jobs share one executable and jax's dispatch cache
+  survives across jobs. Buffer donation is preserved through the cache:
+  params/opt_state update in place on device.
+- **Shape-bucketed batches** — every step of a job runs the same padded
+  batch shape (ragged tails pad by repeating the last real row with
+  zero loss-weight, which is exact: padded rows contribute ``l*0`` to the
+  weighted loss and zero gradient), so the steady loop performs ZERO new
+  traces after the first step (pinned via ``jit.trace`` counter deltas).
+- **Async device feed** — batch assembly (row gather, padding) and the
+  host->device transfer run on the shared ``alink-h2d`` transfer pool via
+  :func:`alink_tpu.common.streaming.stream_map`, double-buffered ahead of
+  compute (``ALINK_STREAM_DEPTH``), so the jitted step never waits on the
+  host. ``TrainConfig.feed="sync"`` keeps the single-threaded reference
+  path; both feeds assemble identical batches, so results are
+  bit-identical (CI-pinned).
 """
 
 from __future__ import annotations
@@ -15,7 +38,7 @@ from __future__ import annotations
 import dataclasses
 from dataclasses import dataclass
 from functools import partial
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -39,6 +62,11 @@ class TrainConfig:
     checkpoint_dir: "str | None" = None
     checkpoint_every: int = 0  # extra mid-epoch saves every N steps; 0 = only per epoch
     resume: bool = True
+    # input pipeline: "async" assembles + ships batches on the transfer pool
+    # (double-buffered, the device never waits on the host); "sync" is the
+    # single-threaded reference feed. Bit-identical either way.
+    feed: str = "async"
+    feed_depth: int = 0  # in-flight batches ahead of compute; 0 = ALINK_STREAM_DEPTH
 
 
 def _make_optimizer(cfg: TrainConfig, total_steps: int):
@@ -57,73 +85,183 @@ def _make_optimizer(cfg: TrainConfig, total_steps: int):
     raise ValueError(f"unknown optimizer {cfg.optimizer!r}")
 
 
-def _loss_fn(kind: str, regression: bool):
+def _loss_fn(kind: str, regression: bool, weighted: bool = False):
+    """Scalar loss ``f(logits, y)`` — or, with ``weighted``, the exact
+    masked form ``f(logits, y, w) = sum(l_i*w_i)/sum(w)`` used by the
+    bucketed train loop (``w==1`` rows reproduce the unweighted mean
+    bit-for-bit; ``w==0`` pad rows contribute exactly zero loss and
+    gradient)."""
     import jax.numpy as jnp
     import optax
 
     if kind == "auto":
         kind = "mse" if regression else "softmax"
     if kind == "softmax":
-        def f(logits, y):
+        def per_row(logits, y):
             return optax.softmax_cross_entropy_with_integer_labels(
-                logits, y.astype(jnp.int32)
-            ).mean()
-        return f
-    if kind == "mse":
-        def f(logits, y):
-            return jnp.mean((logits.squeeze(-1) - y.astype(jnp.float32)) ** 2)
-        return f
-    if kind == "gaussian_nll":
+                logits, y.astype(jnp.int32))
+    elif kind == "mse":
+        def per_row(logits, y):
+            return (logits.squeeze(-1) - y.astype(jnp.float32)) ** 2
+    elif kind == "gaussian_nll":
         # logits (n, 2) = (mu, log_sigma); probabilistic regression (DeepAR)
-        def f(logits, y):
+        def per_row(logits, y):
             mu, log_sigma = logits[..., 0], logits[..., 1]
             sigma2 = jnp.exp(2.0 * log_sigma)
-            return jnp.mean(log_sigma
-                            + 0.5 * (y.astype(jnp.float32) - mu) ** 2 / sigma2)
+            return log_sigma + 0.5 * (y.astype(jnp.float32) - mu) ** 2 / sigma2
+    else:
+        raise ValueError(f"unknown loss {kind!r}")
+
+    if not weighted:
+        def f(logits, y):
+            return per_row(logits, y).mean()
         return f
-    raise ValueError(f"unknown loss {kind!r}")
+
+    def fw(logits, y, w):
+        w = w.astype(jnp.float32)
+        return (per_row(logits, y) * w).sum() / jnp.maximum(w.sum(), 1.0)
+    return fw
 
 
-def make_train_step(model, tx, loss_of):
-    """One jitted optimizer step — shared by train_model, bench, and the
-    multichip dryrun. ``loss_of(logits, y) -> scalar``.
+def _model_key(model) -> tuple:
+    """Content key for a flax module: class + field repr. Two modules built
+    from the same config hash equal, so fine-tune jobs constructed per run
+    share one compiled train step."""
+    t = type(model)
+    return ("model", f"{t.__module__}.{t.__qualname__}", repr(model))
+
+
+def make_train_step(model, tx, loss_of, *, weighted: bool = False,
+                    cache_key: Any = None):
+    """One optimizer step, resident in the process-wide ProgramCache —
+    shared by train_model, bench, and the multichip dryrun.
+    ``loss_of(logits, y[, w]) -> scalar``.
 
     ``variables`` is the full flax variables dict; non-"params" collections
     (e.g. BatchNorm "batch_stats") are threaded through mutably and excluded
     from the optimizer update. The optimizer state must be built over
-    ``variables["params"]`` only."""
-    import jax
-    import optax
+    ``variables["params"]`` only.
 
-    # donate params/opt_state buffers: the update writes in place on device
-    # (HBM headroom for large models; callers rebind to the returned state)
-    @partial(jax.jit, donate_argnums=(0, 1))
-    def train_step(variables, opt_state, batch, y, dkey=None):
-        params = variables["params"]
-        stats = {k: v for k, v in variables.items() if k != "params"}
-        mutable = list(stats.keys())
+    Donation is preserved through the cache: params/opt_state buffers are
+    donated (the update writes in place on device — HBM headroom for large
+    models; callers rebind to the returned state, the old trees are dead).
 
-        def loss(p):
-            kwargs = {"rngs": {"dropout": dkey}} if dkey is not None else {}
-            if mutable:
-                logits, new_stats = model.apply(
-                    {"params": p, **stats}, **batch,
-                    deterministic=dkey is None, mutable=mutable, **kwargs
-                )
-            else:
-                logits = model.apply(
-                    {"params": p, **stats}, **batch,
-                    deterministic=dkey is None, **kwargs
-                )
-                new_stats = {}
-            return loss_of(logits, y), new_stats
+    ``cache_key`` supplies a content descriptor (model/optimizer/loss
+    config) under which DIFFERENT jobs share the compiled program; without
+    it the key falls back to instance identity — same instances reuse the
+    program, fresh instances compile their own (never aliased wrongly)."""
+    from ..common.jitcache import cached_jit, instance_token
 
-        (l, new_stats), g = jax.value_and_grad(loss, has_aux=True)(params)
-        updates, opt_state = tx.update(g, opt_state, params)
-        new_params = optax.apply_updates(params, updates)
-        return {"params": new_params, **dict(new_stats)}, opt_state, l
+    def _build_train_step():
+        import jax
+        import optax
 
-    return train_step
+        def step_body(variables, opt_state, batch, y, w, dkey):
+            params = variables["params"]
+            stats = {k: v for k, v in variables.items() if k != "params"}
+            mutable = list(stats.keys())
+
+            def loss(p):
+                kwargs = {"rngs": {"dropout": dkey}} if dkey is not None else {}
+                if mutable:
+                    logits, new_stats = model.apply(
+                        {"params": p, **stats}, **batch,
+                        deterministic=dkey is None, mutable=mutable, **kwargs
+                    )
+                else:
+                    logits = model.apply(
+                        {"params": p, **stats}, **batch,
+                        deterministic=dkey is None, **kwargs
+                    )
+                    new_stats = {}
+                l = loss_of(logits, y, w) if weighted else loss_of(logits, y)
+                return l, new_stats
+
+            (l, new_stats), g = jax.value_and_grad(loss, has_aux=True)(params)
+            updates, opt_state = tx.update(g, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            return {"params": new_params, **dict(new_stats)}, opt_state, l
+
+        if weighted:
+            @partial(jax.jit, donate_argnums=(0, 1))
+            def train_step(variables, opt_state, batch, y, w, dkey=None):
+                return step_body(variables, opt_state, batch, y, w, dkey)
+        else:
+            @partial(jax.jit, donate_argnums=(0, 1))
+            def train_step(variables, opt_state, batch, y, dkey=None):
+                return step_body(variables, opt_state, batch, y, None, dkey)
+        return train_step
+
+    key = cache_key
+    if key is None:
+        key = ("inst", instance_token(model), instance_token(tx),
+               instance_token(loss_of))
+    return cached_jit("dl.train_step", _build_train_step,
+                      key_extra=("weighted" if weighted else "plain", key))
+
+
+def _apply_program(model, key: Any = None):
+    """Deterministic forward pass ``prog(params, batch) -> logits`` in the
+    ProgramCache — eval and predict share one compiled program per model
+    config."""
+    from ..common.jitcache import cached_jit
+
+    def _build_apply():
+        import jax
+
+        return jax.jit(
+            lambda params, batch: model.apply(params, **batch,
+                                              deterministic=True))
+
+    return cached_jit("dl.apply_logits", _build_apply,
+                      key_extra=key if key is not None else _model_key(model))
+
+
+def _feed(build: Callable[[int], Sequence[np.ndarray]],
+          place: Callable[[Sequence[np.ndarray]], Sequence[Any]],
+          steps: int, *, mode: str = "async",
+          depth: int = 0, phases: Optional[dict] = None
+          ) -> Iterator[Tuple[int, Sequence[Any]]]:
+    """Yield ``(step, device_arrays)`` for ``build(step)`` host batches.
+
+    ``async``: batch assembly AND the sharded ``device_put`` run on the
+    shared ``alink-h2d`` transfer pool via
+    :func:`~alink_tpu.common.streaming.stream_map`, with up to ``depth``
+    batches in flight ahead of compute — the train step consumes
+    device-resident buffers and never blocks on the host. ``sync`` builds
+    and ships inline (the bit-identical reference feed: both modes call the
+    same ``build``/``place`` on the same step order)."""
+    if mode not in ("async", "sync"):
+        raise ValueError(f"unknown feed mode {mode!r}")
+    if mode == "sync":
+        for s in range(steps):
+            yield s, place(build(s))
+        return
+
+    from ..common.streaming import stream_map
+
+    def batches():
+        for s in range(steps):
+            # the "host arrays" slot carries only the step number — the
+            # real assembly happens inside put() on the transfer thread
+            yield s, (s,)
+
+    def put(args):
+        return place(build(int(args[0])))
+
+    yield from stream_map(lambda *devs: list(devs), batches(), put=put,
+                          depth=depth or None, phases=phases)
+
+
+def _pad_tail(arrs: List[np.ndarray], target: int) -> List[np.ndarray]:
+    """Pad row-aligned arrays to ``target`` rows by repeating the last real
+    row — numerically safe for any model (no all-padding attention rows, no
+    degenerate inputs), and exact under a zero loss-weight."""
+    m = arrs[0].shape[0]
+    if m == target:
+        return arrs
+    return [np.concatenate([a, np.repeat(a[-1:], target - m, axis=0)])
+            for a in arrs]
 
 
 def train_model(
@@ -144,6 +282,7 @@ def train_model(
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    from ..common.jitcache import bucket_rows, bucketing_enabled
     from ..parallel.mesh import default_mesh
 
     mesh = mesh or default_mesh()
@@ -165,7 +304,19 @@ def train_model(
     dp = mesh.shape.get(AXIS_DATA, 1)
     # batch dim must divide evenly over the data axis
     bs = max(dp, (min(cfg.batch_size, n_train) // dp) * dp)
-    steps_per_epoch = max(1, n_train // bs)
+    # device batch shape snaps onto the bucket ladder (rungs are multiples
+    # of 8; pad rows carry zero loss-weight) so a batch-size sweep across
+    # jobs shares compiled programs — and within a job, the ragged tail
+    # batch reuses the full-batch program instead of tracing a second shape
+    padded_bs = bs
+    if bucketing_enabled():
+        b = bucket_rows(bs)
+        if b % dp == 0:
+            padded_bs = b
+    if n_train >= bs:
+        steps_per_epoch = -(-n_train // bs)  # tail rows now train too
+    else:
+        steps_per_epoch = 1
     total_steps = steps_per_epoch * cfg.num_epochs
 
     # init
@@ -180,24 +331,31 @@ def train_model(
 
     tx = _make_optimizer(cfg, total_steps)
     opt_state = tx.init(params["params"])
-    loss_of = _loss_fn(cfg.loss, regression)
+    loss_of = _loss_fn(cfg.loss, regression, weighted=True)
 
     def in_shard(arr):
         sa = seq_axis if arr.ndim > (seq_axis or 0) else None
         return batch_sharding(mesh, arr.ndim, seq_axis=sa)
 
-    train_step = make_train_step(model, tx, loss_of)
-
-    @jax.jit
-    def eval_logits(params, batch):
-        return model.apply(params, **batch, deterministic=True)
+    # content-keyed: N jobs with the same (model, optimizer, loss) config
+    # share ONE compiled step; the key carries everything the closure bakes
+    # into the program (schedule length included)
+    job_key = (
+        _model_key(model),
+        ("opt", cfg.optimizer, cfg.learning_rate, cfg.weight_decay,
+         cfg.warmup_ratio, total_steps),
+        ("loss", cfg.loss, regression),
+    )
+    train_step = make_train_step(model, tx, loss_of, weighted=True,
+                                 cache_key=job_key)
+    eval_prog = _apply_program(model)
 
     from ..common.metrics import metrics as _metrics
     import time as _time
 
     ckpt = None
     start_epoch = 0
-    history = {"loss": [], "eval_metric": []}
+    history: Dict[str, Any] = {"loss": [], "eval_metric": []}
     best_metric, best_params, patience_left = None, None, cfg.early_stopping_patience
     step = 0
     if cfg.checkpoint_dir:
@@ -223,21 +381,48 @@ def train_model(
                 opt_state = jax.tree.map(_place, opt_state, r_opt)
                 step = int(extra.get("step", 0))
                 start_epoch = int(extra.get("epoch", -1)) + 1
+
+    names = sorted(tr_inputs)
+    in_shards = [in_shard(tr_inputs[k]) for k in names]
+    row_shard = batch_sharding(mesh, 1)
+
+    def place(arrs):
+        # runs on the transfer pool under async feed: the sharded copies
+        # complete inside the transfer thread (that is what makes the
+        # overlap real), so the consuming step dispatches with zero wait
+        devs = [jax.device_put(a, sh)
+                for a, sh in zip(arrs, in_shards + [row_shard, row_shard])]
+        jax.block_until_ready(devs)
+        return devs
+
+    feed_phases: Dict[str, Any] = {}
     t_start = _time.perf_counter()
     start_step = step   # resume restores the global counter; rate uses deltas
     for epoch in range(start_epoch, cfg.num_epochs):
-        order = rng.permutation(n_train)
+        # per-(seed, epoch) generator, NOT the sequentially-consumed rng: a
+        # crash-resumed run must replay the exact shuffle of the epochs it
+        # skipped past (dropout keys already align via fold_in(key, step))
+        order = np.random.default_rng((cfg.seed, epoch)).permutation(n_train)
         if n_train < bs:  # tile tiny datasets up to one full batch
             order = np.resize(order, bs)
-        for s in range(steps_per_epoch):
-            idx = order[s * bs:(s + 1) * bs]
-            batch = {
-                k: jax.device_put(v[idx], in_shard(v[idx]))
-                for k, v in tr_inputs.items()
-            }
-            yb = jax.device_put(tr_y[idx], batch_sharding(mesh, 1))
+
+        def build(s, _order=order):
+            idx = _order[s * bs:(s + 1) * bs]
+            arrs = [tr_inputs[k][idx] for k in names] + [tr_y[idx]]
+            w = np.ones(len(idx), np.float32)
+            if len(idx) < padded_bs:
+                arrs = _pad_tail(arrs, padded_bs)
+                w = np.concatenate(
+                    [w, np.zeros(padded_bs - len(idx), np.float32)])
+            return arrs + [w]
+
+        for s, devs in _feed(build, place, steps_per_epoch, mode=cfg.feed,
+                             depth=cfg.feed_depth, phases=feed_phases):
+            batch = dict(zip(names, devs[:-2]))
+            yb, wb = devs[-2], devs[-1]
             params, opt_state, l = train_step(
-                params, opt_state, batch, yb, jax.random.fold_in(key, step)
+                params, opt_state, batch, yb, wb,
+                jax.random.fold_in(key, step)
             )
             step += 1
             if ckpt is not None and cfg.checkpoint_every and \
@@ -264,7 +449,7 @@ def train_model(
             ckpt.save(step, jax.device_get(params), jax.device_get(opt_state),
                       {"step": step, "epoch": epoch})
         if n_eval:
-            logits = _batched_apply(eval_logits, params, ev_inputs, mesh,
+            logits = _batched_apply(eval_prog, params, ev_inputs, mesh,
                                     in_shard, bs)
             if regression:
                 metric = -float(np.mean((logits.squeeze(-1) - ev_y) ** 2))
@@ -284,6 +469,14 @@ def train_model(
     if best_params is not None:
         params = best_params
     history["final_loss"] = history["loss"][-1] if history["loss"] else None
+    if feed_phases:
+        # compute runs in THIS loop (the feed's fn is identity), so only the
+        # transfer-side phases carry signal here
+        history["feed"] = {
+            "mode": cfg.feed,
+            "transfer_s": round(feed_phases.get("transfer_s", 0.0), 4),
+            "batches": feed_phases.get("batches", 0),
+        }
     return jax.device_get(params), history
 
 
@@ -291,21 +484,25 @@ def _batched_apply(fn, params, inputs: Dict[str, np.ndarray], mesh, in_shard,
                    bs: int) -> np.ndarray:
     import jax
 
+    from ..common.jitcache import bucket_rows, bucketing_enabled
     from ..parallel.mesh import AXIS_DATA
 
     dp = mesh.shape.get(AXIS_DATA, 1)
-    n = next(iter(inputs.values())).shape[0]
+    names = sorted(inputs)
+    n = inputs[names[0]].shape[0]
     outs = []
     for s in range(0, n, bs):
-        chunk = {k: v[s:s + bs] for k, v in inputs.items()}
-        m = next(iter(chunk.values())).shape[0]
-        pad = (-m) % dp
-        if pad:  # pad to the data-axis multiple, trim after
-            chunk = {
-                k: np.concatenate([v, np.repeat(v[-1:], pad, axis=0)])
-                for k, v in chunk.items()
-            }
-        batch = {k: jax.device_put(v, in_shard(v)) for k, v in chunk.items()}
+        chunk = [np.asarray(inputs[k][s:s + bs]) for k in names]
+        m = chunk[0].shape[0]
+        # pad up the bucket ladder (then to the data-axis multiple) and trim
+        # after — the forward pass is row-wise, so repeated-last-row padding
+        # is exact, and ragged eval tails reuse the full-chunk program
+        target = bucket_rows(m) if bucketing_enabled() else m
+        target += (-target) % dp
+        if target != m:
+            chunk = _pad_tail(chunk, target)
+        batch = {k: jax.device_put(v, in_shard(v))
+                 for k, v in zip(names, chunk)}
         outs.append(np.asarray(fn(params, batch))[:m])
     return np.concatenate(outs, axis=0)
 
@@ -323,9 +520,7 @@ def predict_model(
     p_shard = param_shardings(params, mesh)
     params = jax.device_put(params, p_shard)
 
-    @jax.jit
-    def apply(params, batch):
-        return model.apply(params, **batch, deterministic=True)
+    apply = _apply_program(model)
 
     def in_shard(arr):
         sa = seq_axis if arr.ndim > (seq_axis or 0) else None

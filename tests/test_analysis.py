@@ -415,6 +415,36 @@ def test_lint_direct_jit_alk001(tmp_path):
     assert diags[0].line == 5
 
 
+def test_lint_jit_decorator_forms_alk001(tmp_path):
+    """Every decorator spelling is judged in the ENCLOSING scope: a
+    jit-decorated function is itself a compiled program even when its NAME
+    says `_build*` — only jit built INSIDE a builder is exempt."""
+    diags = _lint_src(tmp_path, "mod.py", """
+        from functools import partial
+        import jax
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def _build_a(x):
+            return x
+
+        @jax.jit
+        def _build_b(x):
+            return x
+
+        @jax.jit(static_argnums=(1,))
+        def _build_c(x, n):
+            return x
+
+        def _build_real():
+            @partial(jax.jit, donate_argnums=(0,))
+            def step(x):
+                return x
+            return step
+    """)
+    assert [d.rule for d in diags] == ["ALK001"] * 3
+    assert sorted(d.line for d in diags) == [5, 9, 13]
+
+
 def test_lint_jit_exemptions(tmp_path):
     # builder idiom + cached_jit inline lambda + jitcache module itself
     assert _lint_src(tmp_path, "a.py", """

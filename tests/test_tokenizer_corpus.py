@@ -1,0 +1,105 @@
+"""Tokenizer coverage on the SHIPPED real-text corpora (their first tier-1
+consumers): vocab round-trips (list + file), deterministic vocab builds,
+and deterministic batch shapes on data/reviews_unlabeled.txt and
+data/sst2_mini.csv."""
+
+import numpy as np
+import pytest
+
+from alink_tpu.dl.data import load_reviews, load_sst2, sst2_split
+from alink_tpu.dl.tokenizer import CLS, PAD, SEP, Tokenizer
+
+pytestmark = pytest.mark.training
+
+
+# ---------------------------------------------------------------------------
+# corpus loaders
+# ---------------------------------------------------------------------------
+
+def test_load_reviews_shape_and_content():
+    texts = load_reviews()
+    assert len(texts) == 4400
+    assert all(isinstance(t, str) and t for t in texts)
+    assert load_reviews(limit=16) == texts[:16]
+
+
+def test_load_sst2_rows_and_labels():
+    texts, y = load_sst2()
+    assert len(texts) == len(y) > 400
+    assert set(np.unique(y)) == {0, 1}
+    # quoted commas must survive csv parsing as one text field
+    assert all("\n" not in t for t in texts)
+    # roughly balanced — the holdout accuracy metric is meaningful
+    assert 0.3 < float(y.mean()) < 0.7
+
+
+def test_sst2_split_deterministic_and_disjoint():
+    tr1, try1, ho1, hoy1 = sst2_split(seed=0)
+    tr2, try2, ho2, hoy2 = sst2_split(seed=0)
+    assert tr1 == tr2 and ho1 == ho2
+    assert np.array_equal(try1, try2) and np.array_equal(hoy1, hoy2)
+    texts, _ = load_sst2()
+    assert len(tr1) + len(ho1) == len(texts)
+    assert len(ho1) == max(1, int(len(texts) * 0.2))
+
+
+# ---------------------------------------------------------------------------
+# vocab round-trips
+# ---------------------------------------------------------------------------
+
+def test_vocab_roundtrip_list_and_file(tmp_path):
+    texts = load_reviews(limit=200)
+    tok = Tokenizer.build(texts, vocab_size=500)
+    sample = texts[:20]
+
+    # list round-trip (the checkpoint path: save_bert_checkpoint stores
+    # to_list(), fine-tune rebuilds via from_list)
+    tok2 = Tokenizer.from_list(tok.to_list())
+    assert tok2.vocab == tok.vocab
+    for t in sample:
+        assert tok2.tokenize(t) == tok.tokenize(t)
+
+    # vocab.txt round-trip (the HF-layout file the BERT ops read)
+    p = tmp_path / "vocab.txt"
+    p.write_text("\n".join(tok.to_list()) + "\n", encoding="utf-8")
+    tok3 = Tokenizer.from_vocab_file(str(p))
+    assert tok3.vocab == tok.vocab
+    for t in sample:
+        assert tok3.encode(t, max_len=24) == tok.encode(t, max_len=24)
+
+
+def test_vocab_build_deterministic():
+    texts = load_reviews(limit=300)
+    a = Tokenizer.build(texts, vocab_size=400)
+    b = Tokenizer.build(texts, vocab_size=400)
+    assert a.to_list() == b.to_list()
+
+
+# ---------------------------------------------------------------------------
+# deterministic batch shapes
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("max_len", [16, 32])
+def test_encode_batch_shapes_on_corpora(max_len):
+    sst_texts, _ = load_sst2()
+    texts = sst_texts[:64] + load_reviews(limit=64)
+    tok = Tokenizer.build(texts, vocab_size=600)
+    enc = tok.encode_batch(texts, max_len=max_len)
+    assert sorted(enc) == ["attention_mask", "input_ids", "token_type_ids"]
+    for k, arr in enc.items():
+        assert arr.shape == (len(texts), max_len), k
+        assert arr.dtype == np.int32, k
+    ids, mask = enc["input_ids"], enc["attention_mask"]
+    assert set(np.unique(mask)) <= {0, 1}
+    # layout: [CLS] first, ids outside the mask are all [PAD], real tokens
+    # never exceed the vocab
+    assert (ids[:, 0] == tok.vocab[CLS]).all()
+    assert (ids[mask == 0] == tok.vocab[PAD]).all()
+    assert ids.max() < tok.vocab_size
+    # every row ends its masked span with [SEP] (truncation keeps it)
+    last = mask.sum(axis=1) - 1
+    assert (ids[np.arange(len(texts)), last] == tok.vocab[SEP]).all()
+    # determinism: the same corpus encodes to the same blocks
+    enc2 = tok.encode_batch(texts, max_len=max_len)
+    for k in enc:
+        assert np.array_equal(enc[k], enc2[k]), k
