@@ -10,7 +10,7 @@ mappers replacing per-row JVM inference.
 
 __version__ = "0.1.0"
 
-from .common.env import enable_compilation_cache as _enable_cc  # noqa: E402
+from .common.jitcache import enable_persistent_cache as _enable_cc  # noqa: E402
 
 _enable_cc()
 
@@ -27,13 +27,20 @@ from .common import (  # noqa: F401
     RetryPolicy,
     SparseVector,
     TableSchema,
+    compile_cache_dir,
     compile_summary,
+    disable_persistent_cache,
+    enable_persistent_cache,
     export_prometheus,
     is_retryable,
     job_report,
+    persist_summary,
     profile_summary,
     program_costs,
+    prune_persistent_cache,
     run_with_recovery,
+    save_warmup_specs,
+    seen_warmup_specs,
     trace_span,
     warmup,
     with_retries,

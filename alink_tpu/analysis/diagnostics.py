@@ -51,6 +51,13 @@ RULES: Dict[str, tuple] = {
     "ALK005": ("except-swallow", WARNING,
                "bare except, or broad except whose body only passes — "
                "failures vanish without a counter or log"),
+    "ALK006": ("compile-cache-drift", WARNING,
+               "direct jax compilation-cache configuration "
+               "(jax.config.update('jax_compilation_cache_*'/'jax_"
+               "persistent_cache_*') or a raw compilation_cache import) "
+               "outside common/jitcache.py — bypasses the one sanctioned "
+               "owner (knob ALINK_COMPILE_CACHE_DIR, persist counters, "
+               "corruption fallback, disk LRU cap)"),
     # -- plan validation (pre-flight over user DAGs) -----------------------
     "ALK101": ("missing-column", ERROR,
                "a column named by selectedCols/featureCols/labelCol/... is "

@@ -22,7 +22,14 @@ pre-lowering shape inference — PAPERS.md):
 - **ALK004** mutation of a module-level dict outside a ``with *lock*:``
   block in threaded modules (executor, metrics, serving, ...);
 - **ALK005** bare ``except:``, or a broad ``except (Base)Exception:`` whose
-  body only passes — swallowed failures with no counter or log.
+  body only passes — swallowed failures with no counter or log;
+- **ALK006** direct jax compilation-cache configuration —
+  ``jax.config.update("jax_compilation_cache_*" / "jax_persistent_cache_*",
+  ...)`` or any raw ``compilation_cache`` import — outside
+  ``common/jitcache.py``, the one sanctioned owner of persistent compile
+  artifacts (same single-owner shape as ALK002): bypasses the
+  ``ALINK_COMPILE_CACHE_DIR`` knob, the ``jit.persist_*`` counters, the
+  corruption fallback, and the on-disk LRU cap.
 
 (**ALK000** parse-error, error severity, marks a file ``ast.parse`` rejects —
 no other rule could run on it.)
@@ -73,6 +80,10 @@ _JITCACHE_MODULE = "common/jitcache.py"
 _SHARDMAP_SHIM = "parallel/shardmap.py"
 
 _MUTATORS = ("update", "setdefault", "pop", "popitem", "clear")
+
+# jax config names ALK006 treats as compile-cache configuration — writing
+# any of them outside common/jitcache.py bypasses the sanctioned owner
+_CACHE_CONFIG_PREFIXES = ("jax_compilation_cache", "jax_persistent_cache")
 
 # every spelling of "build me a compiled program" ALK001 polices — the call
 # form, the bare-decorator form, and the functools.partial decorator form
@@ -254,6 +265,19 @@ class _FileLinter(ast.NodeVisitor):
                 "path re-runs",
                 hint="wrap in a _build*() builder registered via "
                      "common/jitcache.cached_jit")
+        if tail == "update" and d.endswith("config.update") and node.args \
+                and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, str) \
+                and node.args[0].value.startswith(_CACHE_CONFIG_PREFIXES) \
+                and not self.is_jitcache:
+            self._add(
+                "ALK006", node,
+                f"direct {d}({node.args[0].value!r}, ...) outside "
+                "common/jitcache.py — compile-cache configuration bypasses "
+                "the sanctioned owner (no persist counters, no corruption "
+                "fallback, no disk LRU cap)",
+                hint="route through common/jitcache.enable_persistent_cache "
+                     "(knob ALINK_COMPILE_CACHE_DIR)")
         if tail == "get" and isinstance(node.func, ast.Attribute) \
                 and _is_environ(node.func.value) and not self.is_env_module:
             self._add(
@@ -296,6 +320,13 @@ class _FileLinter(ast.NodeVisitor):
                     f"import {alias.name} — shard_map drift",
                     hint="from alink_tpu.parallel.shardmap import "
                          "shard_map (the one sanctioned import)")
+            if "compilation_cache" in alias.name and not self.is_jitcache:
+                self._add(
+                    "ALK006", node,
+                    f"import {alias.name} — compile-cache drift",
+                    hint="use common/jitcache (enable_persistent_cache / "
+                         "persist_summary / prune_persistent_cache), the "
+                         "one sanctioned owner")
         self.generic_visit(node)
 
     def visit_ImportFrom(self, node: ast.ImportFrom):
@@ -310,6 +341,17 @@ class _FileLinter(ast.NodeVisitor):
                 f"from {mod} import {names} — shard_map drift",
                 hint="from alink_tpu.parallel.shardmap import shard_map "
                      "(the one sanctioned import)")
+        cache_drift = "compilation_cache" in mod or (
+            mod.startswith("jax")
+            and any("compilation_cache" in a.name for a in node.names))
+        if cache_drift and not self.is_jitcache:
+            names = ", ".join(a.name for a in node.names)
+            self._add(
+                "ALK006", node,
+                f"from {mod} import {names} — compile-cache drift",
+                hint="use common/jitcache (enable_persistent_cache / "
+                     "persist_summary / prune_persistent_cache), the one "
+                     "sanctioned owner")
         self.generic_visit(node)
 
     def visit_Subscript(self, node: ast.Subscript):

@@ -26,7 +26,14 @@ from .jitcache import (
     bucket_rows,
     cached_jit,
     clear_program_cache,
+    compile_cache_dir,
     compile_summary,
+    disable_persistent_cache,
+    enable_persistent_cache,
+    persist_summary,
+    prune_persistent_cache,
+    save_warmup_specs,
+    seen_warmup_specs,
     warmup,
 )
 from .resilience import (

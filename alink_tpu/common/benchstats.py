@@ -204,15 +204,50 @@ def flatten_metrics(doc: Dict[str, Any]) -> Dict[str, float]:
     return out
 
 
+def round_device_kind(doc: Dict[str, Any]) -> Optional[str]:
+    """The accelerator a BENCH round ran on, read from the round's own
+    extras (``profiling.device.device_kind``, falling back to
+    ``bert_mfu.device_kind`` for rounds archived before the profiling
+    extra existed). None when the round carries no device evidence."""
+    root = doc.get("parsed") if isinstance(doc.get("parsed"), dict) else doc
+    extras = root.get("extras") or {}
+    for probe in (("profiling", "device", "device_kind"),
+                  ("bert_mfu", "device_kind")):
+        v: Any = extras
+        for k in probe:
+            v = v.get(k) if isinstance(v, dict) else None
+        if isinstance(v, str) and v:
+            return v
+    return None
+
+
+# metric-name substrings that stay comparable ACROSS accelerators: model
+# quality and cache-behavior ratios do not change when the chip does, so a
+# platform-change compare still gates them. Everything with a direction
+# that is not in this list is hardware-bound (rates, wall-clocks, FLOPs)
+# and demotes to an explicit "platform-change" verdict instead of
+# false-flagging a hardware swap as a code regression.
+_PLATFORM_INDEPENDENT = ("accuracy", "purity", "hit_rate", "holdout")
+
+
 def compare_bench_files(old_path: str, new_path: str, *,
                         threshold: Optional[float] = None) -> Dict[str, Any]:
     """Compare two BENCH round files metric-by-metric and return the
     regression report ``bench.py --compare`` prints. ``threshold``
-    overrides every per-metric noise threshold (fraction, e.g. 0.1)."""
+    overrides every per-metric noise threshold (fraction, e.g. 0.1).
+
+    Platform awareness: when the two rounds ran on different accelerators
+    (``round_device_kind`` differs — e.g. a TPU round vs a CPU container),
+    hardware-bound perf metrics cannot evidence a code regression; they are
+    reported under the explicit ``platform-change`` verdict (loud, counted,
+    never silently dropped) while hardware-independent quality metrics
+    (accuracy/purity/hit-rate) keep gating."""
     with open(old_path) as f:
         old = json.load(f)
     with open(new_path) as f:
         new = json.load(f)
+    kind_old, kind_new = round_device_kind(old), round_device_kind(new)
+    platform_changed = bool(kind_old and kind_new and kind_old != kind_new)
     mo, mn = flatten_metrics(old), flatten_metrics(new)
     entries: List[Dict[str, Any]] = []
     for path in sorted(set(mo) & set(mn)):
@@ -229,6 +264,9 @@ def compare_bench_files(old_path: str, new_path: str, *,
         thr = metric_threshold(path, threshold)
         if direction is None:
             verdict = "info"
+        elif platform_changed and not any(
+                s in path.lower() for s in _PLATFORM_INDEPENDENT):
+            verdict = "platform-change"
         elif direction == "higher":
             verdict = ("regression" if delta < -thr
                        else "improvement" if delta > thr else "no-change")
@@ -250,6 +288,8 @@ def compare_bench_files(old_path: str, new_path: str, *,
     return {
         "old": str(old_path),
         "new": str(new_path),
+        "platform_change": ({"old": kind_old, "new": kind_new}
+                            if platform_changed else None),
         "metrics_compared": len(entries),
         "only_in_old": len(set(mo) - set(mn)),
         "only_in_new": len(set(mn) - set(mo)),
@@ -257,5 +297,7 @@ def compare_bench_files(old_path: str, new_path: str, *,
         "improvements": improvements,
         "no_change": sum(1 for e in entries if e["verdict"] == "no-change"),
         "informational": sum(1 for e in entries if e["verdict"] == "info"),
+        "platform_demoted": sum(1 for e in entries
+                                if e["verdict"] == "platform-change"),
         "verdict": "regression" if regressions else "ok",
     }

@@ -121,62 +121,15 @@ class AlinkGlobalConfiguration:
         cls._wire_precision = p
 
 
-_cache_enabled = False
-
-
 def enable_compilation_cache(cache_dir: Optional[str] = None) -> None:
-    """Point JAX at a persistent XLA compilation cache so short jobs (e.g. a
-    KMeans fit) pay compile cost once per machine, not once per process.
+    """Back-compat shim: the persistent compile cache is owned by
+    ``common/jitcache.py`` since PR 11 (knob ``ALINK_COMPILE_CACHE_DIR``;
+    the legacy ``ALINK_COMPILATION_CACHE_DIR`` still works; alink-lint
+    ALK006 pins the single ownership). Delegates to
+    :func:`alink_tpu.common.jitcache.enable_persistent_cache`."""
+    from .jitcache import enable_persistent_cache
 
-    Called at package import; calling again with an explicit ``cache_dir``
-    re-points the cache. When jax is not yet imported this only sets env
-    vars (jax reads them at init) so ``import alink_tpu`` stays jax-free.
-    Env override: ``ALINK_COMPILATION_CACHE_DIR`` (empty string disables)."""
-    global _cache_enabled
-    env = os.environ.get("ALINK_COMPILATION_CACHE_DIR")
-    if env == "" and cache_dir is None:
-        return
-    if cache_dir is None:
-        if _cache_enabled:
-            return
-        # CPU-only processes (tests, virtual meshes) skip the default-on
-        # cache: XLA:CPU AOT entries are machine-feature-pinned and reload
-        # with SIGILL-risk warnings; the win this targets is the real TPU
-        # chip, where compiles cost 20-40s
-        if env is None and os.environ.get("JAX_PLATFORMS",
-                                          "").strip() == "cpu":
-            return
-    d = cache_dir or env or os.path.join(
-        os.path.expanduser("~"), ".cache", "alink_tpu", "xla_cache")
-    try:
-        import sys
-
-        os.makedirs(d, exist_ok=True)
-        if "jax" in sys.modules:
-            import jax
-
-            jax.config.update("jax_compilation_cache_dir", d)
-            # cache everything: the default 1s floor skips exactly the
-            # small per-op programs this framework compiles most often
-            jax.config.update(
-                "jax_persistent_cache_min_compile_time_secs", 0.0)
-            jax.config.update(
-                "jax_persistent_cache_min_entry_size_bytes", -1)
-        elif cache_dir is not None:
-            # explicit re-point before jax import must override any earlier
-            # default this function wrote
-            os.environ["JAX_COMPILATION_CACHE_DIR"] = d
-            os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] = "0.0"
-            os.environ["JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES"] = "-1"
-        else:
-            os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", d)
-            os.environ.setdefault(
-                "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.0")
-            os.environ.setdefault(
-                "JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")
-        _cache_enabled = True
-    except Exception:  # pragma: no cover — older jax w/o these flags
-        pass
+    enable_persistent_cache(cache_dir)
 
 
 class MLEnvironment:

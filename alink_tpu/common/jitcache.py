@@ -25,6 +25,17 @@ once-per-machine) event:
    (or profiled, env ``ALINK_SHAPE_PROFILE``) shape signatures ahead of
    time on a background thread, off the serving critical path.
 
+4. **Persistent compile artifacts** — :func:`enable_persistent_cache`
+   (env ``ALINK_COMPILE_CACHE_DIR``) wires jax's persistent compilation
+   cache under the ProgramCache so executables survive process death: a
+   fresh process pays trace + deserialize (``jit.persist_hit``) instead of
+   a backend compile, corrupt entries fall back to a fresh compile
+   (``jit.persist_error``), and the on-disk footprint is LRU-bounded
+   (``ALINK_COMPILE_CACHE_MAX_BYTES``). Paired with
+   :func:`save_warmup_specs` / ``warmup(path)``, a replica that has never
+   compiled reaches warm-path readiness from disk alone — see
+   docs/coldstart.md.
+
 Observability: every first call of a program with a new shape signature is
 counted (``jit.trace`` / ``jit.compile``) and timed (global and per-kernel
 ``jitcache.*.compile_s`` timers, plus a ``compile_s`` phase on the active
@@ -44,9 +55,11 @@ rebind to the returned state and never re-use a donated tree.
 
 from __future__ import annotations
 
+import functools
 import itertools
 import json
 import os
+import sys
 import threading
 import time
 from collections import OrderedDict
@@ -55,7 +68,7 @@ from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tupl
 import numpy as np
 
 from . import profiling as _profiling
-from .env import env_int, env_str
+from .env import env_int, env_raw, env_str
 from .metrics import add_node_phase, metrics
 
 # ---------------------------------------------------------------------------
@@ -370,6 +383,388 @@ def load_shape_profile(path: Optional[str] = None) -> List[Tuple[str, list]]:
 
 
 # ---------------------------------------------------------------------------
+# Persistent compile artifacts (cross-process)
+# ---------------------------------------------------------------------------
+# This module is the ONE sanctioned owner of jax's persistent compilation
+# cache configuration (alink-lint ALK006 bans jax_compilation_cache_* config
+# writes and raw compilation_cache imports anywhere else). Everything below
+# only changes WHERE compiled executables come from — never what they
+# compute: a persist hit deserializes the exact executable a previous
+# process compiled for the same HLO + compile options, and every failure
+# (corrupt entry, unwritable dir, version skew) falls back to a fresh
+# backend compile.
+
+_PERSIST_DIR_ENV = "ALINK_COMPILE_CACHE_DIR"
+_PERSIST_LEGACY_DIR_ENV = "ALINK_COMPILATION_CACHE_DIR"  # pre-PR-11 name
+_PERSIST_CAP_ENV = "ALINK_COMPILE_CACHE_MAX_BYTES"
+_DEFAULT_PERSIST_CAP = 2 * 1024 ** 3   # on-disk LRU bound (2 GiB)
+
+_persist_lock = threading.Lock()
+_persist: Dict[str, Any] = {"enabled": False, "dir": None, "hooked": False,
+                            "configured": False, "explicit": True,
+                            "wrote_env": {}}
+
+
+def persist_cap_bytes() -> int:
+    """On-disk size bound for the persistent cache (env
+    ``ALINK_COMPILE_CACHE_MAX_BYTES``, 0 = unbounded)."""
+    return env_int(_PERSIST_CAP_ENV, _DEFAULT_PERSIST_CAP)
+
+
+def compile_cache_dir() -> Optional[str]:
+    """The active persistent-cache directory, or None when persistence is
+    off."""
+    with _persist_lock:
+        return _persist["dir"] if _persist["enabled"] else None
+
+
+def _resolve_persist_dir(cache_dir: Optional[str]
+                         ) -> Tuple[Optional[str], bool]:
+    """Resolve the cache dir: explicit arg > ``ALINK_COMPILE_CACHE_DIR`` >
+    the legacy ``ALINK_COMPILATION_CACHE_DIR`` > (off-CPU only) the
+    per-user default. An exported-but-blank knob is an explicit OFF.
+    Returns ``(dir, explicit)`` — ``(None, _)`` when persistence should
+    stay disabled; ``explicit`` is False only for the fallback default,
+    which must YIELD to a cache dir the user configured on jax directly
+    (``JAX_COMPILATION_CACHE_DIR``) instead of clobbering it."""
+    if cache_dir is not None:
+        return (cache_dir or None), True
+    for name in (_PERSIST_DIR_ENV, _PERSIST_LEGACY_DIR_ENV):
+        raw = env_raw(name)  # blank-but-exported must read as explicit OFF
+        if raw is not None:
+            return (raw.strip() or None), True
+    # no knob set: default ON only off-CPU. XLA:CPU AOT entries are
+    # machine-feature-pinned and reload with SIGILL-risk warnings in
+    # heterogeneous fleets; the win this defaults for is the real TPU
+    # chip, where compiles cost 20-40s. CPU users opt in via the knob.
+    if (env_str("JAX_PLATFORMS", "") or "").strip() == "cpu":
+        return None, False
+    return os.path.join(os.path.expanduser("~"), ".cache", "alink_tpu",
+                        "xla_cache"), False
+
+
+def _counted_cache_io(fn):
+    """Wrap one jax compilation-cache IO entry point so every read/write
+    failure is counted as ``jit.persist_error`` before jax's own fallback
+    (warn + fresh compile) takes over. Behavior-preserving: the exception
+    re-raises unchanged."""
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        try:
+            return fn(*args, **kwargs)
+        except Exception:
+            metrics.incr("jit.persist_error")
+            raise
+    wrapper._alink_counted = True  # type: ignore[attr-defined]
+    return wrapper
+
+
+def _install_persist_hooks() -> bool:
+    """Counter plumbing: ``jit.persist_hit`` / ``jit.persist_miss`` /
+    ``jit.persist_saved_s`` from jax's monitoring events,
+    ``jit.persist_error`` from wrapped cache IO. Returns True when the
+    hooks should be considered installed (callers record that under
+    ``_persist_lock`` — including after a failure, so a jax without these
+    internals is probed exactly once)."""
+    if _persist["hooked"]:
+        return True
+    try:
+        from jax._src import monitoring
+
+        def _on_event(event: str, **kwargs) -> None:
+            if event == "/jax/compilation_cache/cache_hits":
+                metrics.incr("jit.persist_hit")
+            elif event == "/jax/compilation_cache/cache_misses":
+                metrics.incr("jit.persist_miss")
+
+        monitoring.register_event_listener(_on_event)
+        try:
+            def _on_duration(event: str, duration: float, **kwargs) -> None:
+                # backend-compile seconds each persist hit skipped (jax
+                # stores whole seconds, so sub-second CPU compiles read 0 —
+                # the number this exists for is the 20-40s TPU compile)
+                if event == "/jax/compilation_cache/compile_time_saved_sec":
+                    metrics.add_time("jit.persist_saved_s",
+                                     max(float(duration), 0.0))
+
+            monitoring.register_event_duration_secs_listener(_on_duration)
+        except Exception:
+            metrics.incr("jit.persist_hook_errors")
+        from jax._src import compilation_cache as _cc
+
+        for name in ("get_executable_and_time", "put_executable_and_time"):
+            fn = getattr(_cc, name, None)
+            if fn is not None and not getattr(fn, "_alink_counted", False):
+                setattr(_cc, name, _counted_cache_io(fn))
+    except Exception:
+        # hit/miss accounting is observability, not correctness: a jax
+        # without these internals still persists fine, just uncounted
+        metrics.incr("jit.persist_hook_errors")
+    return True
+
+
+def _apply_jax_persist_config(d: str, explicit: bool = True) -> str:
+    """Point jax's persistent cache at ``d`` and return the dir actually in
+    effect. A non-``explicit`` (fallback-default) dir yields to a cache dir
+    the user already configured on jax (``JAX_COMPILATION_CACHE_DIR`` /
+    direct config) — e.g. a pre-warmed shared cache — instead of silently
+    clobbering it with the alink default."""
+    import jax
+
+    current = getattr(jax.config, "jax_compilation_cache_dir", None)
+    if not explicit and current:
+        d = current
+    elif current != d:
+        jax.config.update("jax_compilation_cache_dir", d)
+        try:
+            # jax latches its cache-used decision on the first compile of
+            # the task; a process that already compiled before this enable
+            # (tests, late re-points) must re-evaluate or the new dir is
+            # ignored
+            from jax._src import compilation_cache as _cc
+
+            _cc.reset_cache()
+        except Exception:
+            metrics.incr("jit.persist_hook_errors")
+    # cache everything: the default 1s floor skips exactly the small
+    # per-op programs this framework compiles most often. A user-exported
+    # JAX_PERSISTENT_CACHE_* knob wins (jax consumed it at import); the
+    # env vars our own pre-jax enable wrote hold these same values, so
+    # skipping the update there is equivalent.
+    if env_raw("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS") is None:
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    if env_raw("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES") is None:
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    cap = persist_cap_bytes()
+    if cap > 0:
+        try:
+            # jax's own LRU eviction (by entry atime) enforces the cap on
+            # every write; prune_persistent_cache() below additionally
+            # bounds a pre-existing oversized dir at enable time
+            jax.config.update("jax_compilation_cache_max_size", cap)
+        except Exception:
+            metrics.incr("jit.persist_hook_errors")
+    return d
+
+
+def enable_persistent_cache(cache_dir: Optional[str] = None) -> Optional[str]:
+    """Wire jax's persistent compilation cache underneath the ProgramCache
+    so compiled programs survive process death: a fresh process pays trace +
+    deserialize instead of trace + backend-compile (BASELINE #1: 50.2s cold
+    vs 0.35s warm on kmeans_iris).
+
+    Called at package import. Directory resolution: explicit ``cache_dir``
+    argument > ``ALINK_COMPILE_CACHE_DIR`` (blank = explicitly off) > the
+    legacy ``ALINK_COMPILATION_CACHE_DIR`` > a per-user default on
+    non-CPU platforms. When jax is not imported yet this only sets the
+    ``JAX_*`` env vars (jax reads them at init) so ``import alink_tpu``
+    stays jax-free; the config + counter hooks are finalized lazily on the
+    first ``cached_jit`` miss. Returns the active dir, or None when
+    persistence stays off — in which case process behavior is byte-for-byte
+    unchanged. The fallback default (no knob anywhere) yields to a cache
+    dir the user configured on jax directly."""
+    d, explicit = _resolve_persist_dir(cache_dir)
+    if d is None:
+        return None
+    try:
+        os.makedirs(d, exist_ok=True)
+        with _persist_lock:
+            if "jax" in sys.modules:
+                d = _apply_jax_persist_config(d, explicit)
+                _persist["hooked"] = _install_persist_hooks()
+                _persist["configured"] = True
+                _persist["explicit"] = explicit
+            else:
+                # pre-jax: hand the config to jax via env vars it reads at
+                # init. Precedence: an explicit re-point overrides the dir
+                # a user exported (that is what "explicit" means), but the
+                # min_* tuning knobs and — for the fallback default — the
+                # dir itself always YIELD to user-exported values. Every
+                # write records the prior value so disable can restore it.
+                wrote: Dict[str, Optional[str]] = _persist["wrote_env"]
+
+                def _set(name: str, value: str, force: bool) -> None:
+                    prior = env_raw(name)
+                    if force or prior is None:
+                        wrote.setdefault(name, prior)
+                        os.environ[name] = value
+
+                _set("JAX_COMPILATION_CACHE_DIR", d,
+                     force=cache_dir is not None)
+                d = env_raw("JAX_COMPILATION_CACHE_DIR") or d
+                _set("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.0",
+                     force=False)
+                _set("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1",
+                     force=False)
+                _persist["configured"] = False
+                _persist["explicit"] = explicit
+            _persist["enabled"] = True
+            _persist["dir"] = d
+        prune_persistent_cache()
+        return d
+    except Exception:  # pragma: no cover — unwritable dir, exotic platform
+        metrics.incr("jit.persist_hook_errors")
+        return None
+
+
+def disable_persistent_cache() -> None:
+    """Turn persistence back off (tests, operators draining a bad disk).
+    In-flight executables are unaffected; the next compile goes straight to
+    the backend. Env vars a pre-jax enable wrote are restored to their
+    prior values (user-exported ``JAX_*`` knobs this module never touched
+    stay untouched) — otherwise a jax that initializes later would read
+    our leftovers and silently re-activate the cache this call turned
+    off."""
+    with _persist_lock:
+        wrote: Dict[str, Optional[str]] = _persist["wrote_env"]
+        _persist.update(enabled=False, dir=None, configured=False,
+                        wrote_env={})
+    for name, prior in wrote.items():
+        if prior is None:
+            os.environ.pop(name, None)
+        else:
+            os.environ[name] = prior
+    if "jax" in sys.modules:
+        try:
+            import jax
+
+            jax.config.update("jax_compilation_cache_dir", None)
+            from jax._src import compilation_cache as _cc
+
+            _cc.reset_cache()
+        except Exception:
+            metrics.incr("jit.persist_hook_errors")
+
+
+def _ensure_persist_ready() -> None:
+    """Finalize the jax-side config + counter hooks on the first
+    ``cached_jit`` miss (cheap dict reads once done). Imports jax if the
+    enable ran before jax did — the miss path's builder is about to anyway,
+    and the config must land BEFORE that first compile so the very first
+    program already persists and counts."""
+    if not _persist["enabled"] or _persist["configured"]:
+        return
+    with _persist_lock:
+        if _persist["configured"] or not _persist["enabled"]:
+            return
+        try:
+            _persist["dir"] = _apply_jax_persist_config(
+                _persist["dir"], bool(_persist.get("explicit", True)))
+            _persist["hooked"] = _install_persist_hooks()
+        except Exception:
+            metrics.incr("jit.persist_hook_errors")
+        _persist["configured"] = True  # do not retry per miss
+
+
+def _persist_entries(d: str) -> List[Tuple[str, float, int]]:
+    """(path, last-use stamp, bytes) per on-disk cache entry. jax's LRUCache
+    layout keeps a sibling ``<key>-atime`` file as the last-use marker; its
+    mtime (falling back to the entry's own mtime) orders eviction."""
+    entries: List[Tuple[str, float, int]] = []
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return entries
+    for name in names:
+        if not name.endswith("-cache"):
+            continue
+        path = os.path.join(d, name)
+        try:
+            size = os.path.getsize(path)
+            stamp_path = path[:-len("-cache")] + "-atime"
+            try:
+                stamp = os.path.getmtime(stamp_path)
+            except OSError:
+                stamp = os.path.getmtime(path)
+            entries.append((path, stamp, size))
+        except OSError:
+            continue
+    return entries
+
+
+def prune_persistent_cache(cache_dir: Optional[str] = None,
+                           max_bytes: Optional[int] = None) -> Dict[str, int]:
+    """LRU-prune the on-disk cache to ``max_bytes`` (default: the configured
+    cap): least-recently-used entries (and their ``-atime`` companions)
+    delete first until the directory fits. Safe to run concurrently with
+    live processes — a reader that loses an entry re-compiles and re-writes
+    it. Returns ``{"entries", "bytes", "removed", "removed_bytes"}``."""
+    d = cache_dir or compile_cache_dir()
+    cap = persist_cap_bytes() if max_bytes is None else max_bytes
+    if not d:
+        return {"entries": 0, "bytes": 0, "removed": 0, "removed_bytes": 0}
+    entries = _persist_entries(d)
+    total = sum(e[2] for e in entries)
+    removed = removed_bytes = 0
+    if cap > 0 and total > cap:
+        for path, _, size in sorted(entries, key=lambda e: e[1]):
+            if total <= cap:
+                break
+            try:
+                os.remove(path)
+                try:
+                    os.remove(path[:-len("-cache")] + "-atime")
+                except OSError:
+                    pass
+            except OSError:
+                continue
+            total -= size
+            removed += 1
+            removed_bytes += size
+            metrics.incr("jit.persist_evict")
+    return {"entries": len(entries) - removed, "bytes": total,
+            "removed": removed, "removed_bytes": removed_bytes}
+
+
+def persist_summary() -> Dict[str, Any]:
+    """One-call persistence readout: knob state, on-disk entry count/bytes
+    vs the cap, and the ``jit.persist_*`` counters. Embedded in
+    :func:`compile_summary` (the BENCH ``compile``/``coldstart`` extras) and
+    exported as gauges at ``/metrics``."""
+    d = compile_cache_dir()
+    out: Dict[str, Any] = {
+        "enabled": d is not None,
+        "dir": d,
+        "max_bytes": persist_cap_bytes(),
+        "entries": 0,
+        "bytes": 0,
+        "counters": metrics.counters("jit.persist"),
+    }
+    if d:
+        entries = _persist_entries(d)
+        out["entries"] = len(entries)
+        out["bytes"] = sum(e[2] for e in entries)
+    saved = metrics.timer_stats("jit.persist_saved_s")
+    if saved:
+        out["compile_s_saved"] = saved.get("total_s")
+    return out
+
+
+_GAUGE_TTL_S = 60.0
+_gauge_stamp: Dict[str, float] = {"t": 0.0}
+
+
+def _export_persist_gauges() -> None:
+    # runs on every /metrics scrape: refresh the on-disk readout (a full
+    # directory stat walk) at most once per TTL so a 10s Prometheus scrape
+    # interval never turns into thousands of stat() calls per scrape on a
+    # network-filesystem cache dir
+    if not _persist["enabled"]:
+        return
+    now = time.monotonic()
+    with _persist_lock:
+        if now - _gauge_stamp["t"] < _GAUGE_TTL_S:
+            return
+        _gauge_stamp["t"] = now
+    s = persist_summary()
+    metrics.set_gauge("jit.persist_cache_entries", s["entries"])
+    metrics.set_gauge("jit.persist_cache_bytes", s["bytes"])
+
+
+metrics.register_export_hook(_export_persist_gauges)
+
+
+# ---------------------------------------------------------------------------
 # The program cache
 # ---------------------------------------------------------------------------
 
@@ -421,6 +816,13 @@ class CachedProgram:
         metrics.incr("jit.trace")
         metrics.incr("jit.compile")
         _record_profile(self.kernel_id, sig)
+        # persist attribution: a jump in the process-wide persist-hit
+        # counter across this compile window means the executable came off
+        # disk, not from the backend compiler (best-effort under concurrent
+        # compiles — cost records stay correct either way, only the
+        # hit/compile label could cross-attribute)
+        ph0 = metrics.counter("jit.persist_hit") if _persist["enabled"] \
+            else None
         t0 = time.perf_counter()
         try:
             out = self.jit_fn(*args)
@@ -433,7 +835,9 @@ class CachedProgram:
                                    kernel=self.kernel_id,
                                    ms=round(dt * 1e3, 3))
             add_node_phase("compile_s", dt)
-        _profiling.note_compiled(self, sig, args, out, dt)
+        persist = None if ph0 is None else \
+            ("hit" if metrics.counter("jit.persist_hit") > ph0 else "compile")
+        _profiling.note_compiled(self, sig, args, out, dt, persist=persist)
         return out
 
     def lower(self, *args):
@@ -502,6 +906,9 @@ def cached_jit(kernel_id: str, builder: Callable, *static,
             metrics.incr("jit.program_hit")
             return prog
         metrics.incr("jit.program_miss")
+        # builders are where jax enters the process: finalize the
+        # persistent-cache config + counter hooks before the first compile
+        _ensure_persist_ready()
         jit_fn = builder(mesh, *static) if mesh is not None else \
             builder(*static)
         prog = _PROGRAMS[key] = CachedProgram(kernel_id, key, jit_fn)
@@ -570,12 +977,58 @@ def compile_summary() -> Dict[str, Any]:
         "counters": counters,
         "hit_rate": round(hits / (hits + misses), 4) if hits + misses else None,
         "kernels": kernels,
+        "persist": persist_summary(),
     }
 
 
 # ---------------------------------------------------------------------------
 # AOT warmup
 # ---------------------------------------------------------------------------
+
+def seen_warmup_specs(kernel_ids: Optional[Iterable[str]] = None
+                      ) -> List[Tuple[str, list]]:
+    """Warmup specs ``[(kernel_id, [(shape, dtype), ...]), ...]`` for every
+    shape signature the process has executed — the array leaves of each
+    recorded signature, in the exact shape :func:`warmup` consumes and
+    :func:`load_shape_profile` returns. This is the live-process twin of
+    the ``ALINK_SHAPE_PROFILE`` file: it lets a replica snapshot what it
+    warmed and persist that next to its model artifacts."""
+    wanted = set(kernel_ids) if kernel_ids is not None else None
+    specs: List[Tuple[str, list]] = []
+    seen = set()
+    for p in programs():
+        if wanted is not None and p.kernel_id not in wanted:
+            continue
+        with p._lock:
+            sigs = list(p._sigs)
+        for sig in sigs:
+            arrs = [(tuple(s[1]), s[2]) for s in sig if s[0] == "a"]
+            if not arrs:
+                continue
+            key = (p.kernel_id, tuple(arrs))
+            if key not in seen:
+                seen.add(key)
+                specs.append((p.kernel_id, arrs))
+    return specs
+
+
+def save_warmup_specs(path: str,
+                      specs: Optional[Iterable] = None) -> int:
+    """Write warmup specs to ``path`` in the ``ALINK_SHAPE_PROFILE`` jsonl
+    format (what :func:`load_shape_profile` / ``warmup(path)`` read back in
+    a process that has never compiled). Atomic replace — a reader never
+    sees a half-written profile. Returns the number of specs written."""
+    items = list(seen_warmup_specs() if specs is None else specs)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        for kernel_id, arg_sigs in items:
+            f.write(json.dumps({
+                "kernel": kernel_id,
+                "args": [[list(s), str(d)] for s, d in arg_sigs],
+            }) + "\n")
+    os.replace(tmp, path)
+    return len(items)
+
 
 def _run_warmup(specs: List[Tuple[str, list]], result: dict) -> None:
     compiled = errors = 0
@@ -593,16 +1046,23 @@ def _run_warmup(specs: List[Tuple[str, list]], result: dict) -> None:
 def warmup(specs: Optional[Iterable] = None, *, block: bool = False):
     """AOT-compile registered kernels ahead of the first real call.
 
-    ``specs``: iterable of ``(kernel_id, [(shape, dtype), ...])``; ``None``
-    loads the shape profile recorded under ``ALINK_SHAPE_PROFILE``. Only
-    kernels already registered in this process (their ``cached_jit`` call
-    has run — e.g. a model mapper was loaded) are warmable; unknown ids are
-    skipped silently. By default the compiles run on a daemon thread (off
-    the serving critical path) and the started thread is returned with a
-    ``.result`` dict it fills; ``block=True`` runs inline and returns the
-    dict ``{"compiled": n, "errors": e, "specs": s}``."""
+    ``specs``: iterable of ``(kernel_id, [(shape, dtype), ...])``, or a
+    path to a profile jsonl written by :func:`save_warmup_specs` /
+    ``ALINK_SHAPE_PROFILE`` recording — the disk artifact that lets a
+    process that has never compiled AOT-warm (with the persistent compile
+    cache, each warm call deserializes the executable a previous process
+    compiled). ``None`` loads the profile recorded under
+    ``ALINK_SHAPE_PROFILE``. Only kernels already registered in this
+    process (their ``cached_jit`` call has run — e.g. a model mapper was
+    loaded) are warmable; unknown ids are skipped silently. By default the
+    compiles run on a daemon thread (off the serving critical path) and the
+    started thread is returned with a ``.result`` dict it fills;
+    ``block=True`` runs inline and returns the dict
+    ``{"compiled": n, "errors": e, "specs": s}``."""
     if specs is None:
         specs = load_shape_profile()
+    elif isinstance(specs, str):
+        specs = load_shape_profile(specs)
     norm: List[Tuple[str, list]] = []
     for item in specs:
         kid, sigs = item

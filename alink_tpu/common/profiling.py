@@ -282,6 +282,7 @@ def _new_record(prog, sig: tuple, treedef=None) -> Dict[str, Any]:
         "peak_hbm_bytes": None,
         "memory_source": "estimate",
         "compile_s": None,
+        "persist": None,
         "calls": 0,
         "exec_total_s": 0.0,
         "exec_min_s": None,
@@ -305,12 +306,20 @@ def _refresh_peak_estimate(rec: Dict[str, Any]) -> None:
             (rec.get("output_bytes") or 0)
 
 
-def note_compiled(prog, sig: tuple, args, out, compile_s: float) -> None:
+def note_compiled(prog, sig: tuple, args, out, compile_s: float,
+                  persist: Optional[str] = None) -> None:
     """Called by ``CachedProgram`` on the first successful call of a new
     shape signature: enqueue a pending cost record (cheap — a dict insert
     plus the pytree structure of ``args``). ``deep`` mode resolves it
     eagerly, charging the extra lower+compile to the compile event it
-    rides on."""
+    rides on.
+
+    ``persist`` labels where the executable came from: ``"hit"`` (the
+    persistent compile cache served it — ``compile_s`` measured trace +
+    deserialize, not a backend compile), ``"compile"`` (persistence on,
+    compiled fresh), or None (persistence off). Cost capture is identical
+    either way: lazy resolution re-lowers from the recorded signature, so
+    the static XLA cost survives a persist-hit that skipped the compiler."""
     mode = profiling_mode()
     if mode == "off":
         return
@@ -330,6 +339,7 @@ def note_compiled(prog, sig: tuple, args, out, compile_s: float) -> None:
         elif rec.get("_treedef") is None:
             rec["_treedef"] = treedef
         rec["compile_s"] = round(float(compile_s), 6)
+        rec["persist"] = persist
         if rec["output_bytes"] is None:
             rec["output_bytes"] = _tree_bytes(out)
             _refresh_peak_estimate(rec)
@@ -745,6 +755,7 @@ def profile_summary(top: Optional[int] = None, *,
             "peak_hbm_bytes": dom["peak_hbm_bytes"],
             "memory_source": dom["memory_source"],
             "compile_s": dom["compile_s"],
+            "persist": dom.get("persist"),
             "exec_mean_s": dom["exec_mean_s"],
             "achieved_flops_per_s": dom["achieved_flops_per_s"],
         }

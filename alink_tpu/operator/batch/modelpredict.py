@@ -401,6 +401,7 @@ class StableHloModelMapper(_BaseIngestMapper, HasIngestParams):
 
     def _load(self, path: str):
         import jax
+        import jax.export  # the submodule is not imported by `import jax`
 
         with open(path, "rb") as fh:
             exported = jax.export.deserialize(fh.read())
@@ -472,6 +473,7 @@ def export_stablehlo(fn, example_args: Sequence, path: str):
     StableHloModelPredictBatchOp (the framework's model-export story for
     serving: jax.export under the hood)."""
     import jax
+    import jax.export  # the submodule is not imported by `import jax`
 
     exported = jax.export.export(jax.jit(fn))(*example_args)
     data = exported.serialize()

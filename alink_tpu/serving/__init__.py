@@ -19,6 +19,11 @@ from .router import (  # noqa: F401
     serving_bucket_ladder,
     serving_summary,
 )
+from .warmup_store import (  # noqa: F401
+    load_warmup_spec,
+    save_warmup_spec,
+    warmup_sidecar_path,
+)
 
 from ..common.exceptions import (  # noqa: F401
     AkDeadlineExceededException,

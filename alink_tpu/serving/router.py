@@ -39,7 +39,7 @@ from collections import deque
 from dataclasses import dataclass, replace
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
-from ..common.env import env_float, env_int, env_str
+from ..common.env import env_flag, env_float, env_int, env_str
 from ..common.exceptions import (
     AkCircuitOpenException,
     AkDeadlineExceededException,
@@ -47,13 +47,14 @@ from ..common.exceptions import (
     AkIllegalStateException,
     AkServingOverloadException,
 )
-from ..common.jitcache import bucket_rows
+from ..common.jitcache import bucket_rows, seen_warmup_specs
 from ..common.metrics import metrics
 from ..common.mtable import MTable, TableSchema
 from ..common.resilience import CircuitBreaker
 from ..common.tracing import trace_span
 from ..pipeline.local_predictor import LocalPredictor
 from ..pipeline.pipeline import PipelineModel
+from .warmup_store import load_warmup_spec, save_warmup_spec
 
 _ROW_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0,
                 512.0, 1024.0, 2048.0, 4096.0)
@@ -444,24 +445,47 @@ class ModelServer:
     def load(self, name: str, model: "PipelineModel | LocalPredictor | str",
              input_schema: "TableSchema | str | None" = None, *,
              config: Optional[ServingConfig] = None,
-             warmup_rows: Optional[Sequence[Sequence]] = None) -> Dict[str, Any]:
+             warmup_rows: Optional[Sequence[Sequence]] = None,
+             persist_warmup: Optional[bool] = None) -> Dict[str, Any]:
         """Load (or hot-swap) ``name``. ``model`` is a PipelineModel, a saved
         ``.ak`` path, or a ready LocalPredictor. ``warmup_rows`` (sample
         input rows) drives AOT warmup: every bucket rung up to
         ``max_batch_rows`` is predicted once before the model starts taking
         traffic, so steady-state load performs zero new traces. Hot-swap is
         safe: the old entry keeps serving until the new one (warmup
-        included) is ready, then drains and retires."""
+        included) is ready, then drains and retires.
+
+        Zero cold start: when ``model`` is an ``.ak`` path, a warmup
+        sidecar (``<model>.ak.warmup.json``) persisted by a previous
+        replica supplies the sample rows — and the ``input_schema``, when
+        the caller omits it — so a fresh process warms from disk artifacts
+        instead of needing live inputs; with the persistent compile cache
+        active the warmed executables deserialize instead of compiling.
+        After a successful live warmup the sidecar is (re)written for the
+        next replica (``persist_warmup``, default on, env
+        ``ALINK_SERVING_PERSIST_WARMUP``). Predictions are bit-identical
+        whichever side warmed — warmup only populates caches."""
         cfg = config or self._config
+        if persist_warmup is None:
+            persist_warmup = env_flag("ALINK_SERVING_PERSIST_WARMUP", True)
+        model_path = model if isinstance(model, str) else None
+        sidecar = load_warmup_spec(model_path) if model_path else None
+        source = "caller" if warmup_rows else None
         if isinstance(model, LocalPredictor):
             predictor = model
         else:
+            if input_schema is None and sidecar is not None:
+                input_schema = sidecar.get("input_schema")
             if input_schema is None:
                 raise AkIllegalArgumentException(
                     "input_schema is required when loading from a "
-                    "PipelineModel or path")
+                    "PipelineModel or path with no warmup sidecar")
             predictor = LocalPredictor(model, input_schema)
         warm = {"rungs": 0, "rows": 0}
+        if not warmup_rows and sidecar is not None and \
+                sidecar.get("warmup_rows"):
+            warmup_rows = sidecar["warmup_rows"]
+            source = "sidecar"
         synthesized = False
         if not warmup_rows:
             # the zero-traces-before-traffic contract must not silently
@@ -470,18 +494,73 @@ class ModelServer:
             # — exotic input types need real sample rows)
             warmup_rows = _schema_zero_rows(predictor.input_schema)
             synthesized = warmup_rows is not None
+            source = "synthesized" if synthesized else None
+        warmed = False
+        kernels_before = {(kid, tuple(sigs))
+                          for kid, sigs in seen_warmup_specs()} \
+            if model_path and persist_warmup else set()
         if warmup_rows:
             try:
                 warm = self._warmup(predictor, warmup_rows,
                                     bucket_rows(cfg.max_batch_rows))
+                warmed = True
             except Exception:
-                if not synthesized:
+                if source == "caller":
                     raise  # caller-provided rows failing is a load error
-                # a pipeline that chokes on the synthetic row falls back to
-                # warming lazily on first traffic — counted, not fatal
                 metrics.incr("serving.warmup_errors")
+                if source == "sidecar":
+                    # bad sidecar rows must not be WORSE than no sidecar:
+                    # retry the synthesized-zero-row path before degrading
+                    # to lazy warm-on-first-traffic
+                    rows = _schema_zero_rows(predictor.input_schema)
+                    if rows:
+                        try:
+                            warm = self._warmup(
+                                predictor, rows,
+                                bucket_rows(cfg.max_batch_rows))
+                            warmed = True
+                            warmup_rows = rows
+                            source = "synthesized"
+                        except Exception:
+                            metrics.incr("serving.warmup_errors")
         else:
             metrics.incr("serving.warmup_skipped")
+        sidecar_written = None
+        if warmed and model_path and persist_warmup \
+                and source != "sidecar":
+            # a sidecar-sourced warmup would rewrite byte-identical content
+            # — skipping keeps replica loads read-only against the model
+            # store (the expected production rollout shape)
+            # persist what this load learned so the NEXT replica (a fresh
+            # process) warms from disk: the rows, the ladder they warmed,
+            # and the kernel shape specs this warmup newly registered
+            kernels = [
+                (kid, list(sigs)) for kid, sigs in
+                ((k, tuple(s)) for k, s in seen_warmup_specs())
+                if (kid, sigs) not in kernels_before
+            ]
+            if sidecar is not None:
+                # an already-warm process re-load sees an empty delta —
+                # merging keeps the first replica's kernel specs intact
+                have = {(k, tuple(s)) for k, s in kernels}
+                kernels.extend(
+                    (k, list(s)) for k, s in sidecar.get("kernels") or []
+                    if (k, tuple(s)) not in have)
+            try:
+                sidecar_written = save_warmup_spec(
+                    model_path,
+                    input_schema=predictor.input_schema.to_str(),
+                    warmup_rows=warmup_rows,
+                    max_batch_rows=bucket_rows(cfg.max_batch_rows),
+                    ladder=serving_bucket_ladder(
+                        bucket_rows(cfg.max_batch_rows)),
+                    kernels=kernels)
+            except OSError:
+                # read-only model store: the replica still serves, the
+                # next one just warms live again (counted apart from
+                # corruption so a healthy read-only fleet stays
+                # distinguishable on dashboards)
+                metrics.incr("serving.warmup_spec_write_errors")
         entry = _ModelEntry(name, predictor, cfg)
         with self._lock:
             old = self._entries.get(name)
@@ -490,6 +569,8 @@ class ModelServer:
             old.shutdown(drain=True)
         metrics.incr("serving.models_loaded")
         return {"model": name, "warmup": warm,
+                "warmup_source": source if warmed else None,
+                "warmup_sidecar": sidecar_written,
                 "max_batch_rows": entry.config.max_batch_rows}
 
     @staticmethod

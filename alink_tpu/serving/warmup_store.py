@@ -1,0 +1,161 @@
+"""Persisted warmup specs — the disk half of zero-cold-start serving.
+
+A serving replica's readiness cost is warmup: :meth:`ModelServer.load`
+predicts once at every bucket rung so production traffic performs zero new
+traces. In a fresh process that warmup used to be rediscovered live — the
+caller had to re-supply sample rows, and every rung paid a full backend
+compile. This module persists what the first replica learned as a JSON
+sidecar next to the ``.ak`` model (``<model>.ak.warmup.json``):
+
+- the serving ``input_schema`` and the sample ``warmup_rows`` the ladder
+  warmup tiles (so ``server.load(name, "model.ak")`` needs no other input),
+- the bucket ladder + ``max_batch_rows`` the rows were warmed at,
+- the per-kernel shape specs recorded during warmup
+  (``common/jitcache.seen_warmup_specs`` format — consumable by
+  ``alink_tpu.warmup()`` for non-serving AOT warm paths).
+
+Paired with the persistent compile cache (``ALINK_COMPILE_CACHE_DIR``,
+``common/jitcache.py``), a replica that has NEVER compiled reaches
+zero-trace readiness from disk artifacts alone: the sidecar replays the
+warmup shapes, the compile cache serves each executable. Predictions are
+bit-identical either way — warmup only populates caches, it never changes
+what a program computes.
+
+Corruption-safe: a missing, truncated, or schema-incompatible sidecar reads
+as None (counted under ``serving.warmup_spec_errors``) and the caller falls
+back to live ladder warmup, exactly the pre-sidecar behavior; a sidecar
+whose recorded ``model_digest`` no longer matches the ``.ak`` content (the
+model was retrained) reads as None too (``serving.warmup_spec_stale``) so
+stale inputs never bind to a different model — while byte-preserving
+copies (the normal replica rollout) keep it valid. Writes are atomic (tmp + rename) so a
+crashed writer can never leave a half sidecar a later replica would trip
+on; replica loads that warmed FROM a sidecar never rewrite it (read-only
+model stores stay quiet — failed writes elsewhere count under
+``serving.warmup_spec_write_errors``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..common.metrics import metrics
+
+WARMUP_SIDECAR_SUFFIX = ".warmup.json"
+WARMUP_SPEC_VERSION = 1
+
+
+def warmup_sidecar_path(model_path: str) -> str:
+    """The sidecar path for a saved model: ``<model>.ak.warmup.json``."""
+    return model_path + WARMUP_SIDECAR_SUFFIX
+
+
+def _model_digest(model_path: str) -> Optional[str]:
+    """Streamed content hash of the model file (None when unreadable).
+    One full read per save/load — load happens once per replica, and the
+    copy-safety it buys (stat-based stamps break under every rollout tool
+    that rewrites mtimes) is the point of the sidecar."""
+    import hashlib
+
+    try:
+        h = hashlib.blake2b(digest_size=16)
+        with open(model_path, "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                h.update(chunk)
+        return h.hexdigest()
+    except OSError:
+        return None
+
+
+def _json_cell(v) -> Any:
+    """A warmup-row cell as a JSON scalar; raises TypeError for cells that
+    do not round-trip (vectors/tensors — those models fall back to live
+    warmup with caller-provided rows)."""
+    if isinstance(v, (bool, int, float, str)) or v is None:
+        return v
+    if isinstance(v, np.generic):
+        return v.item()
+    raise TypeError(f"warmup row cell of type {type(v).__name__} does not "
+                    "round-trip through JSON")
+
+
+def save_warmup_spec(model_path: str, *,
+                     input_schema: str,
+                     warmup_rows: Sequence[Sequence],
+                     max_batch_rows: int,
+                     ladder: Sequence[int],
+                     kernels: Optional[Sequence[Tuple[str, list]]] = None,
+                     path: Optional[str] = None) -> Optional[str]:
+    """Persist one model's warmup spec next to its ``.ak``. Returns the
+    sidecar path, or None when the rows cannot be JSON-persisted (exotic
+    cell types) — never raises on content, only on unwritable storage."""
+    try:
+        rows = [[_json_cell(c) for c in row] for row in warmup_rows]
+    except TypeError:
+        metrics.incr("serving.warmup_spec_skipped")
+        return None
+    spec: Dict[str, Any] = {
+        "version": WARMUP_SPEC_VERSION,
+        "model": os.path.basename(model_path),
+        # CONTENT fingerprint of the .ak this warmup belongs to: a
+        # re-saved model at the same path must invalidate the sidecar
+        # (stale schema/rows must never bind to a retrained model), while
+        # copy-based rollouts (cp/gsutil/docker ADD — mtimes rewritten)
+        # must keep it valid — so hash the bytes, not the stat
+        "model_digest": _model_digest(model_path),
+        "input_schema": input_schema,
+        "warmup_rows": rows,
+        "max_batch_rows": int(max_batch_rows),
+        "ladder": [int(r) for r in ladder],
+        "kernels": [[kid, [[list(map(int, s)), str(d)] for s, d in sigs]]
+                    for kid, sigs in (kernels or [])],
+    }
+    out = path or warmup_sidecar_path(model_path)
+    tmp = f"{out}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(spec, f)
+    os.replace(tmp, out)
+    metrics.incr("serving.warmup_spec_saved")
+    return out
+
+
+def load_warmup_spec(model_path: str,
+                     path: Optional[str] = None) -> Optional[Dict[str, Any]]:
+    """Read a model's persisted warmup spec. Returns the spec dict with
+    ``kernels`` normalized to the ``[(kernel_id, [(shape, dtype), ...])]``
+    shape ``alink_tpu.warmup()`` consumes, or None (missing / corrupt /
+    future-versioned — counted, never raised: a bad sidecar must degrade to
+    live warmup, not fail a replica rollout)."""
+    p = path or warmup_sidecar_path(model_path)
+    if not os.path.exists(p):
+        return None
+    try:
+        with open(p) as f:
+            spec = json.load(f)
+        if not isinstance(spec, dict) or \
+                int(spec.get("version", 0)) > WARMUP_SPEC_VERSION:
+            raise ValueError(f"unsupported warmup spec: {p}")
+        stamp = spec.get("model_digest")
+        if stamp is not None and os.path.exists(model_path):
+            if _model_digest(model_path) != stamp:
+                # the .ak's CONTENT changed since this sidecar was
+                # written: its schema/rows describe a DIFFERENT model —
+                # stale, not corrupt, and the caller falls back to live
+                # warmup
+                metrics.incr("serving.warmup_spec_stale")
+                return None
+        rows = [tuple(r) for r in spec.get("warmup_rows") or []]
+        kernels: List[Tuple[str, list]] = []
+        for kid, sigs in spec.get("kernels") or []:
+            kernels.append((str(kid),
+                            [(tuple(int(x) for x in s), str(d))
+                             for s, d in sigs]))
+        spec["warmup_rows"] = rows
+        spec["kernels"] = kernels
+        return spec
+    except (OSError, ValueError, TypeError, KeyError):
+        metrics.incr("serving.warmup_spec_errors")
+        return None

@@ -593,6 +593,47 @@ def test_lint_except_swallow_alk005(tmp_path):
     assert [d.rule for d in diags] == ["ALK005", "ALK005"]
 
 
+def test_lint_compile_cache_drift_alk006(tmp_path):
+    """Every spelling of "configure the persistent compile cache" outside
+    common/jitcache.py is drift: config writes and raw compilation_cache
+    imports both bypass the sanctioned owner."""
+    diags = _lint_src(tmp_path, "mod.py", """
+        import jax
+        from jax.experimental.compilation_cache import compilation_cache
+        from jax._src import compilation_cache as cc2
+        import jax.experimental.compilation_cache.compilation_cache as cc3
+
+        def setup(d):
+            jax.config.update("jax_compilation_cache_dir", d)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+            jax.config.update("jax_compilation_cache_max_size", 1 << 30)
+            jax.config.update("jax_default_matmul_precision", "float32")  # ok
+    """)
+    assert [d.rule for d in diags] == ["ALK006"] * 6
+    assert all("jitcache" in d.hint for d in diags)
+
+
+def test_lint_alk006_exempts_the_owner_itself(tmp_path):
+    diags = _lint_src(tmp_path, "common/jitcache.py", """
+        import jax
+        from jax._src import compilation_cache as _cc
+
+        def _apply(d):
+            jax.config.update("jax_compilation_cache_dir", d)
+    """)
+    assert [d.rule for d in diags] == []
+
+
+def test_alk006_absent_from_baseline():
+    """The suppression baseline carries no ALK006 budget — any new direct
+    compile-cache configuration outside common/jitcache.py fails
+    ``--check`` (the env.py implementation moved to the owner in PR 11)."""
+    with open(os.path.join(
+            REPO_ROOT, "alink_tpu", "analysis", "lint_baseline.json")) as f:
+        baseline = json.load(f)
+    assert "ALK006" not in baseline["counts"]
+
+
 # ---------------------------------------------------------------------------
 # Self-lint gate + baseline ratchet + inventory
 # ---------------------------------------------------------------------------
@@ -658,7 +699,7 @@ def test_alk002_absent_from_baseline():
 
 def test_rule_table_complete():
     # every rule either engine can emit is documented in the table
-    for rid in ("ALK001", "ALK002", "ALK003", "ALK004", "ALK005",
+    for rid in ("ALK001", "ALK002", "ALK003", "ALK004", "ALK005", "ALK006",
                 "ALK101", "ALK102", "ALK103", "ALK104", "ALK105",
                 "ALK106"):
         title, sev, desc = RULES[rid]

@@ -1,0 +1,322 @@
+"""Persistent compile artifacts (common/jitcache.py persistence layer):
+cross-process cache hits in fresh interpreters, corruption fallback, knob
+resolution, the on-disk LRU cap, warmup-spec persistence, and
+profiling-record survival across persist hits.
+
+The cross-process tests are the PR's reason to exist: two FRESH interpreters
+sharing one ``ALINK_COMPILE_CACHE_DIR`` must produce bit-identical results,
+with the second reaching them on ``jit.persist_hit`` instead of backend
+compiles — and a truncated cache entry must degrade to a fresh compile
+(counted), never to a wrong answer or a crash.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from alink_tpu.common import jitcache
+from alink_tpu.common.jitcache import (
+    cached_jit,
+    clear_program_cache,
+    compile_cache_dir,
+    disable_persistent_cache,
+    enable_persistent_cache,
+    persist_summary,
+    prune_persistent_cache,
+    save_warmup_specs,
+    seen_warmup_specs,
+    warmup,
+)
+from alink_tpu.common.metrics import metrics
+
+pytestmark = pytest.mark.compile
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# ---------------------------------------------------------------------------
+# cross-process drills (fresh interpreters sharing one cache dir)
+# ---------------------------------------------------------------------------
+
+_CHILD = """
+import json, os, sys
+sys.path.insert(0, {repo!r})
+import numpy as np
+
+import alink_tpu  # noqa: F401 — wires the persistent cache from env
+from alink_tpu.common.metrics import metrics
+from alink_tpu.common.profiling import program_costs
+from alink_tpu.operator.batch.base import CsvSourceBatchOp
+from alink_tpu.pipeline import KMeans, Pipeline
+
+src = CsvSourceBatchOp(
+    filePath=os.path.join({repo!r}, "data", "iris.csv"),
+    schemaStr="sl double, sw double, pl double, pw double, species string")
+pipe = Pipeline(KMeans(k=3, maxIter=5, featureCols=["sl", "sw", "pl", "pw"],
+                       predictionCol="pred"))
+out = pipe.fit(src).transform(src).collect()
+print(json.dumps({{
+    "labels": [int(x) for x in np.asarray(out.col("pred"))],
+    "persist_hit": metrics.counter("jit.persist_hit"),
+    "persist_miss": metrics.counter("jit.persist_miss"),
+    "persist_error": metrics.counter("jit.persist_error"),
+    "compiles": metrics.counter("jit.compile"),
+    "profile_records": len(program_costs(resolve=False)),
+}}))
+"""
+
+
+def _run_child(cache_dir: str) -> dict:
+    env = dict(os.environ)
+    env["ALINK_COMPILE_CACHE_DIR"] = str(cache_dir)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run(
+        [sys.executable, "-c", _CHILD.format(repo=REPO_ROOT)],
+        env=env, capture_output=True, text=True, timeout=240)
+    assert proc.returncode == 0, f"child failed:\n{proc.stderr[-2000:]}"
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def _corrupt_entries(cache_dir) -> int:
+    n = 0
+    for name in os.listdir(cache_dir):
+        if name.endswith("-cache"):
+            path = os.path.join(cache_dir, name)
+            with open(path, "rb") as f:
+                data = f.read()
+            with open(path, "wb") as f:
+                f.write(data[: max(1, len(data) // 3)])
+            n += 1
+    return n
+
+
+def test_cross_process_persist_hit_bit_identical(tmp_path):
+    """The acceptance drill: kmeans_iris in two fresh interpreters sharing
+    one cache dir — the second must land persist hits (no fresh backend
+    compiles served it wrong), produce bit-identical predictions, and still
+    carry profiling cost records (a persist-hit that skips the compiler
+    must not skip the observatory)."""
+    cache = tmp_path / "cc"
+    cache.mkdir()
+    first = _run_child(str(cache))
+    assert first["persist_miss"] > 0          # cold machine: populated
+    assert first["persist_error"] == 0
+    entries = [f for f in os.listdir(cache) if f.endswith("-cache")]
+    assert entries, "first process must write cache entries"
+
+    second = _run_child(str(cache))
+    assert second["persist_hit"] > 0, second   # served from disk
+    assert second["persist_error"] == 0
+    assert second["labels"] == first["labels"]  # bit-identical
+    assert second["profile_records"] > 0        # observatory survived
+
+
+def test_corrupt_cache_entry_falls_back_to_fresh_compile(tmp_path):
+    """Truncate every on-disk entry between two processes: the second must
+    count ``jit.persist_error``, compile fresh (zero hits), and still
+    produce bit-identical predictions with exit code 0."""
+    cache = tmp_path / "cc"
+    cache.mkdir()
+    first = _run_child(str(cache))
+    assert _corrupt_entries(cache) > 0
+
+    second = _run_child(str(cache))
+    assert second["persist_error"] > 0, second  # corruption was seen
+    assert second["persist_hit"] == 0, second   # nothing served from disk
+    assert second["labels"] == first["labels"]  # fresh compile: same answer
+
+
+# ---------------------------------------------------------------------------
+# knob resolution + lifecycle (in-process)
+# ---------------------------------------------------------------------------
+
+def test_knob_resolution(monkeypatch, tmp_path):
+    # tests run with JAX_PLATFORMS=cpu (root conftest): default is OFF
+    monkeypatch.delenv("ALINK_COMPILE_CACHE_DIR", raising=False)
+    monkeypatch.delenv("ALINK_COMPILATION_CACHE_DIR", raising=False)
+    assert jitcache._resolve_persist_dir(None)[0] is None
+    # blank-but-exported knob is an explicit OFF
+    monkeypatch.setenv("ALINK_COMPILE_CACHE_DIR", "  ")
+    assert jitcache._resolve_persist_dir(None)[0] is None
+    # the legacy name still works ...
+    monkeypatch.setenv("ALINK_COMPILE_CACHE_DIR", "")
+    monkeypatch.setenv("ALINK_COMPILATION_CACHE_DIR", str(tmp_path / "b"))
+    monkeypatch.delenv("ALINK_COMPILE_CACHE_DIR")
+    assert jitcache._resolve_persist_dir(None) == (str(tmp_path / "b"), True)
+    # ... and the new name wins over it
+    monkeypatch.setenv("ALINK_COMPILE_CACHE_DIR", str(tmp_path / "a"))
+    assert jitcache._resolve_persist_dir(None) == (str(tmp_path / "a"), True)
+    # an explicit argument wins over everything
+    assert jitcache._resolve_persist_dir(str(tmp_path / "c")) == \
+        (str(tmp_path / "c"), True)
+    # off-CPU (knobs unset): the per-user default dir, marked NON-explicit
+    # so it yields to a user-configured jax cache dir instead of
+    # clobbering it
+    monkeypatch.delenv("ALINK_COMPILE_CACHE_DIR")
+    monkeypatch.delenv("ALINK_COMPILATION_CACHE_DIR")
+    monkeypatch.setenv("JAX_PLATFORMS", "tpu")
+    d, explicit = jitcache._resolve_persist_dir(None)
+    assert d.endswith("xla_cache") and explicit is False
+
+
+def _build_scale(factor):
+    import jax
+
+    return jax.jit(lambda x: x * factor)
+
+
+def test_in_process_persist_hit_and_profiling_survival(tmp_path):
+    """Enable → compile → drop every in-memory cache → recompile: the
+    executable must come off disk (``jit.persist_hit``), results must be
+    bit-identical, and the profiling registry must still resolve static XLA
+    costs for the persist-hit program (lazy lower() needs no compiler)."""
+    import jax
+
+    from alink_tpu.common.profiling import program_costs
+
+    try:
+        d = enable_persistent_cache(str(tmp_path / "cc"))
+        assert d == str(tmp_path / "cc") == compile_cache_dir()
+        prog = cached_jit("test.persist_prof", _build_scale, 2.5)
+        x = np.arange(64, dtype=np.float32)
+        out1 = np.asarray(prog(x))
+        assert persist_summary()["entries"] >= 1
+
+        clear_program_cache()
+        jax.clear_caches()
+        h0 = metrics.counter("jit.persist_hit")
+        prog2 = cached_jit("test.persist_prof", _build_scale, 2.5)
+        out2 = np.asarray(prog2(x))
+        assert metrics.counter("jit.persist_hit") > h0
+        assert np.array_equal(out1, out2)
+
+        recs = [r for r in program_costs("test.persist_prof")
+                if r["capture"] in ("cost", "deep")]
+        assert recs, "persist-hit program must still resolve XLA costs"
+        assert any(r.get("persist") == "hit" for r in
+                   program_costs("test.persist_prof", resolve=False))
+    finally:
+        disable_persistent_cache()
+        clear_program_cache()
+    assert compile_cache_dir() is None
+    # compile_summary embeds the (now disabled) persistence readout
+    from alink_tpu.common.jitcache import compile_summary
+
+    assert compile_summary()["persist"]["enabled"] is False
+
+
+def test_disabled_writes_nothing(tmp_path):
+    """Persistence off (the default in this CPU test env): compiling adds
+    no on-disk entries anywhere under the would-be cache dir."""
+    assert compile_cache_dir() is None
+    prog = cached_jit("test.persist_off", _build_scale, 7.5)
+    prog(np.ones(16, np.float32))
+    s = persist_summary()
+    assert s["enabled"] is False and s["dir"] is None
+    assert s["entries"] == 0 and s["bytes"] == 0
+
+
+# ---------------------------------------------------------------------------
+# on-disk LRU cap
+# ---------------------------------------------------------------------------
+
+def _fake_entry(d, name, size, age):
+    path = os.path.join(d, f"{name}-cache")
+    with open(path, "wb") as f:
+        f.write(b"x" * size)
+    stamp = os.path.join(d, f"{name}-atime")
+    with open(stamp, "w") as f:
+        f.write("")
+    os.utime(stamp, (age, age))
+    return path
+
+
+def test_prune_lru_evicts_oldest_first(tmp_path):
+    d = str(tmp_path)
+    old = _fake_entry(d, "old", 600, 1_000)
+    mid = _fake_entry(d, "mid", 600, 2_000)
+    new = _fake_entry(d, "new", 600, 3_000)
+    ev0 = metrics.counter("jit.persist_evict")
+    out = prune_persistent_cache(d, max_bytes=1300)
+    assert not os.path.exists(old)            # LRU goes first
+    assert os.path.exists(mid) and os.path.exists(new)
+    assert not os.path.exists(os.path.join(d, "old-atime"))
+    assert out["removed"] == 1 and out["bytes"] == 1200
+    assert metrics.counter("jit.persist_evict") == ev0 + 1
+    # under the cap: a no-op
+    assert prune_persistent_cache(d, max_bytes=1300)["removed"] == 0
+    # cap 0 = unbounded
+    assert prune_persistent_cache(d, max_bytes=0)["removed"] == 0
+
+
+# ---------------------------------------------------------------------------
+# warmup-spec persistence (the disk half of zero-trace readiness)
+# ---------------------------------------------------------------------------
+
+def test_warmup_specs_roundtrip_from_disk(tmp_path):
+    prog = cached_jit("test.persist_warm", _build_scale, 3.25)
+    prog(np.ones((40, 2), np.float32))
+    specs = [s for s in seen_warmup_specs() if s[0] == "test.persist_warm"]
+    assert (("test.persist_warm", [((40, 2), "<f4")]) in
+            [(k, list(v)) for k, v in specs])
+    path = str(tmp_path / "warm.jsonl")
+    assert save_warmup_specs(path, specs) == len(specs)
+    # a process that never compiled replays the file: simulate by dropping
+    # the program and warming from the path (string arg = read from disk)
+    jitcache.clear_kernel("test.persist_warm")
+    prog2 = cached_jit("test.persist_warm", _build_scale, 3.25)
+    res = warmup(path, block=True)
+    assert res["errors"] == 0 and res["compiled"] >= 1
+    c0 = metrics.counter("jit.compile")
+    prog2(np.ones((40, 2), np.float32))
+    assert metrics.counter("jit.compile") == c0, \
+        "disk-spec-warmed shape must not compile on first real call"
+
+
+def test_prejax_enable_env_writes_are_restored_on_disable(monkeypatch,
+                                                          tmp_path):
+    """A pre-jax enable hands config to jax via env vars; disable must
+    restore exactly what it changed — a user-exported JAX_* knob is
+    neither clobbered (min_* tuning) nor deleted (their own cache dir)."""
+    import os
+
+    monkeypatch.setenv("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2.5")
+    monkeypatch.delenv("JAX_COMPILATION_CACHE_DIR", raising=False)
+    monkeypatch.delenv("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES",
+                       raising=False)
+    # simulate the pre-jax branch directly: force configured=False path
+    with jitcache._persist_lock:
+        saved = dict(jitcache._persist)
+    monkeypatch.setattr(jitcache, "sys", jitcache.sys)  # no-op guard
+    try:
+        # pretend jax is absent for the enable by driving the env branch:
+        # call the writer helper the way enable does
+        with jitcache._persist_lock:
+            jitcache._persist.update(enabled=False, dir=None,
+                                     configured=False, wrote_env={})
+        real_modules = jitcache.sys.modules
+        class _NoJax(dict):
+            def __contains__(self, k):
+                return False if k == "jax" else k in real_modules
+        monkeypatch.setattr(jitcache.sys, "modules", _NoJax())
+        d = jitcache.enable_persistent_cache(str(tmp_path / "cc"))
+        assert d == str(tmp_path / "cc")
+        # user's min-compile floor survived; our writes landed
+        assert os.environ[
+            "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] == "2.5"
+        assert os.environ["JAX_COMPILATION_CACHE_DIR"] == d
+        assert os.environ[
+            "JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES"] == "-1"
+        monkeypatch.setattr(jitcache.sys, "modules", real_modules)
+        jitcache.disable_persistent_cache()
+        # ours removed, the user's untouched
+        assert "JAX_COMPILATION_CACHE_DIR" not in os.environ
+        assert "JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES" not in os.environ
+        assert os.environ[
+            "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] == "2.5"
+    finally:
+        with jitcache._persist_lock:
+            jitcache._persist.update(saved)

@@ -474,3 +474,45 @@ def test_compare_bench_files_handles_raw_and_wrapped(tmp_path):
              + rep["improvements"]}
     assert "extras.w.samples_per_sec" not in names    # +1% is noise
     assert rep["metrics_compared"] == 3               # text/bool/list skipped
+    assert rep["platform_change"] is None             # no device evidence
+
+
+def test_compare_bench_files_platform_change_demotes_hw_metrics(tmp_path):
+    """A round pair from DIFFERENT accelerators (TPU round vs CPU
+    container) must not false-flag the hardware swap as a code regression:
+    hardware-bound perf metrics demote to the loud ``platform-change``
+    verdict, while hardware-independent quality metrics keep gating —
+    the r05 (TPU) → r06 (CPU) handover case."""
+    from alink_tpu.common.benchstats import (compare_bench_files,
+                                             round_device_kind)
+
+    def doc(kind, sps, acc):
+        return {"metric": "m", "value": sps, "extras": {
+            "bert_mfu": {"device_kind": kind},
+            "w": {"samples_per_sec": sps, "accuracy_holdout": acc}}}
+
+    tpu = tmp_path / "tpu.json"
+    cpu = tmp_path / "cpu.json"
+    tpu.write_text(json.dumps(doc("TPU v5 lite", 1900.0, 0.96)))
+    # 400x slower chip, same model quality
+    cpu.write_text(json.dumps(doc("cpu", 4.4, 0.958)))
+    assert round_device_kind(json.loads(tpu.read_text())) == "TPU v5 lite"
+    rep = compare_bench_files(str(tpu), str(cpu))
+    assert rep["platform_change"] == {"old": "TPU v5 lite", "new": "cpu"}
+    assert rep["regressions"] == []                   # hw swap ≠ regression
+    assert rep["platform_demoted"] >= 2               # value + samples/sec
+    assert rep["verdict"] == "ok"
+    # ... but a QUALITY drop still gates across the platform change
+    cpu.write_text(json.dumps(doc("cpu", 4.4, 0.55)))
+    rep = compare_bench_files(str(tpu), str(cpu))
+    assert any(e["metric"] == "extras.w.accuracy_holdout"
+               for e in rep["regressions"])
+    assert rep["verdict"] == "regression"
+    # same-platform rounds: full gating, exactly as before
+    fast = tmp_path / "fast.json"
+    slow = tmp_path / "slow.json"
+    fast.write_text(json.dumps(doc("cpu", 100.0, 0.9)))
+    slow.write_text(json.dumps(doc("cpu", 50.0, 0.9)))
+    rep = compare_bench_files(str(fast), str(slow))
+    assert rep["platform_change"] is None
+    assert any(e["metric"] == "value" for e in rep["regressions"])

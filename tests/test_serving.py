@@ -565,3 +565,145 @@ def test_http_shed_maps_to_429(fitted, tmp_path):
     finally:
         web.stop()
         srv.close()
+
+
+# ---------------------------------------------------------------------------
+# persisted warmup specs (zero cold start: PR 11)
+# ---------------------------------------------------------------------------
+
+
+def test_warmup_sidecar_roundtrip_bit_identical(fitted, serial_rows,
+                                                tmp_path):
+    """The save side emits ``<model>.ak.warmup.json`` after a live warmup;
+    a later load needs NOTHING but the path — schema and sample rows come
+    from the sidecar — and serves bit-identical predictions with zero new
+    traces under traffic (the replica-rollout contract)."""
+    from alink_tpu.serving import load_warmup_spec, warmup_sidecar_path
+
+    X, _, model = fitted
+    ak = str(tmp_path / "m.ak")
+    model.save(ak)
+    srv = ModelServer(ServingConfig(max_batch_rows=16))
+    try:
+        info1 = srv.load("live", ak, SCHEMA, warmup_rows=[tuple(X[0])])
+        assert info1["warmup_source"] == "caller"
+        assert info1["warmup_sidecar"] == warmup_sidecar_path(ak)
+        spec = load_warmup_spec(ak)
+        assert spec["input_schema"].lower() == SCHEMA  # to_str upper-cases
+        assert spec["warmup_rows"] == [tuple(map(float, X[0]))]
+        assert spec["max_batch_rows"] == 16
+        assert spec["ladder"] == serving_bucket_ladder(16)
+
+        # the fresh-replica side: no schema, no rows — disk artifacts only
+        info2 = srv.load("replica", ak)
+        assert info2["warmup_source"] == "sidecar"
+        # a sidecar-sourced load never rewrites the sidecar: replica loads
+        # stay read-only against the model store
+        assert info2["warmup_sidecar"] is None
+        t0 = metrics.counter("jit.trace")
+        got = [srv.predict("replica", tuple(r)) for r in X[:24]]
+        assert metrics.counter("jit.trace") == t0, \
+            "traffic after a sidecar-warmed load must not trace"
+        assert got == serial_rows[:24]
+    finally:
+        srv.close()
+
+
+def test_warmup_sidecar_corrupt_falls_back_to_live(fitted, serial_rows,
+                                                   tmp_path):
+    """A truncated sidecar must read as absent: the load falls back to the
+    live (here: schema-synthesized) warmup path, counts the corruption, and
+    still serves bit-identical results."""
+    from alink_tpu.serving import warmup_sidecar_path
+
+    X, _, model = fitted
+    ak = str(tmp_path / "m.ak")
+    model.save(ak)
+    with open(warmup_sidecar_path(ak), "w") as f:
+        f.write('{"version": 1, "warmup_rows": [[')   # truncated JSON
+    e0 = metrics.counter("serving.warmup_spec_errors")
+    srv = ModelServer(ServingConfig(max_batch_rows=16))
+    try:
+        info = srv.load("m", ak, SCHEMA)
+        assert metrics.counter("serving.warmup_spec_errors") > e0
+        assert info["warmup_source"] == "synthesized"
+        got = [srv.predict("m", tuple(r)) for r in X[:8]]
+        assert got == serial_rows[:8]
+    finally:
+        srv.close()
+
+
+def test_warmup_sidecar_knob_off_writes_nothing(fitted, tmp_path,
+                                                monkeypatch):
+    import os
+
+    from alink_tpu.serving import warmup_sidecar_path
+
+    X, _, model = fitted
+    ak = str(tmp_path / "m.ak")
+    model.save(ak)
+    monkeypatch.setenv("ALINK_SERVING_PERSIST_WARMUP", "0")
+    srv = ModelServer(ServingConfig(max_batch_rows=16))
+    try:
+        info = srv.load("m", ak, SCHEMA, warmup_rows=[tuple(X[0])])
+        assert info["warmup_sidecar"] is None
+        assert not os.path.exists(warmup_sidecar_path(ak))
+    finally:
+        srv.close()
+
+
+def test_load_path_needs_schema_or_sidecar(tmp_path, fitted):
+    from alink_tpu.common.exceptions import AkIllegalArgumentException
+
+    _, _, model = fitted
+    ak = str(tmp_path / "m.ak")
+    model.save(ak)
+    srv = ModelServer()
+    try:
+        with pytest.raises(AkIllegalArgumentException):
+            srv.load("m", ak)   # no schema anywhere
+    finally:
+        srv.close()
+
+
+def test_warmup_sidecar_stale_after_model_retrain(fitted, tmp_path):
+    """Retraining a model at the same path must invalidate the old sidecar
+    (its schema/rows describe a DIFFERENT model): the load falls back to
+    live warmup and counts the staleness — while a byte-preserving
+    copy/re-save (the normal rollout) keeps the sidecar valid (the
+    fingerprint is content, not mtime, so cp/gsutil-style distribution
+    cannot void zero cold start)."""
+    import os
+    import shutil
+
+    from alink_tpu.serving import load_warmup_spec, warmup_sidecar_path
+
+    X, _, model = fitted
+    ak = str(tmp_path / "m.ak")
+    model.save(ak)
+    srv = ModelServer(ServingConfig(max_batch_rows=16))
+    try:
+        srv.load("v1", ak, SCHEMA, warmup_rows=[tuple(X[0])])
+        assert load_warmup_spec(ak) is not None
+        # a copy with rewritten mtimes (every rollout tool) stays VALID
+        ak2 = str(tmp_path / "copy.ak")
+        shutil.copyfile(ak, ak2)
+        shutil.copyfile(warmup_sidecar_path(ak), warmup_sidecar_path(ak2))
+        st = os.stat(ak2)
+        os.utime(ak2, (st.st_atime, st.st_mtime + 999))
+        assert load_warmup_spec(ak2) is not None
+        # "retrain": same path, different CONTENT
+        _, t2 = _make_data(seed=9)
+        Pipeline(
+            StandardScaler(selectedCols=FEATS),
+            VectorAssembler(selectedCols=FEATS, outputCol="vec"),
+            NaiveBayes(vectorCol="vec", labelCol="label",
+                       predictionCol="pred"),
+        ).fit(t2).save(ak)
+        s0 = metrics.counter("serving.warmup_spec_stale")
+        assert load_warmup_spec(ak) is None
+        assert metrics.counter("serving.warmup_spec_stale") > s0
+        info = srv.load("v2", ak, SCHEMA)
+        assert info["warmup_source"] == "synthesized"   # not the stale rows
+    finally:
+        srv.close()
