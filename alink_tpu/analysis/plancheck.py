@@ -289,6 +289,41 @@ def _check_unkeyable(op, schema, label: str, report: Report) -> None:
                  "instead of device arrays or open handles")
 
 
+def _check_huge_engine(op, label: str, report: Report) -> None:
+    """ALK103 (huge family): a walk/SGNS op headed for the SHARDED engine
+    with an off-ladder batch size. The sharded trainer compiles one
+    routed-exchange program per (batch, blocks, …) config — a batch off the
+    ``bucket_rows`` ladder can never share a compiled exchange with
+    neighboring configs, so every sweep point traces fresh."""
+    if not getattr(op, "_huge_sgns", False):
+        return
+    try:
+        from ..common.jitcache import bucket_rows
+        from ..embedding.engine import huge_engine
+
+        p = op.get_params()
+        forced = p.contains("shardModel") and bool(p.get("shardModel"))
+        if not forced and huge_engine() != "sharded":
+            return
+        bs = p.get("batchSize") if p.contains("batchSize") else None
+        if bs is None:
+            bs = getattr(getattr(type(op), "BATCH_SIZE", None),
+                         "default", None)
+    except Exception:
+        return
+    if bs and int(bs) > 0 and bucket_rows(int(bs)) != int(bs):
+        report.add(
+            "ALK103",
+            f"batchSize={int(bs)} is off the bucket_rows ladder on the "
+            "sharded huge-embedding engine (one routed-exchange program "
+            "per batch config; off-ladder sizes never share a compile "
+            "across sweeps)",
+            where=label,
+            hint=f"use a ladder size (e.g. floor_bucket_rows({int(bs)})="
+                 f"{_floor(int(bs))}) or pin ALINK_HUGE_ENGINE=host for "
+                 "this job")
+
+
 def _check_fusion_chain(order: Sequence[Any], labels: Dict[int, str],
                         report: Report) -> None:
     """ALK105: a mapper-family op that the executor cannot fuse, sitting on
@@ -360,6 +395,8 @@ def _validate_batch(roots: Sequence[Any], report: Report) -> None:
         if data_schema is not None and not op._executed:
             _check_columns(op, data_schema, label, report)
             _check_unkeyable(op, data_schema, label, report)
+        if not op._executed:
+            _check_huge_engine(op, label, report)
         schemas[id(op)] = _derive_schema(op, in_schemas, label, report)
     _check_fusion_chain(order, labels, report)
 

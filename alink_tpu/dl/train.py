@@ -102,7 +102,14 @@ def _loss_fn(kind: str, regression: bool, weighted: bool = False):
                 logits, y.astype(jnp.int32))
     elif kind == "mse":
         def per_row(logits, y):
-            return (logits.squeeze(-1) - y.astype(jnp.float32)) ** 2
+            y = y.astype(jnp.float32)
+            # scalar regression ships (n, 1) logits against (n,) targets;
+            # vector regression (e.g. LSTNet's direct multi-horizon head)
+            # ships (n, h) against (n, h) and averages within the row
+            if logits.ndim == y.ndim + 1 and logits.shape[-1] == 1:
+                logits = logits.squeeze(-1)
+            d = (logits - y) ** 2
+            return d if d.ndim == 1 else d.mean(-1)
     elif kind == "gaussian_nll":
         # logits (n, 2) = (mu, log_sigma); probabilistic regression (DeepAR)
         def per_row(logits, y):

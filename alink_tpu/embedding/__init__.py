@@ -14,6 +14,7 @@ mini-batch loop is ONE compiled XLA program (``fori_loop`` over pair blocks,
 shard over the ``model`` axis and the same gather/scatter rides ICI.
 """
 
+from .engine import huge_engine, train_embedding
 from .skipgram import (
     SkipGramConfig,
     build_vocab,
@@ -25,6 +26,8 @@ from .walks import random_walks, node2vec_walks
 
 __all__ = [
     "SkipGramConfig",
+    "huge_engine",
+    "train_embedding",
     "train_skipgram",
     "train_skipgram_sharded",
     "build_vocab",

@@ -1,15 +1,29 @@
-"""Skip-gram with negative sampling (SGNS) — the Word2Vec trainer.
+"""Skip-gram with negative sampling (SGNS) — the huge-embedding trainer.
 
 (reference: com/alibaba/alink/operator/batch/huge/impl/Word2VecImpl.java:82-91
 driving ApsEnv pull->train->push; the in-JVM trainer
 operator/common/nlp/Word2VecTrainer via word2vec's original C algorithm.)
 
-TPU-first: the entire epoch is one jit — ``fori_loop`` over pair blocks;
-each block gathers its rows, computes SGNS gradients, and applies scatter-add
-updates. Under ``shard_map`` over the data axis each device trains on its own
-pair shard and the per-block embedding deltas are ``psum``-combined
-(synchronous mini-batch SGD — replacing the reference's asynchronous PS
-push/pull with the mesh-native equivalent).
+Two engines, one contract (``ALINK_HUGE_ENGINE``, see embedding/engine.py):
+
+- **host** (:func:`train_skipgram`): both tables replicated; each device
+  trains its pair shard and updates apply via
+  :func:`~alink_tpu.parallel.aps.apply_gathered_replicated` — per-device
+  dedup, ``all_gather``, full-table scatter-add in source-device order.
+- **sharded** (:func:`train_skipgram_sharded`): both tables row-sharded
+  over the ``model`` axis (the APS path for vocab >> HBM/chip); per step
+  each device PULLs the rows its block touches and PUSHes gradients back
+  through the owner-routed O(B·D) exchange (``parallel/aps.py``), with the
+  hot-key cache (``parallel/hotcache.py``) serving Zipf-hot rows from a
+  device-local replica.
+
+Both engines run the same per-step math — identical pair blocks, identical
+negative-sampling streams (keys fold in the device's axis index, equal on
+equal-size meshes), identical gradient formulas, and identical per-row
+update sequences (every row's scatter-add reduction group holds exactly its
+true contributions in source-device order) — so host, routed, and
+routed+cache results are **bit-identical at equal seed and mesh size**.
+That parity is CI-pinned for the whole walk-embedding family.
 """
 
 from __future__ import annotations
@@ -20,8 +34,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..parallel.mesh import AXIS_DATA, default_mesh
-from ..parallel.shardmap import shard_map
+from ..parallel.mesh import AXIS_DATA, AXIS_MODEL, default_mesh
 
 
 @dataclass
@@ -84,6 +97,280 @@ def make_pairs(
     return np.asarray(pairs, np.int32)
 
 
+# ---------------------------------------------------------------------------
+# shared engine pieces — both engines MUST run exactly this math
+# ---------------------------------------------------------------------------
+
+
+def _unigram75_logits(counts: np.ndarray) -> np.ndarray:
+    """unigram^0.75 negative-sampling distribution (word2vec standard)."""
+    probs = np.asarray(counts, np.float64) ** 0.75
+    return np.log(probs / probs.sum()).astype(np.float32)
+
+
+def _fresh_init(seed: int, V: int, D: int) -> np.ndarray:
+    """The input-table init — byte-for-byte what ``ShardedEmbedding``'s
+    default init draws, so both engines start from identical tables."""
+    rng = np.random.default_rng(seed)
+    return ((rng.random((V, D)) - 0.5) / D).astype(np.float32)
+
+
+def _prep_pairs(pairs: np.ndarray, batch: int, ndev: int,
+                seed: int) -> Tuple[np.ndarray, int]:
+    """Shuffle once; cyclically pad so blocks divide evenly over
+    (devices × batch). Identical for both engines."""
+    rng = np.random.default_rng(seed)
+    pairs = pairs[rng.permutation(pairs.shape[0])]
+    block = batch * ndev
+    n_blocks = max(1, pairs.shape[0] // block)
+    return np.resize(pairs, (n_blocks * block, 2)), n_blocks
+
+
+def _negatives(key0, s, axis: str, B: int, negs: int, neg_logits, neg_v: int):
+    """Per-(step, device) negative draws: unigram^0.75 categorical when
+    ``neg_logits`` is given (SGNS), uniform over ``neg_v`` otherwise
+    (LINE). Keys fold the device's axis index — equal streams on
+    equal-size meshes whichever axis name the engine runs on."""
+    import jax
+
+    key = jax.random.fold_in(key0, s)
+    key = jax.random.fold_in(key, jax.lax.axis_index(axis))
+    if neg_logits is None:
+        return jax.random.randint(key, (B, negs), 0, neg_v)
+    return jax.random.categorical(key, neg_logits[None, :], shape=(B, negs))
+
+
+def _block_grads(v, u_pos, u_neg, D: int):
+    """SGNS gradients for one block: returns (grad_v, grad_u) with grad_u
+    the concatenated context+negative rows (matching ``concat(ctx, negs)``
+    id order)."""
+    import jax
+    import jax.numpy as jnp
+
+    s_pos = jax.nn.sigmoid((v * u_pos).sum(-1))               # (B,)
+    s_neg = jax.nn.sigmoid(jnp.einsum("bd,bnd->bn", v, u_neg))  # (B, N)
+    g_pos = (s_pos - 1.0)[:, None]                            # dL/d(u_pos.v)
+    g_neg = s_neg[..., None]                                  # (B, N, 1)
+    grad_v = g_pos * u_pos + (g_neg * u_neg).sum(1)           # (B, D)
+    grad_u = jnp.concatenate(
+        [g_pos * v, (g_neg * v[:, None, :]).reshape(-1, D)])
+    return grad_v, grad_u
+
+
+# ---------------------------------------------------------------------------
+# program builders (ProgramCache: one compile per config, shared across fits)
+# ---------------------------------------------------------------------------
+
+
+def _build_sgns_host(mesh, axis, spec, neg_logits):
+    """Host engine: replicated tables, gathered scatter-add updates."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.aps import apply_gathered_replicated
+    from ..parallel.shardmap import shard_map
+
+    (V, D, B, negs, steps, n_blocks, lr0, seed, tie, neg_v) = spec
+    dp = mesh.shape[axis]
+    key0 = jax.random.PRNGKey(seed)
+    neg_np = neg_logits
+
+    def body(pairs_l, w_in, w_out):
+        neg_l = None if neg_np is None else jnp.asarray(neg_np)
+
+        def step(s, carry):
+            w_in, w_out = carry
+            w_ctx = w_in if tie else w_out
+            lr = lr0 * jnp.maximum(
+                0.0001, 1.0 - s.astype(jnp.float32) / steps)
+            b = jnp.mod(s, n_blocks)
+            blk = jax.lax.dynamic_slice_in_dim(pairs_l, b * B, B, 0)
+            center, ctx = blk[:, 0], blk[:, 1]
+            neg = _negatives(key0, s, axis, B, negs, neg_l, neg_v)
+
+            v = w_in[center]                       # "pull" = local gather
+            u_pos = w_ctx[ctx]
+            u_neg = w_ctx[neg]
+            grad_v, grad_u = _block_grads(v, u_pos, u_neg, D)
+            uids = jnp.concatenate([ctx, neg.reshape(-1)])
+
+            scale = lr / dp
+            w_in = apply_gathered_replicated(
+                w_in, center, grad_v, axis, V, scale)
+            if tie:
+                w_in = apply_gathered_replicated(
+                    w_in, uids, grad_u, axis, V, scale)
+            else:
+                w_out = apply_gathered_replicated(
+                    w_out, uids, grad_u, axis, V, scale)
+            return w_in, w_out
+
+        return jax.lax.fori_loop(0, steps, step, (w_in, w_out))
+
+    return jax.jit(shard_map(
+        body, mesh=mesh, in_specs=(P(axis), P(), P()), out_specs=P(),
+        check_vma=False))
+
+
+def _build_sgns_sharded(mesh, axis, spec, neg_logits, hot, cap_in, cap_ctx):
+    """Sharded engine: owner-routed pull/push (+ hot-key cache when
+    ``hot > 0``; ``hot == 0`` compiles to exactly the uncached program)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.aps import pull, push
+    from ..parallel.hotcache import (pull_cached, refresh_hot,
+                                     refresh_hot_many)
+    from ..parallel.shardmap import shard_map
+
+    (rows, D, B, negs, steps, n_blocks, lr0, seed, tie, neg_v) = spec
+    M = mesh.shape[axis]
+    key0 = jax.random.PRNGKey(seed)
+    neg_np = neg_logits
+
+    def body(pairs_l, win_l, wout_l):
+        neg_l = None if neg_np is None else jnp.asarray(neg_np)
+
+        def step(s, carry):
+            if hot > 0:
+                win_l, wout_l, rep_in, rep_ctx, hits = carry
+            else:
+                win_l, wout_l = carry
+            lr = lr0 * jnp.maximum(
+                0.0001, 1.0 - s.astype(jnp.float32) / steps)
+            b = jnp.mod(s, n_blocks)
+            blk = jax.lax.dynamic_slice_in_dim(pairs_l, b * B, B, 0)
+            center, ctx = blk[:, 0], blk[:, 1]
+            neg = _negatives(key0, s, axis, B, negs, neg_l, neg_v)
+            uids = jnp.concatenate([ctx, neg.reshape(-1)])
+
+            w_ctx = win_l if tie else wout_l
+            if hot > 0:
+                r_ctx = rep_in if tie else rep_ctx
+                v, h1 = pull_cached(win_l, rep_in, center, axis, rows, hot,
+                                    cap=cap_in)
+                u, h2 = pull_cached(w_ctx, r_ctx, uids, axis, rows, hot,
+                                    cap=cap_ctx)
+            else:
+                v = pull(win_l, center, axis, rows)
+                u = pull(w_ctx, uids, axis, rows)
+            u_pos = u[:B]
+            u_neg = u[B:].reshape(B, negs, D)
+            grad_v, grad_u = _block_grads(v, u_pos, u_neg, D)
+
+            scale = lr / M
+            win_l = push(win_l, center, grad_v, axis, rows, scale)
+            if tie:
+                win_l = push(win_l, uids, grad_u, axis, rows, scale)
+            else:
+                wout_l = push(wout_l, uids, grad_u, axis, rows, scale)
+            if hot > 0:
+                if tie:
+                    rep_in = rep_ctx = refresh_hot(win_l, axis, hot)
+                else:
+                    rep_in, rep_ctx = refresh_hot_many(
+                        (win_l, wout_l), axis, hot)
+                return win_l, wout_l, rep_in, rep_ctx, hits + h1 + h2
+            return win_l, wout_l
+
+        if hot > 0:
+            if tie:
+                rep0 = rep0_ctx = refresh_hot(win_l, axis, hot)
+            else:
+                rep0, rep0_ctx = refresh_hot_many((win_l, wout_l), axis, hot)
+            win_l, wout_l, _, _, hits = jax.lax.fori_loop(
+                0, steps, step,
+                (win_l, wout_l, rep0, rep0_ctx, jnp.zeros((), jnp.int32)))
+            return win_l, wout_l, hits[None]
+        win_l, wout_l = jax.lax.fori_loop(0, steps, step, (win_l, wout_l))
+        return win_l, wout_l, jnp.zeros((1,), jnp.int32)
+
+    return jax.jit(shard_map(
+        body, mesh=mesh, in_specs=(P(axis),) * 3,
+        out_specs=(P(axis), P(axis), P(axis)), check_vma=False))
+
+
+# ---------------------------------------------------------------------------
+# engine drivers
+# ---------------------------------------------------------------------------
+
+
+def _run_pairs_host(pairs, V, D, B, negs, steps, n_blocks, lr0, seed, *,
+                    tie=False, neg_logits=None, neg_v=0, mesh=None,
+                    _lower_only=False):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..common.jitcache import cached_jit
+
+    mesh = mesh or default_mesh()
+    axis = AXIS_DATA if AXIS_DATA in mesh.shape else mesh.axis_names[0]
+    spec = (V, D, B, negs, steps, n_blocks, float(lr0), int(seed),
+            bool(tie), int(neg_v))
+    prog = cached_jit("embedding.sgns_host", _build_sgns_host, axis, spec,
+                      neg_logits, mesh=mesh)
+    w_in0 = _fresh_init(seed, V, D)
+    w_out0 = np.zeros((V, D), np.float32)
+    args = (jax.device_put(pairs, NamedSharding(mesh, P(axis))),
+            jnp.asarray(w_in0), jnp.asarray(w_out0))
+    if _lower_only:
+        return prog.lower(*args)
+    w_in, _ = prog(*args)
+    return np.asarray(jax.device_get(w_in))
+
+
+def _run_pairs_sharded(pairs, V, D, B, negs, steps, n_blocks, lr0, seed, *,
+                       tie=False, neg_logits=None, neg_v=0, mesh=None,
+                       hot_rows=None, probs=None, _lower_only=False):
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..common.jitcache import cached_jit
+    from ..parallel.aps import ShardedEmbedding, model_mesh
+    from ..parallel.hotcache import (cold_capacity, note_cache_dropped,
+                                     note_cache_traffic, resolve_hot_rows)
+
+    mesh = mesh or model_mesh()
+    axis = AXIS_MODEL
+    M = mesh.shape[axis]
+    w_in = ShardedEmbedding(mesh, V, D, seed=seed)
+    w_out = ShardedEmbedding(
+        mesh, V, D, init=lambda r: np.zeros((V, D), np.float32), seed=seed)
+    rows = w_in.rows_per_shard
+
+    hot = resolve_hot_rows(hot_rows, V, rows)
+    cap_in = cap_ctx = None
+    if hot > 0:
+        # empirical tail-mass bucket sizing: centers/contexts follow the
+        # id frequency table, negatives their actual sampling distribution
+        freq = (np.asarray(probs, np.float64) if probs is not None
+                else np.ones(V))
+        neg_p = (np.exp(np.asarray(neg_logits, np.float64))
+                 if neg_logits is not None else np.ones(V))
+        cap_in = cold_capacity([(freq, B)], hot, rows, M)
+        cap_ctx = cold_capacity([(freq, B), (neg_p, B * negs)],
+                                hot, rows, M)
+    spec = (rows, D, B, negs, steps, n_blocks, float(lr0), int(seed),
+            bool(tie), int(neg_v))
+    prog = cached_jit("embedding.sgns_sharded", _build_sgns_sharded, axis,
+                      spec, neg_logits, hot, cap_in, cap_ctx, mesh=mesh)
+    args = (jax.device_put(pairs, NamedSharding(mesh, P(axis))),
+            w_in.array, w_out.array)
+    if _lower_only:
+        return prog.lower(*args)
+    new_in, new_out, hits = prog(*args)
+    w_in.array = new_in
+    w_out.array = new_out
+    if hot > 0:
+        pulled = steps * B * (2 + negs)    # per device: center + ctx + negs
+        note_cache_traffic(int(np.asarray(hits).sum()), M * pulled)
+        note_cache_dropped(hot)
+    return w_in
+
+
 def train_skipgram(
     pairs: np.ndarray,
     vocab_size: int,
@@ -91,99 +378,24 @@ def train_skipgram(
     cfg: SkipGramConfig,
     *,
     mesh=None,
+    _lower_only=False,
 ) -> np.ndarray:
-    """Train SGNS; returns the input embedding matrix (V, dim) fp32."""
-    import jax
-    import jax.numpy as jnp
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
+    """Train SGNS on the host engine (replicated tables); returns the input
+    embedding matrix (V, dim) fp32. Bit-identical to the sharded engine at
+    equal seed and mesh size (see module docstring)."""
     mesh = mesh or default_mesh()
-    dp = mesh.shape[AXIS_DATA]
-    rng = np.random.default_rng(cfg.seed)
     V, D = vocab_size, cfg.dim
+    if pairs.shape[0] == 0:
+        return _fresh_init(cfg.seed, V, D)
+    from ..parallel.mesh import data_axis_size
 
-    # unigram^0.75 negative-sampling distribution (word2vec standard)
-    probs = counts ** 0.75
-    neg_logits = np.log(probs / probs.sum()).astype(np.float32)
-
-    n_pairs = pairs.shape[0]
-    if n_pairs == 0:
-        return (rng.random((V, D)).astype(np.float32) - 0.5) / D
-    # shuffle once; pad so blocks divide evenly over (devices x batch)
-    order = rng.permutation(n_pairs)
-    pairs = pairs[order]
-    block = cfg.batch_size * dp
-    n_blocks = max(1, n_pairs // block)
-    used = n_blocks * block
-    pairs = np.resize(pairs, (used, 2))
-
-    w_in0 = ((rng.random((V, D)) - 0.5) / D).astype(np.float32)
-    w_out0 = np.zeros((V, D), np.float32)
-
-    lr0 = cfg.learning_rate
-    negs = cfg.negatives
-    epochs = cfg.epochs
-    key0 = jax.random.PRNGKey(cfg.seed)
-    total_steps = n_blocks * epochs
-
-    def body(pairs_l, w_in, w_out):
-        neg_l = jnp.asarray(neg_logits)
-
-        def step(s, carry):
-            w_in, w_out = carry
-            lr = lr0 * jnp.maximum(
-                0.0001, 1.0 - s.astype(jnp.float32) / total_steps
-            )
-            b = jnp.mod(s, n_blocks)
-            blk = jax.lax.dynamic_slice_in_dim(
-                pairs_l, b * cfg.batch_size, cfg.batch_size, 0
-            )
-            center, ctx = blk[:, 0], blk[:, 1]
-            key = jax.random.fold_in(key0, s)
-            key = jax.random.fold_in(key, jax.lax.axis_index(AXIS_DATA))
-            neg = jax.random.categorical(
-                key, neg_l[None, :], shape=(cfg.batch_size, negs)
-            )
-
-            v = w_in[center]                      # (B, D) pull
-            u_pos = w_out[ctx]                    # (B, D)
-            u_neg = w_out[neg]                    # (B, N, D)
-
-            s_pos = jax.nn.sigmoid((v * u_pos).sum(-1))          # (B,)
-            s_neg = jax.nn.sigmoid(
-                jnp.einsum("bd,bnd->bn", v, u_neg)
-            )                                                     # (B, N)
-            g_pos = (s_pos - 1.0)[:, None]                        # dL/d(u_pos.v)
-            g_neg = s_neg[..., None]                              # (B, N, 1)
-
-            grad_v = g_pos * u_pos + (g_neg * u_neg).sum(1)       # (B, D)
-            grad_upos = g_pos * v
-            grad_uneg = g_neg * v[:, None, :]
-
-            # push: scatter-add deltas, psum across the data axis
-            d_in = jnp.zeros_like(w_in).at[center].add(grad_v)
-            d_out = (
-                jnp.zeros_like(w_out)
-                .at[ctx].add(grad_upos)
-                .at[neg.reshape(-1)].add(grad_uneg.reshape(-1, D))
-            )
-            d_in = jax.lax.psum(d_in, AXIS_DATA)
-            d_out = jax.lax.psum(d_out, AXIS_DATA)
-            scale = lr / dp
-            return w_in - scale * d_in, w_out - scale * d_out
-
-        w_in, w_out = jax.lax.fori_loop(0, total_steps, step, (w_in, w_out))
-        return w_in, w_out
-
-    f = jax.jit(
-        shard_map(
-            body, mesh=mesh, in_specs=(P(AXIS_DATA), P(), P()),
-            out_specs=P(), check_vma=False,
-        )
-    )
-    pairs_dev = jax.device_put(pairs, NamedSharding(mesh, P(AXIS_DATA)))
-    w_in, _ = f(pairs_dev, jnp.asarray(w_in0), jnp.asarray(w_out0))
-    return np.asarray(jax.device_get(w_in))
+    pairs, n_blocks = _prep_pairs(pairs, cfg.batch_size,
+                                  data_axis_size(mesh), cfg.seed)
+    return _run_pairs_host(
+        pairs, V, D, cfg.batch_size, cfg.negatives,
+        n_blocks * cfg.epochs, n_blocks, cfg.learning_rate, cfg.seed,
+        neg_logits=_unigram75_logits(counts), mesh=mesh,
+        _lower_only=_lower_only)
 
 
 def train_skipgram_sharded(
@@ -193,101 +405,28 @@ def train_skipgram_sharded(
     cfg: SkipGramConfig,
     *,
     mesh=None,
+    hot_rows: Optional[int] = None,
+    _lower_only=False,
 ):
     """SGNS with BOTH embedding tables sharded over the ``model`` axis — the
     APS path for vocabularies larger than one chip's HBM (reference:
     huge/impl/Word2VecImpl.java:82-91 over ApsEnv pull→train→push).
 
     Each device trains its own pair shard; per step it PULLs the rows it
-    needs from the owning shards and PUSHes gradients back (parallel/aps.py
-    collectives). Returns the trained input-embedding ``ShardedEmbedding``
-    handle — call ``.to_numpy()`` to materialize on host.
-    """
-    import jax
-    import jax.numpy as jnp
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
-    from ..parallel.aps import ShardedEmbedding, model_mesh, pull, push
-    from ..parallel.mesh import AXIS_MODEL
+    needs from the owning shards (hot rows from the device-local cache
+    replica, ``hot_rows``/``ALINK_APS_HOT_ROWS``) and PUSHes gradients back
+    (parallel/aps.py collectives). Returns the trained input-embedding
+    ``ShardedEmbedding`` handle — call ``.to_numpy()`` to materialize."""
+    from ..parallel.aps import ShardedEmbedding, model_mesh
 
     mesh = mesh or model_mesh()
-    M = mesh.shape[AXIS_MODEL]
-    rng = np.random.default_rng(cfg.seed)
     V, D = vocab_size, cfg.dim
-
-    w_in = ShardedEmbedding(mesh, V, D, seed=cfg.seed)
-    w_out = ShardedEmbedding(
-        mesh, V, D, init=lambda r: np.zeros((V, D), np.float32),
-        seed=cfg.seed)
-    rows = w_in.rows_per_shard
-
-    probs = counts ** 0.75
-    neg_logits = np.log(probs / probs.sum()).astype(np.float32)
-
-    n_pairs = pairs.shape[0]
-    if n_pairs == 0:
-        return w_in
-    order = rng.permutation(n_pairs)
-    pairs = pairs[order]
-    block = cfg.batch_size * M
-    n_blocks = max(1, n_pairs // block)
-    used = n_blocks * block
-    pairs = np.resize(pairs, (used, 2))
-
-    B = cfg.batch_size
-    negs = cfg.negatives
-    lr0 = cfg.learning_rate
-    total_steps = n_blocks * cfg.epochs
-    key0 = jax.random.PRNGKey(cfg.seed)
-
-    def body(pairs_l, win_l, wout_l):
-        neg_l = jnp.asarray(neg_logits)
-
-        def step(s, carry):
-            win_l, wout_l = carry
-            lr = lr0 * jnp.maximum(
-                0.0001, 1.0 - s.astype(jnp.float32) / total_steps)
-            b = jnp.mod(s, n_blocks)
-            blk = jax.lax.dynamic_slice_in_dim(pairs_l, b * B, B, 0)
-            center, ctx = blk[:, 0], blk[:, 1]
-            key = jax.random.fold_in(key0, s)
-            key = jax.random.fold_in(key, jax.lax.axis_index(AXIS_MODEL))
-            neg = jax.random.categorical(key, neg_l[None, :], shape=(B, negs))
-
-            # PULL the rows this device's batch touches
-            v = pull(win_l, center, AXIS_MODEL, rows)               # (B, D)
-            uids = jnp.concatenate([ctx, neg.reshape(-1)])
-            u = pull(wout_l, uids, AXIS_MODEL, rows)                # (B(1+N), D)
-            u_pos = u[:B]
-            u_neg = u[B:].reshape(B, negs, D)
-
-            s_pos = jax.nn.sigmoid((v * u_pos).sum(-1))
-            s_neg = jax.nn.sigmoid(jnp.einsum("bd,bnd->bn", v, u_neg))
-            g_pos = (s_pos - 1.0)[:, None]
-            g_neg = s_neg[..., None]
-
-            grad_v = g_pos * u_pos + (g_neg * u_neg).sum(1)
-            grad_u = jnp.concatenate(
-                [g_pos * v, (g_neg * v[:, None, :]).reshape(-1, D)])
-
-            # PUSH gradients to the owning shards (averaged over devices)
-            scale = lr / M
-            win_l = push(win_l, center, grad_v, AXIS_MODEL, rows, scale)
-            wout_l = push(wout_l, uids, grad_u, AXIS_MODEL, rows, scale)
-            return win_l, wout_l
-
-        return jax.lax.fori_loop(0, total_steps, step, (win_l, wout_l))
-
-    f = jax.jit(
-        shard_map(
-            body, mesh=mesh,
-            in_specs=(P(AXIS_MODEL), P(AXIS_MODEL), P(AXIS_MODEL)),
-            out_specs=(P(AXIS_MODEL), P(AXIS_MODEL)),
-            check_vma=False,
-        )
-    )
-    pairs_dev = jax.device_put(pairs, NamedSharding(mesh, P(AXIS_MODEL)))
-    new_in, new_out = f(pairs_dev, w_in.array, w_out.array)
-    w_in.array = new_in
-    w_out.array = new_out
-    return w_in
+    if pairs.shape[0] == 0:
+        return ShardedEmbedding(mesh, V, D, seed=cfg.seed)
+    pairs, n_blocks = _prep_pairs(pairs, cfg.batch_size,
+                                  mesh.shape[AXIS_MODEL], cfg.seed)
+    return _run_pairs_sharded(
+        pairs, V, D, cfg.batch_size, cfg.negatives,
+        n_blocks * cfg.epochs, n_blocks, cfg.learning_rate, cfg.seed,
+        neg_logits=_unigram75_logits(counts), mesh=mesh, hot_rows=hot_rows,
+        probs=np.asarray(counts, np.float64), _lower_only=_lower_only)

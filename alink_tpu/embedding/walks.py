@@ -162,68 +162,61 @@ def line_embeddings(
     batch_size: int = 512,
     learning_rate: float = 0.025,
     seed: int = 0,
+    *,
+    engine: Optional[str] = None,
+    mesh=None,
+    hot_rows: Optional[int] = None,
 ) -> np.ndarray:
     """LINE first/second-order proximity embeddings (reference:
     operator/batch/graph/LineBatchOp + huge LINE impl).
 
-    One jit: fori_loop over edge mini-batches; each step samples negatives,
-    computes the LINE objective gradient, and scatter-adds updates — the
-    same device pattern as SGNS (order=2 uses a context table)."""
-    import jax
-    import jax.numpy as jnp
+    LINE is SGNS over edge mini-batches — order=2 trains a separate context
+    table (``w_out``), order=1 ties both sides to ONE table — so it rides
+    the shared huge-embedding engine (``embedding/skipgram.py``): the
+    ``host`` engine keeps tables replicated, ``sharded`` routes pull/push
+    through the owner-routed APS with the hot-key cache. Negatives are
+    uniform over nodes in BOTH engines, so host/sharded/sharded+cache stay
+    bit-identical at equal seed and mesh size. ``batch_size`` is
+    per-device; it is clamped so one global block never tiles the edge set
+    into duplicate scatter-adds (which would multiply the effective
+    learning rate)."""
+    from ..parallel.mesh import data_axis_size, default_mesh
+    from .engine import huge_engine
+    from .skipgram import _prep_pairs, _run_pairs_host, _run_pairs_sharded
 
     rng = np.random.default_rng(seed)
     E = src.shape[0]
     if E == 0:
         return ((rng.random((num_nodes, dim)) - 0.5) / dim).astype(np.float32)
+    eng = huge_engine(engine)
+    host_mesh = mesh or default_mesh()
+    # BOTH engines block edges over the same device count (the data-axis
+    # size — the sharded model mesh is built over exactly this count), so
+    # the pair stream and negative keys match and parity holds
+    ndev = data_axis_size(host_mesh)
+    # floor, not ceil: one global block must never cyclically tile an edge
+    # twice (duplicates land on different devices, escape the per-device
+    # dedup, and double that edge's effective learning rate); the shuffled
+    # tail shorter than a block is dropped instead — the same trade the
+    # skipgram trainer makes. Degenerate E < ndev still tiles (B = 1).
+    B = max(1, min(batch_size, E // ndev))
     edges = np.stack([src, dst], axis=1).astype(np.int32)
-    edges = edges[rng.permutation(E)]
-    # a batch larger than the edge set would tile duplicates into one
-    # scatter-add step (multiplying the effective learning rate) — clamp
-    batch_size = min(batch_size, E)
-    total = ((E + batch_size - 1) // batch_size) * batch_size
-    edges = np.resize(edges, (total, 2))  # cyclic tile up to a full batch
-    n_batches = edges.shape[0] // batch_size
+    edges, n_batches = _prep_pairs(edges, B, ndev, seed)
+    tie = order != 2
+    common = dict(tie=tie, neg_logits=None, neg_v=num_nodes)
+    if eng == "host":
+        return _run_pairs_host(
+            edges, num_nodes, dim, B, num_negatives, num_steps, n_batches,
+            learning_rate, seed, mesh=host_mesh, **common)
+    from ..parallel.aps import model_mesh
 
-    emb0 = ((rng.random((num_nodes, dim)) - 0.5) / dim).astype(np.float32)
-    ctx0 = np.zeros((num_nodes, dim), np.float32)
-    key0 = jax.random.PRNGKey(seed)
-
-    @jax.jit
-    def run(edges_d, emb, ctx):
-        def step(s, carry):
-            emb, ctx = carry
-            lr = learning_rate * jnp.maximum(
-                0.0001, 1.0 - s.astype(jnp.float32) / num_steps)
-            b = jnp.mod(s, n_batches)
-            blk = jax.lax.dynamic_slice_in_dim(
-                edges_d, b * batch_size, batch_size, 0)
-            u, v = blk[:, 0], blk[:, 1]
-            key = jax.random.fold_in(key0, s)
-            neg = jax.random.randint(
-                key, (batch_size, num_negatives), 0, num_nodes)
-            target = ctx if order == 2 else emb
-            eu = emb[u]
-            pv = target[v]
-            nv = target[neg]                                  # (B, N, D)
-            s_pos = jax.nn.sigmoid((eu * pv).sum(-1))
-            s_neg = jax.nn.sigmoid(jnp.einsum("bd,bnd->bn", eu, nv))
-            g_pos = (s_pos - 1.0)[:, None]
-            g_neg = s_neg[..., None]
-            grad_u = g_pos * pv + (g_neg * nv).sum(1)
-            emb = emb.at[u].add(-lr * grad_u)
-            upd_pos = g_pos * eu
-            upd_neg = (g_neg * eu[:, None, :]).reshape(-1, dim)
-            if order == 2:
-                ctx = ctx.at[v].add(-lr * upd_pos)
-                ctx = ctx.at[neg.reshape(-1)].add(-lr * upd_neg)
-            else:
-                emb = emb.at[v].add(-lr * upd_pos)
-                emb = emb.at[neg.reshape(-1)].add(-lr * upd_neg)
-            return emb, ctx
-
-        return jax.lax.fori_loop(0, num_steps, step, (emb, ctx))
-
-    emb, _ = jax.device_get(run(jnp.asarray(edges), jnp.asarray(emb0),
-                                jnp.asarray(ctx0)))
-    return np.asarray(emb)
+    m = model_mesh(ndev) if mesh is not None else None
+    # endpoint frequency = the empirical id distribution the hot cache
+    # sizes its cold buckets from (negatives are uniform)
+    deg = np.bincount(np.concatenate([src, dst]).astype(np.int64),
+                      minlength=num_nodes).astype(np.float64)
+    handle = _run_pairs_sharded(
+        edges, num_nodes, dim, B, num_negatives, num_steps, n_batches,
+        learning_rate, seed, mesh=m, hot_rows=hot_rows, probs=deg + 1.0,
+        **common)
+    return handle.to_numpy()

@@ -27,8 +27,7 @@ from ...embedding import (
     make_pairs,
     node2vec_walks,
     random_walks,
-    train_skipgram,
-    train_skipgram_sharded,
+    train_embedding,
 )
 from ...embedding.walks import build_csr
 from ...mapper import HasPredictionCol, HasReservedCols, ModelMapper
@@ -51,8 +50,10 @@ class HasWord2VecParams:
     WORD_DELIMITER = ParamInfo("wordDelimiter", str, default=" ")
     SHARD_MODEL = ParamInfo(
         "shardModel", bool, default=False,
-        desc="shard the embedding tables over the model mesh axis (the APS "
-             "path for vocab >> HBM/chip; reference: huge/Word2VecBatchOp)")
+        desc="force the model-sharded APS engine for this op regardless of "
+             "ALINK_HUGE_ENGINE (reference: huge/Word2VecBatchOp); the "
+             "knob's default is already 'sharded' — both engines are "
+             "bit-identical at equal seed")
 
 
 def _w2v_model_table(vocab, emb: np.ndarray) -> MTable:
@@ -70,6 +71,7 @@ class Word2VecTrainBatchOp(BatchOperator, HasWord2VecParams):
 
     _min_inputs = 1
     _max_inputs = 1
+    _huge_sgns = True      # plan validator: SGNS op under ALINK_HUGE_ENGINE
 
     def _out_schema(self, in_schema: TableSchema) -> TableSchema:
         return TableSchema(["word", "vec"],
@@ -93,12 +95,9 @@ class Word2VecTrainBatchOp(BatchOperator, HasWord2VecParams):
         )
         pairs = make_pairs(docs, vocab, counts, cfg.window, cfg.subsample,
                            cfg.seed)
-        if self.get(self.SHARD_MODEL):
-            handle = train_skipgram_sharded(pairs, len(vocab), counts, cfg)
-            emb = handle.to_numpy()
-        else:
-            emb = train_skipgram(pairs, len(vocab), counts, cfg,
-                                 mesh=self.env.mesh)
+        emb = train_embedding(
+            pairs, len(vocab), counts, cfg, mesh=self.env.mesh,
+            engine="sharded" if self.get(self.SHARD_MODEL) else None)
         return _w2v_model_table(vocab, emb)
 
 
@@ -248,6 +247,7 @@ class _WalkEmbeddingBase(BatchOperator, HasWalkParams, HasWord2VecParams):
     _min_inputs = 1
     _max_inputs = 1
     _walk_op_cls = None
+    _huge_sgns = True      # plan validator: SGNS op under ALINK_HUGE_ENGINE
 
     def _out_schema(self, in_schema: TableSchema) -> TableSchema:
         return TableSchema(["word", "vec"],
@@ -272,8 +272,8 @@ class _WalkEmbeddingBase(BatchOperator, HasWalkParams, HasWord2VecParams):
             seed=self.get(self.RANDOM_SEED),
         )
         pairs = make_pairs(docs, vocab, counts, cfg.window, 0.0, cfg.seed)
-        emb = train_skipgram(pairs, len(vocab), counts, cfg,
-                             mesh=self.env.mesh)
+        emb = train_embedding(pairs, len(vocab), counts, cfg,
+                              mesh=self.env.mesh)
         return _w2v_model_table(vocab, emb)
 
 
@@ -340,6 +340,7 @@ class MetaPath2VecBatchOp(BatchOperator, HasWalkParams, HasWord2VecParams):
 
     _min_inputs = 2
     _max_inputs = 2
+    _huge_sgns = True      # plan validator: SGNS op under ALINK_HUGE_ENGINE
 
     def _out_schema(self, *in_schemas) -> TableSchema:
         return TableSchema(["word", "vec"],
@@ -362,8 +363,8 @@ class MetaPath2VecBatchOp(BatchOperator, HasWalkParams, HasWord2VecParams):
             seed=self.get(self.RANDOM_SEED),
         )
         pairs = make_pairs(docs, vocab, counts, cfg.window, 0.0, cfg.seed)
-        emb = train_skipgram(pairs, len(vocab), counts, cfg,
-                             mesh=self.env.mesh)
+        emb = train_embedding(pairs, len(vocab), counts, cfg,
+                              mesh=self.env.mesh)
         return _w2v_model_table(vocab, emb)
 
 
@@ -371,12 +372,17 @@ class LineBatchOp(BatchOperator, HasWalkParams):
     """LINE first/second-order embeddings (reference:
     operator/batch/graph/LineBatchOp.java)."""
 
+    _huge_sgns = True      # plan validator: SGNS op under ALINK_HUGE_ENGINE
+
     VECTOR_SIZE = ParamInfo("vectorSize", int, default=64)
     ORDER = ParamInfo("order", int, default=2,
                       validator=InValidator(1, 2))
     NUM_STEPS = ParamInfo("numSteps", int, default=2000)
     NEGATIVE = ParamInfo("negative", int, default=5)
     LEARNING_RATE = ParamInfo("learningRate", float, default=0.025)
+    BATCH_SIZE = ParamInfo("batchSize", int, default=512,
+                           validator=MinValidator(1),
+                           desc="per-device edge mini-batch size")
 
     _min_inputs = 1
     _max_inputs = 1
@@ -398,7 +404,9 @@ class LineBatchOp(BatchOperator, HasWalkParams):
             order=self.get(self.ORDER),
             num_negatives=self.get(self.NEGATIVE),
             num_steps=self.get(self.NUM_STEPS),
+            batch_size=self.get(self.BATCH_SIZE),
             learning_rate=self.get(self.LEARNING_RATE),
-            seed=self.get(self.RANDOM_SEED))
+            seed=self.get(self.RANDOM_SEED),
+            mesh=self.env.mesh)
         vocab = {v: i for i, v in enumerate(nodes)}
         return _w2v_model_table(vocab, emb)

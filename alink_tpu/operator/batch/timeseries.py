@@ -605,8 +605,10 @@ class LSTNetBatchOp(_BaseForecastOp):
     DLLauncher — core/src/main/python/akdl/akdl/models/tf/lstnet/ +
     resources/entries/lstnet_entry.py).
 
-    Rides the shared DL train loop like DeepAR; forecasting rolls the
-    window forward on predictions."""
+    Rides the shared DL train loop like DeepAR. The head is trained
+    direct-multi-horizon (the LSTNet-paper contract): one forward pass
+    emits the whole ``predictNum`` path, instead of compounding one-step
+    recursion error across the horizon."""
 
     LOOKBACK = ParamInfo("lookback", int, default=24,
                          validator=MinValidator(4))
@@ -630,7 +632,8 @@ class LSTNetBatchOp(_BaseForecastOp):
             num_epochs=self.get(self.NUM_EPOCHS),
             batch_size=self.get(self.BATCH_SIZE),
             learning_rate=self.get(self.LEARNING_RATE),
-            seed=self.get(self.RANDOM_SEED))
+            seed=self.get(self.RANDOM_SEED),
+            horizon=horizon)     # direct multi-horizon head (LSTNet paper)
         means, _ = net_forecast(model, y, horizon)
         return means
 

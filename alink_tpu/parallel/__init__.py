@@ -18,3 +18,5 @@ from .collectives import (
     reduce_scatter,
 )
 from .comqueue import ComContext, IterativeComQueue, shard_rows
+from .aps import aps_summary
+from .hotcache import resolve_hot_rows

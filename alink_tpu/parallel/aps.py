@@ -173,15 +173,81 @@ def push_allgather(table_l, ids, grads, axis: str, rows_per_shard: int,
     return _push_gathered(table_l, uid, g, axis, rows_per_shard, scale)
 
 
+def apply_gathered_replicated(table, ids, grads, axis: str, num_rows: int,
+                              scale):
+    """Replicated-table twin of :func:`push` — the "host engine" update.
+
+    Per-device dedup, then ``all_gather`` of (uid, grads) + a full-table
+    scatter-add applied identically on every device. Each row's scatter-add
+    reduction group holds exactly its true contributions in source-device
+    order — the same per-row add sequence the routed/all-gather sharded
+    pushes replay — so a replicated table driven through this function
+    evolves bit-identically to a model-sharded one driven through
+    :func:`push` on an equal-size mesh. That is the parity contract the
+    huge-embedding engines (``ALINK_HUGE_ENGINE=sharded|host``) are pinned
+    against. Ids outside ``[0, num_rows)`` (dedup padding) park at the OOB
+    row and drop."""
+    import jax
+    import jax.numpy as jnp
+
+    uid, g = _dedup_batch(ids, grads, num_rows)
+    ids_all = jax.lax.all_gather(uid, axis).reshape(-1)
+    g_all = jax.lax.all_gather(g, axis).reshape(-1, g.shape[-1])
+    lidx = jnp.where((ids_all >= 0) & (ids_all < num_rows), ids_all, num_rows)
+    return table.at[lidx].add(-scale * g_all, mode="drop")
+
+
+def aps_summary() -> dict:
+    """One-call health readout of the APS exchange + hot-key cache counters
+    (the block the WebUI profile panel and bench read)."""
+    from ..common.metrics import metrics
+
+    hits = metrics.counter("aps.cache_hits")
+    misses = metrics.counter("aps.cache_misses")
+    return {
+        "cache_hits": hits,
+        "cache_misses": misses,
+        "cache_evictions": metrics.counter("aps.cache_evictions"),
+        "cache_hit_rate": round(hits / (hits + misses), 4)
+        if hits + misses else None,
+        "bucket_overflows": metrics.counter("aps.bucket_overflows"),
+    }
+
+
+def _export_aps_gauges() -> None:
+    # labeled gauges alongside the raw *_total counters: one family per
+    # surface (cache events / exchange health), refreshed at scrape time
+    from ..common.metrics import metrics
+
+    for event in ("hits", "misses", "evictions"):
+        metrics.set_gauge("aps.cache_events",
+                          metrics.counter(f"aps.cache_{event}"), event=event)
+    metrics.set_gauge("aps.health", metrics.counter("aps.bucket_overflows"),
+                      event="bucket_overflows")
+
+
+def _register_gauges() -> None:
+    from ..common.metrics import metrics
+
+    metrics.register_export_hook(_export_aps_gauges)
+
+
+_register_gauges()
+
+
 def pull(table_l, ids, axis: str, rows_per_shard: int, *,
-         slack: Optional[float] = None):
+         slack: Optional[float] = None, cap: Optional[int] = None):
     """Inside shard_map: fetch rows for this device's ``ids`` from whichever
     shard owns them. ``table_l``: (V/M, D) local shard; ``ids``: (B,) global
     row ids. Returns (B, D).
 
     Owner-routed: per-device comm is ~``slack·B·D`` regardless of the model
     axis size (see module docstring); ids whose bucket overflows fall back
-    to :func:`pull_allgather` under a mesh-agreed ``cond``.
+    to :func:`pull_allgather` under a mesh-agreed ``cond``. ``cap`` overrides
+    the per-owner bucket capacity (the hot-key cache sizes the cold
+    remainder's buckets from the empirical tail mass — see
+    ``parallel/hotcache.py``); out-of-range ids (e.g. the cache's parked
+    sentinel ``M·rows``) are dropped and read back as zero rows.
     """
     import jax
     import jax.numpy as jnp
@@ -189,7 +255,7 @@ def pull(table_l, ids, axis: str, rows_per_shard: int, *,
     M = axis_size(axis)
     B = int(ids.shape[0])
     rows = rows_per_shard
-    cap = bucket_capacity(B, M, slack)
+    cap = bucket_capacity(B, M, slack) if cap is None else max(1, int(cap))
     m = jax.lax.axis_index(axis)
     ids = ids.astype(jnp.int32)
 

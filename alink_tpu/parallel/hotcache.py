@@ -1,0 +1,193 @@
+"""Hot-key embedding cache over the owner-routed APS pull/push.
+
+Zipf-skewed id traffic (the SURVEY §2.3 huge-embedding family: word and
+node frequencies are power-law) concentrates most pulls on a small set of
+hot rows. Because ``build_vocab`` sorts the vocabulary most-frequent-first
+and the APS shards rows contiguously, those hot rows are exactly the table
+PREFIX ``[0, hot)`` — all owned by shard 0. That is simultaneously the
+cache opportunity and the routed path's worst case: every device's bucket
+for owner 0 fills with the same hot ids and overflows into the all-gather
+fallback.
+
+The cache is a device-resident replica of the first ``hot`` rows on every
+device — the in-jit analog of the host-side LRU in ``common/staging.py``.
+Under a frequency-sorted vocabulary the top-``hot`` prefix IS the
+steady-state content an LRU would converge to, but a static hot set stays
+shape-stable inside ``jit`` (no dynamic eviction state), so
+``aps.cache_evictions`` moves only when a trainer drops/resizes a replica,
+never per step:
+
+- **pull**: ids ``< hot`` gather from the local replica — zero wire bytes
+  (counted ``aps.cache_hits``). Cold ids ride the routed exchange with
+  buckets sized from the *empirical tail mass* (:func:`cold_capacity`):
+  the expected per-owner unique cold ids for the actual frequency table,
+  not the worst-case batch size. Undersized buckets fall back exactly
+  (``aps.bucket_overflows``) — raise ``ALINK_APS_BUCKET_SLACK`` for more
+  headroom.
+- **push**: gradients keep riding the routed push unchanged — exact
+  accumulation needs every per-device contribution applied on the owner in
+  source-device order, and the routed push already moves only O(B·D)
+  bytes. Write-back to the replicas is :func:`refresh_hot`: the owner's
+  updated hot rows are re-broadcast by summing their int32 *bit patterns*
+  over the mesh (integer adds of zeros are exact, so the replica is
+  bit-identical to the owner — a float psum could flip ``-0.0``).
+
+Both cached paths are therefore bit-identical to the uncached routed path
+and to the all-gather reference, for every cache size including 0
+(``hot_rows=0`` compiles to exactly the uncached program).
+
+Knobs: ``ALINK_APS_HOT_ROWS`` = ``auto`` (default: 0 for small vocabs,
+else ``min(1024, V/4, rows_per_shard)``) | row count (0 disables).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from .aps import bucket_capacity, pull
+from .shardmap import axis_size
+
+_AUTO_MIN_VOCAB = 64
+_AUTO_MAX_ROWS = 1024
+
+
+def resolve_hot_rows(explicit: Optional[int], vocab_size: int,
+                     rows_per_shard: int) -> int:
+    """Effective hot-set size: explicit argument > ``ALINK_APS_HOT_ROWS`` >
+    auto heuristic; always clamped to ``[0, rows_per_shard]`` (the hot
+    prefix must sit inside shard 0)."""
+    if explicit is None:
+        from ..common.env import env_raw
+
+        raw = env_raw("ALINK_APS_HOT_ROWS")
+        if raw is not None and raw.strip().lower() not in ("", "auto"):
+            try:
+                explicit = int(raw)
+            except ValueError:
+                explicit = None  # malformed tuning knob: fall back to auto
+    if explicit is None:
+        explicit = (0 if vocab_size < _AUTO_MIN_VOCAB
+                    else min(_AUTO_MAX_ROWS, vocab_size // 4))
+    return max(0, min(int(explicit), int(rows_per_shard)))
+
+
+def expected_cold_draws(
+    components: Sequence[Tuple[np.ndarray, int]],
+    hot: int,
+) -> float:
+    """E[# draws that MISS the hot set per batch] from the empirical id
+    distribution.
+
+    ``components`` is the batch's draw mixture: ``(weights, n_draws)`` pairs
+    (e.g. contexts ~ word frequency, negatives ~ unigram^0.75), weights
+    unnormalized over the vocabulary. Each component contributes
+    ``n_draws × (1 - mass of its top-hot prefix)`` — under Zipf skew the
+    prefix holds most of the mass, so the cold remainder is a small
+    fraction of the batch."""
+    e = 0.0
+    for weights, n_draws in components:
+        p = np.asarray(weights, np.float64)
+        tot = p.sum()
+        tail = (p[hot:].sum() / tot) if tot > 0 else \
+            max(0.0, 1.0 - hot / max(1, len(p)))
+        e += n_draws * tail
+    return e
+
+
+def cold_capacity(
+    components: Sequence[Tuple[np.ndarray, int]],
+    hot: int,
+    rows_per_shard: int,
+    num_shards: int,
+    slack: Optional[float] = None,
+) -> int:
+    """Per-owner bucket capacity for the cold remainder of a cached pull.
+
+    The uncached capacity formula ``ceil(slack·B/M)`` with the batch size
+    shrunk to the *expected cold draws* for the actual frequency table —
+    per-device wire bytes stay ``~slack·E[cold]·D`` (flat in M, and a
+    ``tail-mass`` fraction of the uncached cost), never above the uncached
+    capacity and never below 1. A batch skewier than the frequency table
+    predicts overflows into the exact, counted all-gather fallback — raise
+    ``ALINK_APS_BUCKET_SLACK`` (or the hot-set size) if
+    ``aps.bucket_overflows`` climbs."""
+    total = sum(n for _, n in components)
+    if hot <= 0:
+        return bucket_capacity(total, num_shards, slack)
+    basis = min(total, max(1, int(math.ceil(
+        expected_cold_draws(components, hot)))))
+    return bucket_capacity(basis, num_shards, slack)
+
+
+def refresh_hot(table_l, axis: str, hot: int):
+    """Bit-exact replica of shard 0's first ``hot`` rows on every device.
+
+    The rows' float bit patterns are bitcast to int32, zero-masked off the
+    owner, and ``psum``-combined — integer adds of zeros reproduce the
+    owner's bits exactly (a float psum could rewrite ``-0.0 + 0.0`` to
+    ``+0.0``), so the replica is indistinguishable from pulling the rows."""
+    return refresh_hot_many((table_l,), axis, hot)[0]
+
+
+def refresh_hot_many(tables, axis: str, hot: int):
+    """One-collective :func:`refresh_hot` for several equally-shaped tables
+    (the SGNS step refreshes BOTH embedding replicas — concatenating their
+    hot blocks into a single psum halves the per-step collective latency;
+    the elementwise integer adds, and therefore the bits, are unchanged)."""
+    import jax
+    import jax.numpy as jnp
+
+    m = jax.lax.axis_index(axis)
+    blk = jnp.concatenate(
+        [jax.lax.dynamic_slice_in_dim(t, 0, hot, axis=0) for t in tables])
+    bits = jax.lax.bitcast_convert_type(blk, jnp.int32)
+    bits = jnp.where(m == 0, bits, jnp.zeros_like(bits))
+    bits = jax.lax.psum(bits, axis)
+    out = jax.lax.bitcast_convert_type(bits, tables[0].dtype)
+    return tuple(out[i * hot:(i + 1) * hot] for i in range(len(tables)))
+
+
+def pull_cached(table_l, replica, ids, axis: str, rows_per_shard: int,
+                hot: int, *, cap: Optional[int] = None,
+                slack: Optional[float] = None):
+    """Routed pull with hot ids served from the local replica.
+
+    Returns ``(rows, n_hot)`` — ``rows`` bit-identical to an uncached
+    :func:`~alink_tpu.parallel.aps.pull` of the same ids (the replica holds
+    the owner's exact bits), ``n_hot`` the per-device cache-hit count for
+    this batch. Hot ids are parked at the dropped sentinel ``M·rows`` so
+    they occupy no bucket slot; ``cap`` sizes the cold buckets (see
+    :func:`cold_capacity`)."""
+    import jax.numpy as jnp
+
+    M = axis_size(axis)
+    ids = ids.astype(jnp.int32)
+    is_hot = (ids >= 0) & (ids < hot)
+    sentinel = jnp.int32(M * rows_per_shard)
+    cold = pull(table_l, jnp.where(is_hot, sentinel, ids), axis,
+                rows_per_shard, slack=slack, cap=cap)
+    hot_vals = replica[jnp.clip(ids, 0, hot - 1)]
+    out = jnp.where(is_hot[:, None], hot_vals, cold)
+    return out, is_hot.sum().astype(jnp.int32)
+
+
+def note_cache_traffic(hits: int, total: int) -> None:
+    """Fold one training call's cache counters into the process metrics
+    (``aps.cache_hits``/``aps.cache_misses``)."""
+    from ..common.metrics import metrics
+
+    hits = int(hits)
+    metrics.incr("aps.cache_hits", hits)
+    metrics.incr("aps.cache_misses", max(0, int(total) - hits))
+
+
+def note_cache_dropped(hot: int) -> None:
+    """Count a replica being released/resized (``aps.cache_evictions``) —
+    the static hot set never evicts per step."""
+    if hot > 0:
+        from ..common.metrics import metrics
+
+        metrics.incr("aps.cache_evictions", int(hot))

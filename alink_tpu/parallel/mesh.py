@@ -64,6 +64,16 @@ def make_mesh(
     return Mesh(dev_array, names)
 
 
+def data_axis_size(mesh) -> int:
+    """The device count a trainer blocks its row/pair stream over: the
+    mesh's ``data`` axis, falling back to the first axis on meshes that
+    don't name one. The huge-embedding engines both resolve their device
+    count through THIS function (the sharded engine builds its model mesh
+    over exactly this count, not the mesh's total device count) — their
+    bit-parity contract rests on the two call sites agreeing."""
+    return mesh.shape.get(AXIS_DATA) or mesh.shape[mesh.axis_names[0]]
+
+
 def data_sharding(mesh, *, axis: str = AXIS_DATA):
     """NamedSharding that shards the leading (batch/row) dimension over `axis`."""
     from jax.sharding import NamedSharding, PartitionSpec as P

@@ -376,10 +376,14 @@ class _Handler(BaseHTTPRequestHandler):
         if parts == ["profile"]:
             # performance observatory: per-kernel XLA cost + roofline
             # verdicts joined with measured exec timings (resolves any
-            # pending captures — one lower() per new program, amortized)
+            # pending captures — one lower() per new program, amortized),
+            # plus the APS exchange / hot-key-cache health block
             from ..common.profiling import profile_summary
+            from ..parallel.aps import aps_summary
 
-            return self._send_json(profile_summary())
+            summ = profile_summary()
+            summ["aps"] = aps_summary()
+            return self._send_json(summ)
         if parts == ["analysis"]:
             # static-analysis panel: the last pre-flight plan report, the
             # analysis.* counters, and the rule table

@@ -1,0 +1,304 @@
+"""Huge-embedding engine + hot-key cache suite.
+
+Pins the PR's contract: every walk workload (DeepWalk/Node2Vec embeddings,
+MetaPath2Vec, LINE) runs through the owner-routed APS by default
+(``ALINK_HUGE_ENGINE``), and host engine ≡ routed APS ≡ routed+hot-key-cache
+bit-for-bit at equal seed — for every cache size, under Zipf-skewed id
+traffic (reference behavior: huge/impl/* over ApsEnv pull→train→push)."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from alink_tpu.common.metrics import metrics
+from alink_tpu.common.mtable import AlinkTypes, MTable, TableSchema
+from alink_tpu.embedding import (
+    SkipGramConfig,
+    build_vocab,
+    huge_engine,
+    make_pairs,
+    train_skipgram,
+    train_skipgram_sharded,
+)
+from alink_tpu.operator.batch import (
+    DeepWalkEmbeddingBatchOp,
+    LineBatchOp,
+    MemSourceBatchOp,
+    MetaPath2VecBatchOp,
+    Node2VecEmbeddingBatchOp,
+)
+from alink_tpu.operator.batch.base import TableSourceBatchOp
+from alink_tpu.parallel.hotcache import (
+    cold_capacity,
+    expected_cold_draws,
+    resolve_hot_rows,
+)
+
+pytestmark = pytest.mark.huge
+
+
+# ---------------------------------------------------------------------------
+# engine knob
+# ---------------------------------------------------------------------------
+
+
+def test_engine_knob_default_sharded(monkeypatch):
+    monkeypatch.delenv("ALINK_HUGE_ENGINE", raising=False)
+    assert huge_engine() == "sharded"
+
+
+def test_engine_knob_override(monkeypatch):
+    monkeypatch.setenv("ALINK_HUGE_ENGINE", "host")
+    assert huge_engine() == "host"
+    monkeypatch.setenv("ALINK_HUGE_ENGINE", "  SHARDED ")
+    assert huge_engine() == "sharded"
+    # explicit argument beats the env
+    assert huge_engine("host") == "host"
+
+
+def test_engine_knob_malformed_falls_back_counted(monkeypatch):
+    monkeypatch.setenv("ALINK_HUGE_ENGINE", "shardedd")
+    before = metrics.counter("huge.engine_bad_knob")
+    assert huge_engine() == "sharded"
+    assert metrics.counter("huge.engine_bad_knob") == before + 1
+
+
+# ---------------------------------------------------------------------------
+# hot-set resolution + cold-bucket sizing
+# ---------------------------------------------------------------------------
+
+
+def test_hot_rows_resolution(monkeypatch):
+    monkeypatch.delenv("ALINK_APS_HOT_ROWS", raising=False)
+    # auto: off for tiny vocabs, V/4 capped by the shard for big ones
+    assert resolve_hot_rows(None, 32, 1000) == 0
+    assert resolve_hot_rows(None, 400, 1000) == 100
+    assert resolve_hot_rows(None, 100_000, 1000) == 1000  # shard-clamped
+    monkeypatch.setenv("ALINK_APS_HOT_ROWS", "7")
+    assert resolve_hot_rows(None, 400, 1000) == 7
+    monkeypatch.setenv("ALINK_APS_HOT_ROWS", "auto")
+    assert resolve_hot_rows(None, 400, 1000) == 100
+    monkeypatch.setenv("ALINK_APS_HOT_ROWS", "not-a-number")
+    assert resolve_hot_rows(None, 400, 1000) == 100   # malformed → auto
+    # explicit argument beats the env; clamps apply either way
+    assert resolve_hot_rows(12, 400, 1000) == 12
+    assert resolve_hot_rows(5000, 400, 64) == 64
+    assert resolve_hot_rows(-3, 400, 64) == 0
+
+
+def test_cold_capacity_shrinks_with_head_mass():
+    V = 256
+    zipf = 1.0 / (np.arange(V) + 1.0) ** 1.5
+    uniform = np.ones(V)
+    from alink_tpu.parallel.aps import bucket_capacity
+
+    B, M = 64, 8
+    uncached = bucket_capacity(B, M)
+    # hot=0 → the uncached formula
+    assert cold_capacity([(zipf, B)], 0, V // M, M) == uncached
+    skewed = cold_capacity([(zipf, B)], 32, V // M, M)
+    flat = cold_capacity([(uniform, B)], 32, V // M, M)
+    assert 1 <= skewed < flat <= uncached
+    # mixture components sum their cold draws
+    e = expected_cold_draws([(zipf, B), (uniform, 3 * B)], 32)
+    tail_z = zipf[32:].sum() / zipf.sum()
+    assert e == pytest.approx(B * tail_z + 3 * B * (1 - 32 / V))
+
+
+def test_refresh_hot_is_bit_exact_including_negative_zero():
+    from jax.sharding import PartitionSpec as P
+
+    from alink_tpu.parallel.aps import ShardedEmbedding, model_mesh
+    from alink_tpu.parallel.hotcache import refresh_hot
+    from alink_tpu.parallel.mesh import AXIS_MODEL
+    from alink_tpu.parallel.shardmap import shard_map
+
+    mesh = model_mesh()
+    m = mesh.shape[AXIS_MODEL]
+    V, D, hot = 4 * m, 3, 4
+    rng = np.random.default_rng(0)
+    base = rng.normal(size=(V, D)).astype(np.float32)
+    base[0, 0] = -0.0                       # a float psum could flip this
+    base[1, 1] = 0.0
+    table = ShardedEmbedding(mesh, V, D, init=lambda r: base.copy())
+
+    def body(tl):
+        return refresh_hot(tl, AXIS_MODEL, hot)
+
+    f = jax.jit(shard_map(body, mesh=mesh, in_specs=(P(AXIS_MODEL),),
+                          out_specs=P(AXIS_MODEL), check_vma=False))
+    out = np.asarray(jax.device_get(f(table.array)))   # (m*hot, D)
+    for dev in range(m):
+        rep = out[dev * hot:(dev + 1) * hot]
+        np.testing.assert_array_equal(rep.view(np.int32),
+                                      base[:hot].view(np.int32))
+
+
+# ---------------------------------------------------------------------------
+# trainer-level 3-way parity under Zipf stress, across cache sizes
+# ---------------------------------------------------------------------------
+
+
+def _zipf_corpus(seed=0, v=30, docs=60, length=10, a=1.3):
+    rng = np.random.default_rng(seed)
+    return [[f"w{min(int(i), v - 1)}" for i in (rng.zipf(a, length) - 1)]
+            for _ in range(docs)]
+
+
+def test_cache_size_sweep_bit_identical_zipf():
+    """cache=0 ≡ routed ≡ every cache size ≡ the host (gathered) engine,
+    on Zipf-skewed pairs that exercise the overflow fallback at small
+    caches."""
+    docs = _zipf_corpus()
+    vocab, counts = build_vocab(docs)
+    cfg = SkipGramConfig(dim=6, window=2, negatives=2, epochs=2,
+                         batch_size=8, seed=7)
+    pairs = make_pairs(docs, vocab, counts, cfg.window, 0.0, cfg.seed)
+    ref = train_skipgram_sharded(pairs, len(vocab), counts, cfg,
+                                 hot_rows=0).to_numpy()
+    host = train_skipgram(pairs, len(vocab), counts, cfg)
+    np.testing.assert_array_equal(host, ref)
+    for hot in (1, 3, 8, 10_000):          # 10k clamps to the whole shard
+        got = train_skipgram_sharded(pairs, len(vocab), counts, cfg,
+                                     hot_rows=hot).to_numpy()
+        np.testing.assert_array_equal(got, ref, err_msg=f"hot={hot}")
+
+
+def test_cache_hit_counters_and_summary():
+    from alink_tpu.parallel.aps import aps_summary
+
+    docs = _zipf_corpus(seed=3)
+    vocab, counts = build_vocab(docs)
+    cfg = SkipGramConfig(dim=6, window=2, negatives=2, epochs=1,
+                         batch_size=8, seed=1)
+    pairs = make_pairs(docs, vocab, counts, cfg.window, 0.0, cfg.seed)
+    h0 = metrics.counter("aps.cache_hits")
+    m0 = metrics.counter("aps.cache_misses")
+    e0 = metrics.counter("aps.cache_evictions")
+    train_skipgram_sharded(pairs, len(vocab), counts, cfg, hot_rows=4)
+    assert metrics.counter("aps.cache_hits") > h0       # Zipf head is hot
+    assert metrics.counter("aps.cache_misses") > m0
+    assert metrics.counter("aps.cache_evictions") == e0 + 4
+    s = aps_summary()
+    assert set(s) == {"cache_hits", "cache_misses", "cache_evictions",
+                      "cache_hit_rate", "bucket_overflows"}
+    assert s["cache_hit_rate"] is None or 0.0 <= s["cache_hit_rate"] <= 1.0
+
+
+def test_aps_gauges_exported_prometheus():
+    text = metrics.export_prometheus()
+    assert 'alink_aps_cache_events{event="hits"}' in text
+    assert 'alink_aps_cache_events{event="misses"}' in text
+    assert 'alink_aps_cache_events{event="evictions"}' in text
+    assert 'alink_aps_health{event="bucket_overflows"}' in text
+
+
+# ---------------------------------------------------------------------------
+# all four newly-routed workloads: host ≡ routed ≡ routed+cache, CI-pinned
+# ---------------------------------------------------------------------------
+
+
+def _edge_table():
+    edges = [(0, 1), (1, 2), (2, 0), (3, 4), (4, 5), (5, 3), (2, 3),
+             (0, 2), (1, 3), (4, 0)]
+    return MTable({
+        "src": np.asarray([f"n{a}" for a, _ in edges], object),
+        "dst": np.asarray([f"n{b}" for _, b in edges], object),
+    }, TableSchema(["src", "dst"], [AlinkTypes.STRING, AlinkTypes.STRING]))
+
+
+def _deepwalk_emb():
+    return DeepWalkEmbeddingBatchOp(
+        sourceCol="src", targetCol="dst", walkNum=4, walkLength=8,
+        vectorSize=8, numIter=2, batchSize=16, randomSeed=5,
+    ).link_from(TableSourceBatchOp(_edge_table()))
+
+
+def _node2vec_emb():
+    return Node2VecEmbeddingBatchOp(
+        sourceCol="src", targetCol="dst", walkNum=4, walkLength=8, p=0.5,
+        q=2.0, vectorSize=8, numIter=2, batchSize=16, randomSeed=5,
+    ).link_from(TableSourceBatchOp(_edge_table()))
+
+
+def _metapath2vec():
+    edges = [("u%d" % (i % 4), "i%d" % (i % 3)) for i in range(24)]
+    types = [("u%d" % i, "user") for i in range(4)] + \
+            [("i%d" % i, "item") for i in range(3)]
+    return MetaPath2VecBatchOp(
+        sourceCol="source", targetCol="target", metaPath="user-item-user",
+        walkNum=8, vectorSize=8, numIter=2, batchSize=16,
+        randomSeed=1).link_from(
+        MemSourceBatchOp(edges, "source string, target string"),
+        MemSourceBatchOp(types, "vertex string, type string"))
+
+
+def _line():
+    return LineBatchOp(
+        sourceCol="src", targetCol="dst", vectorSize=8, numSteps=40,
+        batchSize=8, randomSeed=2, order=2,
+    ).link_from(TableSourceBatchOp(_edge_table()))
+
+
+_WORKLOADS = [("deepwalk", _deepwalk_emb), ("node2vec", _node2vec_emb),
+              ("metapath2vec", _metapath2vec), ("line", _line)]
+
+
+def _collect_vecs(factory):
+    out = factory().collect()
+    return {w: np.asarray(v.data) for w, v in
+            zip(out.col("word"), out.col("vec"))}
+
+
+def test_alk103_flags_off_ladder_batch_on_sharded_engine(monkeypatch):
+    """Plan validator: a walk op with an off-ladder batchSize headed for
+    the sharded engine is a recompile hazard (one routed-exchange program
+    per batch config); the host engine and on-ladder sizes stay clean."""
+    from alink_tpu.analysis import validate_plan
+    from alink_tpu.common.jitcache import bucket_rows
+
+    assert bucket_rows(100) != 100
+
+    def op(bs):
+        return DeepWalkEmbeddingBatchOp(
+            sourceCol="src", targetCol="dst", batchSize=bs,
+        ).link_from(TableSourceBatchOp(_edge_table()))
+
+    monkeypatch.setenv("ALINK_HUGE_ENGINE", "sharded")
+    rep = validate_plan(op(100))
+    assert rep.by_rule().get("ALK103") == 1
+    assert "batchSize=100" in [d for d in rep.diagnostics
+                               if d.rule == "ALK103"][0].message
+    assert validate_plan(op(128)).by_rule().get("ALK103") is None
+    monkeypatch.setenv("ALINK_HUGE_ENGINE", "host")
+    assert validate_plan(op(100)).by_rule().get("ALK103") is None
+    # an explicit shardModel pin forces the sharded engine past the knob
+    from alink_tpu.operator.batch import Word2VecTrainBatchOp
+
+    docs = MTable({"doc": np.asarray(["a b c"] * 4, object)},
+                  TableSchema(["doc"], [AlinkTypes.STRING]))
+    w2v = Word2VecTrainBatchOp(
+        selectedCol="doc", batchSize=100, shardModel=True,
+    ).link_from(TableSourceBatchOp(docs))
+    assert validate_plan(w2v).by_rule().get("ALK103") == 1
+
+
+@pytest.mark.parametrize("name,factory", _WORKLOADS)
+def test_workload_engines_bit_identical(name, factory, monkeypatch):
+    """The acceptance pin: each newly-routed workload produces bit-identical
+    embeddings on the host engine, the routed APS, and routed+hot-key-cache
+    at equal seed."""
+    monkeypatch.setenv("ALINK_HUGE_ENGINE", "host")
+    monkeypatch.delenv("ALINK_APS_HOT_ROWS", raising=False)
+    host = _collect_vecs(factory)
+    monkeypatch.setenv("ALINK_HUGE_ENGINE", "sharded")
+    routed = _collect_vecs(factory)
+    monkeypatch.setenv("ALINK_APS_HOT_ROWS", "3")
+    cached = _collect_vecs(factory)
+    assert set(host) == set(routed) == set(cached)
+    for w in host:
+        np.testing.assert_array_equal(host[w], routed[w],
+                                      err_msg=f"{name}:{w} host vs routed")
+        np.testing.assert_array_equal(routed[w], cached[w],
+                                      err_msg=f"{name}:{w} routed vs cached")

@@ -181,6 +181,66 @@ def test_aps_gather_reference_collective_bytes_grow(mode):
     assert routed_big < gathered[ms[-1]] / 2, (routed_big, gathered)
 
 
+# ---------------------------------------------------------------------------
+# the REAL huge-embedding training loop (not the micro pull/push cycle):
+# per-device collective bytes ~constant in M for the routed engine, with and
+# without the hot-key cache; the host (gathered) engine grows ~linearly
+# ---------------------------------------------------------------------------
+
+def _sgns_loop_bytes(m, engine, hot=0):
+    """The canonical probe (shared with the BENCH `huge` extra — one
+    recipe, so the CI pin and the bench measure the same program)."""
+    from alink_tpu.embedding.engine import collective_bytes_probe
+
+    return collective_bytes_probe(m, engine, hot_rows=hot)
+
+
+@pytest.mark.parametrize("hot", [0, 16])
+def test_sgns_training_loop_collective_bytes_flat(hot):
+    """ROADMAP open item 2 at the workload level: the whole sharded-SGNS
+    training program (pull → grads → push per step, hot-key cache at
+    hot=16) keeps per-device steady-state collective bytes ~flat as the
+    model axis grows — the micro pull/push pin alone can't see a gather
+    sneaking into the composed loop."""
+    ms = _dp_values()
+    assert ms[-1] >= 4, "needs the 8-virtual-device CPU mesh"
+    got = {m: _sgns_loop_bytes(m, "sharded", hot) for m in ms if m >= 2}
+    base = got[ms[1]]
+    assert base > 0
+    for m in list(got)[1:]:
+        ratio = got[m] / base
+        assert ratio < 1.6, (got, ratio)
+
+
+def test_sgns_cached_loop_bytes_below_routed():
+    """The hot-key cache is a net byte reduction on the full mesh under the
+    Zipf frequency table (hot pulls never ride the wire; the replica
+    refresh costs a flat broadcast)."""
+    ms = _dp_values()
+    if ms[-1] < 4:
+        pytest.skip("needs a multi-device mesh")
+    m = ms[-1]
+    routed = _sgns_loop_bytes(m, "sharded", hot=0)
+    cached = _sgns_loop_bytes(m, "sharded", hot=16)
+    assert cached < routed, (cached, routed)
+
+
+def test_sgns_host_reference_bytes_grow():
+    """Sensitivity check: the host engine's gathered updates DO grow
+    ~linearly in M, so the flat routed curve is signal, not a blind
+    meter."""
+    ms = [m for m in _dp_values() if m >= 2]
+    if len(ms) < 2:
+        pytest.skip("needs ≥4 devices")
+    got = {m: _sgns_loop_bytes(m, "host") for m in ms}
+    growth = got[ms[-1]] / got[ms[0]]
+    expected = ms[-1] / ms[0]
+    assert growth > 0.6 * expected, (got, growth)
+    # and the routed engine beats the host engine outright at full scale
+    routed_big = _sgns_loop_bytes(ms[-1], "sharded")
+    assert routed_big < got[ms[-1]], (routed_big, got)
+
+
 def test_staged_arrays_actually_sharded():
     """Each device holds n/dp rows — full replication would hold n."""
     from alink_tpu.parallel.comqueue import shard_rows
