@@ -18,8 +18,10 @@ from .common import (  # noqa: F401
     AkException,
     AkRetryableException,
     AlinkTypes,
+    BackpressureController,
     DenseMatrix,
     DenseVector,
+    ElasticStreamJob,
     FaultSpec,
     MTable,
     Params,

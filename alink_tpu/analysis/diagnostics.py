@@ -80,6 +80,11 @@ RULES: Dict[str, tuple] = {
     "ALK106": ("schema-underivable", INFO,
                "static output schema could not be derived for a node; "
                "downstream schema checks were skipped"),
+    "ALK107": ("missing-partition-hook", WARNING,
+               "stateful stream op without keyed-state hooks "
+               "(state_partition/state_merge) in a job that requests "
+               "elastic parallelism — its state cannot be redistributed "
+               "across a rescale; ElasticStreamJob refuses it at build"),
 }
 
 

@@ -407,7 +407,7 @@ def _validate_batch(roots: Sequence[Any], report: Report) -> None:
 
 
 def _validate_stream(roots: Sequence[Any], report: Report,
-                     recovery: bool = False) -> None:
+                     recovery: bool = False, elastic: bool = False) -> None:
     from ..common.jitcache import bucket_rows
 
     order = _collect_batch(roots)  # same _inputs shape
@@ -425,6 +425,18 @@ def _validate_stream(roots: Sequence[Any], report: Report,
                 hint="add the snapshot hooks (move generator-local state "
                      "onto the instance) or run the op outside "
                      "run_with_recovery")
+        if elastic and _stateful_without_partition_hooks(op):
+            report.add(
+                "ALK107",
+                f"{type(op).__name__} has snapshot hooks but no keyed-"
+                "state hooks (state_partition/state_merge); an elastic "
+                "job cannot redistribute its state across a parallelism "
+                "change",
+                where=label,
+                severity=ERROR if recovery else "",
+                hint="implement state_partition/state_merge (key-range "
+                     "split/merge), or mix in GlobalElasticStateMixin "
+                     "for unkeyed accumulator state")
         try:
             p = op.get_params()
             cs = p.get("chunkSize") if p.contains("chunkSize") else None
@@ -440,6 +452,15 @@ def _validate_stream(roots: Sequence[Any], report: Report,
                 hint=f"use a ladder size (e.g. "
                      f"floor_bucket_rows({int(cs)})="
                      f"{_floor(int(cs))}) so steady chunks ship unpadded")
+
+
+def _stateful_without_partition_hooks(op) -> bool:
+    from ..operator.stream.base import StreamOperator
+
+    if getattr(op, "_stateful_unhooked", False):
+        return False  # already an ALK104 finding; don't double-report
+    stateful = type(op).state_snapshot is not StreamOperator.state_snapshot
+    return stateful and not getattr(op, "_elastic_hooks", False)
 
 
 def _floor(n: int) -> int:
@@ -520,7 +541,8 @@ def _pipeline_tail(stages, op, report: Report):
 # ---------------------------------------------------------------------------
 
 
-def validate_plan(target, data=None, *, recovery: bool = False) -> Report:
+def validate_plan(target, data=None, *, recovery: bool = False,
+                  elastic: bool = False) -> Report:
     """Statically validate a deferred plan before running it.
 
     ``target`` may be a batch :class:`AlgoOperator` (or a list of them — the
@@ -551,7 +573,7 @@ def validate_plan(target, data=None, *, recovery: bool = False) -> Report:
         return report
     report.target = ", ".join(sorted({type(r).__name__ for r in roots}))
     if isinstance(roots[0], StreamOperator):
-        _validate_stream(roots, report, recovery=recovery)
+        _validate_stream(roots, report, recovery=recovery, elastic=elastic)
     elif isinstance(roots[0], AlgoOperator):
         _validate_batch(roots, report)
     else:
@@ -599,7 +621,8 @@ def _record_report(report: Report, mode: str) -> None:
 
 
 def preflight(target, data=None, *, where: str = "execute",
-              recovery: bool = False) -> Optional[Report]:
+              recovery: bool = False,
+              elastic: bool = False) -> Optional[Report]:
     """The opt-in pre-flight hook ``execute()``/``collect()``/``fit()``
     call (and ``RecoverableStreamJob`` with ``recovery=True``, which
     escalates ALK104 to error severity). ``off`` → None without walking
@@ -615,7 +638,8 @@ def preflight(target, data=None, *, where: str = "execute",
     if mode == "off" or getattr(_suppressed, "depth", 0):
         return None
     try:
-        report = validate_plan(target, data, recovery=recovery)
+        report = validate_plan(target, data, recovery=recovery,
+                               elastic=elastic)
     except Exception as e:
         metrics.incr("analysis.validator_errors")
         logger.debug("plan validator failed at %s: %r", where, e)

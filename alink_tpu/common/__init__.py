@@ -78,3 +78,13 @@ from .recovery import (
     recovery_summary,
     run_with_recovery,
 )
+
+# elastic rescaling on the epoch runtime (builds on recovery above)
+from .elastic import (
+    BackpressureController,
+    ElasticCoordinator,
+    ElasticStreamJob,
+    elastic_summary,
+    key_group,
+    partition_ranges,
+)

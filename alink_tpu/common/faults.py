@@ -14,6 +14,18 @@ Injection points wired into the runtime:
   (``common/streaming.py``).
 - ``io``        — connector poll/read/write calls (Kafka/DataHub source
   polls and sinks, ODPS read/write, HBase batch gets).
+- ``recovery``  — the epoch runtime (``common/recovery.py``): per-chunk
+  delivery (labels ``chunkN``) and the epoch cut (labels
+  ``epochN.pre_snapshot`` / ``epochN.pre_commit``).
+- ``rescale``   — the elastic rescale sequence (``common/elastic.py``),
+  labels ``epochN.pre_redistribute`` (before old instances partition
+  their state), ``epochN.mid_redistribute`` (state split, snapshot not
+  yet committed), ``epochN.pre_resume`` (snapshot committed at the new
+  parallelism, new chain set not yet running). With ``kinds=crash`` these
+  make crash-during-rescale drills deterministic: a kill before the
+  manifest commit restarts at the OLD parallelism (the rescale simply
+  never happened), a kill after it resumes at the NEW one — either way
+  bit-identical output.
 
 Spec grammar (``ALINK_FAULT_SPEC``)::
 

@@ -75,6 +75,14 @@ logger = logging.getLogger("alink_tpu.recovery")
 _END = object()  # source-exhausted sentinel inside the shared reader
 
 
+class _RescaleInterrupt(BaseException):
+    """Raised inside parked chain generators when the elastic coordinator
+    tears a generation down at a quiescent epoch barrier (rescale). A
+    BaseException on purpose: it must unwind straight through operator
+    generators — skipping their end-of-stream flush code — and through any
+    ``except Exception`` an op might hold, exactly like GeneratorExit."""
+
+
 # ---------------------------------------------------------------------------
 # Durable snapshot store
 # ---------------------------------------------------------------------------
@@ -442,6 +450,7 @@ class _SharedSourceReader:
         self._done = [False] * n_consumers
         self._waiting: List[Optional[int]] = [None] * n_consumers
         self._error: Optional[BaseException] = None
+        self._interrupted = False
         self.replayed = 0
 
     @property
@@ -462,9 +471,33 @@ class _SharedSourceReader:
 
     def mark_done(self, cid: int) -> None:
         with self._cv:
-            self._done[cid] = True
-            self._waiting[cid] = None
+            if cid < len(self._done):
+                self._done[cid] = True
+                self._waiting[cid] = None
             self._cv.notify_all()
+
+    # -- elastic generation teardown/rebuild (rescale at a barrier) --------
+    def interrupt(self) -> None:
+        """Unwind every parked consumer with :class:`_RescaleInterrupt`.
+        Only called while all consumers are quiescent at an epoch barrier;
+        the workers exit without running their chains' end-of-stream
+        flush, and :meth:`resize` re-arms the reader for the new set."""
+        with self._cv:
+            self._interrupted = True
+            self._cv.notify_all()
+
+    def resize(self, n_consumers: int, pos: int) -> None:
+        """Re-arm for a new consumer generation, every consumer starting
+        at absolute chunk ``pos`` (the committed epoch boundary). The
+        source iterator, delivered-chunk accounting, and budget carry
+        over untouched."""
+        with self._cv:
+            self._interrupted = False
+            self._pos = [int(pos)] * n_consumers
+            self._done = [False] * n_consumers
+            self._waiting: List[Optional[int]] = [None] * n_consumers
+            for k in [k for k in self._buf if k < pos]:
+                del self._buf[k]
 
     def _pull_to(self, idx: int) -> None:  # lock held
         while self._end is None and self._next_abs <= idx:
@@ -486,6 +519,8 @@ class _SharedSourceReader:
     def get(self, cid: int, idx: int):
         with self._cv:
             while True:
+                if self._interrupted:
+                    raise _RescaleInterrupt()
                 if self._error is not None:
                     raise self._error
                 if self._end is not None and idx >= self._end:
@@ -547,15 +582,10 @@ class CheckpointCoordinator:
                                             keep=job.keep_snapshots)
 
     # -- restore -------------------------------------------------------------
-    def _restore(self, summary: Dict[str, Any]) -> Tuple[int, int]:
-        """Apply the latest snapshot; returns (first epoch to run, source
-        chunk offset to resume from — the manifest's persisted offset, the
-        one source of truth for what the restored state already covers)."""
-        loaded = self.store.load_latest()
-        if loaded is None:
-            return 0, 0
-        t0 = time.perf_counter()
-        epoch, manifest, blob = loaded
+    def _fence_manifest(self, manifest: Dict[str, Any]) -> None:
+        """Refuse a snapshot cut under a different job configuration
+        (overridable: the elastic coordinator adds key-space fences and
+        reads the manifest's parallelism here)."""
         if manifest.get("epoch_chunks") != self.job.epoch_chunks:
             # epoch numbering and budgets assume one uniform epoch size for
             # the job's whole life; resuming with a different size would
@@ -565,6 +595,30 @@ class CheckpointCoordinator:
                 f"{manifest.get('epoch_chunks')} but the job was rebuilt "
                 f"with epoch_chunks={self.job.epoch_chunks}; restart with "
                 "the original value")
+
+    def _apply_operator_states(self, blob: Dict[str, Any]) -> None:
+        """Re-seed fresh operator instances from the snapshot blob
+        (overridable: the elastic coordinator defers this to its
+        generation build, where instances exist per partition)."""
+        op_states = blob.get("operators", {})
+        ops = dict(self.job.iter_ops())
+        for key, state in op_states.items():
+            if key not in ops:
+                raise AkIllegalStateException(
+                    f"snapshot state for {key!r} has no matching operator; "
+                    "restart needs the same job topology")
+            ops[key].state_restore(state)
+
+    def _restore(self, summary: Dict[str, Any]) -> Tuple[int, int]:
+        """Apply the latest snapshot; returns (first epoch to run, source
+        chunk offset to resume from — the manifest's persisted offset, the
+        one source of truth for what the restored state already covers)."""
+        loaded = self.store.load_latest()
+        if loaded is None:
+            return 0, 0
+        t0 = time.perf_counter()
+        epoch, manifest, blob = loaded
+        self._fence_manifest(manifest)
         metrics.incr("checkpoint.restores")
         summary["restored"] = True
         summary["restored_epoch"] = epoch
@@ -583,27 +637,34 @@ class CheckpointCoordinator:
         if manifest.get("complete"):
             summary["complete"] = True
             return epoch + 1, next_offset
-        op_states = blob.get("operators", {})
-        ops = dict(self.job.iter_ops())
-        for key, state in op_states.items():
-            if key not in ops:
-                raise AkIllegalStateException(
-                    f"snapshot state for {key!r} has no matching operator; "
-                    "restart needs the same job topology")
-            ops[key].state_restore(state)
+        self._apply_operator_states(blob)
         metrics.add_time("recovery.restore_s", time.perf_counter() - t0)
         return epoch + 1, next_offset
 
     # -- epoch cut -----------------------------------------------------------
-    def _cut_epoch(self, epoch: int, next_offset: int, final: bool) -> None:
+    def _gather_op_states(self) -> Dict[str, Any]:
+        """Per-logical-op snapshot payloads for the epoch blob
+        (overridable: the elastic coordinator stores key-range-partitioned
+        parts instead of one blob per op)."""
+        op_states: Dict[str, Any] = {}
+        for key, op in self.job.iter_ops():
+            snap = op.state_snapshot()
+            if snap is not None:
+                op_states[key] = snap
+        return op_states
+
+    def _manifest_extra(self) -> Dict[str, Any]:
+        """Extra manifest fields (overridable: the elastic coordinator
+        records parallelism / key-space config here)."""
+        return {}
+
+    def _cut_epoch(self, epoch: int, next_offset: int, final: bool,
+                   op_states: Optional[Dict[str, Any]] = None) -> None:
         with trace_span("recovery.epoch", epoch=epoch) as sp:
             t0 = time.perf_counter()
             maybe_fail("recovery", label=f"epoch{epoch}.pre_snapshot")
-            op_states: Dict[str, Any] = {}
-            for key, op in self.job.iter_ops():
-                snap = op.state_snapshot()
-                if snap is not None:
-                    op_states[key] = snap
+            if op_states is None:
+                op_states = self._gather_op_states()
             sinks = self.job.all_sinks()
             staged = {s.sink_id: s.staged() for s in sinks}
             manifest = {
@@ -614,6 +675,7 @@ class CheckpointCoordinator:
                           {"committed": s.committed_epoch(self.store)}
                           for s in sinks},
             }
+            manifest.update(self._manifest_extra())
             self.store.write_snapshot(
                 epoch, manifest, {"operators": op_states, "sinks": staged})
             dt_snap = time.perf_counter() - t0
@@ -774,7 +836,12 @@ def run_with_recovery(
     attempt = 0
     while True:
         try:
-            return CheckpointCoordinator(job_factory()).run()
+            job = job_factory()
+            # jobs pick their coordinator: ElasticStreamJob routes to the
+            # rescale-capable ElasticCoordinator (common/elastic.py)
+            coord_cls = getattr(job, "_coordinator_cls",
+                                None) or CheckpointCoordinator
+            return coord_cls(job).run()
         except BaseException as exc:
             attempt += 1
             if not retries_enabled() or attempt >= policy.max_attempts \

@@ -94,6 +94,67 @@ class StreamOperator(WithParams):
             f"{type(self).__name__} does not support operator-state "
             "restore (no state_snapshot/state_restore override)")
 
+    # -- keyed-state partitioning (elastic rescaling, common/elastic.py) ----
+    # The elastic runtime shards the key space [0, num_key_groups) into
+    # contiguous hash ranges, one per parallel partition (Flink's key-group
+    # design: the key group is the atom of state redistribution, so results
+    # are invariant to the parallelism that happens to host it). Stateful
+    # ops opt in by setting ``_elastic_hooks = True`` and implementing
+    # state_partition/state_merge; ops whose state is keyed by the job's
+    # key column additionally report True from ``_elastic_keyed_impl`` so
+    # the runtime routes rows by hash instead of pinning the whole chain.
+
+    # True on ops implementing the partition/merge hooks below (directly or
+    # via GlobalElasticStateMixin); the elastic job refuses stateful ops
+    # without them (plan-time analog: rule ALK107).
+    _elastic_hooks = False
+
+    # (key_col, num_key_groups) installed by the elastic runtime before any
+    # data flows; None under the plain/recovery runtimes (single key group).
+    _key_ctx = None
+    _elastic_pin = 0
+
+    def set_key_context(self, key_col: Optional[str], num_key_groups: int,
+                        pin_group: int = 0) -> None:
+        """Called by the elastic runtime on fresh instances: ``key_col`` is
+        the routing column for keyed chains (None for pinned/global
+        chains), ``pin_group`` the key group a global op's whole state
+        rides with."""
+        self._key_ctx = (key_col, int(num_key_groups)) if key_col else None
+        self._elastic_pin = int(pin_group)
+
+    def elastic_keyed(self, key_col: str) -> bool:
+        """Can this op's rows be routed by hash(``key_col``) with per-key
+        semantics preserved? Stateless ops trivially can; stateful ops
+        answer via ``_elastic_keyed_impl`` (windows: yes iff the key
+        column is one of their group columns; global accumulators: no)."""
+        if type(self).state_snapshot is StreamOperator.state_snapshot:
+            return True
+        return bool(self._elastic_keyed_impl(key_col))
+
+    def _elastic_keyed_impl(self, key_col: str) -> bool:
+        return False
+
+    def state_partition(self, key_ranges) -> List[Optional[dict]]:
+        """Split the current state into one blob per ``[lo, hi)`` key-group
+        range (None for ranges this op holds nothing in). Called only
+        while the operator is quiescent at an epoch barrier. Invariant:
+        ``state_merge(state_partition(ranges))`` on a fresh instance must
+        reproduce the state bit-for-bit."""
+        raise AkIllegalOperationException(
+            f"{type(self).__name__} has no keyed-state hooks "
+            "(state_partition/state_merge); it cannot run under elastic "
+            "parallelism")
+
+    def state_merge(self, blobs) -> None:
+        """Adopt the union of ``blobs`` (disjoint key-range parts produced
+        by state_partition, possibly from several old instances) as this
+        fresh instance's state. An empty list is a no-op."""
+        raise AkIllegalOperationException(
+            f"{type(self).__name__} has no keyed-state hooks "
+            "(state_partition/state_merge); it cannot run under elastic "
+            "parallelism")
+
     # -- wiring ------------------------------------------------------------
     def _stream(self) -> Iterator[MTable]:
         """The operator's (shareable) output iterator; tee'd per consumer."""
@@ -135,7 +196,44 @@ class StreamOperator(WithParams):
         return self
 
 
-class CumulativeEvalStateMixin:
+class GlobalElasticStateMixin:
+    """Keyed-state hooks for ops whose cross-chunk state is GLOBAL — one
+    accumulator over the whole stream (FTRL/OnlineFm device state,
+    cumulative eval counters, the legacy single-session window). The state
+    cannot be split by key hash, so the whole blob rides ONE key group
+    (``_elastic_pin``, chosen per chain by the elastic job): at any
+    parallelism exactly one partition owns that group, rows reach it in
+    source order, and a rescale MOVES the state to the new owner instead
+    of splitting it — the degenerate but exact case of hash-range
+    redistribution (Flink's max-parallelism-1 operator analog)."""
+
+    _elastic_hooks = True
+
+    def _elastic_keyed_impl(self, key_col: str) -> bool:
+        return False
+
+    def state_partition(self, key_ranges) -> List[Optional[dict]]:
+        pin = int(getattr(self, "_elastic_pin", 0) or 0)
+        blobs: List[Optional[dict]] = [None] * len(key_ranges)
+        for i, (lo, hi) in enumerate(key_ranges):
+            if lo <= pin < hi:
+                blobs[i] = self.state_snapshot()
+        return blobs
+
+    def state_merge(self, blobs) -> None:
+        live = [b for b in blobs if b is not None]
+        if not live:
+            return
+        if len(live) > 1:
+            raise AkIllegalStateException(
+                f"{type(self).__name__} holds global (unkeyed) state; a "
+                f"merge of {len(live)} non-empty parts means two "
+                "partitions owned it at once — the redistribution is "
+                "corrupt")
+        self.state_restore(live[0])
+
+
+class CumulativeEvalStateMixin(GlobalElasticStateMixin):
     """Shared snapshot/restore hooks for cumulative eval streams: a window
     counter plus per-series row history (series names in ``_eval_series``).
     History compacts to one array per series at snapshot time — exact

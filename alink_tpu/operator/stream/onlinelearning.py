@@ -32,7 +32,8 @@ from ...mapper import (
     resolve_feature_cols,
 )
 from ..batch.linear import LinearModelMapper
-from .base import ModelMapStreamOp, StreamOperator
+from .base import (GlobalElasticStateMixin, ModelMapStreamOp,
+                   StreamOperator)
 
 # warm-up chunks buffer host-side until both classes arrive; bound the
 # buffer so a one-label stream fails fast instead of accumulating RAM
@@ -93,9 +94,15 @@ class HasFtrlParams(HasVectorCol, HasFeatureCols):
     )
 
 
-class FtrlTrainStreamOp(StreamOperator, HasFtrlParams):
+class FtrlTrainStreamOp(GlobalElasticStateMixin, StreamOperator,
+                        HasFtrlParams):
     """Streaming FTRL logistic regression; emits model snapshot tables.
-    Warm-starts from a batch-trained linear model when given one."""
+    Warm-starts from a batch-trained linear model when given one.
+
+    Elastic: the (z, n) accumulators are one global model — the state
+    rides a pinned key group (GlobalElasticStateMixin), so a rescale
+    moves the accumulators whole to the new owner partition and the
+    resumed stream is bit-identical to a fixed-parallelism run."""
 
     _min_inputs = 1
     _max_inputs = 1
@@ -368,7 +375,8 @@ def _build_fm_update(lr: float):
     return update
 
 
-class OnlineFmTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols):
+class OnlineFmTrainStreamOp(GlobalElasticStateMixin, StreamOperator,
+                            HasVectorCol, HasFeatureCols):
     """Streaming factorization machine (binary) with AdaGrad updates; emits
     FmModel snapshot tables servable by FmPredict (reference:
     operator/stream/onlinelearning OnlineFM ops over the FtrlOnlineFm

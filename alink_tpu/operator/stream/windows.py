@@ -104,44 +104,115 @@ class _TimeWindowBase(StreamOperator):
     # open-window buffers live on the instance (not generator locals) so an
     # epoch snapshot can persist them and a restored job resumes mid-stream
     # with its windows still open (closed windows were already emitted and
-    # committed, so they are never re-cut).
+    # committed, so they are never re-cut). State is structured PER KEY
+    # GROUP ({kg: {"buffers": {start: rows}, "wm": watermark}}): under the
+    # elastic runtime a key group's buffers AND its watermark depend only
+    # on that group's own sub-stream, so window close timing — and thus
+    # content, even with late rows — is invariant to the parallelism that
+    # hosts the group, and a rescale redistributes whole key groups.
+    # Outside the elastic runtime every row lands in key group 0, which is
+    # byte-for-byte the old single-watermark behavior.
+    _elastic_hooks = True
+
+    def _elastic_keyed_impl(self, key_col: str) -> bool:
+        return key_col in (self.get(self.GROUP_COLS) or [])
+
     def _win_state(self) -> dict:
         st = getattr(self, "_wstate", None)
         if st is None:
-            st = self._wstate = {"buffers": {}, "watermark": -np.inf,
-                                 "schema": None}
+            st = self._wstate = {"kg": {}, "schema": None}
         return st
+
+    def _row_key_groups(self, chunk) -> Optional[List[int]]:
+        ctx = self._key_ctx
+        if not ctx:
+            return None
+        # the elastic runner stamps single-key-group sub-chunks it routed
+        # (the rows were hashed once at split time — don't re-hash them)
+        kg = getattr(chunk, "_elastic_kg", None)
+        if kg is not None:
+            return [kg] * chunk.num_rows
+        from ...common.elastic import key_group
+
+        key_col, g = ctx
+        return [key_group(v, g) for v in chunk.col(key_col)]
 
     def state_snapshot(self) -> dict:
         st = self._win_state()
-        return {"buffers": {k: list(v) for k, v in st["buffers"].items()},
-                "watermark": st["watermark"], "schema": st["schema"]}
+        return {"kg": {kg: {"buffers": {w: list(rows) for w, rows
+                                        in g["buffers"].items()},
+                            "wm": g["wm"]}
+                       for kg, g in st["kg"].items()},
+                "schema": st["schema"]}
 
     def state_restore(self, state: dict) -> None:
+        if "kg" not in state and "buffers" in state:  # pre-elastic layout
+            state = {"kg": {0: {"buffers": state["buffers"],
+                                "wm": state["watermark"]}},
+                     "schema": state["schema"]}
         self._wstate = {
-            "buffers": {k: list(v) for k, v in state["buffers"].items()},
-            "watermark": state["watermark"], "schema": state["schema"]}
+            "kg": {kg: {"buffers": {w: list(rows) for w, rows
+                                    in g["buffers"].items()},
+                        "wm": g["wm"]}
+                   for kg, g in state["kg"].items()},
+            "schema": state["schema"]}
+
+    def state_partition(self, key_ranges) -> List[Optional[dict]]:
+        st = self._win_state()
+        out: List[Optional[dict]] = []
+        for lo, hi in key_ranges:
+            sub = {kg: g for kg, g in st["kg"].items() if lo <= kg < hi}
+            out.append({"kg": {kg: {"buffers": dict(g["buffers"]),
+                                    "wm": g["wm"]}
+                               for kg, g in sub.items()},
+                        "schema": st["schema"]} if sub else None)
+        return out
+
+    def state_merge(self, blobs) -> None:
+        st = self._win_state()
+        for blob in blobs:
+            if blob is None:
+                continue
+            for kg, g in blob["kg"].items():
+                if kg in st["kg"]:
+                    raise AkIllegalArgumentException(
+                        f"key group {kg} appears in two state parts; the "
+                        "redistribution handed one group to two owners")
+                st["kg"][kg] = {"buffers": dict(g["buffers"]),
+                                "wm": g["wm"]}
+            if blob.get("schema") is not None:
+                st["schema"] = blob["schema"]
 
     def _stream_impl(self, it: Iterator[MTable]) -> Iterator[MTable]:
         time_col = self.get(self.TIME_COL)
         st = self._win_state()
-        buffers: Dict[float, List[tuple]] = st["buffers"]
+        kg_map: Dict[int, dict] = st["kg"]
         for chunk in it:
             st["schema"] = chunk.schema
             times = [_parse_time(v) for v in chunk.col(time_col)]
-            for row, ts in zip(chunk.rows(), times):
+            groups = self._row_key_groups(chunk)
+            if groups is None:
+                groups = [0] * len(times)
+            touched = set()
+            for row, ts, kg in zip(chunk.rows(), times, groups):
+                g = kg_map.setdefault(kg, {"buffers": {}, "wm": -np.inf})
                 for w in self._windows_of(ts):
-                    buffers.setdefault(w, []).append(tuple(row))
-            st["watermark"] = max(st["watermark"],
-                                  max(times, default=st["watermark"]))
-            closed = [w for w in buffers
-                      if self._window_end(w) <= st["watermark"]]
-            for w in sorted(closed):
-                yield self._aggregate(w, buffers.pop(w), st["schema"])
-        for w in sorted(buffers):  # flush at end-of-stream
-            rows = buffers.pop(w)  # emitted → off the instance, so the
-            if rows and st["schema"] is not None:  # final snapshot doesn't
-                yield self._aggregate(w, rows, st["schema"])  # re-pickle it
+                    g["buffers"].setdefault(w, []).append(tuple(row))
+                g["wm"] = max(g["wm"], ts)
+                touched.add(kg)
+            for kg in sorted(touched):
+                g = kg_map[kg]
+                closed = [w for w in g["buffers"]
+                          if self._window_end(w) <= g["wm"]]
+                for w in sorted(closed):
+                    yield self._aggregate(w, g["buffers"].pop(w),
+                                          st["schema"])
+        for kg in sorted(kg_map):  # flush at end-of-stream, key groups in
+            g = kg_map[kg]         # ascending order (parallelism-invariant
+            for w in sorted(g["buffers"]):  # merged with partition ranges)
+                rows = g["buffers"].pop(w)  # emitted → off the instance, so
+                if rows and st["schema"] is not None:  # the final snapshot
+                    yield self._aggregate(w, rows, st["schema"])
 
 
 class TumbleTimeWindowStreamOp(_TimeWindowBase):
@@ -195,26 +266,97 @@ class SessionTimeWindowStreamOp(StreamOperator):
     _max_inputs = 1
 
     # the open session buffers on the instance for epoch snapshots, same
-    # contract as _TimeWindowBase
+    # contract as _TimeWindowBase. Two state layouts: the legacy one open
+    # session per whole stream (plain/recovery runtimes, byte-for-byte the
+    # pre-elastic behavior), and — under the elastic runtime with a key
+    # context installed — per-(key group, group-key) sessions: each group
+    # value sessionizes independently, which is both the real per-user
+    # session semantics and what makes session state redistributable by
+    # hash range with parallelism-invariant results.
+    _elastic_hooks = True
+
+    def _elastic_keyed_impl(self, key_col: str) -> bool:
+        return key_col in (self.get(self.GROUP_COLS) or [])
+
+    def _keyed(self) -> bool:
+        return self._key_ctx is not None
+
     def _win_state(self) -> dict:
         st = getattr(self, "_wstate", None)
         if st is None:
-            st = self._wstate = {"cur": [], "cur_start": None,
-                                 "cur_last": None, "schema": None}
+            if self._keyed():
+                st = self._wstate = {"kg": {}, "schema": None}
+            else:
+                st = self._wstate = {"cur": [], "cur_start": None,
+                                     "cur_last": None, "schema": None}
         return st
 
     def state_snapshot(self) -> dict:
         st = self._win_state()
+        if "kg" in st:
+            return {"kg": {kg: {gk: {"rows": list(s["rows"]),
+                                     "start": s["start"], "last": s["last"]}
+                                for gk, s in sess.items()}
+                           for kg, sess in st["kg"].items()},
+                    "schema": st["schema"]}
         return {"cur": list(st["cur"]), "cur_start": st["cur_start"],
                 "cur_last": st["cur_last"], "schema": st["schema"]}
 
     def state_restore(self, state: dict) -> None:
+        if "kg" in state:
+            self._wstate = {
+                "kg": {kg: {gk: {"rows": list(s["rows"]),
+                                 "start": s["start"], "last": s["last"]}
+                            for gk, s in sess.items()}
+                       for kg, sess in state["kg"].items()},
+                "schema": state["schema"]}
+            return
         self._wstate = {"cur": list(state["cur"]),
                         "cur_start": state["cur_start"],
                         "cur_last": state["cur_last"],
                         "schema": state["schema"]}
 
+    def state_partition(self, key_ranges) -> List[Optional[dict]]:
+        st = self._win_state()
+        if "kg" not in st:
+            # legacy single global session: the whole state rides the
+            # pinned key group, exactly like GlobalElasticStateMixin
+            pin = int(getattr(self, "_elastic_pin", 0) or 0)
+            return [self.state_snapshot()
+                    if lo <= pin < hi else None for lo, hi in key_ranges]
+        out: List[Optional[dict]] = []
+        for lo, hi in key_ranges:
+            sub = {kg: sess for kg, sess in st["kg"].items()
+                   if lo <= kg < hi}
+            out.append({"kg": {kg: dict(sess) for kg, sess in sub.items()},
+                        "schema": st["schema"]} if sub else None)
+        return out
+
+    def state_merge(self, blobs) -> None:
+        live = [b for b in blobs if b is not None]
+        if not live:
+            return
+        if any("kg" not in b for b in live):
+            if len(live) > 1:
+                raise AkIllegalArgumentException(
+                    "global session state merged from two owners; the "
+                    "redistribution is corrupt")
+            self.state_restore(live[0])
+            return
+        st = self._win_state()
+        for blob in live:
+            for kg, sess in blob["kg"].items():
+                if kg in st["kg"]:
+                    raise AkIllegalArgumentException(
+                        f"key group {kg} appears in two state parts")
+                st["kg"][kg] = {gk: dict(s) for gk, s in sess.items()}
+            if blob.get("schema") is not None:
+                st["schema"] = blob["schema"]
+
     def _stream_impl(self, it: Iterator[MTable]) -> Iterator[MTable]:
+        if self._keyed():
+            yield from self._stream_keyed(it)
+            return
         gap = float(self.get(self.SESSION_GAP_TIME))
         time_col = self.get(self.TIME_COL)
         # one open session at a time per whole stream (grouped sessions
@@ -253,6 +395,52 @@ class SessionTimeWindowStreamOp(StreamOperator):
         out = flush()
         if out is not None:
             yield out
+
+    def _stream_keyed(self, it: Iterator[MTable]) -> Iterator[MTable]:
+        """Per-group sessionization under the elastic runtime: each group
+        value (e.g. one user) keeps its own open session inside its key
+        group; a session closes when that group's next row arrives past
+        the gap, or at end-of-stream — decisions that depend only on the
+        group's own sub-stream, so output is parallelism-invariant."""
+        from ...common.elastic import key_group
+
+        key_col, num_groups = self._key_ctx
+        gap = float(self.get(self.SESSION_GAP_TIME))
+        time_col = self.get(self.TIME_COL)
+        gcols = self.get(self.GROUP_COLS) or []
+        st = self._win_state()
+        agg = _TimeWindowBase._aggregate
+        for chunk in it:
+            st["schema"] = chunk.schema
+            gidx = [chunk.names.index(c) for c in gcols]
+            times = [_parse_time(v) for v in chunk.col(time_col)]
+            stamped = getattr(chunk, "_elastic_kg", None)
+            keys = None if stamped is not None else chunk.col(key_col)
+            rows = list(chunk.rows())
+            # stable sort: ties keep source order, so a key group's row
+            # sequence is identical no matter which partition hosts it
+            order = np.argsort(times, kind="stable")
+            for i in order:
+                ts = times[i]
+                kg = stamped if stamped is not None \
+                    else key_group(keys[i], num_groups)
+                sess = st["kg"].setdefault(kg, {})
+                gkey = tuple(rows[i][j] for j in gidx)
+                s = sess.get(gkey)
+                if s is not None and ts - s["last"] > gap:
+                    yield agg(self, s["start"], s["rows"], st["schema"])
+                    del sess[gkey]
+                    s = None
+                if s is None:
+                    s = sess[gkey] = {"rows": [], "start": ts, "last": ts}
+                s["rows"].append(tuple(rows[i]))
+                s["last"] = ts
+        for kg in sorted(st["kg"]):  # flush: key groups ascending, groups
+            sess = st["kg"][kg]      # in a deterministic string order
+            for gkey in sorted(sess, key=lambda t: [str(x) for x in t]):
+                s = sess.pop(gkey)
+                if s["rows"] and st["schema"] is not None:
+                    yield agg(self, s["start"], s["rows"], st["schema"])
 
 
 class WindowGroupByStreamOp(StreamOperator):

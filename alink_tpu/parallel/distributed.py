@@ -22,6 +22,26 @@ from ..common.env import env_raw
 _initialized = False
 
 
+def _enable_cpu_collectives(jax) -> None:
+    """Multi-process on the CPU backend needs a real collectives transport:
+    without one, cluster formation succeeds but the first cross-process
+    computation dies with "Multiprocess computations aren't implemented on
+    the CPU backend". jaxlib ships a gloo TCP implementation behind the
+    ``jax_cpu_collectives_implementation`` flag (default "none") — flip it
+    to gloo before the CPU client is created. A no-op on TPU/GPU (the flag
+    only affects CPU client construction) and on jax versions without the
+    flag. Must run before the first backend touch; once the CPU client
+    exists the flag is read-only, so a late call logs and moves on."""
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:  # unknown flag (older/newer jax) or client built
+        import logging
+
+        logging.getLogger("alink_tpu.distributed").info(
+            "could not enable gloo CPU collectives; multi-process CPU "
+            "clusters may not support cross-process computations")
+
+
 def init_multi_host(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
@@ -52,6 +72,7 @@ def init_multi_host(
     should_init = (coordinator_address is not None
                    or (num_processes or 0) > 1)
     if should_init and not _initialized:
+        _enable_cpu_collectives(jax)
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,

@@ -378,11 +378,18 @@ class _Handler(BaseHTTPRequestHandler):
             # verdicts joined with measured exec timings (resolves any
             # pending captures — one lower() per new program, amortized),
             # plus the APS exchange / hot-key-cache health block
+            from ..common.elastic import elastic_summary
             from ..common.profiling import profile_summary
+            from ..common.recovery import recovery_summary
             from ..parallel.aps import aps_summary
 
             summ = profile_summary()
             summ["aps"] = aps_summary()
+            # streaming recovery + elastic rescaling health: epochs cut,
+            # restarts absorbed, rescale out/in/aborted events, current
+            # backpressure lag
+            summ["recovery"] = {**recovery_summary(),
+                                "elastic": elastic_summary()}
             return self._send_json(summ)
         if parts == ["analysis"]:
             # static-analysis panel: the last pre-flight plan report, the

@@ -912,6 +912,105 @@ def bench_recovery(rows=50_000):
     }
 
 
+def bench_elastic(rows=24_000):
+    """Elastic streaming (common/elastic.py): a sustained keyed windowed
+    stream under a load spike. The spike is injected into the
+    backpressure SIGNAL (a scripted queue-lag schedule standing in for a
+    live source's backlog — the data path, epoch runtime, and rescale
+    machinery are all real): the controller scales 2→4 under sustained
+    lag and back in when the spike passes. Reports rescale latency
+    (barrier→resume), chunks replayed, throughput before/during/after
+    the elastic window, and a bit-parity bit vs the fixed-parallelism
+    run."""
+    import tempfile
+
+    from alink_tpu.common import faults
+    from alink_tpu.common.elastic import (BackpressureController,
+                                          ElasticStreamJob, elastic_summary)
+    from alink_tpu.common.metrics import metrics
+    from alink_tpu.common.mtable import MTable
+    from alink_tpu.common.recovery import run_with_recovery
+    from alink_tpu.common.resilience import RetryPolicy
+    from alink_tpu.io.kafka import MemoryKafkaBroker
+    from alink_tpu.operator.stream import (KafkaSinkStreamOp,
+                                           TableSourceStreamOp)
+    from alink_tpu.operator.stream.windows import TumbleTimeWindowStreamOp
+
+    rng = np.random.RandomState(0)
+    t = MTable({"ts": np.arange(rows, dtype=np.float64),
+                "user": rng.randint(0, 64, rows).astype(np.int64),
+                "v": rng.rand(rows)})
+    chunk, epoch_chunks = 256, 4
+    spike_epochs = (5, 9)  # lag injected on these epochs (inclusive lo)
+
+    def chain():
+        return [TumbleTimeWindowStreamOp(
+            timeCol="ts", windowTime=float(chunk * 2), groupCols=["user"],
+            clause="sum(v) as sv, count(*) as c")]
+
+    def lag_fn(stats):
+        lo, hi = spike_epochs
+        if lo <= stats["epoch"] < hi:
+            return 5.0    # backlog: sustained lag → scale out
+        if stats["epoch"] < lo:
+            return 0.02   # keeping up: in the hysteresis band, P holds
+        return 0.0        # idle drain after the spike → scale back in
+
+    def job(tag, ckdir, controller):
+        return ElasticStreamJob(
+            source=TableSourceStreamOp(t, chunkSize=chunk),
+            chains=[(chain, [KafkaSinkStreamOp(
+                bootstrapServers=f"memory://bench-el-{tag}", topic="w")])],
+            checkpoint_dir=ckdir, key_col="user", parallelism=2,
+            epoch_chunks=epoch_chunks, controller=controller)
+
+    faults.clear()
+    MemoryKafkaBroker.named("bench-el-fixed")
+    t0 = time.perf_counter()
+    run_with_recovery(
+        lambda: job("fixed", tempfile.mkdtemp(prefix="alink-el-"), None),
+        RetryPolicy(max_attempts=3, base_delay=0.01))
+    fixed_wall = time.perf_counter() - t0
+
+    MemoryKafkaBroker.named("bench-el-auto")
+    t0 = time.perf_counter()
+    summary = run_with_recovery(
+        lambda: job("auto", tempfile.mkdtemp(prefix="alink-el-"),
+                    BackpressureController(target_chunk_s=0.05, patience=2,
+                                           cooldown_epochs=2,
+                                           lag_fn=lag_fn)),
+        RetryPolicy(max_attempts=3, base_delay=0.01))
+    auto_wall = time.perf_counter() - t0
+
+    parity = (MemoryKafkaBroker.named("bench-el-fixed")._topics.get("w")
+              == MemoryKafkaBroker.named("bench-el-auto")._topics.get("w"))
+
+    def seg_rows_per_s(stats, lo, hi):
+        eps = [e for e in stats if lo <= e["epoch"] < hi and e["chunks"]]
+        wall = sum(e["wall_s"] for e in eps)
+        return round(sum(e["chunks"] for e in eps) * chunk / wall, 1) \
+            if wall > 0 else None
+
+    es = summary["epoch_stats"]
+    lo, hi = spike_epochs
+    resc = metrics.timer_stats("recovery.rescale_s") or {}
+    return {
+        "rows": rows,
+        "fixed_wall_s": round(fixed_wall, 3),
+        "elastic_wall_s": round(auto_wall, 3),
+        "rescales": summary["rescales"],
+        "rescale_latency_ms": round(resc.get("mean_s", 0.0) * 1e3, 3),
+        "chunks_replayed": summary["replayed_chunks"],
+        "rows_per_s_before_spike": seg_rows_per_s(es, 0, lo),
+        "rows_per_s_during_spike": seg_rows_per_s(es, lo, hi + 2),
+        "rows_per_s_after_spike": seg_rows_per_s(
+            es, hi + 2, es[-1]["epoch"] + 1),
+        "max_parallelism_reached": max(e["parallelism"] for e in es),
+        "parity_bit_identical": parity,
+        "counters": elastic_summary(),
+    }
+
+
 def bench_compile():
     """Shape-stable execution layer (common/jitcache.py): the compile-tax
     readout tracked across BENCH rounds. Runs the kmeans_iris pipeline and a
@@ -1689,6 +1788,7 @@ def main(argv=None):
         ("executor", bench_executor),
         ("resilience", bench_resilience),
         ("recovery", bench_recovery),
+        ("elastic", bench_elastic),
         ("compile", bench_compile),
         ("coldstart", bench_coldstart),
         ("observability", bench_observability),

@@ -1,0 +1,498 @@
+"""Elastic exactly-once streaming: keyed-state repartitioning and
+backpressure-driven rescaling on the epoch runtime.
+
+Headline CI invariant (the ISSUE's acceptance bar): scale-out 2→4 and
+scale-in 4→2 mid-stream — manual schedule, backpressure-triggered, and
+crash-during-rescale under the ``rescale`` fault point — produce sink
+output bit-for-bit equal to an uninterrupted fixed-parallelism run, for
+FTRL, OnlineFm, all three window kinds, and the eval streams. The design
+makes results invariant to parallelism entirely (key groups are the atom
+of both routing and state redistribution), so fixed runs at different
+parallelism are pinned equal too.
+"""
+
+import numpy as np
+import pytest
+
+from alink_tpu.common import faults
+from alink_tpu.common.elastic import (BackpressureController,
+                                      ElasticCoordinator, ElasticStreamJob,
+                                      elastic_summary, key_group, owner_of,
+                                      partition_ranges)
+from alink_tpu.common.exceptions import (AkIllegalArgumentException,
+                                         AkIllegalStateException)
+from alink_tpu.common.faults import FaultSpec, InjectedCrashError
+from alink_tpu.common.metrics import metrics
+from alink_tpu.common.mtable import MTable
+from alink_tpu.common.recovery import run_with_recovery
+from alink_tpu.common.resilience import RetryPolicy
+from alink_tpu.io.datahub import MemoryDatahubService
+from alink_tpu.io.kafka import MemoryKafkaBroker
+from alink_tpu.operator.stream import (DatahubSinkStreamOp,
+                                       FtrlTrainStreamOp, KafkaSinkStreamOp,
+                                       TableSourceStreamOp)
+from alink_tpu.operator.stream.onlinelearning import OnlineFmTrainStreamOp
+from alink_tpu.operator.stream.windows import (EvalRegressionStreamOp,
+                                               HopTimeWindowStreamOp,
+                                               SessionTimeWindowStreamOp,
+                                               TumbleTimeWindowStreamOp)
+
+pytestmark = pytest.mark.elastic
+
+
+# ---------------------------------------------------------------------------
+# key groups
+# ---------------------------------------------------------------------------
+
+
+def test_partition_ranges_cover_key_space_contiguously():
+    for g, p in [(128, 1), (128, 2), (128, 3), (128, 7), (128, 128),
+                 (5, 5), (16, 4)]:
+        ranges = partition_ranges(g, p)
+        assert len(ranges) == p
+        assert ranges[0][0] == 0 and ranges[-1][1] == g
+        for (lo, hi), (lo2, _) in zip(ranges, ranges[1:]):
+            assert hi == lo2 and lo < hi
+        # every key group owned by exactly one partition
+        for kg in range(g):
+            owner_of(kg, ranges)
+    with pytest.raises(AkIllegalArgumentException):
+        partition_ranges(8, 9)
+    with pytest.raises(AkIllegalArgumentException):
+        partition_ranges(8, 0)
+
+
+def test_key_group_is_stable_and_in_range():
+    assert key_group("user-7", 128) == key_group("user-7", 128)
+    # int and numpy-int forms of the same key hash identically (str form)
+    assert key_group(42, 64) == key_group(np.int64(42), 64)
+    for v in range(1000):
+        assert 0 <= key_group(v, 16) < 16
+
+
+# ---------------------------------------------------------------------------
+# shared drill machinery
+# ---------------------------------------------------------------------------
+
+
+def _drill_table(n=200, users=9, seed=0):
+    rng = np.random.RandomState(seed)
+    return MTable({"ts": np.arange(n, dtype=np.float64),
+                   "user": rng.randint(0, users, n).astype(np.int64),
+                   "x0": rng.rand(n), "x1": rng.rand(n),
+                   "label": (rng.rand(n) > 0.5).astype(np.int64),
+                   "pred": rng.rand(n)})
+
+
+_CHAINS = {
+    "tumble": lambda: [TumbleTimeWindowStreamOp(
+        timeCol="ts", windowTime=25.0, groupCols=["user"],
+        clause="sum(x0) as sx, count(*) as c")],
+    "hop": lambda: [HopTimeWindowStreamOp(
+        timeCol="ts", windowTime=30.0, hopTime=15.0, groupCols=["user"],
+        clause="sum(x0) as sx, count(*) as c")],
+    "session": lambda: [SessionTimeWindowStreamOp(
+        timeCol="ts", sessionGapTime=3.0, groupCols=["user"],
+        clause="sum(x0) as sx, count(*) as c")],
+    "ftrl": lambda: [FtrlTrainStreamOp(
+        featureCols=["x0", "x1"], labelCol="label", modelSaveInterval=4)],
+    "onlinefm": lambda: [OnlineFmTrainStreamOp(
+        featureCols=["x0", "x1"], labelCol="label", numFactor=4,
+        modelSaveInterval=4)],
+    "eval": lambda: [EvalRegressionStreamOp(
+        labelCol="x0", predictionCol="pred")],
+}
+
+
+# model-snapshot streams (ndarray cells) ride the DataHub double; row
+# streams ride Kafka — same split as the PR 3 recovery drills
+_DATAHUB_KINDS = ("ftrl", "onlinefm")
+
+
+def _job(kind, tag, ckdir, table, parallelism, rescale_at=None,
+         controller=None, epoch_chunks=3):
+    if kind in _DATAHUB_KINDS:
+        sink = DatahubSinkStreamOp(endpoint=f"memory://el-{tag}",
+                                   topic="out")
+    else:
+        sink = KafkaSinkStreamOp(bootstrapServers=f"memory://el-{tag}",
+                                 topic="out")
+    return ElasticStreamJob(
+        source=TableSourceStreamOp(table, chunkSize=10),
+        chains=[(_CHAINS[kind], [sink])],
+        checkpoint_dir=ckdir, key_col="user",
+        parallelism=parallelism, epoch_chunks=epoch_chunks,
+        rescale_at=rescale_at, controller=controller)
+
+
+def _run(kind, tag, tmp_path, parallelism, rescale_at=None, spec=None,
+         seed=3, controller=None, table=None):
+    table = _drill_table() if table is None else table
+    MemoryKafkaBroker.named(f"el-{tag}")
+    MemoryDatahubService.named(f"el-{tag}")
+    faults.clear()
+    if spec:
+        faults.install(FaultSpec.parse(spec, seed=seed))
+    try:
+        summary = run_with_recovery(
+            lambda: _job(kind, tag, str(tmp_path / f"ck-{tag}"), table,
+                         parallelism, rescale_at, controller),
+            RetryPolicy(max_attempts=12, base_delay=0.001))
+    finally:
+        faults.clear()
+    if kind in _DATAHUB_KINDS:
+        out = [tuple(x.tobytes() if isinstance(x, np.ndarray) else x
+                     for x in r)
+               for r in MemoryDatahubService.named(
+                   f"el-{tag}")._topics.get("out", [])]
+    else:
+        out = list(MemoryKafkaBroker.named(
+            f"el-{tag}")._topics.get("out", []))
+    return summary, out
+
+
+# ---------------------------------------------------------------------------
+# parallelism invariance + rescale drills (the headline pins)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("kind", sorted(_CHAINS))
+def test_rescale_drills_bit_identical(kind, tmp_path):
+    """For every stateful workload: fixed P=2, fixed P=4, scale-out 2→4
+    and scale-in 4→2 mid-stream all commit the byte-identical sink
+    sequence. Keyed windows genuinely redistribute key-group state;
+    global accumulators (FTRL/OnlineFm/eval) move whole between owner
+    partitions — both paths must be exact."""
+    _, fixed2 = _run(kind, f"{kind}-f2", tmp_path, 2)
+    _, fixed4 = _run(kind, f"{kind}-f4", tmp_path, 4)
+    s_out, out24 = _run(kind, f"{kind}-r24", tmp_path, 2,
+                        rescale_at={1: 4})
+    s_in, out42 = _run(kind, f"{kind}-r42", tmp_path, 4,
+                       rescale_at={1: 2})
+    assert len(fixed2) > 0
+    assert fixed4 == fixed2
+    assert out24 == fixed2
+    assert out42 == fixed2
+    assert s_out["rescales"] == [pytest.approx(
+        {"epoch": 1, "from": 2, "to": 4,
+         "latency_s": s_out["rescales"][0]["latency_s"]})]
+    assert s_in["rescales"][0]["to"] == 2
+    # the post-rescale epochs really ran at the new parallelism
+    assert s_out["parallelism"] == 4 and s_in["parallelism"] == 2
+    assert any(e["parallelism"] == 4 for e in s_out["epoch_stats"])
+
+
+@pytest.mark.parametrize("cut", ["pre_redistribute", "mid_redistribute",
+                                 "pre_resume"])
+def test_crash_during_rescale_bit_identical(cut, tmp_path):
+    """Kill the job at each point of the rescale sequence (the `rescale`
+    fault injection point): the manifest is the rescale's atomic commit
+    point, so a crash before it restarts at the old parallelism (and the
+    deterministic schedule re-triggers), after it at the new one — sink
+    output bit-identical either way."""
+    _, clean = _run("tumble", f"cl-{cut}", tmp_path, 2)
+    summary, crashed = _run(
+        "tumble", f"cr-{cut}", tmp_path, 2, rescale_at={2: 4},
+        spec=f"rescale:count=1,kinds=crash,match={cut}")
+    assert summary["restored"] is True
+    assert summary["parallelism"] == 4  # the rescale still lands
+    assert crashed == clean
+
+
+def test_crash_after_rescale_restores_at_new_parallelism(tmp_path):
+    """A chunk-delivery crash AFTER a committed rescale restores from the
+    rescale-epoch snapshot: the rebuilt job must come up at the
+    manifest's parallelism (4), not the factory's initial (2), and merge
+    the redistributed state parts bit-exactly."""
+    _, clean = _run("session", "cl-after", tmp_path, 2)
+    summary, crashed = _run(
+        "session", "cr-after", tmp_path, 2, rescale_at={1: 4},
+        spec="recovery:count=1,kinds=crash,match=chunk15")
+    assert summary["restored"] is True
+    assert summary["parallelism"] == 4
+    assert 0 < summary["replayed_chunks"] < 20
+    assert crashed == clean
+
+
+def test_multi_chain_mixed_keyed_and_global(tmp_path):
+    """One job fanning out to a keyed window chain AND a global FTRL
+    chain, each with its own sink: a rescale redistributes the first and
+    relocates the second, both bit-identical."""
+    table = _drill_table()
+
+    def job(tag, ckdir, p, rescale_at=None):
+        return ElasticStreamJob(
+            source=TableSourceStreamOp(table, chunkSize=10),
+            chains=[
+                (_CHAINS["tumble"],
+                 [KafkaSinkStreamOp(bootstrapServers=f"memory://mc-{tag}",
+                                    topic="w")]),
+                (_CHAINS["ftrl"],
+                 [DatahubSinkStreamOp(endpoint=f"memory://mc-{tag}",
+                                      topic="m")]),
+            ],
+            checkpoint_dir=ckdir, key_col="user", parallelism=p,
+            epoch_chunks=3, rescale_at=rescale_at)
+
+    def run(tag, p, rescale_at=None):
+        MemoryKafkaBroker.named(f"mc-{tag}")
+        MemoryDatahubService.named(f"mc-{tag}")
+        run_with_recovery(
+            lambda: job(tag, str(tmp_path / f"ck-{tag}"), p, rescale_at),
+            RetryPolicy(max_attempts=3, base_delay=0.001))
+        k = list(MemoryKafkaBroker.named(f"mc-{tag}")._topics.get("w", []))
+        m = [tuple(x.tobytes() if isinstance(x, np.ndarray) else x
+                   for x in r)
+             for r in MemoryDatahubService.named(
+                 f"mc-{tag}")._topics.get("m", [])]
+        return k, m
+
+    clean = run("clean", 2)
+    assert run("fixed4", 4) == clean
+    assert run("resc", 2, rescale_at={1: 4, 3: 2}) == clean
+
+
+# ---------------------------------------------------------------------------
+# backpressure controller
+# ---------------------------------------------------------------------------
+
+
+def _stats(epoch, wall_s, chunks=4, parallelism=2):
+    return {"epoch": epoch, "wall_s": wall_s, "chunks": chunks,
+            "parallelism": parallelism}
+
+
+def test_controller_scales_out_after_patience_and_respects_band():
+    c = BackpressureController(target_chunk_s=0.1, high=1.5, low=0.5,
+                              patience=2, cooldown_epochs=0)
+    # inside the hysteresis band: never a decision, streaks reset
+    assert c.observe(_stats(0, 0.4)) is None       # ratio 1.0
+    assert c.observe(_stats(1, 0.8)) is None       # hot 1/2
+    assert c.observe(_stats(2, 0.4)) is None       # band → reset
+    assert c.observe(_stats(3, 0.8)) is None       # hot 1/2 again
+    assert c.observe(_stats(4, 0.9)) == 4          # hot 2/2 → scale out ×2
+
+
+def test_controller_scales_in_when_idle_with_cooldown():
+    c = BackpressureController(target_chunk_s=0.1, patience=2,
+                              cooldown_epochs=3)
+    assert c.observe(_stats(0, 0.1, parallelism=4)) is None  # cold 1/2
+    assert c.observe(_stats(1, 0.1, parallelism=4)) == 2     # cold 2/2
+    # cooldown: the next cold streak may count but cannot decide yet
+    assert c.observe(_stats(2, 0.1, parallelism=2)) is None
+    assert c.observe(_stats(3, 0.1, parallelism=2)) is None
+    assert c.observe(_stats(4, 0.1, parallelism=2)) == 1     # past cooldown
+
+
+def test_controller_flap_breaker_degrades_to_fixed():
+    c = BackpressureController(target_chunk_s=0.1, patience=1,
+                              cooldown_epochs=0, flap_window=20,
+                              max_flips=3)
+    a0 = metrics.counter("recovery.rescale_aborted")
+    assert c.observe(_stats(0, 0.8)) == 4            # out
+    assert c.observe(_stats(1, 0.01, parallelism=4)) == 2   # in (flip 1)
+    assert c.observe(_stats(2, 0.8, parallelism=2)) == 4    # out (flip 2)
+    assert c.observe(_stats(3, 0.01, parallelism=4)) is None  # flip 3 → OPEN
+    assert c.breaker_open
+    # every further decision is suppressed + counted, never oscillates
+    assert c.observe(_stats(4, 0.8, parallelism=4)) is None
+    assert metrics.counter("recovery.rescale_aborted") - a0 >= 2
+
+
+def test_controller_idle_at_floor_is_healthy_not_thrashing():
+    """A long-lived stream parked at min parallelism: the repeated cold
+    streak must not record no-op decisions, inflate rescale_aborted, or
+    grow the flap history — an idle job is healthy, not flapping."""
+    c = BackpressureController(target_chunk_s=0.1, patience=2,
+                              cooldown_epochs=0)
+    a0 = metrics.counter("recovery.rescale_aborted")
+    for e in range(50):
+        assert c.observe(_stats(e, 0.01, parallelism=1)) is None
+    assert metrics.counter("recovery.rescale_aborted") == a0
+    assert c._decisions == [] and not c.breaker_open
+    # same at a job-imposed floor above 1 (bounds ride in the stats)
+    for e in range(50):
+        s = _stats(e, 0.01, parallelism=2)
+        s["min_parallelism"] = 2
+        assert c.observe(s) is None
+    assert c._decisions == []
+
+
+def test_controller_decision_history_is_bounded():
+    c = BackpressureController(target_chunk_s=0.1, patience=1,
+                              cooldown_epochs=0, flap_window=2,
+                              max_flips=500)
+    for e in range(0, 6000, 3):  # far-apart decisions: never a flip window
+        c.observe(_stats(e, 0.8, parallelism=2))
+    assert len(c._decisions) <= 4 * c.max_flips
+
+
+def test_key_col_matching_no_chain_warns(tmp_path):
+    """A typo'd key_col silently degrades every chain to pinned-global;
+    the build must say so loudly (counted warning), not just run slow."""
+    n0 = metrics.counter("elastic.no_keyed_chains")
+    ElasticStreamJob(
+        source=TableSourceStreamOp(_drill_table(40), chunkSize=10),
+        chains=[(_CHAINS["tumble"],
+                 [KafkaSinkStreamOp(bootstrapServers="memory://el-typo",
+                                    topic="t")])],
+        checkpoint_dir=str(tmp_path / "ck"), key_col="usr")  # typo: "usr"
+    assert metrics.counter("elastic.no_keyed_chains") == n0 + 1
+
+
+def test_controller_exports_lag_gauge():
+    c = BackpressureController(target_chunk_s=0.1)
+    c.observe(_stats(0, 0.9, chunks=4))
+    assert metrics.gauge("stream.lag_s") == pytest.approx(0.5)
+    assert "alink_stream_lag_s" in metrics.export_prometheus()
+
+
+def test_backpressure_triggered_rescale_bit_identical(tmp_path):
+    """End-to-end: a scripted lag signal (high for early epochs, idle
+    after) drives automatic scale-out then scale-in through the REAL
+    coordinator path; output stays bit-identical to the fixed run and
+    the rescale counters tick."""
+    _, clean = _run("tumble", "bp-clean", tmp_path, 2)
+
+    def lag_fn(stats):
+        return 5.0 if stats["epoch"] < 2 else 0.0
+
+    def controller():
+        return BackpressureController(
+            target_chunk_s=0.05, patience=2, cooldown_epochs=2,
+            lag_fn=lag_fn)
+
+    o0 = metrics.counter("recovery.rescale_out")
+    i0 = metrics.counter("recovery.rescale_in")
+    summary, out = _run("tumble", "bp-auto", tmp_path, 2,
+                        controller=controller())
+    assert out == clean
+    assert metrics.counter("recovery.rescale_out") - o0 == 1
+    assert metrics.counter("recovery.rescale_in") - i0 >= 1
+    assert summary["rescales"][0]["to"] == 4
+    s = elastic_summary()
+    assert s["rescale_out"] >= 1 and "rescale_s" in s
+
+
+def test_manual_request_rescale_applies_at_next_barrier(tmp_path):
+    table = _drill_table()
+    MemoryKafkaBroker.named("el-manual")
+    job = _job("tumble", "manual", str(tmp_path / "ck-manual"), table, 2)
+    coord = ElasticCoordinator(job)
+    coord.request_rescale(4)
+    summary = coord.run()
+    assert summary["rescales"][0] == {
+        "epoch": 0, "from": 2, "to": 4,
+        "latency_s": summary["rescales"][0]["latency_s"]}
+    _, fixed = _run("tumble", "manual-ref", tmp_path, 2, table=table)
+    assert list(MemoryKafkaBroker.named("el-manual")._topics["out"]) == fixed
+
+
+# ---------------------------------------------------------------------------
+# build-time validation + ALK107
+# ---------------------------------------------------------------------------
+
+
+class _HookedNoPartitionOp(TumbleTimeWindowStreamOp):
+    """Snapshot hooks but NO keyed-state hooks (simulates a pre-elastic
+    stateful op): the elastic job must refuse it at build."""
+
+    _elastic_hooks = False
+
+    def state_partition(self, key_ranges):  # pragma: no cover
+        raise NotImplementedError
+
+    def state_merge(self, blobs):  # pragma: no cover
+        raise NotImplementedError
+
+
+def test_elastic_job_validation(tmp_path):
+    t = _drill_table(40)
+    src = TableSourceStreamOp(t, chunkSize=10)
+    sink = KafkaSinkStreamOp(bootstrapServers="memory://el-val", topic="t")
+    with pytest.raises(AkIllegalArgumentException):  # instances, not factory
+        ElasticStreamJob(src, [([TumbleTimeWindowStreamOp(
+            timeCol="ts", windowTime=10.0, clause="count(*) as c")],
+            [sink])], checkpoint_dir=str(tmp_path / "x"))
+    shared = _CHAINS["tumble"]()
+    with pytest.raises(AkIllegalArgumentException, match="FRESH"):
+        ElasticStreamJob(src, [(lambda: shared, [sink])],
+                         checkpoint_dir=str(tmp_path / "x"))
+    with pytest.raises(AkIllegalArgumentException, match="ALK107"):
+        ElasticStreamJob(
+            src, [(lambda: [_HookedNoPartitionOp(
+                timeCol="ts", windowTime=10.0, groupCols=["user"],
+                clause="count(*) as c")], [sink])],
+            checkpoint_dir=str(tmp_path / "x"), key_col="user")
+    with pytest.raises(AkIllegalArgumentException):  # P > num_key_groups
+        ElasticStreamJob(src, [(_CHAINS["tumble"], [sink])],
+                         checkpoint_dir=str(tmp_path / "x"),
+                         num_key_groups=4, parallelism=8)
+
+
+def test_alk107_plan_rule(monkeypatch):
+    from alink_tpu.analysis import validate_plan
+
+    op = _HookedNoPartitionOp(timeCol="ts", windowTime=10.0,
+                              clause="count(*) as c")
+    report = validate_plan(op, elastic=True)
+    assert [d.rule for d in report.diagnostics] == ["ALK107"]
+    assert report.diagnostics[0].severity == "warning"
+    report = validate_plan(op, elastic=True, recovery=True)
+    assert report.diagnostics[0].severity == "error"
+    # without the elastic flag the op is a perfectly fine recovery citizen
+    assert validate_plan(op, recovery=True).diagnostics == []
+    # hooked ops never fire it
+    assert validate_plan(_CHAINS["tumble"]()[0],
+                         elastic=True).diagnostics == []
+
+
+def test_key_space_change_is_fenced(tmp_path):
+    """Resuming a snapshot with a different num_key_groups (or key_col)
+    would re-hash keys into different groups than the stored state parts
+    cover — refused explicitly, like the epoch_chunks fence."""
+    table = _drill_table()
+    MemoryKafkaBroker.named("el-fence")
+
+    def job(g):
+        return ElasticStreamJob(
+            source=TableSourceStreamOp(table, chunkSize=10),
+            chains=[(_CHAINS["tumble"],
+                     [KafkaSinkStreamOp(
+                         bootstrapServers="memory://el-fence", topic="out")])],
+            checkpoint_dir=str(tmp_path / "ck"), key_col="user",
+            parallelism=2, epoch_chunks=3, num_key_groups=g)
+
+    faults.clear()
+    faults.install(FaultSpec.parse("recovery:count=1,kinds=crash,match=chunk8"))
+    try:
+        with pytest.raises(InjectedCrashError):
+            ElasticCoordinator(job(128)).run()
+    finally:
+        faults.clear()
+    with pytest.raises(AkIllegalStateException, match="num_key_groups"):
+        run_with_recovery(lambda: job(64),
+                          RetryPolicy(max_attempts=2, base_delay=0.001))
+
+
+# ---------------------------------------------------------------------------
+# fault grammar + telemetry satellites
+# ---------------------------------------------------------------------------
+
+
+def test_rescale_fault_point_grammar():
+    spec = FaultSpec.parse(
+        "rescale:count=1,kinds=crash,match=mid_redistribute")
+    spec.fire("rescale", label="epoch3.pre_redistribute")  # no match
+    with pytest.raises(InjectedCrashError):
+        spec.fire("rescale", label="epoch3.mid_redistribute")
+    spec.fire("rescale", label="epoch4.mid_redistribute")  # count spent
+
+
+def test_rescale_counters_exported_at_metrics(tmp_path):
+    _run("tumble", "prom", tmp_path, 2, rescale_at={1: 4, 3: 2})
+    text = metrics.export_prometheus()
+    assert "alink_recovery_rescale_out_total" in text
+    assert "alink_recovery_rescale_in_total" in text
+    assert metrics.counter("recovery.rescale_out") >= 1
+    assert metrics.counter("recovery.rescale_in") >= 1

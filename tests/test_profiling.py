@@ -373,6 +373,11 @@ def test_api_profile_endpoint(monkeypatch):
     assert body["enabled"] is True
     assert body["device"]["device_kind"]
     assert any(k["kernel"] == kid for k in body["kernels"])
+    # the streaming recovery + elastic health block rides along (the WebUI
+    # profile panel's rescale-event line reads it)
+    assert "elastic" in body["recovery"]
+    assert {"rescale_out", "rescale_in",
+            "rescale_aborted"} <= set(body["recovery"]["elastic"])
 
 
 # ---------------------------------------------------------------------------
