@@ -17,8 +17,10 @@ from .diagnostics import INFO, ERROR, RULES, WARNING, Diagnostic, Report  # noqa
 from .plancheck import (  # noqa: F401
     last_plan_report,
     preflight,
+    preflight_train_config,
     suppress_preflight,
     validate_plan,
+    validate_train_config,
     validation_mode,
 )
 

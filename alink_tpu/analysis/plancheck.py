@@ -324,6 +324,85 @@ def _check_huge_engine(op, label: str, report: Report) -> None:
                  "this job")
 
 
+def validate_train_config(cfg, *, where: str = "TrainConfig") -> Report:
+    """ALK103 over a :class:`~alink_tpu.dl.train.TrainConfig`: batch and
+    micro-batch sizes off the ``bucket_rows`` ladder are recompile hazards
+    on bucketed batches — the train loop snaps its device batch onto the
+    ladder, so an off-ladder ``batch_size`` pads EVERY step (wasted rows)
+    and an off-ladder micro batch (``batch_size / accum_steps``) compiles
+    a micro-step program no neighboring config can share. Pure function of
+    the config — callable standalone; :func:`preflight_train_config` is
+    the mode-gated hook the train loop calls."""
+    from ..common.jitcache import bucket_rows
+
+    report = Report(engine="plan")
+    report.target = type(cfg).__name__
+    bs = int(getattr(cfg, "batch_size", 0) or 0)
+    accum = max(1, int(getattr(cfg, "accum_steps", 1) or 1))
+    if bs > 0 and bucket_rows(bs) != bs:
+        report.add(
+            "ALK103",
+            f"batch_size={bs} is off the bucket_rows ladder (the bucketed "
+            f"batch pads to {bucket_rows(bs)} every step, and the padded "
+            "rows are pure wasted compute)",
+            where=where,
+            hint=f"use a ladder size (e.g. floor_bucket_rows({bs})="
+                 f"{_floor(bs)}) so full batches ship unpadded")
+    if accum > 1:
+        if bs % accum:
+            report.add(
+                "ALK103",
+                f"batch_size={bs} is not divisible by accum_steps={accum} "
+                "— the train loop refuses the config at run time (micro "
+                "batches must tile the effective batch exactly for the "
+                "ordered-chunk gradient contract)",
+                where=where,
+                hint="pick batch_size as a multiple of accum_steps")
+        else:
+            micro = bs // accum
+            if bucket_rows(micro) != micro:
+                report.add(
+                    "ALK103",
+                    f"micro batch {micro} (batch_size={bs} / accum_steps="
+                    f"{accum}) is off the bucket_rows ladder — the "
+                    "micro-step program compiles per batch-shape, so "
+                    "off-ladder micros never share a compile across "
+                    "configs",
+                    where=where,
+                    hint=f"size the effective batch so batch_size/"
+                         f"accum_steps lands on the ladder (e.g. "
+                         f"{_floor(micro) * accum})")
+    return report
+
+
+def preflight_train_config(cfg, *, where: str = "train_model"
+                           ) -> Optional[Report]:
+    """Mode-gated ALK103 pre-flight for the DL train loop — same contract
+    as :func:`preflight`: ``off`` skips, ``warn`` logs + counts (results
+    bit-identical), ``error`` raises only on error-severity findings
+    (ladder findings are warnings; the divisibility error raises in the
+    loop itself regardless of mode). Validator crashes are counted, never
+    propagated."""
+    from ..common.exceptions import AkPlanValidationException
+
+    mode = validation_mode()
+    if mode == "off" or getattr(_suppressed, "depth", 0):
+        return None
+    try:
+        report = validate_train_config(cfg)
+    except Exception as e:
+        metrics.incr("analysis.validator_errors")
+        logger.debug("train-config validator failed at %s: %r", where, e)
+        return None
+    _record_report(report, mode)
+    if report.diagnostics:
+        logger.warning("train-config validation (%s, %s):\n%s",
+                       where, mode, report.render())
+    if mode == "error" and report.errors():
+        raise AkPlanValidationException(report)
+    return report
+
+
 def _check_fusion_chain(order: Sequence[Any], labels: Dict[int, str],
                         report: Report) -> None:
     """ALK105: a mapper-family op that the executor cannot fuse, sitting on

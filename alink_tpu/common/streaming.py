@@ -94,9 +94,11 @@ def stream_map(
     cache when the wire is slow (see module docstring). ``split=k`` ships
     each batch as *k* parallel row-chunk transfers reassembled on device
     (bit-identical input, k× the wire streams). ``phases`` (optional dict)
-    accumulates ``transfer_s`` / ``compute_s`` / ``batches``; the same
-    numbers also land on the active executor node trace, so BENCH and the
-    per-node breakdown see the split without extra plumbing.
+    accumulates ``transfer_s`` / ``wait_s`` (consumer stall on the
+    in-flight transfer — ~0 when the pipeline overlaps) / ``compute_s`` /
+    ``batches``; the same numbers also land on the active executor node
+    trace, so BENCH and the per-node breakdown see the split without
+    extra plumbing.
 
     Transfers retry under the central
     :class:`~alink_tpu.common.resilience.RetryPolicy` when the failure is
@@ -182,11 +184,20 @@ def stream_map(
     pump()
     while inflight:
         meta, handle = inflight.popleft()
+        t_wait = time.perf_counter()
         devs, dt_put = gather(handle)
+        # the consumer-side stall: how long THIS loop blocked on the
+        # in-flight transfer. Near-zero when the pipeline overlaps
+        # (transfer finished while compute ran); ~transfer_s when the wire
+        # is the bottleneck — the one number that says whether the
+        # double-buffering is actually hiding the host
+        dt_wait = time.perf_counter() - t_wait
         add_node_phase("transfer_s", dt_put)
         metrics.observe("stream.transfer_s", dt_put)
+        metrics.observe("stream.wait_s", dt_wait)
         if phases is not None:
             phases["transfer_s"] = phases.get("transfer_s", 0.0) + dt_put
+            phases["wait_s"] = phases.get("wait_s", 0.0) + dt_wait
         t0 = time.perf_counter()
         out = fn(*devs)
         dt_fn = time.perf_counter() - t0

@@ -349,6 +349,27 @@ def _span_tree(spans: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
     return roots
 
 
+def _train_block() -> Optional[Dict[str, Any]]:
+    """The DL training loop's hot-path readout (None when no train ran
+    this process): the ``train.step_s`` / ``train.feed_wait_s`` /
+    ``train.accum_flush_s`` histograms plus every ``train.*`` counter —
+    the observatory sees the training loop like every other hot path.
+    Built from the metrics recorder directly so ``job_report`` never
+    imports the dl stack."""
+    from .metrics import metrics
+
+    out: Dict[str, Any] = {}
+    for name in ("train.step_s", "train.feed_wait_s",
+                 "train.accum_flush_s"):
+        st = metrics.histogram(name)
+        if st is not None:
+            out[name.split(".", 1)[1]] = st
+    counters = metrics.counters("train.")
+    if counters:
+        out["counters"] = counters
+    return out or None
+
+
 def job_report(trace_id: Optional[str] = None) -> Dict[str, Any]:
     """One dict per job run: the DAG-shaped span tree plus the aggregate
     split an operator wants first.
@@ -416,6 +437,7 @@ def job_report(trace_id: Optional[str] = None) -> Dict[str, Any]:
     return {
         "trace_id": trace_id,
         "profile": profile,
+        "train": _train_block(),
         "analysis": analysis,
         "root": None if root is None else
         {"name": root["name"], "wall_s": root["wall_s"],

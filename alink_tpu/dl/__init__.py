@@ -18,12 +18,15 @@ Public surface:
   donated buffers, bucketed batches), eval, checkpoints
 - :mod:`pretrain`  — in-framework MLM pretraining producing HF-layout checkpoints
 - :mod:`tokenizer` — WordPiece-style tokenizer with corpus-built vocab
-- :mod:`data`      — loaders for the shipped real-text corpora
+- :mod:`data`      — loaders for the shipped real-text corpora + the
+  block-scheduled streaming corpus iterator (:class:`~alink_tpu.dl.data.
+  CorpusStream`) for corpora larger than host RAM
 """
 
 from .attention import (blockwise_attention, full_attention,
                         ring_attention)
-from .data import load_reviews, load_sst2, sst2_split
+from .data import (CorpusStream, load_reviews, load_sst2, scheduled_order,
+                   sst2_split)
 from .modules import BertConfig, TransformerEncoder, KerasSequential, parse_layers
 from .pretrain import pretrain_and_save, pretrain_mlm
 from .sharding import param_shardings, make_dl_mesh
@@ -48,5 +51,7 @@ __all__ = [
     "load_reviews",
     "load_sst2",
     "sst2_split",
+    "CorpusStream",
+    "scheduled_order",
     "Tokenizer",
 ]

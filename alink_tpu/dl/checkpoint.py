@@ -18,12 +18,24 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 
 class TrainCheckpointManager:
-    """Thin orbax CheckpointManager wrapper over one training run's state."""
+    """Thin orbax CheckpointManager wrapper over one training run's state.
 
-    def __init__(self, directory: str, max_to_keep: int = 3):
+    Retention is bounded: only the last ``max_to_keep`` checkpoints stay
+    on disk (older steps are pruned at save time), so a long streaming
+    pretrain with mid-epoch ``checkpoint_every`` saves cannot fill the
+    disk. ``max_to_keep=None`` reads the ``ALINK_CKPT_KEEP`` env knob
+    (default 3); a value <= 0 disables pruning (unbounded — explicit
+    opt-in only)."""
+
+    def __init__(self, directory: str, max_to_keep: "int | None" = None):
         import orbax.checkpoint as ocp
 
+        from ..common.env import env_int
+
         self._ocp = ocp
+        if max_to_keep is None:
+            max_to_keep = env_int("ALINK_CKPT_KEEP", 3)
+        self.max_to_keep = max_to_keep if max_to_keep > 0 else None
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
         # item_handlers makes item_metadata() work on a fresh manager (the
@@ -32,16 +44,24 @@ class TrainCheckpointManager:
         self._mgr = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
-                max_to_keep=max_to_keep, create=True),
+                max_to_keep=self.max_to_keep, create=True),
             item_handlers=ocp.StandardCheckpointHandler(),
         )
 
     def save(self, step: int, params, opt_state, extra: Dict[str, Any]):
-        """Persist the full training state at ``step`` (blocking)."""
+        """Persist the full training state at ``step`` (blocking); prunes
+        past the retention bound."""
+        from ..common.metrics import metrics
+
         state = {"params": params, "opt_state": opt_state,
                  "extra": dict(extra)}
         self._mgr.save(step, args=self._ocp.args.StandardSave(state))
         self._mgr.wait_until_finished()
+        metrics.incr("train.ckpt_saves")
+
+    def all_steps(self):
+        """The step numbers currently retained on disk (post-prune)."""
+        return sorted(self._mgr.all_steps())
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()

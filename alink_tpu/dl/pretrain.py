@@ -24,8 +24,25 @@ Hot-path contract (mirrors dl/train.py):
   mask cleared (exact: unselected rows contribute zero MLM loss), so the
   steady loop performs zero retraces;
 - ``checkpoint_dir`` wires :class:`~alink_tpu.dl.checkpoint.
-  TrainCheckpointManager` underneath: per-epoch saves, crash-resume from
-  the latest epoch.
+  TrainCheckpointManager` underneath: per-epoch saves (plus
+  ``checkpoint_every`` mid-epoch saves), crash-resume from the latest
+  step, retention bounded to the last ``checkpoint_keep`` checkpoints.
+
+Corpus scale: ``texts`` may be a :class:`~alink_tpu.dl.data.CorpusStream`
+— the corpus then streams off disk under the per-(seed, epoch) block
+schedule with peak host memory pinned to the stream's row buffer, feeding
+the same async ``alink-h2d`` pipeline (tokenization + masking run on the
+transfer pool, overlapped with compute). The *corpus-scale input
+contract* engages whenever any scale knob is on (a streaming corpus,
+``accum_steps`` > 1, a joined multi-process cluster, an explicit
+``block_rows``, or mid-epoch ``checkpoint_every`` saves): batches follow
+the block-scheduled order and masking
+draws are *row-stable* (drawn for the full effective-batch shape and
+sliced per chunk), so ANY partition of the effective batch into
+micro-steps or process shards reproduces the exact same arrays — that is
+what makes streaming ≡ in-memory, accumulated ≡ large-batch, and
+P-process ≡ 1-process × ``accum_steps=P`` all bit-identical (CI-pinned).
+Without a scale knob the legacy in-memory path is bit-preserved.
 """
 
 from __future__ import annotations
@@ -35,6 +52,7 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from .data import CorpusStream, scheduled_order
 from .modules import BertConfig, TransformerEncoder
 from .tokenizer import MASK, Tokenizer
 
@@ -43,7 +61,9 @@ def _mask_tokens(ids: np.ndarray, attn: np.ndarray, mask_id: int,
                  vocab_size: int, rng: np.random.Generator,
                  mask_prob: float, n_specials: int = 5):
     """BERT masking: select ``mask_prob`` of real tokens; 80% -> [MASK],
-    10% -> random token, 10% -> kept. Returns (masked_ids, target_mask)."""
+    10% -> random token, 10% -> kept. Returns (masked_ids, target_mask).
+    Draw order depends on the batch shape — the legacy whole-batch form;
+    the corpus-scale loop uses :func:`_mask_rows` instead."""
     sel = (rng.random(ids.shape) < mask_prob) & (attn == 1) \
         & (ids >= n_specials)
     masked = ids.copy()
@@ -52,6 +72,31 @@ def _mask_tokens(ids: np.ndarray, attn: np.ndarray, mask_id: int,
     rand_sel = sel & (r >= 0.8) & (r < 0.9)
     masked[rand_sel] = rng.integers(
         n_specials, vocab_size, size=int(rand_sel.sum()))
+    return masked, sel
+
+
+def _mask_rows(ids: np.ndarray, attn: np.ndarray, mask_id: int,
+               vocab_size: int, seed_key, full_rows: int, row_start: int,
+               mask_prob: float, n_specials: int = 5):
+    """Row-stable BERT masking: every random draw is made for the FULL
+    effective-batch shape ``(full_rows, seq)`` from the per-(seed, epoch,
+    step) generator and then sliced to this chunk's rows — so any
+    partition of the batch into micro-steps or process shards reproduces
+    the exact same masks (the bit-parity backbone of the corpus-scale
+    loop). The replacement tokens are drawn as a full matrix up front for
+    the same reason (the legacy form draws ``rand_sel.sum()`` values,
+    which couples the stream to other rows' data)."""
+    rng = np.random.default_rng(seed_key)
+    rows, seq = ids.shape
+    lo, hi = row_start, row_start + rows
+    sel_d = rng.random((full_rows, seq))[lo:hi]
+    r = rng.random((full_rows, seq))[lo:hi]
+    repl = rng.integers(n_specials, vocab_size, (full_rows, seq))[lo:hi]
+    sel = (sel_d < mask_prob) & (attn == 1) & (ids >= n_specials)
+    masked = ids.copy()
+    masked[sel & (r < 0.8)] = mask_id
+    rand_sel = sel & (r >= 0.8) & (r < 0.9)
+    masked[rand_sel] = repl[rand_sel]
     return masked, sel
 
 
@@ -89,8 +134,67 @@ def _mlm_step_program(model, tx, cfg: BertConfig, learning_rate: float):
                       key_extra=(repr(cfg), float(learning_rate)))
 
 
+def _mlm_accum_programs(model, tx, cfg: BertConfig, learning_rate: float):
+    """The ordered-chunk MLM programs (micro + apply) — the same contract
+    as :func:`~alink_tpu.dl.train.make_accum_programs`: each micro-chunk
+    differentiates the UNNORMALIZED masked loss ``sum(ll_i * sel_i)``
+    (cotangent seed 1) into donated fp32 accumulators; ``apply`` divides
+    once by the effective batch's total selection count and runs the
+    optimizer update. Chunk grads are therefore independent of how the
+    batch splits — micro-steps, process shards, or one big batch all sum
+    the identical values in the identical order."""
+    from ..common.jitcache import cached_jit
+
+    def _build_micro():
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        @partial(jax.jit, donate_argnums=(0, 1, 2))
+        def micro(gacc, wacc, lacc, params, masked, attn, targets, sel):
+            def loss(p):
+                states = model.apply({"params": p["params"]}, masked, attn,
+                                     return_sequence=True)
+                emb = p["params"]["tok_emb"]["embedding"].astype(jnp.float32)
+                logits = states @ emb.T
+                ll = optax.softmax_cross_entropy_with_integer_labels(
+                    logits, targets)
+                return (ll * sel.astype(jnp.float32)).sum()
+
+            lsum, g = jax.value_and_grad(loss)(params)
+            gacc = jax.tree.map(lambda a, b: a + b.astype(a.dtype), gacc, g)
+            return (gacc, wacc + sel.astype(jnp.float32).sum(),
+                    lacc + lsum)
+
+        return micro
+
+    def _build_apply():
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        @partial(jax.jit, donate_argnums=(0, 1, 2))
+        def apply_grads(params, opt_state, gacc, wacc, lacc):
+            denom = jnp.maximum(wacc, 1.0)
+            g = jax.tree.map(lambda a: a / denom, gacc)
+            updates, opt_state2 = tx.update(g["params"], opt_state,
+                                            params["params"])
+            new_p = optax.apply_updates(params["params"], updates)
+            zero_g = jax.tree.map(jnp.zeros_like, gacc)
+            return ({"params": new_p}, opt_state2, lacc / denom, zero_g,
+                    jnp.zeros_like(wacc), jnp.zeros_like(lacc))
+
+        return apply_grads
+
+    micro = cached_jit("dl.mlm_micro", _build_micro,
+                       key_extra=(repr(cfg),))
+    apply_p = cached_jit("dl.mlm_apply", _build_apply,
+                         key_extra=(repr(cfg), float(learning_rate)))
+    return micro, apply_p
+
+
 def pretrain_mlm(
-    texts: Sequence[str],
+    texts: "Sequence[str] | CorpusStream",
     *,
     vocab_size: int = 2000,
     hidden_size: int = 128,
@@ -107,35 +211,112 @@ def pretrain_mlm(
     feed: str = "async",
     checkpoint_dir: Optional[str] = None,
     resume: bool = True,
+    accum_steps: int = 1,
+    block_rows: Optional[int] = None,
+    checkpoint_every: int = 0,
+    checkpoint_keep: Optional[int] = None,
+    tokenizer_sample: int = 4096,
 ) -> Tuple[BertConfig, dict, Tokenizer, List[float]]:
     """MLM-pretrain a tiny BERT on raw texts. Returns
     ``(cfg, params, tokenizer, loss_history)`` — params fit
     ``save_bert_checkpoint`` and the fine-tune ``checkpointFilePath`` path.
 
+    ``texts`` may be a list of strings (in-memory) or a
+    :class:`~alink_tpu.dl.data.CorpusStream` (streaming ingestion —
+    corpora larger than host RAM train with peak host memory pinned to
+    the stream's row buffer; the vocab then builds from the first
+    ``tokenizer_sample`` rows unless ``tokenizer`` is given).
+    ``batch_size`` is the EFFECTIVE optimizer batch; ``accum_steps=N``
+    splits it into N ordered micro-chunks (one ProgramCache-resident
+    micro invocation each — the HBM knob), bit-identical to the one-shot
+    batch program by the ordered-chunk gradient contract. In a
+    ``jax.distributed`` cluster every process runs this same call: each
+    takes its shard of every chunk, gradients combine rank-ordered, only
+    the coordinator writes checkpoints, and the result is bit-identical
+    to a single process running ``accum_steps = P × accum_steps``.
+
     ``feed="async"`` masks/assembles batches on the transfer pool ahead of
     compute (bit-identical to ``"sync"``); ``checkpoint_dir`` enables
-    per-epoch checkpointing with crash-resume."""
+    per-epoch checkpointing (plus mid-epoch saves every
+    ``checkpoint_every`` optimizer steps) with crash-resume replaying the
+    exact remaining schedule."""
     import jax
     import optax
 
-    from .train import _feed, _pad_tail
+    from ..parallel.distributed import (data_parallel_topology,
+                                        init_multi_host)
 
-    tok = tokenizer or Tokenizer.build(list(texts), vocab_size=vocab_size)
+    init_multi_host()  # idempotent; no-op without the topology env knobs
+    shard_idx, num_shards = data_parallel_topology()
+    accum = int(accum_steps or 1)
+    if accum < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+    streaming = isinstance(texts, CorpusStream)
+    # mid-epoch checkpointing is a scale knob too: only the corpus-scale
+    # loop implements next_batch resume
+    scale = streaming or accum > 1 or num_shards > 1 \
+        or block_rows is not None or checkpoint_every > 0
+
+    if tokenizer is not None:
+        tok = tokenizer
+    elif streaming:
+        tok = Tokenizer.build(texts.sample_texts(tokenizer_sample),
+                              vocab_size=vocab_size)
+    else:
+        tok = Tokenizer.build(list(texts), vocab_size=vocab_size)
     cfg = BertConfig(
         vocab_size=tok.vocab_size, hidden_size=hidden_size,
         num_layers=num_layers, num_heads=num_heads,
         intermediate_size=intermediate_size, max_position=max_len,
         dropout=0.0, pool="cls")
     model = TransformerEncoder(cfg)
-
-    enc = tok.encode_batch([str(t) for t in texts], max_len=max_len)
-    ids = np.asarray(enc["input_ids"], np.int32)
-    attn = np.asarray(enc["attention_mask"], np.int32)
     mask_id = tok.vocab[MASK]
 
-    params = model.init(jax.random.PRNGKey(seed), ids[:1], attn[:1])
+    ids = attn = None
+    if not streaming:
+        enc = tok.encode_batch([str(t) for t in texts], max_len=max_len)
+        ids = np.asarray(enc["input_ids"], np.int32)
+        attn = np.asarray(enc["attention_mask"], np.int32)
+
+    if streaming:
+        penc = tok.encode_batch(texts.sample_texts(1), max_len=max_len)
+        init_ids = np.asarray(penc["input_ids"], np.int32)
+        init_attn = np.asarray(penc["attention_mask"], np.int32)
+    else:
+        init_ids, init_attn = ids[:1], attn[:1]
+    params = model.init(jax.random.PRNGKey(seed), init_ids, init_attn)
     tx = optax.adamw(learning_rate, weight_decay=0.01)
     opt_state = tx.init(params["params"])
+
+    if not scale:
+        return _pretrain_legacy(
+            model, tx, cfg, tok, ids, attn, mask_id, params, opt_state,
+            epochs=epochs, batch_size=batch_size,
+            learning_rate=learning_rate, mask_prob=mask_prob, seed=seed,
+            feed=feed, checkpoint_dir=checkpoint_dir, resume=resume,
+            checkpoint_keep=checkpoint_keep)
+    return _pretrain_scale(
+        model, tx, cfg, tok, texts, ids, attn, mask_id, params, opt_state,
+        streaming=streaming, epochs=epochs, batch_size=batch_size,
+        learning_rate=learning_rate, mask_prob=mask_prob, seed=seed,
+        feed=feed, checkpoint_dir=checkpoint_dir, resume=resume,
+        accum=accum, block_rows=block_rows, max_len=max_len,
+        checkpoint_every=checkpoint_every, checkpoint_keep=checkpoint_keep,
+        shard_idx=shard_idx, num_shards=num_shards)
+
+
+def _pretrain_legacy(model, tx, cfg, tok, ids, attn, mask_id, params,
+                     opt_state, *, epochs, batch_size, learning_rate,
+                     mask_prob, seed, feed, checkpoint_dir, resume,
+                     checkpoint_keep):
+    """The bit-preserved in-memory loop (no scale knobs): whole-batch
+    masking draws, one fused MLM step per batch."""
+    import jax
+
+    from ..common.metrics import metrics as _metrics
+    from .train import _feed, _pad_tail, _timed_feed
+    import time as _time
+
     step_prog = _mlm_step_program(model, tx, cfg, learning_rate)
 
     ckpt = None
@@ -143,7 +324,8 @@ def pretrain_mlm(
     if checkpoint_dir:
         from .checkpoint import TrainCheckpointManager
 
-        ckpt = TrainCheckpointManager(checkpoint_dir)
+        ckpt = TrainCheckpointManager(checkpoint_dir,
+                                      max_to_keep=checkpoint_keep)
         if resume:
             restored = ckpt.restore_latest(jax.device_get(params),
                                            jax.device_get(opt_state))
@@ -187,10 +369,15 @@ def pretrain_mlm(
             return arrs + [sel]
 
         ep_losses = []
-        for s, devs in _feed(build, place, steps_per_epoch, mode=feed):
+        t_step = _time.perf_counter()
+        for s, devs in _timed_feed(_feed(build, place, steps_per_epoch,
+                                         mode=feed)):
             params, opt_state, l = step_prog(
                 params, opt_state, devs[0], devs[1], devs[2], devs[3])
             ep_losses.append(l)   # device scalar; sync once per epoch
+            _metrics.observe("train.step_s", _time.perf_counter() - t_step)
+            t_step = _time.perf_counter()
+            _metrics.incr("train.steps")
         history.append(float(np.mean([float(x) for x in ep_losses])))
         if ckpt is not None:
             ckpt.save(ep, jax.device_get(params), jax.device_get(opt_state),
@@ -198,9 +385,185 @@ def pretrain_mlm(
     return cfg, jax.device_get(params), tok, history
 
 
-def pretrain_and_save(texts: Sequence[str], out_dir: str, **kw) -> dict:
+def _pretrain_scale(model, tx, cfg, tok, texts, ids, attn, mask_id, params,
+                    opt_state, *, streaming, epochs, batch_size,
+                    learning_rate, mask_prob, seed, feed, checkpoint_dir,
+                    resume, accum, block_rows, max_len, checkpoint_every,
+                    checkpoint_keep, shard_idx, num_shards):
+    """The corpus-scale loop: block-scheduled batches, row-stable masking,
+    ordered-chunk gradients (micro + apply programs), per-process shards
+    with rank-ordered combine, coordinator-only checkpoint writes."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..common.metrics import metrics as _metrics
+    from ..common.streaming import stream_map
+    from ..parallel.distributed import ordered_cross_process_sum
+    from .train import _timed_feed
+    import time as _time
+
+    n = len(texts)
+    unit = accum * num_shards
+    if batch_size % accum:
+        raise ValueError(
+            f"batch_size={batch_size} is not divisible by accum_steps="
+            f"{accum}: micro chunks must tile the effective batch exactly")
+    B = max(unit, (min(batch_size, n) // unit) * unit)
+    micro = B // accum
+    shard_rows = micro // num_shards
+    steps_per_epoch = max(1, -(-n // B))
+
+    micro_prog, apply_prog = _mlm_accum_programs(model, tx, cfg,
+                                                 learning_rate)
+
+    ckpt = None
+    start_epoch = 0
+    start_batch = 0
+    step = 0
+    if checkpoint_dir:
+        from .checkpoint import TrainCheckpointManager
+
+        ckpt = TrainCheckpointManager(checkpoint_dir,
+                                      max_to_keep=checkpoint_keep)
+        if resume:
+            restored = ckpt.restore_latest(jax.device_get(params),
+                                           jax.device_get(opt_state))
+            if restored is not None:
+                r_params, r_opt, extra = restored
+                params = jax.device_put(r_params)
+                opt_state = jax.device_put(r_opt)
+                start_epoch = int(extra.get("epoch", -1)) + 1
+                step = int(extra.get("step", 0))
+                if "next_batch" in extra:
+                    # mid-epoch save: restart THIS epoch at the next batch
+                    # — the block schedule is a pure function of
+                    # (seed, epoch), so the remaining order replays exactly
+                    # and already-consumed blocks are skipped unread
+                    start_epoch = int(extra.get("mid_epoch", start_epoch))
+                    start_batch = int(extra["next_batch"])
+    save_ckpt = ckpt is not None and shard_idx == 0
+
+    def place(arrs):
+        devs = [jax.device_put(np.asarray(a)) for a in arrs]
+        jax.block_until_ready(devs)
+        return devs
+
+    gacc = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+    wacc = jnp.zeros((), jnp.float32)
+    lacc = jnp.zeros((), jnp.float32)
+
+    history: List[float] = []
+    for ep in range(start_epoch, epochs):
+        sb = start_batch if ep == start_epoch else 0
+
+        if streaming:
+            def payloads(_ep=ep, _sb=sb):
+                for s, batch_texts in texts.iter_batches(
+                        B, seed, _ep, start_batch=_sb):
+                    nreal = len(batch_texts)
+                    if nreal < B:  # pad by repeating the last real row
+                        batch_texts = list(batch_texts) + \
+                            [batch_texts[-1]] * (B - nreal)
+                    for k in range(accum):
+                        lo = k * micro + shard_idx * shard_rows
+                        yield (s * accum + k,
+                               (s, k, nreal,
+                                batch_texts[lo:lo + shard_rows]))
+        else:
+            if block_rows is not None:
+                order = scheduled_order(n, block_rows, seed, ep)
+            else:
+                order = np.random.default_rng((seed, ep)).permutation(n)
+
+            def payloads(_ep=ep, _sb=sb, _order=order):
+                for s in range(_sb, steps_per_epoch):
+                    idx = _order[s * B:(s + 1) * B]
+                    nreal = len(idx)
+                    if nreal < B:
+                        idx = np.concatenate(
+                            [idx, np.repeat(idx[-1:], B - nreal)])
+                    for k in range(accum):
+                        lo = k * micro + shard_idx * shard_rows
+                        yield (s * accum + k,
+                               (s, k, nreal, idx[lo:lo + shard_rows]))
+
+        def assemble(pl, _ep=ep):
+            s, k, nreal, rows = pl
+            if streaming:
+                enc = tok.encode_batch(rows, max_len=max_len)
+                ids_s = np.asarray(enc["input_ids"], np.int32)
+                attn_s = np.asarray(enc["attention_mask"], np.int32)
+            else:
+                ids_s, attn_s = ids[rows], attn[rows]
+            row0 = k * micro + shard_idx * shard_rows
+            masked, sel = _mask_rows(
+                ids_s, attn_s, mask_id, tok.vocab_size,
+                (seed, _ep, s + 1), B, row0, mask_prob)
+            # pad rows (global position >= nreal) train with selection
+            # cleared — exactly zero MLM loss and gradient
+            pos = np.arange(row0, row0 + ids_s.shape[0])
+            sel = sel & (pos < nreal)[:, None]
+            return place([masked, attn_s, ids_s, sel])
+
+        if feed == "sync":
+            def feed_iter(_payloads=payloads):
+                for m, pl in _payloads():
+                    yield m, assemble(pl)
+            it = feed_iter()
+        elif feed == "async":
+            it = stream_map(lambda *devs: list(devs),
+                            ((m, (pl,)) for m, pl in payloads()),
+                            put=lambda args: assemble(args[0]))
+        else:
+            raise ValueError(f"unknown feed mode {feed!r}")
+
+        ep_losses = []
+        t_step = _time.perf_counter()
+        for m, devs in _timed_feed(it):
+            s, k = divmod(m, accum)
+            gacc, wacc, lacc = micro_prog(
+                gacc, wacc, lacc, params, devs[0], devs[1], devs[2],
+                devs[3])
+            _metrics.incr("train.micro_steps")
+            if k == accum - 1:
+                ga, wa, la = gacc, wacc, lacc
+                if num_shards > 1:
+                    # rank-ordered sum of per-process chunk accumulators
+                    ga, wa, la = ordered_cross_process_sum(
+                        (gacc, wacc, lacc))
+                t_f = _time.perf_counter()
+                params, opt_state, l, gacc, wacc, lacc = apply_prog(
+                    params, opt_state, ga, wa, la)
+                _metrics.observe("train.accum_flush_s",
+                                 _time.perf_counter() - t_f)
+                ep_losses.append(l)
+                step += 1
+                _metrics.observe("train.step_s",
+                                 _time.perf_counter() - t_step)
+                t_step = _time.perf_counter()
+                _metrics.incr("train.steps")
+                _metrics.incr("train.rows",
+                              int(min(B, n - s * B)) if n >= B else B)
+                if save_ckpt and checkpoint_every and \
+                        step % checkpoint_every == 0 and \
+                        s + 1 < steps_per_epoch:
+                    ckpt.save(step, jax.device_get(params),
+                              jax.device_get(opt_state),
+                              {"epoch": ep - 1, "mid_epoch": ep,
+                               "next_batch": s + 1, "step": step})
+        history.append(float(np.mean([float(x) for x in ep_losses]))
+                       if ep_losses else float("nan"))
+        if save_ckpt:
+            ckpt.save(step, jax.device_get(params),
+                      jax.device_get(opt_state),
+                      {"epoch": ep, "step": step})
+    return cfg, jax.device_get(params), tok, history
+
+
+def pretrain_and_save(texts, out_dir: str, **kw) -> dict:
     """Pretrain + write the HF-layout checkpoint dir consumed by
-    ``checkpointFilePath`` on the BERT ops. Returns a summary dict."""
+    ``checkpointFilePath`` on the BERT ops (``texts`` may be a list or a
+    :class:`~alink_tpu.dl.data.CorpusStream`). Returns a summary dict."""
     from .pretrained import save_bert_checkpoint
 
     cfg, params, tok, history = pretrain_mlm(texts, **kw)

@@ -89,6 +89,24 @@ def batch_sharding(mesh, ndim: int, *, seq_axis: Optional[int] = None):
     return NamedSharding(mesh, P(*spec))
 
 
+def chunked_batch_sharding(mesh, ndim: int, *,
+                           seq_axis: Optional[int] = None):
+    """Sharding for a ``(chunks, batch, ...)`` stacked array: dim1 over
+    `data` with dim0 — the gradient-accumulation chunk axis — replicated,
+    so each chunk a fused accumulation program scans over has EXACTLY the
+    per-device layout of a standalone micro batch. That layout identity is
+    what makes the fused large-batch reference bit-identical to the
+    micro-step schedule on a multi-device mesh (a plain in-program reshape
+    would re-shard the rows and change the per-device reduction shapes)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    spec = [None] * ndim
+    spec[1] = AXIS_DATA
+    if seq_axis is not None and mesh.shape.get(AXIS_SEQ, 1) > 1:
+        spec[seq_axis + 1] = AXIS_SEQ
+    return NamedSharding(mesh, P(*spec))
+
+
 def shard_batch(mesh, arr: np.ndarray, *, seq_axis: Optional[int] = None):
     """Pad dim0 to the data-axis multiple and device_put with batch sharding.
     Returns (sharded, n_valid)."""

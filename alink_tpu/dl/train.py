@@ -67,6 +67,20 @@ class TrainConfig:
     # single-threaded reference feed. Bit-identical either way.
     feed: str = "async"
     feed_depth: int = 0  # in-flight batches ahead of compute; 0 = ALINK_STREAM_DEPTH
+    # gradient accumulation: the optimizer step's gradient is the ORDERED
+    # fp32 sum of accum_steps micro-chunk gradients over the effective
+    # batch (batch_size rows; batch_size % accum_steps must be 0).
+    # accum_mode="micro" runs each chunk as its own ProgramCache-resident
+    # invocation (peak activation memory = one micro batch — the HBM
+    # knob); "fused" runs the identical chunk scan inside ONE program (the
+    # large-batch reference at equal effective batch). Both modes compute
+    # the same adds on the same values in the same order, so they are
+    # bit-identical by construction (CI-pinned).
+    accum_steps: int = 1
+    accum_mode: str = "micro"  # micro | fused
+    # checkpoint retention: keep the last K checkpoints on disk (None =
+    # the ALINK_CKPT_KEEP env knob, default 3; <= 0 = unbounded)
+    checkpoint_keep: "int | None" = None
 
 
 def _make_optimizer(cfg: TrainConfig, total_steps: int):
@@ -85,12 +99,16 @@ def _make_optimizer(cfg: TrainConfig, total_steps: int):
     raise ValueError(f"unknown optimizer {cfg.optimizer!r}")
 
 
-def _loss_fn(kind: str, regression: bool, weighted: bool = False):
-    """Scalar loss ``f(logits, y)`` — or, with ``weighted``, the exact
+def _loss_fn(kind: str, regression: bool, weighted: "bool | str" = False):
+    """Scalar loss ``f(logits, y)`` — or, with ``weighted=True``, the exact
     masked form ``f(logits, y, w) = sum(l_i*w_i)/sum(w)`` used by the
     bucketed train loop (``w==1`` rows reproduce the unweighted mean
     bit-for-bit; ``w==0`` pad rows contribute exactly zero loss and
-    gradient)."""
+    gradient). ``weighted="sum"`` returns the UNNORMALIZED numerator
+    ``sum(l_i*w_i)`` — the per-chunk form the gradient-accumulation
+    programs differentiate (cotangent seed 1; the one division by the
+    effective batch's total weight happens at apply time, so a chunk's
+    gradient is independent of how the batch splits into chunks)."""
     import jax.numpy as jnp
     import optax
 
@@ -123,6 +141,12 @@ def _loss_fn(kind: str, regression: bool, weighted: bool = False):
         def f(logits, y):
             return per_row(logits, y).mean()
         return f
+
+    if weighted == "sum":
+        def fs(logits, y, w):
+            w = w.astype(jnp.float32)
+            return (per_row(logits, y) * w).sum()
+        return fs
 
     def fw(logits, y, w):
         w = w.astype(jnp.float32)
@@ -207,6 +231,137 @@ def make_train_step(model, tx, loss_of, *, weighted: bool = False,
                       key_extra=("weighted" if weighted else "plain", key))
 
 
+def make_accum_programs(model, tx, loss_sum_of, accum: int, *,
+                        model_key: Any = None, opt_key: Any = None):
+    """The ordered-chunk gradient programs behind ``TrainConfig.
+    accum_steps`` — returns ``(micro_step, apply_step, fused_step)``, all
+    ProgramCache-resident.
+
+    The gradient of an effective batch is DEFINED as the ordered fp32 sum
+    of its micro-chunk gradients (each chunk differentiates the
+    unnormalized ``sum(l_i*w_i)``; one division by the batch's total
+    weight at apply time). Under that definition the two execution
+    shapes are bit-identical by construction:
+
+    - ``micro_step`` — one chunk per invocation, accumulating into donated
+      fp32 buffers (peak activation memory = one chunk); ``apply_step``
+      normalizes, runs the optimizer update (params/opt_state donated),
+      and returns ZEROED accumulators by writing into the donated grad
+      buffers — the steady loop allocates nothing.
+    - ``fused_step`` — the large-batch reference: the SAME chunk body
+      scanned over the reshaped effective batch inside one program, then
+      the same apply math. ``lax.scan`` compiles the body once and
+      accumulates in the same order on the same values, so its result is
+      bitwise equal to the micro-step schedule (CI-pinned) — and the same
+      ordered-chunk contract is what makes P-process data parallelism
+      bit-identical to ``accum_steps=P`` on one process (`parallel.
+      distributed.ordered_cross_process_sum` adds the per-process chunk
+      sums in rank order).
+
+    ``micro_step``/``apply_step`` keys carry no chunk count — every
+    ``accum_steps`` setting of a job family shares them; ``fused_step``
+    bakes in the reshape and keys per count. Models with non-"params"
+    collections (e.g. BatchNorm stats) are rejected by the train loop —
+    cross-chunk mutable state has no well-defined accumulation order."""
+    from ..common.jitcache import cached_jit, instance_token
+
+    if model_key is None:
+        model_key = ("inst", instance_token(model),
+                     instance_token(loss_sum_of))
+    if opt_key is None:
+        opt_key = ("inst", instance_token(tx))
+
+    def _chunk_grad(jax, params, batch, y, w, dkey):
+        def loss(p):
+            kwargs = {"rngs": {"dropout": dkey}} if dkey is not None else {}
+            logits = model.apply({"params": p}, **batch,
+                                 deterministic=dkey is None, **kwargs)
+            return loss_sum_of(logits, y, w)
+
+        return jax.value_and_grad(loss)(params)
+
+    def _build_micro():
+        import jax
+        import jax.numpy as jnp
+
+        @partial(jax.jit, donate_argnums=(0, 1, 2))
+        def micro_step(gacc, wacc, lacc, variables, batch, y, w, dkey=None):
+            lsum, g = _chunk_grad(jax, variables["params"], batch, y, w,
+                                  dkey)
+            gacc = jax.tree.map(lambda a, b: a + b.astype(a.dtype), gacc, g)
+            return (gacc, wacc + w.astype(jnp.float32).sum(), lacc + lsum)
+
+        return micro_step
+
+    def _apply_math(jax, jnp, optax, params, opt_state, gacc, wacc, lacc):
+        denom = jnp.maximum(wacc, 1.0)
+        g = jax.tree.map(lambda a: a / denom, gacc)
+        updates, opt_state2 = tx.update(g, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        return new_params, opt_state2, lacc / denom
+
+    def _build_apply():
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        @partial(jax.jit, donate_argnums=(0, 1, 2))
+        def apply_step(variables, opt_state, gacc, wacc, lacc):
+            new_params, opt_state2, loss = _apply_math(
+                jax, jnp, optax, variables["params"], opt_state, gacc,
+                wacc, lacc)
+            zero_g = jax.tree.map(jnp.zeros_like, gacc)
+            return ({"params": new_params}, opt_state2, loss, zero_g,
+                    jnp.zeros_like(wacc), jnp.zeros_like(lacc))
+
+        return apply_step
+
+    def _build_fused():
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def fused_step(variables, opt_state, batch, y, w, dkeys=None):
+            # batch/y/w arrive PRE-CHUNKED as (accum, micro, ...) stacks,
+            # sharded on the micro axis (chunked_batch_sharding) — each
+            # scanned chunk then has the per-device layout of a standalone
+            # micro batch, which is what makes this program the bitwise
+            # twin of the micro-step schedule on any mesh
+            params = variables["params"]
+            xs = (batch, y, w)
+            if dkeys is not None:
+                xs = xs + (dkeys,)
+
+            def body(carry, x):
+                gacc, wacc, lacc = carry
+                bk, yk, wk = x[0], x[1], x[2]
+                dk = x[3] if len(x) > 3 else None
+                lsum, g = _chunk_grad(jax, params, bk, yk, wk, dk)
+                gacc = jax.tree.map(lambda a, b: a + b.astype(a.dtype),
+                                    gacc, g)
+                return ((gacc, wacc + wk.astype(jnp.float32).sum(),
+                         lacc + lsum), None)
+
+            zero = (jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), params),
+                jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32))
+            (gacc, wacc, lacc), _ = jax.lax.scan(body, zero, xs)
+            new_params, opt_state2, loss = _apply_math(
+                jax, jnp, optax, params, opt_state, gacc, wacc, lacc)
+            return {"params": new_params}, opt_state2, loss
+
+        return fused_step
+
+    micro = cached_jit("dl.micro_step", _build_micro,
+                       key_extra=("micro", model_key))
+    apply_p = cached_jit("dl.apply_grads", _build_apply,
+                         key_extra=("apply", model_key, opt_key))
+    fused = cached_jit("dl.fused_accum_step", _build_fused,
+                       key_extra=("fused", int(accum), model_key, opt_key))
+    return micro, apply_p, fused
+
+
 def _apply_program(model, key: Any = None):
     """Deterministic forward pass ``prog(params, batch) -> logits`` in the
     ProgramCache — eval and predict share one compiled program per model
@@ -260,6 +415,27 @@ def _feed(build: Callable[[int], Sequence[np.ndarray]],
                           depth=depth or None, phases=phases)
 
 
+def _timed_feed(it):
+    """Drain a feed iterator, observing ``train.feed_wait_s`` — the time
+    the step loop blocked waiting for the next device batch (~0 when the
+    async pipeline overlaps; ~assembly+transfer when the host is the
+    bottleneck). Per-step wall (``train.step_s``) stays with the callers:
+    its unit is the OPTIMIZER step, which under accumulation spans several
+    feed items."""
+    import time as _time
+
+    from ..common.metrics import metrics as _metrics
+
+    while True:
+        t0 = _time.perf_counter()
+        try:
+            item = next(it)
+        except StopIteration:
+            return
+        _metrics.observe("train.feed_wait_s", _time.perf_counter() - t0)
+        yield item
+
+
 def _pad_tail(arrs: List[np.ndarray], target: int) -> List[np.ndarray]:
     """Pad row-aligned arrays to ``target`` rows by repeating the last real
     row — numerically safe for any model (no all-padding attention rows, no
@@ -284,14 +460,57 @@ def train_model(
 ) -> Tuple[Any, Dict[str, Any]]:
     """Train a flax module. `inputs` maps arg names -> (n, ...) arrays; the
     module is called as model.apply(params, **inputs_batch, deterministic=...).
-    Returns (params, history)."""
+    Returns (params, history).
+
+    ``cfg.accum_steps`` > 1 runs the ordered-chunk gradient schedule (see
+    :func:`make_accum_programs`). In a multi-process cluster
+    (``jax.distributed`` joined via ``parallel.distributed.
+    init_multi_host`` — the env knobs COORDINATOR_ADDRESS / NUM_PROCESSES
+    / PROCESS_ID) every process calls ``train_model`` with the SAME
+    arguments: each computes its own shard of every micro-chunk, gradients
+    combine rank-ordered across processes before the optimizer step, and
+    only the coordinator writes checkpoints — results are bit-identical on
+    every process, and bit-identical to a single-process run with
+    ``accum_steps = P × accum_steps`` at equal effective batch."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    from ..analysis import preflight_train_config
     from ..common.jitcache import bucket_rows, bucketing_enabled
+    from ..parallel.distributed import (data_parallel_topology,
+                                        init_multi_host)
     from ..parallel.mesh import default_mesh
 
+    preflight_train_config(cfg)  # ALK103 recompile hazards, mode-gated
+    init_multi_host()  # idempotent; no-op without the topology env knobs
+    shard_idx, num_shards = data_parallel_topology()
+
+    accum = int(cfg.accum_steps or 1)
+    if accum < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {cfg.accum_steps}")
+    if cfg.accum_mode not in ("micro", "fused"):
+        raise ValueError(f"unknown accum_mode {cfg.accum_mode!r}")
+    if accum > 1 and cfg.batch_size % accum:
+        raise ValueError(
+            f"batch_size={cfg.batch_size} is not divisible by "
+            f"accum_steps={accum}: micro chunks must tile the effective "
+            "batch exactly (the ordered-chunk gradient contract)")
+    if num_shards > 1 and cfg.accum_mode == "fused":
+        raise ValueError(
+            "accum_mode='fused' needs the whole effective batch on one "
+            "process; use 'micro' under multi-process data parallelism")
+    scale = accum > 1 or num_shards > 1
+
+    if num_shards > 1 and mesh is None:
+        # per-process shards ride a LOCAL mesh: the global gradient is
+        # combined explicitly (rank-ordered) by the accumulation loop, so
+        # no program spans non-addressable devices
+        from ..parallel.mesh import AXIS_DATA as _AD
+        from ..parallel.mesh import make_mesh
+
+        local = jax.local_devices()
+        mesh = make_mesh({_AD: len(local)}, devices=local)
     mesh = mesh or default_mesh()
     n = y.shape[0]
     rng = np.random.default_rng(cfg.seed)
@@ -309,8 +528,11 @@ def train_model(
     from ..parallel.mesh import AXIS_DATA
 
     dp = mesh.shape.get(AXIS_DATA, 1)
-    # batch dim must divide evenly over the data axis
-    bs = max(dp, (min(cfg.batch_size, n_train) // dp) * dp)
+    # batch dim must divide evenly over the data axis — and under the
+    # scale loop, each of the accum_steps micro chunks must tile over the
+    # (process, data-axis) grid too
+    unit = dp * accum * num_shards
+    bs = max(unit, (min(cfg.batch_size, n_train) // unit) * unit)
     # device batch shape snaps onto the bucket ladder (rungs are multiples
     # of 8; pad rows carry zero loss-weight) so a batch-size sweep across
     # jobs shares compiled programs — and within a job, the ragged tail
@@ -318,7 +540,7 @@ def train_model(
     padded_bs = bs
     if bucketing_enabled():
         b = bucket_rows(bs)
-        if b % dp == 0:
+        if b % unit == 0:
             padded_bs = b
     if n_train >= bs:
         steps_per_epoch = -(-n_train // bs)  # tail rows now train too
@@ -347,14 +569,25 @@ def train_model(
     # content-keyed: N jobs with the same (model, optimizer, loss) config
     # share ONE compiled step; the key carries everything the closure bakes
     # into the program (schedule length included)
-    job_key = (
-        _model_key(model),
-        ("opt", cfg.optimizer, cfg.learning_rate, cfg.weight_decay,
-         cfg.warmup_ratio, total_steps),
-        ("loss", cfg.loss, regression),
-    )
-    train_step = make_train_step(model, tx, loss_of, weighted=True,
-                                 cache_key=job_key)
+    mk = _model_key(model)
+    ok = ("opt", cfg.optimizer, cfg.learning_rate, cfg.weight_decay,
+          cfg.warmup_ratio, total_steps)
+    job_key = (mk, ok, ("loss", cfg.loss, regression))
+    train_step = micro_prog = apply_prog = fused_prog = None
+    if scale:
+        if any(k != "params" for k in params):
+            raise ValueError(
+                "accum_steps/multi-process training supports params-only "
+                "models: non-'params' collections (e.g. BatchNorm "
+                f"batch_stats, here {sorted(params)}) have no well-defined "
+                "cross-chunk accumulation order")
+        loss_sum_of = _loss_fn(cfg.loss, regression, weighted="sum")
+        micro_prog, apply_prog, fused_prog = make_accum_programs(
+            model, tx, loss_sum_of, accum,
+            model_key=(mk, ("loss", cfg.loss, regression)), opt_key=ok)
+    else:
+        train_step = make_train_step(model, tx, loss_of, weighted=True,
+                                     cache_key=job_key)
     eval_prog = _apply_program(model)
 
     from ..common.metrics import metrics as _metrics
@@ -368,7 +601,8 @@ def train_model(
     if cfg.checkpoint_dir:
         from .checkpoint import TrainCheckpointManager
 
-        ckpt = TrainCheckpointManager(cfg.checkpoint_dir)
+        ckpt = TrainCheckpointManager(cfg.checkpoint_dir,
+                                      max_to_keep=cfg.checkpoint_keep)
         if cfg.resume:
             restored = ckpt.restore_latest(params, opt_state)
             if restored is not None:
@@ -402,9 +636,64 @@ def train_model(
         jax.block_until_ready(devs)
         return devs
 
+    place_chunked = None
+    if scale and cfg.accum_mode == "fused":
+        from .sharding import chunked_batch_sharding
+
+        def _in_shard_chunked(logical_ndim):
+            sa = seq_axis if logical_ndim > (seq_axis or 0) else None
+            return chunked_batch_sharding(mesh, logical_ndim + 1,
+                                          seq_axis=sa)
+
+        in_shards_chunked = [_in_shard_chunked(tr_inputs[k].ndim)
+                             for k in names]
+        chunk_row_shard = chunked_batch_sharding(mesh, 2)
+
+        def place_chunked(arrs):
+            # the fused-accumulation feed: (accum, micro, ...) stacks
+            # sharded on the micro axis, same overlap contract as place()
+            devs = [jax.device_put(a, sh)
+                    for a, sh in zip(arrs, in_shards_chunked
+                                     + [chunk_row_shard, chunk_row_shard])]
+            jax.block_until_ready(devs)
+            return devs
+
     feed_phases: Dict[str, Any] = {}
     t_start = _time.perf_counter()
     start_step = step   # resume restores the global counter; rate uses deltas
+    # multi-process: only the coordinator writes checkpoints (every process
+    # computes identical state — the combine is replicated by construction)
+    save_ckpt = ckpt is not None and shard_idx == 0
+    micro_rows = padded_bs // accum          # chunk rows, global
+    shard_rows = micro_rows // num_shards    # chunk rows, this process
+    gacc = wacc = lacc = None
+    if scale and cfg.accum_mode == "micro":
+        gacc = jax.tree.map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params["params"])
+        wacc = jnp.zeros((), jnp.float32)
+        lacc = jnp.zeros((), jnp.float32)
+    if num_shards > 1:
+        from ..parallel.distributed import ordered_cross_process_sum
+
+    def _after_step(s, l, epoch):
+        nonlocal step
+        step += 1
+        _metrics.incr("train.steps")
+        _metrics.incr("train.rows", int(min(bs, n_train - s * bs))
+                      if n_train >= bs else bs)
+        if save_ckpt and cfg.checkpoint_every and \
+                step % cfg.checkpoint_every == 0:
+            # mid-epoch save: resume restarts this epoch with this state
+            ckpt.save(step, jax.device_get(params),
+                      jax.device_get(opt_state),
+                      {"step": step, "epoch": epoch - 1})
+        if cfg.log_every and step % cfg.log_every == 0:
+            lv = float(l)
+            history["loss"].append(lv)
+            elapsed = _time.perf_counter() - t_start
+            _metrics.record("dl.train", step=step, loss=lv,
+                            samples_per_sec=step * bs / max(elapsed, 1e-9))
+
     for epoch in range(start_epoch, cfg.num_epochs):
         # per-(seed, epoch) generator, NOT the sequentially-consumed rng: a
         # crash-resumed run must replay the exact shuffle of the epochs it
@@ -413,37 +702,107 @@ def train_model(
         if n_train < bs:  # tile tiny datasets up to one full batch
             order = np.resize(order, bs)
 
-        def build(s, _order=order):
-            idx = _order[s * bs:(s + 1) * bs]
-            arrs = [tr_inputs[k][idx] for k in names] + [tr_y[idx]]
-            w = np.ones(len(idx), np.float32)
-            if len(idx) < padded_bs:
-                arrs = _pad_tail(arrs, padded_bs)
-                w = np.concatenate(
-                    [w, np.zeros(padded_bs - len(idx), np.float32)])
-            return arrs + [w]
+        if not scale:
+            def build(s, _order=order):
+                idx = _order[s * bs:(s + 1) * bs]
+                arrs = [tr_inputs[k][idx] for k in names] + [tr_y[idx]]
+                w = np.ones(len(idx), np.float32)
+                if len(idx) < padded_bs:
+                    arrs = _pad_tail(arrs, padded_bs)
+                    w = np.concatenate(
+                        [w, np.zeros(padded_bs - len(idx), np.float32)])
+                return arrs + [w]
 
-        for s, devs in _feed(build, place, steps_per_epoch, mode=cfg.feed,
-                             depth=cfg.feed_depth, phases=feed_phases):
-            batch = dict(zip(names, devs[:-2]))
-            yb, wb = devs[-2], devs[-1]
-            params, opt_state, l = train_step(
-                params, opt_state, batch, yb, wb,
-                jax.random.fold_in(key, step)
-            )
-            step += 1
-            if ckpt is not None and cfg.checkpoint_every and \
-                    step % cfg.checkpoint_every == 0:
-                # mid-epoch save: resume restarts this epoch with this state
-                ckpt.save(step, jax.device_get(params),
-                          jax.device_get(opt_state),
-                          {"step": step, "epoch": epoch - 1})
-            if cfg.log_every and step % cfg.log_every == 0:
-                lv = float(l)
-                history["loss"].append(lv)
-                elapsed = _time.perf_counter() - t_start
-                _metrics.record("dl.train", step=step, loss=lv,
-                                samples_per_sec=step * bs / max(elapsed, 1e-9))
+            t_step = _time.perf_counter()
+            for s, devs in _timed_feed(_feed(
+                    build, place, steps_per_epoch, mode=cfg.feed,
+                    depth=cfg.feed_depth, phases=feed_phases)):
+                batch = dict(zip(names, devs[:-2]))
+                yb, wb = devs[-2], devs[-1]
+                params, opt_state, l = train_step(
+                    params, opt_state, batch, yb, wb,
+                    jax.random.fold_in(key, step)
+                )
+                _metrics.observe("train.step_s",
+                                 _time.perf_counter() - t_step)
+                t_step = _time.perf_counter()
+                _after_step(s, l, epoch)
+        elif cfg.accum_mode == "fused":
+            def build_full(s, _order=order):
+                idx = _order[s * bs:(s + 1) * bs]
+                arrs = [tr_inputs[k][idx] for k in names] + [tr_y[idx]]
+                w = np.ones(len(idx), np.float32)
+                if len(idx) < padded_bs:
+                    arrs = _pad_tail(arrs, padded_bs)
+                    w = np.concatenate(
+                        [w, np.zeros(padded_bs - len(idx), np.float32)])
+                # pre-chunk host-side: (accum, micro, ...) — the scan's
+                # chunk layout is decided HERE, not by an in-program
+                # reshard (see chunked_batch_sharding)
+                return [a.reshape((accum, micro_rows) + a.shape[1:])
+                        for a in arrs + [w]]
+
+            t_step = _time.perf_counter()
+            for s, devs in _timed_feed(_feed(
+                    build_full, place_chunked, steps_per_epoch,
+                    mode=cfg.feed, depth=cfg.feed_depth,
+                    phases=feed_phases)):
+                batch = dict(zip(names, devs[:-2]))
+                yb, wb = devs[-2], devs[-1]
+                skey = jax.random.fold_in(key, step)
+                dkeys = jnp.stack([jax.random.fold_in(skey, k)
+                                   for k in range(accum)])
+                params, opt_state, l = fused_prog(
+                    params, opt_state, batch, yb, wb, dkeys)
+                _metrics.observe("train.step_s",
+                                 _time.perf_counter() - t_step)
+                t_step = _time.perf_counter()
+                _after_step(s, l, epoch)
+        else:
+            def build_micro(m, _order=order):
+                s, k = divmod(m, accum)
+                start = s * bs
+                m_real = min(bs, len(_order) - start)
+                lo = k * micro_rows + shard_idx * shard_rows
+                pos = np.arange(lo, lo + shard_rows)
+                # positions past the real rows pad by repeating the LAST
+                # real row of the effective batch with zero loss-weight —
+                # the same exact-padding contract as the fused reference
+                idx = _order[start + np.minimum(pos, m_real - 1)]
+                arrs = [tr_inputs[k2][idx] for k2 in names] + [tr_y[idx]]
+                return arrs + [(pos < m_real).astype(np.float32)]
+
+            t_step = _time.perf_counter()
+            skey = None
+            for m, devs in _timed_feed(_feed(
+                    build_micro, place, steps_per_epoch * accum,
+                    mode=cfg.feed, depth=cfg.feed_depth,
+                    phases=feed_phases)):
+                s, k = divmod(m, accum)
+                if k == 0:
+                    skey = jax.random.fold_in(key, step)
+                batch = dict(zip(names, devs[:-2]))
+                yb, wb = devs[-2], devs[-1]
+                gacc, wacc, lacc = micro_prog(
+                    gacc, wacc, lacc, params, batch, yb, wb,
+                    jax.random.fold_in(skey, k))
+                _metrics.incr("train.micro_steps")
+                if k == accum - 1:
+                    ga, wa, la = gacc, wacc, lacc
+                    if num_shards > 1:
+                        # rank-ordered sum of the per-process chunk
+                        # accumulators — bit-identical on every process
+                        ga, wa, la = ordered_cross_process_sum(
+                            (gacc, wacc, lacc))
+                    t_f = _time.perf_counter()
+                    params, opt_state, l, gacc, wacc, lacc = apply_prog(
+                        params, opt_state, ga, wa, la)
+                    _metrics.observe("train.accum_flush_s",
+                                     _time.perf_counter() - t_f)
+                    _metrics.observe("train.step_s",
+                                     _time.perf_counter() - t_step)
+                    t_step = _time.perf_counter()
+                    _after_step(s, l, epoch)
         if not cfg.log_every:
             lv = float(l)
             history["loss"].append(lv)
@@ -452,7 +811,7 @@ def train_model(
                 "dl.train", step=step, loss=lv,
                 samples_per_sec=(step - start_step) * bs / max(elapsed, 1e-9))
 
-        if ckpt is not None:
+        if save_ckpt:
             ckpt.save(step, jax.device_get(params), jax.device_get(opt_state),
                       {"step": step, "epoch": epoch})
         if n_eval:

@@ -101,3 +101,52 @@ def is_coordinator() -> bool:
     import jax
 
     return jax.process_index() == 0
+
+
+def data_parallel_topology() -> "tuple[int, int]":
+    """``(shard, num_shards)`` for per-process data parallelism: the
+    process index/count of the joined cluster. ``(0, 1)`` single-host —
+    the train loops shard their batches by this, so code written against
+    it runs unchanged on one process or a pod."""
+    import jax
+
+    return (getattr(jax, "process_index", lambda: 0)(),
+            getattr(jax, "process_count", lambda: 1)())
+
+
+def ordered_cross_process_sum(tree):
+    """Deterministic cross-process tree sum: all-gather every process's
+    value, then EVERY process adds the per-process parts in process order.
+
+    This is the collective the data-parallel train loops combine gradients
+    with, instead of a backend all-reduce, because it is bit-stable by
+    construction: the gather moves bytes (no arithmetic), and the
+    rank-ordered sequential sum has one fixed association — identical on
+    every process, and identical to a single-process run that accumulates
+    the same per-shard chunks in the same order (the ``accum_steps``
+    schedule). A psum's reduction order is a topology detail of the
+    backend's ring/tree and carries no such guarantee for P > 2 (two-term
+    float addition is commutative, three is not associative).
+
+    Costs one host gather per call — the train loops pay it once per
+    OPTIMIZER step (after local accumulation), not per micro-step. Returns
+    the input unchanged (single element) when the cluster has one
+    process."""
+    import jax
+
+    if getattr(jax, "process_count", lambda: 1)() <= 1:
+        return tree
+    from jax.experimental import multihost_utils
+
+    import numpy as np
+
+    gathered = multihost_utils.process_allgather(tree)
+
+    def _sum(stacked):
+        parts = np.asarray(stacked)
+        out = parts[0]
+        for k in range(1, parts.shape[0]):
+            out = out + parts[k]  # fixed association, rank order
+        return out
+
+    return jax.tree.map(_sum, gathered)

@@ -804,6 +804,35 @@ def test_pallas_flag_falsey_convention(monkeypatch):
     assert use_pallas_hist() is True
 
 
+def test_train_config_off_ladder_alk103():
+    """ALK103 extended to TrainConfig (ISSUE 15 satellite): off-ladder
+    effective batch, off-ladder micro batch (batch_size/accum_steps), and
+    accum-indivisible batch sizes are all recompile/packing hazards the
+    pre-flight flags before the train loop compiles anything."""
+    from alink_tpu.analysis import validate_train_config
+    from alink_tpu.common.jitcache import bucket_rows
+    from alink_tpu.dl.train import TrainConfig
+
+    # clean: ladder batch, ladder micro
+    assert validate_train_config(TrainConfig(batch_size=64,
+                                             accum_steps=4)).ok
+
+    rep = validate_train_config(TrainConfig(batch_size=50))
+    assert _rules(rep) == {"ALK103": 1}
+    assert "50" in rep.diagnostics[0].message
+
+    # 56 is ON the ladder but 56/2=28 is not: only the micro fires
+    assert bucket_rows(56) == 56 and bucket_rows(28) != 28
+    rep = validate_train_config(TrainConfig(batch_size=56, accum_steps=2))
+    assert _rules(rep) == {"ALK103": 1}
+    assert "micro batch 28" in rep.diagnostics[0].message
+
+    # indivisible accum flags alongside the off-ladder batch
+    rep = validate_train_config(TrainConfig(batch_size=50, accum_steps=3))
+    assert _rules(rep) == {"ALK103": 2}
+    assert any("divisible" in d.message for d in rep.diagnostics)
+
+
 def test_distributed_topology_knobs_fail_loudly(monkeypatch):
     # topology (unlike tuning) knobs must not silently degrade a multi-host
     # job: a malformed NUM_PROCESSES raises, exactly as before the env
