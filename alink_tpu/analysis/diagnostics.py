@@ -58,6 +58,12 @@ RULES: Dict[str, tuple] = {
                "outside common/jitcache.py — bypasses the one sanctioned "
                "owner (knob ALINK_COMPILE_CACHE_DIR, persist counters, "
                "corruption fallback, disk LRU cap)"),
+    "ALK008": ("unregistered-pallas", WARNING,
+               "jax.experimental.pallas import or pl.pallas_call reference "
+               "outside alink_tpu/native/ and the modules registered in "
+               "native/kernels.py — an unregistered kernel has no knob, no "
+               "XLA fallback, no parity contract, and is invisible to the "
+               "kernel_candidates() cross-reference"),
     # -- plan validation (pre-flight over user DAGs) -----------------------
     "ALK101": ("missing-column", ERROR,
                "a column named by selectedCols/featureCols/labelCol/... is "

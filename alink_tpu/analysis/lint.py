@@ -79,6 +79,19 @@ _ENV_MODULE = "common/env.py"
 _JITCACHE_MODULE = "common/jitcache.py"
 _SHARDMAP_SHIM = "parallel/shardmap.py"
 
+# ALK008 allow-list: anything under native/ plus the modules the kernel
+# registry declares (native/kernels.py stays import-light, so reading the
+# list here costs no jax import)
+_NATIVE_DIR = "alink_tpu/native/"
+try:
+    from ..native.kernels import KERNEL_MODULES as _KERNEL_MODULES
+except Exception:  # pragma: no cover — lint must run even mid-refactor
+    _KERNEL_MODULES = ()
+
+_PALLAS_HINT = ("implement the kernel in a module registered in "
+                "alink_tpu/native/kernels.py (knob + fallback + parity "
+                "contract), following docs/kernels.md")
+
 _MUTATORS = ("update", "setdefault", "pop", "popitem", "clear")
 
 # jax config names ALK006 treats as compile-cache configuration — writing
@@ -123,6 +136,8 @@ class _FileLinter(ast.NodeVisitor):
         self.is_env_module = relpath.endswith(_ENV_MODULE)
         self.is_jitcache = relpath.endswith(_JITCACHE_MODULE)
         self.is_shardmap_shim = relpath.endswith(_SHARDMAP_SHIM)
+        self.is_kernel_module = _NATIVE_DIR in relpath or any(
+            relpath.endswith(m) for m in _KERNEL_MODULES)
         self.threaded = any(relpath.endswith(m) for m in _THREADED_MODULES)
         self.shared_dicts = self._module_dicts(tree) if self.threaded else set()
 
@@ -310,6 +325,21 @@ class _FileLinter(ast.NodeVisitor):
                 "without it",
                 hint="from alink_tpu.parallel.shardmap import shard_map "
                      "(the one sanctioned import)")
+        # jax.experimental.pallas attribute chains (innermost match, same
+        # single-report shape as ALK002); pl.pallas_call catches call sites
+        # whose import dodged the import rules (e.g. importlib)
+        if not self.is_kernel_module and (
+                (node.attr == "pallas"
+                 and _dotted(node.value) == "jax.experimental")
+                or (node.attr == "pallas_call"
+                    # full chains report once, at the inner pallas attr
+                    and "jax.experimental" not in _dotted(node.value))):
+            self._add(
+                "ALK008", node,
+                f"direct {_dotted(node)} reference outside a registered "
+                "kernel module — unregistered Pallas kernels have no knob, "
+                "no fallback, and no parity contract",
+                hint=_PALLAS_HINT)
         self.generic_visit(node)
 
     def visit_Import(self, node: ast.Import):
@@ -327,6 +357,13 @@ class _FileLinter(ast.NodeVisitor):
                     hint="use common/jitcache (enable_persistent_cache / "
                          "persist_summary / prune_persistent_cache), the "
                          "one sanctioned owner")
+            if "pallas" in alias.name and "jax" in alias.name \
+                    and not self.is_kernel_module:
+                self._add(
+                    "ALK008", node,
+                    f"import {alias.name} — Pallas outside a registered "
+                    "kernel module",
+                    hint=_PALLAS_HINT)
         self.generic_visit(node)
 
     def visit_ImportFrom(self, node: ast.ImportFrom):
@@ -352,6 +389,19 @@ class _FileLinter(ast.NodeVisitor):
                 hint="use common/jitcache (enable_persistent_cache / "
                      "persist_summary / prune_persistent_cache), the one "
                      "sanctioned owner")
+        # jax pallas only: relative imports of the registered *_pallas
+        # wrapper modules (their public entry points) are the sanctioned
+        # integration idiom and carry no pl.pallas_call themselves
+        pallas_drift = mod.startswith("jax") and (
+            "pallas" in mod
+            or any("pallas" in a.name for a in node.names))
+        if pallas_drift and not self.is_kernel_module:
+            names = ", ".join(a.name for a in node.names)
+            self._add(
+                "ALK008", node,
+                f"from {mod} import {names} — Pallas outside a registered "
+                "kernel module",
+                hint=_PALLAS_HINT)
         self.generic_visit(node)
 
     def visit_Subscript(self, node: ast.Subscript):

@@ -156,7 +156,8 @@ def metric_direction(path: str) -> Optional[str]:
         # is meaningless and would false-flag healthy rounds
         return None
     for s in ("per_sec", "accuracy", "purity", "mfu", "hit_rate",
-              "speedup", "tflops", "batch_fill", "bandwidth", "mb_per_s"):
+              "speedup", "tflops", "batch_fill", "bandwidth", "mb_per_s",
+              "efficiency"):
         if s in p:
             return "higher"
     for s in ("wall", "latency", "overhead", "tax", "span_cost",
@@ -174,6 +175,11 @@ def metric_threshold(path: str, override: Optional[float] = None) -> float:
     p = path.lower()
     if "cold" in p:
         return COLD_THRESHOLD
+    if "efficiency" in p:
+        # roofline efficiency = achieved / ceiling with the achieved side
+        # read off a measured wall — it inherits the wall's jitter, not a
+        # rate metric's stability
+        return WALL_THRESHOLD
     if metric_direction(p) == "lower":
         return WALL_THRESHOLD
     return DEFAULT_THRESHOLD

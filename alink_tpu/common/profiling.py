@@ -708,21 +708,12 @@ def costs_by_kernel(*, resolve: bool = True) -> Dict[str, Dict[str, Any]]:
     return out
 
 
-def profile_summary(top: Optional[int] = None, *,
-                    resolve: bool = True) -> Dict[str, Any]:
-    """The one-call performance-observatory readout: device peaks + ridge,
-    HBM watermark, and a per-kernel table joining static XLA cost with
-    measured exec timings into roofline verdicts. Feeds ``job_report()``,
-    ``GET /api/profile``, the ``alink_profile_*`` Prometheus gauges, and
-    the BENCH ``profiling`` extra."""
-    if resolve and profiling_enabled():
-        resolve_pending()
-        sample_device_memory()
-    peaks = device_peaks()
-    with _reg_lock:
-        recs = [dict(r) for r in _COSTS.values()]
-    pending = sum(1 for r in recs if r["capture"] == "pending")
-
+def _kernel_rows(recs: List[Dict[str, Any]],
+                 peaks: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Per-kernel aggregation shared by ``profile_summary`` and
+    ``kernel_candidates``: sums calls/wall over a kernel id's programs,
+    picks the dominant (most-called) program's static costs, and attaches
+    its roofline verdict. Sorted by total wall, busiest first."""
     by_kernel: Dict[str, Dict[str, Any]] = {}
     dominant: Dict[str, Dict[str, Any]] = {}
     for r in recs:
@@ -763,6 +754,77 @@ def profile_summary(top: Optional[int] = None, *,
                                    dom["exec_mean_s"], peaks)
         rows.append(row)
     rows.sort(key=lambda r: -(r["exec_total_s"] or 0.0))
+    return rows
+
+
+def kernel_candidates(top: Optional[int] = None, *,
+                      resolve: bool = True) -> List[Dict[str, Any]]:
+    """The roofline worst-offenders table: which program to hand-fuse next.
+
+    Joins each kernel id's measured warm wall time with its roofline
+    verdict — ``lost_s = exec_total_s × (1 − efficiency)`` is the seconds
+    the program left on the table against its attainable ceiling — and
+    cross-references the custom-kernel registry (``native/kernels.py``) so
+    every row answers "does this path already have a hand-written kernel,
+    and is it switched on". Rows with a measurable efficiency rank first
+    by lost seconds, worst offender on top; rows without one (no flops
+    capture or no warm timing yet) follow, ordered by wall time.
+
+    Surfaced by ``profile_summary()`` (hence ``job_report()`` and
+    ``GET /api/profile``) and the BENCH ``kernels`` extra."""
+    from ..native.kernels import covering, kernel_enabled, kernel_spec
+
+    if resolve and profiling_enabled():
+        resolve_pending()
+    peaks = device_peaks()
+    with _reg_lock:
+        recs = [dict(r) for r in _COSTS.values()]
+    out: List[Dict[str, Any]] = []
+    for row in _kernel_rows(recs, peaks):
+        eff = row["roofline"].get("efficiency")
+        lost = None
+        if eff is not None:
+            lost = round(
+                (row["exec_total_s"] or 0.0) * max(0.0, 1.0 - min(eff, 1.0)),
+                6)
+        covered = covering(row["kernel"])
+        spec = kernel_spec(covered) if covered else None
+        out.append({
+            "kernel": row["kernel"],
+            "programs": row["programs"],
+            "calls": row["calls"],
+            "exec_total_s": row["exec_total_s"],
+            "exec_mean_s": row["exec_mean_s"],
+            "bound": row["roofline"].get("bound"),
+            "efficiency": eff,
+            "lost_s": lost,
+            "custom_kernel": covered,
+            "knob": spec["knob"] if spec else None,
+            "kernel_enabled": kernel_enabled(spec["knob"]) if spec else None,
+        })
+    out.sort(key=lambda r: (0, -r["lost_s"]) if r["lost_s"] is not None
+             else (1, -(r["exec_total_s"] or 0.0)))
+    if top is not None:
+        out = out[:top]
+    return out
+
+
+def profile_summary(top: Optional[int] = None, *,
+                    resolve: bool = True) -> Dict[str, Any]:
+    """The one-call performance-observatory readout: device peaks + ridge,
+    HBM watermark, a per-kernel table joining static XLA cost with
+    measured exec timings into roofline verdicts, and the ranked
+    ``candidates`` worst-offenders table. Feeds ``job_report()``,
+    ``GET /api/profile``, the ``alink_profile_*`` Prometheus gauges, and
+    the BENCH ``profiling``/``kernels`` extras."""
+    if resolve and profiling_enabled():
+        resolve_pending()
+        sample_device_memory()
+    peaks = device_peaks()
+    with _reg_lock:
+        recs = [dict(r) for r in _COSTS.values()]
+    pending = sum(1 for r in recs if r["capture"] == "pending")
+    rows = _kernel_rows(recs, peaks)
     if top is not None:
         rows = rows[:top]
     return {
@@ -771,6 +833,7 @@ def profile_summary(top: Optional[int] = None, *,
         "device": peaks,
         "hbm": hbm_watermark(),
         "kernels": rows,
+        "candidates": kernel_candidates(top=top, resolve=False),
         "registry": {"records": len(recs), "pending": pending},
         "counters": metrics.counters("profile."),
     }

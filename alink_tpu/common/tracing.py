@@ -450,3 +450,56 @@ def job_report(trace_id: Optional[str] = None) -> Dict[str, Any]:
         "outcomes": outcomes,
         "caches": caches,
     }
+
+
+def chrome_trace(trace_id: Optional[str] = None) -> Dict[str, Any]:
+    """The span ring as a chrome://tracing / Perfetto JSON object (trace
+    event format). ``trace_id=None`` exports every finished span in the
+    ring — one waterfall across jobs; pass an id to cut one job out.
+
+    Each span becomes one complete ("X") event with its phases, attrs,
+    outcome, and span/parent ids under ``args``; threads map to stable
+    integer tids with thread_name metadata so the waterfall groups by the
+    pool/transfer/driver thread that ran the work. Load the file via
+    ui.perfetto.dev or chrome://tracing. ``bench.py --trace-artifact``
+    writes one per round."""
+    spans = tracer.spans(trace_id)
+    events: List[Dict[str, Any]] = [{
+        "ph": "M", "pid": 1, "tid": 0, "name": "process_name",
+        "args": {"name": "alink_tpu"},
+    }]
+    tids: Dict[str, int] = {}
+    for s in spans:
+        thread = s.get("thread") or "?"
+        tid = tids.get(thread)
+        if tid is None:
+            tid = tids[thread] = len(tids) + 1
+            events.append({"ph": "M", "pid": 1, "tid": tid,
+                           "name": "thread_name",
+                           "args": {"name": thread}})
+        args: Dict[str, Any] = {
+            "trace_id": s["trace_id"], "span_id": s["span_id"],
+            "parent_id": s.get("parent_id"), "outcome": s.get("outcome"),
+        }
+        for key in ("phases", "attrs", "retries", "error"):
+            if s.get(key):
+                args[key] = s[key]
+        events.append({
+            "ph": "X", "pid": 1, "tid": tid,
+            "name": s["name"],
+            "cat": s.get("outcome") or "ok",
+            "ts": round(s["t_start"] * 1e6, 3),
+            "dur": round(max(s.get("wall_s") or 0.0, 0.0) * 1e6, 3),
+            "args": args,
+        })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def write_chrome_trace(path: str, trace_id: Optional[str] = None) -> int:
+    """Write :func:`chrome_trace` to ``path``; returns the span count."""
+    blob = chrome_trace(trace_id)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(blob, f)
+        f.write("\n")
+    # metadata events (process + one per thread) don't count as spans
+    return sum(1 for e in blob["traceEvents"] if e["ph"] == "X")

@@ -27,6 +27,7 @@ import jax.numpy as jnp
 
 from ..parallel.mesh import AXIS_SEQ
 from ..parallel.shardmap import axis_size, pvary, shard_map
+from .attn_pallas import flash_block_update, use_attn_pallas
 
 _NEG_INF = -1e30
 
@@ -106,6 +107,39 @@ def blockwise_attention(
     scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
     q_pos = jnp.arange(sq)
 
+    if use_attn_pallas():
+        # fused flash path: same scan, but the score block + online-softmax
+        # update run inside one Pallas program (attn_pallas.py) with
+        # (B, H, ...) accumulator layout. Knob read at trace time; knob-off
+        # compiles the scan below untouched.
+        from ..native.kernels import interpret_mode
+
+        interp = interpret_mode()
+        scale_f = float(d) ** -0.5
+        qf = q.transpose(0, 2, 1, 3)              # (B, H, Q, D)
+        ok_all = jnp.ones((sq, block_size), jnp.int32)
+
+        def fstep(carry, blk):
+            o, m, l = carry
+            kk, vv, mm, i = blk
+            if causal:
+                k_pos = i * block_size + jnp.arange(block_size)
+                ok = (q_pos[:, None] >= k_pos[None, :]).astype(jnp.int32)
+            else:
+                ok = ok_all
+            o, m, l = flash_block_update(
+                qf, kk.transpose(0, 2, 1, 3), vv.transpose(0, 2, 1, 3),
+                mm, ok, o, m, l, scale=scale_f, interpret=interp)
+            return (o, m, l), None
+
+        of0 = jnp.zeros((b, h, sq, d), jnp.float32)
+        m0 = jnp.full((b, h, sq), _NEG_INF, jnp.float32)
+        l0 = jnp.zeros((b, h, sq), jnp.float32)
+        (o, m, l), _ = jax.lax.scan(
+            fstep, (of0, m0, l0), (kb, vb, mb, jnp.arange(nb)))
+        l = jnp.maximum(l, 1e-30)
+        return (o / l[..., None]).transpose(0, 2, 1, 3).astype(q.dtype)
+
     def step(carry, blk):
         o, m, l = carry
         kk, vv, mm, i = blk
@@ -140,6 +174,45 @@ def _ring_body(q, k, v, mask, axis_name: str, causal: bool):
     # loop outputs (check_vma-tracked), hence pvary
     def _varying(x):
         return pvary(x, axis_name)
+
+    if use_attn_pallas():
+        # fused flash path: per-shard score block + online-softmax update as
+        # one Pallas program; the ppermute ring around it is unchanged.
+        from ..native.kernels import interpret_mode
+
+        interp = interpret_mode()
+        scale_f = float(d) ** -0.5
+        sk = k.shape[1]
+        qf = q.transpose(0, 2, 1, 3)              # (B, H, Q, D)
+        of0 = _varying(jnp.zeros((b, h, sq, d), jnp.float32))
+        mf0 = _varying(jnp.full((b, h, sq), _NEG_INF, jnp.float32))
+        lf0 = _varying(jnp.zeros((b, h, sq), jnp.float32))
+        kv_all = jnp.ones((b, sk), jnp.int32)
+        ok_all = jnp.ones((sq, sk), jnp.int32)
+
+        def fstep(i, carry):
+            o, m, l, k, v, kmask = carry
+            src = jnp.mod(my - i, n)
+            if causal:
+                q_pos = my * sq + jnp.arange(sq)
+                k_pos = src * sk + jnp.arange(sk)
+                ok = (q_pos[:, None] >= k_pos[None, :]).astype(jnp.int32)
+            else:
+                ok = ok_all
+            o, m, l = flash_block_update(
+                qf, k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3),
+                kv_all if kmask is None else kmask, ok, o, m, l,
+                scale=scale_f, interpret=interp)
+            o, m, l = _varying(o), _varying(m), _varying(l)
+            k = jax.lax.ppermute(k, axis_name, perm)
+            v = jax.lax.ppermute(v, axis_name, perm)
+            if kmask is not None:
+                kmask = jax.lax.ppermute(kmask, axis_name, perm)
+            return o, m, l, k, v, kmask
+
+        o, m, l, *_ = jax.lax.fori_loop(0, n, fstep, (of0, mf0, lf0, k, v, mask))
+        l = jnp.maximum(l, 1e-30)
+        return (o / l[..., None]).transpose(0, 2, 1, 3).astype(q.dtype)
 
     o0 = _varying(jnp.zeros((b, sq, h, d), jnp.float32))
     m0 = _varying(jnp.full((b, h, sq), _NEG_INF, jnp.float32))

@@ -213,22 +213,31 @@ def _build_sgns_host(mesh, axis, spec, neg_logits):
         check_vma=False))
 
 
-def _build_sgns_sharded(mesh, axis, spec, neg_logits, hot, cap_in, cap_ctx):
+def _build_sgns_sharded(mesh, axis, spec, neg_logits, hot, cap_in, cap_ctx,
+                        fused=False):
     """Sharded engine: owner-routed pull/push (+ hot-key cache when
-    ``hot > 0``; ``hot == 0`` compiles to exactly the uncached program)."""
+    ``hot > 0``; ``hot == 0`` compiles to exactly the uncached program).
+
+    ``fused`` routes the per-block gradient math through the Pallas kernel
+    (embedding/sgns_pallas.py) instead of :func:`_block_grads`; it is part
+    of the ProgramCache key, so toggling ``ALINK_SGNS_PALLAS`` selects
+    between two coexisting programs without invalidating either."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
 
+    from ..native.kernels import interpret_mode
     from ..parallel.aps import pull, push
     from ..parallel.hotcache import (pull_cached, refresh_hot,
                                      refresh_hot_many)
     from ..parallel.shardmap import shard_map
+    from .sgns_pallas import sgns_block_grads
 
     (rows, D, B, negs, steps, n_blocks, lr0, seed, tie, neg_v) = spec
     M = mesh.shape[axis]
     key0 = jax.random.PRNGKey(seed)
     neg_np = neg_logits
+    interpret = interpret_mode()   # captured at build time, like the flag
 
     def body(pairs_l, win_l, wout_l):
         neg_l = None if neg_np is None else jnp.asarray(neg_np)
@@ -258,7 +267,11 @@ def _build_sgns_sharded(mesh, axis, spec, neg_logits, hot, cap_in, cap_ctx):
                 u = pull(w_ctx, uids, axis, rows)
             u_pos = u[:B]
             u_neg = u[B:].reshape(B, negs, D)
-            grad_v, grad_u = _block_grads(v, u_pos, u_neg, D)
+            if fused:
+                grad_v, grad_u = sgns_block_grads(
+                    v, u_pos, u_neg, interpret=interpret)
+            else:
+                grad_v, grad_u = _block_grads(v, u_pos, u_neg, D)
 
             scale = lr / M
             win_l = push(win_l, center, grad_v, axis, rows, scale)
@@ -355,8 +368,15 @@ def _run_pairs_sharded(pairs, V, D, B, negs, steps, n_blocks, lr0, seed, *,
                                 hot, rows, M)
     spec = (rows, D, B, negs, steps, n_blocks, float(lr0), int(seed),
             bool(tie), int(neg_v))
+    # the Pallas-fusion flag is a STATIC key component: knob-on and
+    # knob-off programs coexist in the cache (toggling re-selects, never
+    # re-traces — the zero-retrace pin in tests/test_kernels.py)
+    from .sgns_pallas import use_sgns_pallas
+
+    fused = bool(use_sgns_pallas()) and negs >= 1
     prog = cached_jit("embedding.sgns_sharded", _build_sgns_sharded, axis,
-                      spec, neg_logits, hot, cap_in, cap_ctx, mesh=mesh)
+                      spec, neg_logits, hot, cap_in, cap_ctx, fused,
+                      mesh=mesh)
     args = (jax.device_put(pairs, NamedSharding(mesh, P(axis))),
             w_in.array, w_out.array)
     if _lower_only:

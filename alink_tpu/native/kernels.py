@@ -1,0 +1,154 @@
+"""Custom-kernel registry: every hand-written Pallas kernel in one table.
+
+The kernel program (ROADMAP item 3) grew from one ad-hoc kernel
+(``tree/pallas_hist.py``) to a family; this registry is the single place
+that records, per kernel id: the env knob that gates it, the module that
+implements it, the XLA fallback it must stay parity-pinned against, and the
+parity contract the CI suite enforces. ``profiling.kernel_candidates()``
+cross-references it so "has a custom kernel" is queryable next to the
+roofline worst-offenders ranking, and alink-lint ALK008 reads
+:data:`KERNEL_MODULES` as the allow-list for ``jax.experimental.pallas``
+imports — a Pallas call site outside a registered module fails ``--check``.
+
+All three kernels share ONE gate parser (:func:`kernel_enabled`): an env
+knob set to a falsey spelling (``0/off/false/no``) disables, any other
+non-blank value enables, blank/unset defers to the backend default (on for
+real TPU backends, off elsewhere). Off-TPU the kernels run in interpret
+mode (:func:`interpret_mode`), so the CPU test mesh validates the exact
+same programs.
+
+This module stays import-light (no jax at module scope): the linter and
+the WebUI import it without touching an accelerator runtime.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from ..common.env import env_str
+
+# backends on which the Mosaic lowering is real hardware ("axon" is the
+# tunneled TPU platform) — the gate default and the interpret-mode switch
+_TPU_BACKENDS = ("tpu", "axon")
+
+# falsey spellings shared with env_flag; blank counts as UNSET (backend
+# default), not as off — the convention pallas_hist established
+_FALSEY = ("0", "off", "false", "no")
+
+
+def interpret_mode() -> bool:
+    """True when Pallas kernels must run in interpret mode (no TPU
+    backend). One switch for every kernel so CPU meshes validate the same
+    programs Mosaic compiles on the chip."""
+    import jax
+
+    return jax.default_backend() not in _TPU_BACKENDS
+
+
+def kernel_enabled(knob: str) -> bool:
+    """The shared gate parser: explicit knob value wins (falsey spellings
+    off, anything else on, blank = unset), otherwise default-on exactly on
+    real TPU backends. Every registered kernel's ``use_*()`` routes through
+    here so all knobs parse on/off/backend identically."""
+    flag = env_str(knob)
+    if flag is not None and flag.strip():
+        return flag.strip().lower() not in _FALSEY
+    import jax
+
+    return jax.default_backend() in _TPU_BACKENDS
+
+
+# kernel id -> static registration record. ``module`` paths are
+# repo-relative and feed the ALK008 allow-list; ``fallback`` names the XLA
+# path the knob-off route compiles; ``contract`` is the CI-pinned parity
+# promise; ``programs`` lists the ProgramCache kernel_id prefixes the
+# kernel rides inside — the join key :func:`covering` resolves for the
+# candidates table.
+_REGISTRY: Dict[str, Dict[str, Any]] = {
+    "tree.pallas_hist": {
+        "knob": "ALINK_GBDT_PALLAS",
+        "module": "alink_tpu/tree/pallas_hist.py",
+        "entry": "pallas_histogram",
+        "programs": ("tree.level",),
+        "fallback": "vmapped segment_sum histogram (tree/grow.py)",
+        "contract": "forest trees identical vs fallback at atol=1e-5 "
+                    "(tests/test_pallas_hist.py)",
+    },
+    "embedding.sgns_pallas": {
+        "knob": "ALINK_SGNS_PALLAS",
+        "module": "alink_tpu/embedding/sgns_pallas.py",
+        "entry": "sgns_block_grads",
+        "programs": ("embedding.sgns_sharded",),
+        "fallback": "XLA gather/einsum/scatter step "
+                    "(embedding/skipgram._block_grads)",
+        "contract": "block gradients within atol=1e-5 of _block_grads "
+                    "(fp32; summation order over negatives differs), "
+                    "knob-off byte-identical (tests/test_kernels.py)",
+    },
+    "dl.attn_pallas": {
+        "knob": "ALINK_ATTN_PALLAS",
+        "module": "alink_tpu/dl/attn_pallas.py",
+        "entry": "flash_block_update",
+        "programs": ("dl.train_step", "dl.micro_step",
+                     "dl.fused_accum_step", "dl.mlm_step", "dl.mlm_micro",
+                     "dl.attention"),
+        "fallback": "lax.scan online-softmax "
+                    "(dl/attention._online_softmax_update)",
+        "contract": "blockwise/ring outputs within atol=1e-5 of the XLA "
+                    "path (fp32), knob-off byte-identical "
+                    "(tests/test_kernels.py)",
+    },
+}
+
+# repo-relative module suffixes allowed to import jax.experimental.pallas —
+# the ALK008 allow-list (anything under alink_tpu/native/ is additionally
+# allowed; see analysis/lint.py)
+KERNEL_MODULES = tuple(sorted(rec["module"] for rec in _REGISTRY.values()))
+
+
+def kernel_ids() -> tuple:
+    return tuple(sorted(_REGISTRY))
+
+
+def kernel_spec(kernel_id: str) -> Optional[Dict[str, Any]]:
+    """Static registration record for one kernel id (None if the id has no
+    custom kernel). The candidates table calls this per row."""
+    rec = _REGISTRY.get(kernel_id)
+    return dict(rec) if rec is not None else None
+
+
+def covering(program_kernel_id: str) -> Optional[str]:
+    """The registered custom kernel riding inside a ProgramCache program,
+    by kernel_id prefix match — ``covering("tree.level") ->
+    "tree.pallas_hist"``, ``covering("optim.lbfgs") -> None``. This is how
+    the candidates table answers "does this worst-offender already have a
+    hand-written kernel"."""
+    for kid, rec in _REGISTRY.items():
+        if program_kernel_id == kid:
+            return kid
+        for prefix in rec["programs"]:
+            if program_kernel_id == prefix or \
+                    program_kernel_id.startswith(prefix + "."):
+                return kid
+    return None
+
+
+def registry(*, live: bool = True) -> Dict[str, Dict[str, Any]]:
+    """JSON-able registry snapshot. With ``live`` (default) each record
+    additionally reports the gate's CURRENT reading (``enabled``) and
+    whether the kernel would run interpreted — the answer depends on the
+    process env + backend, so readouts re-evaluate per call."""
+    out: Dict[str, Dict[str, Any]] = {}
+    interp = None
+    for kid, rec in sorted(_REGISTRY.items()):
+        row = dict(rec)
+        if live:
+            if interp is None:
+                try:
+                    interp = interpret_mode()
+                except Exception:
+                    interp = None
+            row["enabled"] = kernel_enabled(rec["knob"])
+            row["interpret"] = interp
+        out[kid] = row
+    return out

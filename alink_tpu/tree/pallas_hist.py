@@ -11,13 +11,19 @@ compare+matvec, revisiting the same output block across the row grid
 
 Off-TPU the kernel runs in interpret mode, so tests validate the exact same
 program on the 8-virtual-device CPU mesh.
+
+Registered as ``tree.pallas_hist`` in the custom-kernel registry
+(``native/kernels.py``); the gate and interpret-mode switches are the
+registry's shared helpers so all kernels parse on/off/backend identically.
 """
 
 from __future__ import annotations
 
 from functools import partial
 
-from ..common.env import env_str
+# shared registry gate: re-exported so existing importers of
+# pallas_hist.interpret_mode keep working
+from ..native.kernels import interpret_mode, kernel_enabled
 
 import numpy as np
 
@@ -25,24 +31,10 @@ _ROWS = 512      # row block (grid-minor: revisits the output block)
 _DBLK = 128      # feature block = lane width
 
 
-def interpret_mode() -> bool:
-    """True when the kernel must run in interpret mode (no TPU backend)."""
-    import jax
-
-    return jax.default_backend() not in ("tpu", "axon")
-
-
 def use_pallas_hist() -> bool:
     """Opt-in switch: on by default on a real TPU backend, forceable via
-    ALINK_GBDT_PALLAS=1/0."""
-    import jax
-
-    flag = env_str("ALINK_GBDT_PALLAS")
-    if flag is not None:
-        # same falsey convention as env_flag; blank counts as unset (above)
-        return flag.strip().lower() not in ("0", "off", "false", "no")
-    # axon = the tunneled TPU platform; both compile the real Mosaic kernel
-    return jax.default_backend() in ("tpu", "axon")
+    ALINK_GBDT_PALLAS=1/0 — parsed by the registry's shared gate."""
+    return kernel_enabled("ALINK_GBDT_PALLAS")
 
 
 def _pad_to(x, m, axis):

@@ -1729,6 +1729,158 @@ def bench_profiling(repeats=3, rows=300_000):
     }
 
 
+def bench_kernels(repeats=5):
+    """Custom-kernel program (native/kernels.py + the Pallas kernels): the
+    registry snapshot, the ranked roofline worst-offenders table
+    (profiling.kernel_candidates), and per-kernel before/after — the fused
+    SGNS block-gradient kernel vs the XLA _block_grads path and the flash
+    attention kernel vs the XLA blockwise scan, each as its own cached
+    program so the observatory captures both sides' roofline efficiency.
+    Efficiency must move toward the ceiling and the wall must not regress
+    on accelerator backends; on CPU containers both kernels run in Pallas
+    interpret mode, so the verdicts report informationally
+    (``wall_gate_applies`` false, the platform-aware-compare convention).
+    Parity (atol 1e-5, the registry's pinned contract) gates everywhere."""
+    import jax
+    import jax.numpy as jnp
+
+    from alink_tpu.common.benchstats import compare_samples, \
+        measure_interleaved
+    from alink_tpu.common.jitcache import cached_jit
+    from alink_tpu.common.profiling import kernel_candidates, roofline
+    from alink_tpu.dl.attention import blockwise_attention
+    from alink_tpu.embedding.skipgram import _block_grads
+    from alink_tpu.embedding.sgns_pallas import sgns_block_grads
+    from alink_tpu.native.kernels import interpret_mode, registry
+
+    platform = jax.devices()[0].platform
+    wall_gate_applies = platform in ("tpu", "gpu")
+    interp = interpret_mode()
+    rng = np.random.RandomState(0)
+
+    def bench_pair(kid, build, args_of, atol=1e-5):
+        """Warm an XLA and a Pallas cached program of the same math, check
+        parity, time interleaved, and read each side's roofline."""
+        progs = {var: cached_jit(f"bench.{kid}_{var}", build, var)
+                 for var in ("xla", "pallas")}
+        args = args_of()
+        outs = {var: jax.tree_util.tree_map(
+            np.asarray, progs[var](*args)) for var in progs}
+        flat_x = jax.tree_util.tree_leaves(outs["xla"])
+        flat_p = jax.tree_util.tree_leaves(outs["pallas"])
+        max_diff = max(float(np.abs(x - p).max())
+                       for x, p in zip(flat_x, flat_p))
+        walls = measure_interleaved(
+            {var: (lambda v=var: jax.block_until_ready(progs[v](*args)))
+             for var in progs}, repeats=repeats, warmup=1)
+        delta = compare_samples(walls["xla"], walls["pallas"])
+        eff = {}
+        for var in progs:
+            rows = [c for c in kernel_candidates(resolve=True)
+                    if c["kernel"] == f"bench.{kid}_{var}"]
+            eff[var] = rows[0]["efficiency"] if rows else None
+        return {
+            "parity_max_diff": max_diff,
+            "parity_ok": bool(max_diff <= atol),
+            "xla_wall_s": delta["base_mean_s"],
+            "pallas_wall_s": delta["cand_mean_s"],
+            "wall_delta_pct": delta["delta_pct"],
+            "wall_verdict": delta["verdict"],
+            "efficiency_before": eff["xla"],
+            "efficiency_after": eff["pallas"],
+        }
+
+    # small enough that the interpret-mode grid emulation on CPU rounds
+    # stays seconds-fast; real backends compile the Mosaic kernel
+    B, negs, D = 1024, 4, 128
+
+    def build_sgns(variant):
+        def f(v, u_pos, u_neg):
+            if variant == "pallas":
+                return sgns_block_grads(v, u_pos, u_neg, interpret=interp)
+            return _block_grads(v, u_pos, u_neg, D)
+
+        return jax.jit(f)
+
+    def sgns_args():
+        return (jnp.asarray(rng.randn(B, D), jnp.float32),
+                jnp.asarray(rng.randn(B, D), jnp.float32),
+                jnp.asarray(rng.randn(B, negs, D), jnp.float32))
+
+    b, s, h, d, blk = 4, 256, 4, 64, 128
+
+    def build_attn(variant):
+        def f(q, k, v, mask):
+            prev = os.environ.get("ALINK_ATTN_PALLAS")
+            # the knob is read at trace time; pin it to this variant for
+            # the trace (variant is the cache-key static, so both programs
+            # coexist)
+            os.environ["ALINK_ATTN_PALLAS"] = \
+                "1" if variant == "pallas" else "0"
+            try:
+                return blockwise_attention(q, k, v, mask, block_size=blk,
+                                           causal=True)
+            finally:
+                if prev is None:
+                    os.environ.pop("ALINK_ATTN_PALLAS", None)
+                else:
+                    os.environ["ALINK_ATTN_PALLAS"] = prev
+
+        return jax.jit(f)
+
+    def attn_args():
+        return (jnp.asarray(rng.randn(b, s, h, d), jnp.float32),
+                jnp.asarray(rng.randn(b, s, h, d), jnp.float32),
+                jnp.asarray(rng.randn(b, s, h, d), jnp.float32),
+                jnp.asarray((rng.rand(b, s) < 0.9).astype(np.int32)))
+
+    prev_prof = os.environ.get("ALINK_PROFILING")
+    try:
+        os.environ["ALINK_PROFILING"] = "on"
+        sgns = bench_pair("sgns", build_sgns, sgns_args)
+        attn = bench_pair("attn", build_attn, attn_args)
+        cands = kernel_candidates(top=8)
+    finally:
+        if prev_prof is None:
+            os.environ.pop("ALINK_PROFILING", None)
+        else:
+            os.environ["ALINK_PROFILING"] = prev_prof
+
+    candidates = [{
+        "kernel": c["kernel"],
+        "exec_total_s": c["exec_total_s"],
+        "bound": c["bound"],
+        "efficiency": c["efficiency"],
+        "lost_s": c["lost_s"],
+        "custom_kernel": c["custom_kernel"],
+        "kernel_enabled": c["kernel_enabled"],
+    } for c in cands]
+
+    def eff_moved(pair):
+        before, after = pair["efficiency_before"], pair["efficiency_after"]
+        if before is None or after is None:
+            return True   # no roofline capture — nothing to gate on
+        return after >= before * 0.95   # toward the ceiling, 5% noise floor
+
+    ok = (sgns["parity_ok"] and attn["parity_ok"]
+          and (not wall_gate_applies
+               or (eff_moved(sgns) and eff_moved(attn)
+                   and sgns["wall_verdict"] in ("no-change", "improvement")
+                   and attn["wall_verdict"] in ("no-change", "improvement"))))
+    return {
+        "platform": platform,
+        "interpret_mode": interp,
+        "wall_gate_applies": wall_gate_applies,
+        "registry": {kid: {"knob": rec["knob"],
+                           "enabled": rec["enabled"]}
+                     for kid, rec in registry().items()},
+        "sgns": sgns,
+        "attention": attn,
+        "candidates": candidates,
+        "gate": {"ok": bool(ok)},
+    }
+
+
 def bench_aps(steps=20):
     """Pod-scale sparse-embedding exchange (parallel/aps.py): owner-routed
     pull/push on the sharded-skipgram exchange pattern — rows/s through a
@@ -1967,6 +2119,12 @@ def main(argv=None):
              "full run (where a failing extra never sinks the primary "
              "metric), this mode IS the gate: exit 1 when any selected "
              "extra errors or reports gate.ok=false, 2 on unknown names")
+    ap.add_argument(
+        "--trace-artifact", default=None, metavar="PATH",
+        help="after the run, write the span ring as a Perfetto-loadable "
+             "chrome://tracing JSON to PATH (open at ui.perfetto.dev) — "
+             "the measured span waterfall feeding the kernel-candidates "
+             "ranking; drop it next to BENCH_r0N.json per round")
     args = ap.parse_args(argv)
     if args.compare:
         from alink_tpu.common.benchstats import compare_bench_files
@@ -1992,6 +2150,7 @@ def main(argv=None):
         ("coldstart", bench_coldstart),
         ("observability", bench_observability),
         ("profiling", bench_profiling),
+        ("kernels", bench_kernels),
         ("serving", bench_serving),
         ("aps", bench_aps),
         ("huge", bench_huge),
@@ -2017,6 +2176,17 @@ def main(argv=None):
             extras[name] = fn()
         except Exception as e:  # a failing extra must not sink the primary
             extras[name] = {"error": f"{type(e).__name__}: {e}"[:300]}
+
+    if args.trace_artifact:
+        # stderr so stdout stays the parseable BENCH JSON
+        try:
+            from alink_tpu.common.tracing import write_chrome_trace
+
+            n = write_chrome_trace(args.trace_artifact)
+            print(f"trace artifact: {args.trace_artifact} ({n} spans)",
+                  file=sys.stderr)
+        except Exception as e:
+            print(f"trace artifact failed: {e}", file=sys.stderr)
 
     if only is not None:
         print(json.dumps({"metric": "extras_subset", "value": None,

@@ -634,6 +634,59 @@ def test_alk006_absent_from_baseline():
     assert "ALK006" not in baseline["counts"]
 
 
+def test_lint_unregistered_pallas_alk008(tmp_path):
+    """Every spelling of "use Pallas" outside alink_tpu/native/ and the
+    registered kernel modules is drift: unregistered kernels carry no
+    knob, fallback, or parity contract."""
+    diags = _lint_src(tmp_path, "mod.py", """
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import pallas_call
+        import jax.experimental.pallas as plx
+
+        def f(x):
+            return pl.pallas_call(lambda r, o: None)(x)
+
+        def g(x):
+            import jax
+            return jax.experimental.pallas.pallas_call(lambda r, o: None)(x)
+    """)
+    assert [d.rule for d in diags] == ["ALK008"] * 5
+    assert all("kernels.py" in d.hint for d in diags)
+
+
+def test_lint_alk008_exempts_registered_modules(tmp_path):
+    """native/ and every module the registry declares may hold the real
+    pallas_call; relative imports of a kernel module's public entry points
+    (the integration idiom attention.py/skipgram.py use) are clean too."""
+    src = """
+        from jax.experimental import pallas as pl
+
+        def kernel(x):
+            return pl.pallas_call(lambda r, o: None)(x)
+    """
+    assert _lint_src(tmp_path, "alink_tpu/native/fancy.py", src) == []
+    from alink_tpu.native.kernels import KERNEL_MODULES
+
+    assert "alink_tpu/dl/attn_pallas.py" in KERNEL_MODULES
+    assert "alink_tpu/embedding/sgns_pallas.py" in KERNEL_MODULES
+    assert "alink_tpu/tree/pallas_hist.py" in KERNEL_MODULES
+    for rel in KERNEL_MODULES:
+        assert _lint_src(tmp_path, rel, src) == []
+    caller = _lint_src(tmp_path, "alink_tpu/dl/attention.py", """
+        from .attn_pallas import flash_block_update, use_attn_pallas
+    """)
+    assert [d.rule for d in caller] == []
+
+
+def test_alk008_absent_from_baseline():
+    """Pallas containment is banned from day one: no ALK008 budget exists,
+    so the first unregistered pallas_call anywhere fails ``--check``."""
+    with open(os.path.join(
+            REPO_ROOT, "alink_tpu", "analysis", "lint_baseline.json")) as f:
+        baseline = json.load(f)
+    assert "ALK008" not in baseline["counts"]
+
+
 # ---------------------------------------------------------------------------
 # Self-lint gate + baseline ratchet + inventory
 # ---------------------------------------------------------------------------
@@ -700,6 +753,7 @@ def test_alk002_absent_from_baseline():
 def test_rule_table_complete():
     # every rule either engine can emit is documented in the table
     for rid in ("ALK001", "ALK002", "ALK003", "ALK004", "ALK005", "ALK006",
+                "ALK008",
                 "ALK101", "ALK102", "ALK103", "ALK104", "ALK105",
                 "ALK106", "ALK107"):
         title, sev, desc = RULES[rid]

@@ -433,6 +433,15 @@ def test_metric_direction_classification():
     assert metric_direction("extras.profiling.overhead_ci_pct") is None
     assert metric_direction(
         "extras.profiling.perf_gate.slowdown_detail.delta_pct") is None
+    # roofline efficiency (kernels extra): higher is better, but it is
+    # derived from a measured wall so it gets the wall-noise threshold
+    from alink_tpu.common.benchstats import WALL_THRESHOLD, metric_threshold
+
+    assert metric_direction("extras.kernels.sgns.efficiency_after") == "higher"
+    assert metric_threshold(
+        "extras.kernels.sgns.efficiency_after") == WALL_THRESHOLD
+    assert metric_direction("extras.kernels.attention.parity_max_diff") is None
+    assert metric_direction("extras.kernels.sgns.pallas_wall_s") == "lower"
 
 
 def test_compare_bench_files_flags_bert_regression(tmp_path):
