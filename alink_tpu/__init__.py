@@ -48,3 +48,8 @@ from .common import (  # noqa: F401
     with_retries,
 )
 from .analysis import validate_plan  # noqa: E402,F401
+from .modelstream import (  # noqa: E402,F401
+    ModelStreamPublisher,
+    ModelStreamStore,
+    modelstream_summary,
+)

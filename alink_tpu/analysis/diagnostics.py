@@ -91,6 +91,11 @@ RULES: Dict[str, tuple] = {
                "(state_partition/state_merge) in a job that requests "
                "elastic parallelism — its state cannot be redistributed "
                "across a rescale; ElasticStreamJob refuses it at build"),
+    "ALK109": ("unpublishable-model-stream", WARNING,
+               "stream-train op bound to a ModelStreamPublisher without "
+               "state_snapshot/state_restore hooks — after a crash the "
+               "retrain diverges from the published version history, so "
+               "the republish-bit-identical contract cannot hold"),
 }
 
 

@@ -516,6 +516,19 @@ def _validate_stream(roots: Sequence[Any], report: Report,
                 hint="implement state_partition/state_merge (key-range "
                      "split/merge), or mix in GlobalElasticStateMixin "
                      "for unkeyed accumulator state")
+        if getattr(op, "_modelstream_bound", False) \
+                and _without_snapshot_hooks(op):
+            report.add(
+                "ALK109",
+                f"{type(op).__name__} is bound to a ModelStreamPublisher "
+                "but has no state_snapshot/state_restore hooks; after a "
+                "crash the retrain diverges and the publisher cannot "
+                "republish bit-identically",
+                where=label,
+                severity=ERROR if recovery else "",
+                hint="add the snapshot hooks, or publish from an op that "
+                     "has them (the publisher republishes the crashed "
+                     "epoch from the restored state)")
         try:
             p = op.get_params()
             cs = p.get("chunkSize") if p.contains("chunkSize") else None
@@ -540,6 +553,12 @@ def _stateful_without_partition_hooks(op) -> bool:
         return False  # already an ALK104 finding; don't double-report
     stateful = type(op).state_snapshot is not StreamOperator.state_snapshot
     return stateful and not getattr(op, "_elastic_hooks", False)
+
+
+def _without_snapshot_hooks(op) -> bool:
+    from ..operator.stream.base import StreamOperator
+
+    return type(op).state_snapshot is StreamOperator.state_snapshot
 
 
 def _floor(n: int) -> int:

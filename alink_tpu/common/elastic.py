@@ -331,7 +331,8 @@ class ElasticStreamJob:
                  min_parallelism: int = 1,
                  max_parallelism: Optional[int] = None,
                  rescale_at: Optional[Dict[int, int]] = None,
-                 controller: Optional[BackpressureController] = None):
+                 controller: Optional[BackpressureController] = None,
+                 publishers: Sequence[Any] = ()):
         if not chains:
             raise AkIllegalArgumentException("job needs >= 1 chain")
         if getattr(source, "_max_inputs", None) != 0:
@@ -367,6 +368,7 @@ class ElasticStreamJob:
         self.chain_specs: List[_ChainSpec] = []
         seen_sinks: set = set()
         probe_ops_all: List[Any] = []
+        probe_by_chain: List[List[Any]] = []
         for ci, (factory, sinks) in enumerate(chains):
             if not callable(factory):
                 raise AkIllegalArgumentException(
@@ -383,6 +385,7 @@ class ElasticStreamJob:
             for op in ops:
                 self._check_op(op)
             probe_ops_all.extend(ops)
+            probe_by_chain.append(ops)
             keyed = key_col is not None and \
                 all(op.elastic_keyed(key_col) for op in ops)
             if not sinks:
@@ -414,6 +417,20 @@ class ElasticStreamJob:
                 "to one partition and rescaling will not add throughput. "
                 "Check for a typo, or drop key_col for an all-global job.",
                 key_col)
+        # modelstream publishers: bind each to its chain's op (the probe
+        # instances stand in for per-generation ops at validation time —
+        # stamping them feeds the ALK109 pre-flight rule below). Keyed
+        # chains are refused: their model state is split across partitions
+        # at the barrier, so there is no one op to publish from.
+        self.publishers = list(publishers or [])
+        for pub in self.publishers:
+            if not (0 <= pub.chain < len(probe_by_chain)) or \
+                    not (0 <= pub.op_index < len(probe_by_chain[pub.chain])):
+                raise AkIllegalArgumentException(
+                    f"publisher {pub.name!r} binds chain {pub.chain} op "
+                    f"{pub.op_index}, which this job does not have")
+            pub.validate_target(probe_by_chain[pub.chain][pub.op_index],
+                                keyed=self.chain_specs[pub.chain].keyed)
         # opt-in pre-flight: under ALINK_VALIDATE_PLAN the elastic rules
         # run too — ALK107 (stateful op without partition hooks) escalates
         # to error alongside ALK104, landing a structured report before
@@ -606,6 +623,16 @@ class ElasticCoordinator(CheckpointCoordinator):
             "key_col": self.job.key_col,
         }
 
+    def _live_op(self, chain: int, op_index: int):
+        """Publisher target in the CURRENT generation: a non-keyed chain
+        (the only kind a publisher may bind — enforced at build) runs as
+        exactly one pinned runner, so the instance is unambiguous."""
+        for r in self.runners:
+            if r.ci == chain:
+                return r.ops[op_index]
+        raise AkIllegalStateException(
+            f"no live runner for publisher chain {chain}")
+
     def _logical_ops(self) -> Dict[str, List[Tuple[int, Any]]]:
         out: Dict[str, List[Tuple[int, Any]]] = {}
         for r in self.runners:
@@ -794,6 +821,7 @@ class ElasticCoordinator(CheckpointCoordinator):
             "rescales": [], "epoch_stats": [], "parallelism": None,
         }
         start_epoch, start_offset = self._restore(summary)
+        self._resume_publishers()
         if summary["complete"]:
             summary["parallelism"] = self.parallelism
             return summary
@@ -832,11 +860,16 @@ class ElasticCoordinator(CheckpointCoordinator):
                 if len(summary["epoch_stats"]) > 1024:  # long-lived jobs:
                     del summary["epoch_stats"][:-1024]  # keep the tail
                 target = None if final else self._decide(stats)
+                # model publish rides the SAME parked barrier as the epoch
+                # cut (and precedes a rescale's state redistribution, so
+                # the op still holds this epoch's undisturbed state)
+                self._publish_epoch(epoch, final)
                 if target is not None:
                     self._rescale(epoch, next_offset, target, summary,
                                   reader)
                 else:
                     self._cut_epoch(epoch, next_offset, final)
+                self._swap_published(epoch, t_ep)
                 summary["epochs"] += 1
                 prev_offset = next_offset
                 epoch += 1

@@ -26,6 +26,16 @@ Injection points wired into the runtime:
   manifest commit restarts at the OLD parallelism (the rescale simply
   never happened), a kill after it resumes at the NEW one — either way
   bit-identical output.
+- ``publish``   — the continuous-serving publish sequence
+  (``modelstream/``), labels ``epochN.pre_blob`` (before the model blob
+  lands), ``epochN.pre_sidecar`` (blob durable, warmup sidecar not yet
+  written), ``epochN.pre_manifest`` (blob + sidecar durable, manifest —
+  the atomic commit point — not yet renamed), ``epochN.pre_swap``
+  (version committed, the server hot-swap not yet run). ``match=`` picks
+  a site the usual way: ``publish:count=1,kinds=crash,match=pre_manifest``
+  kills the job exactly once with a fully-written-but-uncommitted version
+  on disk — the drill then asserts readers skip the torn version and the
+  restarted job republishes it bit-identically.
 
 Spec grammar (``ALINK_FAULT_SPEC``)::
 

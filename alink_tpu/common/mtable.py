@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import io
 import json
+import zipfile
 from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
@@ -481,7 +482,7 @@ class MTable:
             else:
                 arrays[key] = c
         bio = io.BytesIO()
-        np.savez_compressed(bio, **arrays)
+        _savez_deterministic(bio, arrays)
         meta = json.dumps({"schema": self.schema.to_str()})
         return bio.getvalue(), meta
 
@@ -506,6 +507,25 @@ class MTable:
             else:
                 cols[n] = npz[key]
         return MTable(cols, schema)
+
+
+def _savez_deterministic(bio: io.BytesIO, arrays: Dict[str, np.ndarray]) -> None:
+    """``np.savez_compressed`` with fixed member timestamps.
+
+    An npz is a zip of ``<name>.npy`` members, and ``np.savez`` stamps each
+    with current localtime — so serializing the same table twice yields
+    different bytes. The .ak payload must be content-deterministic (the
+    modelstream publisher republishes after a crash and the retry has to be
+    bit-identical to the fault-free write), hence a fixed epoch per member.
+    ``np.load`` reads the result unchanged."""
+    with zipfile.ZipFile(bio, "w", zipfile.ZIP_DEFLATED) as zf:
+        for name, arr in arrays.items():
+            buf = io.BytesIO()
+            np.lib.format.write_array(buf, np.asarray(arr),
+                                      allow_pickle=False)
+            zi = zipfile.ZipInfo(name + ".npy", date_time=(1980, 1, 1, 0, 0, 0))
+            zi.compress_type = zipfile.ZIP_DEFLATED
+            zf.writestr(zi, buf.getvalue())
 
 
 def _as_column(col) -> np.ndarray:

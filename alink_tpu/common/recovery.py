@@ -354,7 +354,7 @@ class RecoverableStreamJob:
     def __init__(self, source, chains: Sequence[Tuple[Sequence[Any],
                                                       Sequence[Any]]],
                  checkpoint_dir: str, epoch_chunks: int = 1,
-                 keep_snapshots: int = 3):
+                 keep_snapshots: int = 3, publishers: Sequence[Any] = ()):
         if not chains:
             raise AkIllegalArgumentException("job needs >= 1 chain")
         if getattr(source, "_max_inputs", None) != 0:
@@ -366,6 +366,17 @@ class RecoverableStreamJob:
         self.checkpoint_dir = checkpoint_dir
         self.epoch_chunks = max(1, int(epoch_chunks))
         self.keep_snapshots = keep_snapshots
+        chains = [(list(ops), list(sinks)) for ops, sinks in chains]
+        # modelstream publishers ride the epoch barrier: bind each to its
+        # chain op now (stamping feeds the ALK109 pre-flight rule below)
+        self.publishers = list(publishers or [])
+        for pub in self.publishers:
+            if not (0 <= pub.chain < len(chains)) or \
+                    not (0 <= pub.op_index < len(chains[pub.chain][0])):
+                raise AkIllegalArgumentException(
+                    f"publisher {pub.name!r} binds chain {pub.chain} op "
+                    f"{pub.op_index}, which this job does not have")
+            pub.validate_target(chains[pub.chain][0][pub.op_index])
         # opt-in pre-flight with recovery escalation: under
         # ALINK_VALIDATE_PLAN, missing-snapshot-hook (ALK104) reads as an
         # ERROR here — the structured report lands before the hard
@@ -641,6 +652,36 @@ class CheckpointCoordinator:
         metrics.add_time("recovery.restore_s", time.perf_counter() - t0)
         return epoch + 1, next_offset
 
+    # -- modelstream publishers ----------------------------------------------
+    def _live_op(self, chain: int, op_index: int):
+        """Resolve the live operator instance a publisher is bound to
+        (overridable: the elastic coordinator resolves through its current
+        generation's runners)."""
+        return self.job.chains[chain][0][op_index]
+
+    def _publish_epoch(self, epoch: int, final: bool) -> None:
+        """Store-side model publish for every bound publisher. Runs at the
+        barrier BEFORE the epoch snapshot commits: a crash anywhere inside
+        rewinds training to the previous snapshot, and the deterministic
+        retrain republishes this epoch bit-identically over any debris."""
+        for pub in getattr(self.job, "publishers", ()):
+            pub.publish_epoch(self._live_op(pub.chain, pub.op_index),
+                              epoch, final=final)
+
+    def _swap_published(self, epoch: int, epoch_t0: float) -> None:
+        """Serve-side hot-swap AFTER the epoch snapshot committed — the
+        server only ever loads versions that are durable on both sides."""
+        for pub in getattr(self.job, "publishers", ()):
+            pub.swap_epoch(epoch, epoch_t0)
+
+    def _resume_publishers(self) -> None:
+        """Post-restore healing: a crash between a version's manifest
+        commit and its hot-swap (the ``pre_swap`` window, including on the
+        final epoch's complete-path) leaves the store ahead of the server
+        — swap the newest committed version back in."""
+        for pub in getattr(self.job, "publishers", ()):
+            pub.resume()
+
     # -- epoch cut -----------------------------------------------------------
     def _gather_op_states(self) -> Dict[str, Any]:
         """Per-logical-op snapshot payloads for the epoch blob
@@ -722,6 +763,7 @@ class CheckpointCoordinator:
             "sink_replays": 0, "replayed_chunks": 0,
         }
         start_epoch, start_offset = self._restore(summary)
+        self._resume_publishers()
         if summary["complete"]:
             return summary  # finished in a previous attempt; sinks healed
         k = job.epoch_chunks
@@ -746,13 +788,16 @@ class CheckpointCoordinator:
         epoch = start_epoch
         try:
             while True:
+                t_ep = time.perf_counter()
                 budget = (epoch + 1) * k
                 reader.set_budget(budget)
                 reader.wait_barrier(budget)
                 final = reader.end is not None and reader.all_done()
                 next_offset = budget if reader.end is None \
                     else min(budget, reader.end)
+                self._publish_epoch(epoch, final)
                 self._cut_epoch(epoch, next_offset, final)
+                self._swap_published(epoch, t_ep)
                 summary["epochs"] += 1
                 epoch += 1
                 if final:

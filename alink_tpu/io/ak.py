@@ -25,6 +25,19 @@ META_ENTRY = "alink_meta.json"
 DATA_PREFIX = "data/part-"
 FORMAT_VERSION = 1
 
+# zipfile stamps each member with current localtime by default, which makes
+# two writes of the same table differ byte-for-byte. The .ak contract is
+# content-deterministic (modelstream republishes after a crash must be
+# bit-identical to the fault-free write), so every entry carries this fixed
+# epoch instead.
+ZIP_EPOCH = (1980, 1, 1, 0, 0, 0)
+
+
+def _write_zip_entry(zf: zipfile.ZipFile, name: str, data) -> None:
+    zi = zipfile.ZipInfo(name, date_time=ZIP_EPOCH)
+    zi.compress_type = zipfile.ZIP_DEFLATED
+    zf.writestr(zi, data)
+
 
 def write_ak(path: str, table: MTable, num_partitions: int = 1, extra_meta: Optional[dict] = None):
     n = table.num_rows
@@ -38,7 +51,7 @@ def write_ak(path: str, table: MTable, num_partitions: int = 1, extra_meta: Opti
 
             part = table.take(np.arange(bounds[p], bounds[p + 1]))
             data, meta = part.to_payload()
-            zf.writestr(f"{DATA_PREFIX}{p:05d}", data)
+            _write_zip_entry(zf, f"{DATA_PREFIX}{p:05d}", data)
             metas.append(meta)
         header = {
             "version": FORMAT_VERSION,
@@ -49,7 +62,7 @@ def write_ak(path: str, table: MTable, num_partitions: int = 1, extra_meta: Opti
         }
         if extra_meta:
             header["extra"] = extra_meta
-        zf.writestr(META_ENTRY, json.dumps(header))
+        _write_zip_entry(zf, META_ENTRY, json.dumps(header))
 
 
 def read_ak(path: str) -> MTable:

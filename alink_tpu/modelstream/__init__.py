@@ -1,0 +1,15 @@
+"""Continuous model streaming: exactly-once stream-train → serve
+publishing with crash-safe hot-swap.
+
+The loop the reference's modelstream package exists for (SURVEY §2.3 —
+online-trained models reach serving without a redeploy), closed over this
+repo's own halves: the epoch-barrier recovery runtime publishes, the
+serving tier hot-swaps. See :mod:`.store` for the on-disk commit protocol
+and :mod:`.publisher` for the barrier hook.
+"""
+
+from .publisher import ModelStreamPublisher, modelstream_summary
+from .store import ModelStreamStore
+
+__all__ = ["ModelStreamPublisher", "ModelStreamStore",
+           "modelstream_summary"]

@@ -126,7 +126,7 @@ class FtrlTrainStreamOp(GlobalElasticStateMixin, StreamOperator,
         l1, l2 = self.get(self.L_1), self.get(self.L_2)
         st = {
             "z": None, "n": None,
-            "labels": None,
+            "labels": None, "label_type": None,
             "meta0": {},
             "vec_col": self.get(HasVectorCol.VECTOR_COL),
             # resolved once (first chunk / initial model) and persisted in
@@ -144,6 +144,7 @@ class FtrlTrainStreamOp(GlobalElasticStateMixin, StreamOperator,
             )
             st["meta0"] = meta0
             st["labels"] = meta0.get("labels")
+            st["label_type"] = meta0.get("labelType", AlinkTypes.STRING)
             st["vec_col"] = st["vec_col"] or meta0.get("vectorCol")
             st["feat_cols"] = st["feat_cols"] or meta0.get("featureCols")
             # invert the closed form at n=0 so weights(z, 0) == w0
@@ -167,6 +168,38 @@ class FtrlTrainStreamOp(GlobalElasticStateMixin, StreamOperator,
         # z/n stay host numpy here; the jitted step accepts them directly
         # and the values round-trip bit-exactly (float32 both ways)
         self._fstate = dict(state)
+
+    def servable_model(self) -> Optional[MTable]:
+        """Barrier-time model snapshot for the modelstream publisher: the
+        current (z, n) accumulators rendered as a servable LinearModel
+        table via the FTRL closed form, computed host-side — a restored
+        epoch's accumulators are bit-exact, so a republished epoch yields
+        the identical model. None until warm-up resolved both labels."""
+        st = getattr(self, "_fstate", None)
+        if not st or st.get("z") is None or not st.get("labels") \
+                or len(st["labels"]) < 2:
+            return None
+        alpha, beta = self.get(self.ALPHA), self.get(self.BETA)
+        l1, l2 = self.get(self.L_1), self.get(self.L_2)
+        z = np.asarray(st["z"], np.float32)
+        n = np.asarray(st["n"], np.float32)
+        w = -(z - np.sign(z) * l1) / ((beta + np.sqrt(n)) / alpha + l2)
+        w = np.where(np.abs(z) <= l1, 0.0, w).astype(np.float32)
+        meta = {
+            "modelName": "LinearModel",
+            "linearModelType": "LR",
+            "vectorCol": st["vec_col"],
+            "featureCols": st["feat_cols"],
+            "labelCol": self.get(self.LABEL_COL),
+            "labelType": st.get("label_type") or AlinkTypes.STRING,
+            "labels": st["labels"],
+            "hasIntercept": True,
+            "dim": int(z.shape[0] - 1),
+            "batchNo": st["batch_no"],
+        }
+        return model_to_table(meta, {
+            "weights": w[:-1].astype(np.float32),
+            "intercept": np.asarray([w[-1]], np.float32)})
 
     def _stream_impl(self, it: Iterator[MTable]) -> Iterator[MTable]:
         import jax.numpy as jnp
@@ -206,6 +239,7 @@ class FtrlTrainStreamOp(GlobalElasticStateMixin, StreamOperator,
                             "a batch model carrying the label set)")
                     continue
                 st["labels"] = sorted(st["seen_labels"], key=str)
+                st["label_type"] = chunk.schema.type_of(label_col)
                 if st["warmup"]:
                     chunk = MTable.concat(st["warmup"] + [chunk])
                     st["warmup"] = []
@@ -422,6 +456,32 @@ class OnlineFmTrainStreamOp(GlobalElasticStateMixin, StreamOperator,
 
     def state_restore(self, state: dict) -> None:
         self._fmstate = dict(state)
+
+    def servable_model(self) -> Optional[MTable]:
+        """Barrier-time FmModel snapshot for the modelstream publisher —
+        the AdaGrad params straight from state, so a restored epoch
+        republishes bit-identically. None until warm-up resolved."""
+        st = getattr(self, "_fmstate", None)
+        if not st or st.get("state") is None or not st.get("labels"):
+            return None
+        import jax
+
+        params, _ = st["state"]
+        w0, w, V = (np.asarray(a) for a in jax.device_get(params))
+        meta = {
+            "modelName": "FmModel", "fmTask": "binary",
+            "numFactor": self.get(self.NUM_FACTOR),
+            "vectorCol": st["vec_col"],
+            "featureCols": (list(st["feat_cols"])
+                            if st["feat_cols"] else None),
+            "labelCol": self.get(self.LABEL_COL),
+            "labelType": st["label_type"],
+            "labels": st["labels"], "dim": int(w.shape[0]),
+        }
+        return model_to_table(meta, {
+            "w0": np.asarray([w0], np.float32),
+            "w": np.asarray(w, np.float32),
+            "V": np.asarray(V, np.float32)})
 
     def _stream_impl(self, it: Iterator[MTable]) -> Iterator[MTable]:
         import jax

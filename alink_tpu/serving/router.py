@@ -440,6 +440,11 @@ class ModelServer:
         self._config = config or ServingConfig.default()
         self._lock = threading.Lock()
         self._entries: Dict[str, _ModelEntry] = {}
+        # monotone load ticket: concurrent load() calls on the same name
+        # must resolve last-writer-wins by CALL order, not by whichever
+        # warmup finishes last (a slow stale load must never clobber a
+        # newer entry at install time)
+        self._load_seq = 0
 
     # -- model lifecycle -----------------------------------------------------
     def load(self, name: str, model: "PipelineModel | LocalPredictor | str",
@@ -466,6 +471,9 @@ class ModelServer:
         ``ALINK_SERVING_PERSIST_WARMUP``). Predictions are bit-identical
         whichever side warmed — warmup only populates caches."""
         cfg = config or self._config
+        with self._lock:
+            self._load_seq += 1
+            load_seq = self._load_seq
         if persist_warmup is None:
             persist_warmup = env_flag("ALINK_SERVING_PERSIST_WARMUP", True)
         model_path = model if isinstance(model, str) else None
@@ -562,9 +570,27 @@ class ModelServer:
                 # distinguishable on dashboards)
                 metrics.incr("serving.warmup_spec_write_errors")
         entry = _ModelEntry(name, predictor, cfg)
+        entry._load_seq = load_seq
+        stale = old = None
         with self._lock:
-            old = self._entries.get(name)
-            self._entries[name] = entry
+            cur = self._entries.get(name)
+            if cur is not None and getattr(cur, "_load_seq", 0) > load_seq:
+                # a load that STARTED after this one has already installed:
+                # swapping now would move the served weights backwards.
+                # Last-writer-wins is by load-call order, so this entry
+                # loses the race and retires unused.
+                stale = entry
+            else:
+                old = cur
+                self._entries[name] = entry
+        if stale is not None:
+            stale.shutdown(drain=True)
+            metrics.incr("serving.load_superseded")
+            return {"model": name, "warmup": warm,
+                    "warmup_source": source if warmed else None,
+                    "warmup_sidecar": sidecar_written,
+                    "superseded": True,
+                    "max_batch_rows": entry.config.max_batch_rows}
         if old is not None:
             old.shutdown(drain=True)
         metrics.incr("serving.models_loaded")

@@ -88,7 +88,8 @@ def save_warmup_spec(model_path: str, *,
                      max_batch_rows: int,
                      ladder: Sequence[int],
                      kernels: Optional[Sequence[Tuple[str, list]]] = None,
-                     path: Optional[str] = None) -> Optional[str]:
+                     path: Optional[str] = None,
+                     fsync: bool = False) -> Optional[str]:
     """Persist one model's warmup spec next to its ``.ak``. Returns the
     sidecar path, or None when the rows cannot be JSON-persisted (exotic
     cell types) — never raises on content, only on unwritable storage."""
@@ -117,6 +118,14 @@ def save_warmup_spec(model_path: str, *,
     tmp = f"{out}.tmp.{os.getpid()}"
     with open(tmp, "w") as f:
         json.dump(spec, f)
+        if fsync:
+            # the modelstream publisher commits a manifest that names this
+            # sidecar — its bytes must be on disk before that rename
+            f.flush()
+            try:
+                os.fsync(f.fileno())
+            except OSError:
+                metrics.incr("serving.warmup_spec_fsync_errors")
     os.replace(tmp, out)
     metrics.incr("serving.warmup_spec_saved")
     return out
@@ -157,5 +166,10 @@ def load_warmup_spec(model_path: str,
         spec["kernels"] = kernels
         return spec
     except (OSError, ValueError, TypeError, KeyError):
+        # the sidecar file EXISTS but failed to parse/validate — a torn or
+        # garbage write, distinct from the missing-file path above. Count it
+        # on its own so a fleet rollout that keeps "working" via live warmup
+        # still surfaces the corruption.
+        metrics.incr("serving.warmup_sidecar_corrupt")
         metrics.incr("serving.warmup_spec_errors")
         return None

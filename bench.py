@@ -1210,6 +1210,121 @@ def bench_elastic(rows=24_000):
     }
 
 
+def bench_modelstream(rows=4_000):
+    """Continuous model streaming (alink_tpu/modelstream/): an FTRL
+    stream-train job publishing at every epoch barrier into a live
+    ModelServer while a traffic thread keeps predicting against the
+    swapping model. Reports publish→servable lag (p50/p99 of
+    ``modelstream.lag_s``), hot-swap latency, publishes per epoch, the
+    zero-trace bit (jit.trace delta across swaps after the first), and a
+    parity bit (served row == LocalPredictor over the latest published
+    blob). The gate pins parity, zero traces, every-epoch publishing,
+    and the staleness bound (lag p99 within LAG_BOUND_S)."""
+    import tempfile
+    import threading
+
+    from alink_tpu.common import faults
+    from alink_tpu.common.metrics import metrics
+    from alink_tpu.common.mtable import MTable
+    from alink_tpu.common.recovery import (RecoverableStreamJob,
+                                           run_with_recovery)
+    from alink_tpu.common.resilience import RetryPolicy
+    from alink_tpu.modelstream import ModelStreamPublisher
+    from alink_tpu.operator.stream import (DatahubSinkStreamOp,
+                                           FtrlTrainStreamOp,
+                                           TableSourceStreamOp)
+    from alink_tpu.pipeline.local_predictor import LocalPredictor
+    from alink_tpu.serving.router import ModelServer
+
+    LAG_BOUND_S = 30.0  # staleness bound: epoch start → servable swap
+    rng = np.random.RandomState(0)
+    t = MTable({"x0": rng.rand(rows), "x1": rng.rand(rows),
+                "label": (rng.rand(rows) > 0.5).astype(np.int64)})
+    schema = "x0 DOUBLE, x1 DOUBLE"
+    store_dir = tempfile.mkdtemp(prefix="alink-ms-")
+
+    server = ModelServer()
+    pub = ModelStreamPublisher(store_dir, "ftrl-bench", server=server,
+                               input_schema=schema, keep=3)
+
+    stop = threading.Event()
+    traffic = {"hits": 0, "misses": 0}
+
+    def drive():
+        while not stop.is_set():
+            try:
+                server.predict("ftrl-bench", [0.3, 0.7])
+                traffic["hits"] += 1
+            except Exception:
+                traffic["misses"] += 1  # model not swapped in yet
+            stop.wait(0.002)
+
+    def job():
+        return RecoverableStreamJob(
+            source=TableSourceStreamOp(t, chunkSize=128),
+            chains=[([FtrlTrainStreamOp(featureCols=["x0", "x1"],
+                                        labelCol="label")],
+                     [DatahubSinkStreamOp(endpoint="memory://bench-ms",
+                                          topic="m")])],
+            checkpoint_dir=tempfile.mkdtemp(prefix="alink-ms-ck-"),
+            epoch_chunks=4, publishers=[pub])
+
+    faults.clear()
+    thread = threading.Thread(target=drive, daemon=True)
+    thread.start()
+    t0 = time.perf_counter()
+    try:
+        summary = run_with_recovery(job, RetryPolicy(max_attempts=3,
+                                                     base_delay=0.01))
+    finally:
+        stop.set()
+        thread.join(timeout=5)
+    wall = time.perf_counter() - t0
+
+    epochs = summary["epochs"]
+    publishes = metrics.counter("modelstream.publishes")
+    trace_delta = metrics.counter("modelstream.swap_trace_delta")
+    lag = metrics.histogram("modelstream.lag_s") or {}
+    swap = metrics.timer_stats("modelstream.swap_s") or {}
+
+    latest = pub.store.latest()
+    served = served_local = None
+    if latest is not None:
+        blob = pub.store.blob_path(latest[0])
+        served = tuple(server.predict("ftrl-bench", [0.3, 0.7]))
+        served_local = tuple(
+            LocalPredictor(blob, schema).predict_row([0.3, 0.7]))
+    parity = served is not None and served == served_local
+    zero_trace = publishes >= 3 and trace_delta == 0
+    lag_ok = lag.get("p99") is not None and lag["p99"] <= LAG_BOUND_S
+    return {
+        "rows": rows,
+        "wall_s": round(wall, 3),
+        "epochs": epochs,
+        "publishes": publishes,
+        "publishes_per_epoch": round(publishes / epochs, 3) if epochs
+        else None,
+        "lag_p50_ms": round(lag["p50"] * 1e3, 3) if lag.get("p50")
+        is not None else None,
+        "lag_p99_ms": round(lag["p99"] * 1e3, 3) if lag.get("p99")
+        is not None else None,
+        "swap_latency_ms": round(swap.get("mean_s", 0.0) * 1e3, 3),
+        "swaps": swap.get("count", 0),
+        "traffic_hits": traffic["hits"],
+        "traffic_misses": traffic["misses"],
+        "zero_trace_swaps": zero_trace,
+        "parity_bit_identical": parity,
+        "gate": {
+            "ok": bool(parity and zero_trace and lag_ok
+                       and publishes == epochs),
+            "parity": parity,
+            "zero_trace": zero_trace,
+            "lag_p99_within_bound_s": LAG_BOUND_S if lag_ok else False,
+            "published_every_epoch": publishes == epochs,
+        },
+    }
+
+
 def bench_compile():
     """Shape-stable execution layer (common/jitcache.py): the compile-tax
     readout tracked across BENCH rounds. Runs the kmeans_iris pipeline and a
@@ -2146,6 +2261,7 @@ def main(argv=None):
         ("resilience", bench_resilience),
         ("recovery", bench_recovery),
         ("elastic", bench_elastic),
+        ("modelstream", bench_modelstream),
         ("compile", bench_compile),
         ("coldstart", bench_coldstart),
         ("observability", bench_observability),

@@ -1,0 +1,99 @@
+"""Continuous learning quick start: an FTRL online-learning stream that
+publishes a servable model at EVERY epoch barrier and hot-swaps it into
+a live ModelServer — with a crash injected in the middle of a publish to
+show the exactly-once contract (alink_tpu/modelstream/ — see README
+"Continuous learning").
+
+The crash lands at the ``publish`` fault point's ``pre_manifest`` site:
+the model blob and warmup sidecar are fully written but the version
+manifest — the one atomic commit point — never renames. The restarted
+job must (a) never serve that torn version, and (b) republish the same
+epoch bit-identically over the debris. Both are asserted below, plus the
+serving parity pin: the row the server answers equals a LocalPredictor
+run over the exact published blob.
+"""
+
+import tempfile
+
+import numpy as np
+
+from alink_tpu.common import RetryPolicy, faults, run_with_recovery
+from alink_tpu.common.faults import FaultSpec
+from alink_tpu.common.metrics import metrics
+from alink_tpu.common.mtable import MTable
+from alink_tpu.common.recovery import RecoverableStreamJob
+from alink_tpu.modelstream import ModelStreamPublisher, modelstream_summary
+from alink_tpu.operator.stream import (DatahubSinkStreamOp,
+                                       FtrlTrainStreamOp,
+                                       TableSourceStreamOp)
+from alink_tpu.pipeline.local_predictor import LocalPredictor
+from alink_tpu.serving.router import ModelServer
+
+# -- a labeled event stream --------------------------------------------------
+rng = np.random.RandomState(7)
+n = 2000
+table = MTable({"x0": rng.rand(n), "x1": rng.rand(n),
+                "label": (rng.rand(n) > 0.5).astype(np.int64)})
+SCHEMA = "x0 DOUBLE, x1 DOUBLE"
+
+server = ModelServer()
+store_dir = tempfile.mkdtemp(prefix="alink-ms-")
+publisher = ModelStreamPublisher(store_dir, "ctr", server=server,
+                                 input_schema=SCHEMA, keep=3)
+
+
+def build_job():
+    """A job FACTORY (fresh ops per restart attempt). The publisher binds
+    chain 0 / op 0 — the FTRL trainer — and rides its epoch barrier."""
+    ftrl = FtrlTrainStreamOp(featureCols=["x0", "x1"], labelCol="label")
+    sink = DatahubSinkStreamOp(endpoint="memory://ms-quickstart", topic="m")
+    return RecoverableStreamJob(
+        source=TableSourceStreamOp(table, chunkSize=64),
+        chains=[([ftrl], [sink])],
+        checkpoint_dir=build_job.ckdir, epoch_chunks=4,
+        publishers=[publisher])
+
+
+build_job.ckdir = tempfile.mkdtemp(prefix="alink-ms-ck-")
+
+# -- run with a crash injected mid-publish -----------------------------------
+# kills the job EXACTLY once, at epoch 3, with the blob+sidecar written
+# but the manifest (the atomic commit point) not yet renamed
+faults.install(FaultSpec.parse(
+    "publish:count=1,kinds=crash,match=epoch3.pre_manifest", seed=1))
+try:
+    summary = run_with_recovery(build_job,
+                                RetryPolicy(max_attempts=5,
+                                            base_delay=0.01))
+finally:
+    faults.clear()
+
+assert summary["complete"] and summary["restored"]
+
+# -- the exactly-once publish contract ---------------------------------------
+# every epoch committed exactly once, the torn epoch-3 debris was
+# republished (bit-identical by determinism), and the crash never
+# surfaced a torn version to a reader
+print("epochs:", summary["epochs"], "versions:", publisher.store.versions())
+ms = modelstream_summary()
+print("publishes:", ms["counters"].get("modelstream.publishes"),
+      "torn skipped:", ms["counters"].get("modelstream.torn_skipped", 0),
+      "lag p99 (s):", ms["lag_s"]["p99"])
+
+# -- serving parity: the server answers with the exact published bytes ------
+epoch, _manifest = publisher.store.latest()
+# every epoch 0..N committed exactly once — the crashed epoch's debris
+# was overwritten by the restart's republish, never double-counted
+assert ms["counters"]["modelstream.publishes"] == epoch + 1
+blob = publisher.store.blob_path(epoch)
+row = [0.3, 0.7]
+served = tuple(server.predict("ctr", row))
+local = tuple(LocalPredictor(blob, SCHEMA).predict_row(row))
+print(f"served@epoch{epoch}: {served}")
+assert served == local, (served, local)
+
+# hot-swaps reused the compiled serving ladder: zero traces after the
+# first load (weights ride as cached_jit arguments, not constants)
+assert metrics.counter("modelstream.swap_trace_delta") == 0
+print("OK — crash mid-publish, no torn serve, bit-identical republish, "
+      "served == LocalPredictor")

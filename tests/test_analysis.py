@@ -755,7 +755,7 @@ def test_rule_table_complete():
     for rid in ("ALK001", "ALK002", "ALK003", "ALK004", "ALK005", "ALK006",
                 "ALK008",
                 "ALK101", "ALK102", "ALK103", "ALK104", "ALK105",
-                "ALK106", "ALK107"):
+                "ALK106", "ALK107", "ALK109"):
         title, sev, desc = RULES[rid]
         assert title and sev in ("error", "warning", "info") and desc
 

@@ -1,0 +1,474 @@
+"""Continuous model streaming (alink_tpu/modelstream/): exactly-once
+stream-train → serve publishing with crash-safe hot-swap.
+
+Pins the PR's contracts:
+
+- crash drills at every ``publish`` site (``pre_blob``/``pre_sidecar``/
+  ``pre_manifest``/``pre_swap``): a torn version is never served, and the
+  restarted job republishes every epoch bit-identical to a fault-free run;
+- served-vs-local parity (FTRL and OnlineFm): the server answers with the
+  exact bytes ``LocalPredictor`` reads from the published blob;
+- zero-trace hot-swap: the jit.trace delta across ≥3 consecutive swaps
+  after the first is 0 (weights ride as cached_jit arguments);
+- ``modelstream.lag_s`` exports at GET /metrics;
+- torn-debris skip, idempotent republish, bounded retention;
+- satellite regressions: corrupt warmup sidecar counted
+  (``serving.warmup_sidecar_corrupt``) without losing the warmup, rapid
+  double hot-swap resolves last-writer-wins, plan rule ALK109.
+"""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from alink_tpu.common import faults
+from alink_tpu.common.exceptions import AkIllegalArgumentException
+from alink_tpu.common.faults import FaultSpec
+from alink_tpu.common.metrics import export_prometheus, metrics
+from alink_tpu.common.mtable import MTable
+from alink_tpu.common.recovery import RecoverableStreamJob, run_with_recovery
+from alink_tpu.common.resilience import RetryPolicy
+from alink_tpu.modelstream import ModelStreamPublisher, ModelStreamStore
+from alink_tpu.operator.stream import (DatahubSinkStreamOp,
+                                       FtrlTrainStreamOp,
+                                       OnlineFmTrainStreamOp,
+                                       TableSourceStreamOp)
+from alink_tpu.pipeline.local_predictor import LocalPredictor
+from alink_tpu.serving.router import ModelServer
+
+pytestmark = pytest.mark.modelstream
+
+SCHEMA = "x0 DOUBLE, x1 DOUBLE"
+ROW = [0.3, 0.7]
+
+
+def _table(n=200, seed=7):
+    rng = np.random.RandomState(seed)
+    return MTable({"x0": rng.rand(n), "x1": rng.rand(n),
+                   "label": (rng.rand(n) > 0.5).astype(np.int64)})
+
+
+def _ftrl():
+    return FtrlTrainStreamOp(featureCols=["x0", "x1"], labelCol="label",
+                             modelSaveInterval=5)
+
+
+def _run_job(base, tag, *, spec=None, keep=10, op_factory=_ftrl,
+             table=None, attempts=10):
+    """One publisher-attached FTRL (or ``op_factory``) recovery job run,
+    optionally under an installed fault spec. Fresh store/checkpoint dirs
+    per (base, tag)."""
+    server = ModelServer()
+    pub = ModelStreamPublisher(os.path.join(base, f"store-{tag}"),
+                               f"m-{tag}", server=server,
+                               input_schema=SCHEMA, keep=keep)
+    t = table if table is not None else _table()
+
+    def job():
+        return RecoverableStreamJob(
+            source=TableSourceStreamOp(t, chunkSize=10),
+            chains=[([op_factory()],
+                     [DatahubSinkStreamOp(endpoint=f"memory://msp-{tag}",
+                                          topic="m")])],
+            checkpoint_dir=os.path.join(base, f"ck-{tag}"),
+            epoch_chunks=4, publishers=[pub])
+
+    faults.clear()
+    if spec:
+        faults.install(FaultSpec.parse(spec, seed=3))
+    try:
+        summary = run_with_recovery(job, RetryPolicy(max_attempts=attempts,
+                                                     base_delay=0.001))
+    finally:
+        faults.clear()
+    return summary, pub, server
+
+
+def _blob_bytes(pub):
+    return {e: open(pub.store.blob_path(e), "rb").read()
+            for e in pub.store.versions()}
+
+
+@pytest.fixture(scope="module")
+def clean_run(tmp_path_factory):
+    """The fault-free baseline every crash drill compares against."""
+    base = str(tmp_path_factory.mktemp("ms-clean"))
+    summary, pub, server = _run_job(base, "clean")
+    return {"summary": summary, "pub": pub, "server": server,
+            "blobs": _blob_bytes(pub),
+            "served": tuple(server.predict("m-clean", ROW))}
+
+
+# ---------------------------------------------------------------------------
+# publish loop, retention, idempotence
+# ---------------------------------------------------------------------------
+
+
+def test_publish_every_epoch_and_parity(clean_run):
+    s, pub = clean_run["summary"], clean_run["pub"]
+    assert s["complete"]
+    assert pub.store.versions() == list(range(s["epochs"]))
+    epoch, manifest = pub.store.latest()
+    assert epoch == s["epochs"] - 1 and manifest["epoch"] == epoch
+    # served row == LocalPredictor over the exact published blob
+    local = tuple(LocalPredictor(pub.store.blob_path(epoch),
+                                 SCHEMA).predict_row(ROW))
+    assert clean_run["served"] == local
+    assert pub.summary()["swapped_epoch"] == epoch
+    assert [p["epoch"] for p in pub.summary()["published"]] \
+        == list(range(s["epochs"]))
+
+
+def test_retention_keeps_last_k(tmp_path):
+    _, pub, server = _run_job(str(tmp_path), "keep", keep=2)
+    versions = pub.store.versions()
+    assert len(versions) == 2
+    epoch, _ = pub.store.latest()
+    assert epoch == versions[-1]
+    # pruned versions are fully gone — no manifest orphaned without a blob
+    for old in range(versions[0]):
+        assert not os.path.exists(pub.store.blob_path(old))
+    # the retained newest still serves
+    assert tuple(server.predict("m-keep", ROW)) == tuple(
+        LocalPredictor(pub.store.blob_path(epoch), SCHEMA).predict_row(ROW))
+
+
+def test_republish_is_idempotent(tmp_path):
+    store = ModelStreamStore(str(tmp_path / "s"), keep=5)
+    payload = b"x" * 257
+
+    def write(path):
+        with open(path, "wb") as f:
+            f.write(payload)
+
+    before = metrics.counter("modelstream.republish_skipped")
+    store.publish(0, write)
+    first = open(store.blob_path(0), "rb").read()
+
+    def write_other(path):  # a second commit attempt must be a no-op
+        with open(path, "wb") as f:
+            f.write(b"DIFFERENT")
+
+    store.publish(0, write_other)
+    assert open(store.blob_path(0), "rb").read() == first == payload
+    assert metrics.counter("modelstream.republish_skipped") == before + 1
+
+
+# ---------------------------------------------------------------------------
+# crash drills: every publish site, never torn, bit-identical republish
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("site", [
+    "epoch0.pre_blob",          # nothing of the epoch durable yet
+    "epoch2.pre_sidecar",       # blob durable, sidecar missing
+    "epoch3.pre_manifest",      # blob+sidecar durable, commit point not
+    "epoch1.pre_swap",          # version committed, swap never ran
+    "epoch5.pre_swap",          # ... on the FINAL epoch (complete manifest)
+])
+def test_crash_drill_bit_identical(tmp_path, clean_run, site):
+    tag = site.replace(".", "-")
+    summary, pub, server = _run_job(
+        str(tmp_path), tag,
+        spec=f"publish:count=1,kinds=crash,match={site}")
+    assert summary["complete"]
+    got = _blob_bytes(pub)
+    assert sorted(got) == sorted(clean_run["blobs"])
+    for epoch, data in clean_run["blobs"].items():
+        assert got[epoch] == data, f"{site}: epoch {epoch} bytes diverged"
+    # the reader never surfaced a torn version: latest() is the real
+    # newest commit and the served row matches the fault-free run
+    # (summary["epochs"] counts only the final attempt's epochs, so the
+    # baseline run's count is the total-epoch yardstick)
+    epoch, _ = pub.store.latest()
+    assert epoch == clean_run["summary"]["epochs"] - 1
+    assert tuple(server.predict(f"m-{tag}", ROW)) == clean_run["served"]
+
+
+def test_restart_resume_is_idempotent(tmp_path):
+    base = str(tmp_path)
+    s1, pub1, _ = _run_job(base, "resume")
+    published = metrics.counter("modelstream.publishes")
+    # a SECOND process over the same checkpoint + store dirs: the job's
+    # manifest says complete, so no epoch re-runs — but resume() must
+    # still hot-swap the newest committed version into the fresh server
+    server2 = ModelServer()
+    pub2 = ModelStreamPublisher(os.path.join(base, "store-resume"),
+                                "m-resume2", server=server2,
+                                input_schema=SCHEMA, keep=10)
+
+    def job():
+        return RecoverableStreamJob(
+            source=TableSourceStreamOp(_table(), chunkSize=10),
+            chains=[([_ftrl()],
+                     [DatahubSinkStreamOp(endpoint="memory://msp-resume",
+                                          topic="m")])],
+            checkpoint_dir=os.path.join(base, "ck-resume"),
+            epoch_chunks=4, publishers=[pub2])
+
+    faults.clear()
+    run_with_recovery(job, RetryPolicy(max_attempts=2, base_delay=0.001))
+    assert metrics.counter("modelstream.publishes") == published  # no dup
+    epoch, _ = pub1.store.latest()
+    assert tuple(server2.predict("m-resume2", ROW)) == tuple(
+        LocalPredictor(pub1.store.blob_path(epoch),
+                       SCHEMA).predict_row(ROW))
+
+
+def test_torn_debris_skipped_and_counted(tmp_path):
+    store = ModelStreamStore(str(tmp_path / "s"), keep=5)
+
+    def write(path):
+        with open(path, "wb") as f:
+            f.write(b"committed")
+
+    store.publish(0, write)
+    # orphan blob: crash landed between blob rename and manifest rename
+    with open(store.blob_path(5), "wb") as f:
+        f.write(b"torn")
+    # checksum mismatch: manifest committed, blob later corrupted on disk
+    store.publish(2, write)
+    with open(store.blob_path(2), "ab") as f:
+        f.write(b"bitrot")
+    before = metrics.counter("modelstream.torn_skipped")
+    epoch, _ = store.latest()
+    assert epoch == 0
+    # versions() lists committed manifests (2's manifest IS committed —
+    # the bitrot is a read-side concern); latest() checksum-verifies and
+    # refuses to surface it
+    assert store.versions() == [0, 2]
+    assert metrics.counter("modelstream.torn_skipped") >= before + 2
+
+
+# ---------------------------------------------------------------------------
+# parity pins (FTRL and OnlineFm) + zero-trace swaps + metrics export
+# ---------------------------------------------------------------------------
+
+
+def test_parity_onlinefm(tmp_path):
+    def fm():
+        return OnlineFmTrainStreamOp(featureCols=["x0", "x1"],
+                                     labelCol="label", numFactor=4,
+                                     modelSaveInterval=5)
+
+    summary, pub, server = _run_job(str(tmp_path), "fm", op_factory=fm)
+    assert summary["complete"] and pub.store.versions()
+    epoch, _ = pub.store.latest()
+    assert tuple(server.predict("m-fm", ROW)) == tuple(
+        LocalPredictor(pub.store.blob_path(epoch), SCHEMA).predict_row(ROW))
+
+
+def test_zero_trace_across_swaps(tmp_path):
+    before = metrics.counter("modelstream.swap_trace_delta")
+    summary, pub, _ = _run_job(str(tmp_path), "trace")
+    # ≥4 publishes → ≥3 swaps AFTER the first: all must reuse the
+    # compiled serving ladder (weights are cached_jit arguments)
+    assert metrics.counter("modelstream.publishes") >= 4
+    assert summary["epochs"] >= 4
+    assert metrics.counter("modelstream.swap_trace_delta") == before == 0
+
+
+def test_lag_histogram_exported(clean_run):
+    lag = metrics.histogram("modelstream.lag_s")
+    assert lag and lag["count"] >= clean_run["summary"]["epochs"]
+    assert lag["p99"] is not None
+    text = export_prometheus()
+    assert "modelstream_lag_s" in text
+    assert "modelstream_publishes" in text
+
+
+def test_elastic_job_publishes_across_rescale(tmp_path):
+    """The publisher rides the ElasticCoordinator's barrier too: the
+    global FTRL chain keeps publishing through a mid-stream rescale (its
+    state MOVES to the new owner partition, the model stays whole)."""
+    from alink_tpu.common.elastic import ElasticStreamJob
+
+    rng = np.random.RandomState(0)
+    n = 200
+    t = MTable({"ts": np.arange(n, dtype=np.float64),
+                "user": rng.randint(0, 9, n).astype(np.int64),
+                "x0": rng.rand(n), "x1": rng.rand(n),
+                "label": (rng.rand(n) > 0.5).astype(np.int64)})
+    server = ModelServer()
+    pub = ModelStreamPublisher(str(tmp_path / "store"), "m-el",
+                               server=server, input_schema=SCHEMA,
+                               keep=10)
+
+    def job():
+        return ElasticStreamJob(
+            source=TableSourceStreamOp(t, chunkSize=10),
+            chains=[(lambda: [_ftrl()],
+                     [DatahubSinkStreamOp(endpoint="memory://msp-el",
+                                          topic="m")])],
+            checkpoint_dir=str(tmp_path / "ck"), key_col="user",
+            parallelism=2, epoch_chunks=4, rescale_at={2: 4},
+            publishers=[pub])
+
+    faults.clear()
+    summary = run_with_recovery(job, RetryPolicy(max_attempts=3,
+                                                 base_delay=0.001))
+    assert summary["complete"] and summary["rescales"]
+    versions = pub.store.versions()
+    assert versions and versions == list(range(versions[-1] + 1))
+    epoch, _ = pub.store.latest()
+    assert tuple(server.predict("m-el", ROW)) == tuple(
+        LocalPredictor(pub.store.blob_path(epoch), SCHEMA).predict_row(ROW))
+
+
+# ---------------------------------------------------------------------------
+# build-time validation + plan rule ALK109
+# ---------------------------------------------------------------------------
+
+
+def test_publisher_build_validation(tmp_path):
+    pub = ModelStreamPublisher(str(tmp_path / "s"), "m", chain=3)
+    with pytest.raises(AkIllegalArgumentException, match="chain 3"):
+        RecoverableStreamJob(
+            source=TableSourceStreamOp(_table(), chunkSize=10),
+            chains=[([_ftrl()], [DatahubSinkStreamOp(
+                endpoint="memory://msp-val", topic="m")])],
+            checkpoint_dir=str(tmp_path / "ck"), epoch_chunks=4,
+            publishers=[pub])
+    with pytest.raises(AkIllegalArgumentException, match="servable_model"):
+        ModelStreamPublisher(str(tmp_path / "s2"), "m").validate_target(
+            object())
+    with pytest.raises(AkIllegalArgumentException, match="keyed"):
+        ModelStreamPublisher(str(tmp_path / "s3"), "m").validate_target(
+            _ftrl(), keyed=True)
+    with pytest.raises(AkIllegalArgumentException, match="input_schema"):
+        ModelStreamPublisher(str(tmp_path / "s4"), "m",
+                             server=ModelServer())
+
+
+def test_alk109_plan_rule(tmp_path):
+    from alink_tpu.analysis import validate_plan
+    from alink_tpu.operator.stream.base import StreamOperator
+
+    class _NoHooksTrainOp(StreamOperator):
+        def servable_model(self):  # pragma: no cover - never called
+            return None
+
+        def _stream_impl(self, chunks):
+            return chunks
+
+    op = _NoHooksTrainOp()
+    # un-bound: a hookless op is not a modelstream concern
+    assert validate_plan(op).diagnostics == []
+    ModelStreamPublisher(str(tmp_path / "s"), "m").validate_target(op)
+    report = validate_plan(op)
+    assert [d.rule for d in report.diagnostics] == ["ALK109"]
+    assert report.diagnostics[0].severity == "warning"
+    assert validate_plan(op, recovery=True).diagnostics[0].severity \
+        == "error"
+    # ops WITH snapshot hooks never fire it
+    hooked = _ftrl()
+    ModelStreamPublisher(str(tmp_path / "s2"), "m").validate_target(hooked)
+    assert validate_plan(hooked, recovery=True).diagnostics == []
+
+
+# ---------------------------------------------------------------------------
+# satellite 2: corrupt warmup sidecar is counted, warmup still happens
+# ---------------------------------------------------------------------------
+
+
+def test_corrupt_sidecar_counted_and_warmup_survives(tmp_path, clean_run):
+    pub = clean_run["pub"]
+    epoch, _ = pub.store.latest()
+    blob = pub.store.blob_path(epoch)
+    dst = str(tmp_path / "model.ak")
+    with open(blob, "rb") as f:
+        data = f.read()
+    with open(dst, "wb") as f:
+        f.write(data)
+    with open(dst + ".warmup.json", "w") as f:
+        f.write("{not json")  # file EXISTS but is garbage
+    before = metrics.counter("serving.warmup_sidecar_corrupt")
+    server = ModelServer()
+    # read-only store shape: don't let the load rewrite the sidecar
+    res = server.load("m", dst, SCHEMA, persist_warmup=False)
+    assert metrics.counter("serving.warmup_sidecar_corrupt") == before + 1
+    # zero-trace contract survived: the load warmed via the synthesized
+    # fallback instead of silently skipping warmup
+    assert res["warmup"]["rungs"] > 0
+    assert res["warmup_source"] == "synthesized"
+    assert tuple(server.predict("m", ROW)) == clean_run["served"]
+
+
+# ---------------------------------------------------------------------------
+# satellite 3: rapid double hot-swap resolves last-writer-wins
+# ---------------------------------------------------------------------------
+
+
+class _GatedPredictor(LocalPredictor):
+    """First predict_table (the load's warmup) parks on a gate — models a
+    slow load racing a faster, NEWER one."""
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self.entered = threading.Event()
+        self.release = threading.Event()
+        self._gated = True
+
+    def predict_table(self, table):
+        if self._gated:
+            self._gated = False
+            self.entered.set()
+            assert self.release.wait(timeout=30), "gate never released"
+        return super().predict_table(table)
+
+
+def test_double_hot_swap_last_writer_wins(clean_run):
+    pub = clean_run["pub"]
+    epoch, _ = pub.store.latest()
+    blob = pub.store.blob_path(epoch)
+    server = ModelServer()
+    slow = _GatedPredictor(blob, SCHEMA)
+    fast = LocalPredictor(blob, SCHEMA)
+    results = {}
+
+    def first_load():
+        results["slow"] = server.load("m", slow)
+
+    t = threading.Thread(target=first_load)
+    t.start()
+    assert slow.entered.wait(timeout=30)
+    before = metrics.counter("serving.load_superseded")
+    # the NEWER load starts and finishes while the older one is parked
+    results["fast"] = server.load("m", fast)
+    slow.release.set()
+    t.join(timeout=30)
+    assert not t.is_alive()
+    # last-writer-wins by load-call order: the parked older load must NOT
+    # clobber the newer entry when it finally finishes
+    assert results["slow"].get("superseded") is True
+    assert "superseded" not in results["fast"]
+    assert metrics.counter("serving.load_superseded") == before + 1
+    assert server._entries["m"].predictor is fast
+    assert tuple(server.predict("m", ROW)) == clean_run["served"]
+
+
+# ---------------------------------------------------------------------------
+# blob byte-determinism (the property every drill leans on)
+# ---------------------------------------------------------------------------
+
+
+def test_published_blob_bytes_deterministic(tmp_path, clean_run):
+    """Two publishes of the same trained state are byte-identical — both
+    zip layers write fixed timestamps, so the crash-retry republish can
+    be compared bit-for-bit against what the torn attempt left behind."""
+    summary, pub, _ = _run_job(str(tmp_path), "det")
+    assert summary["complete"]
+    assert _blob_bytes(pub) == clean_run["blobs"]
+    # manifests agree on the checksums too
+    for e in pub.store.versions():
+        a = pub.store._read_manifest(e)
+        b = clean_run["pub"].store._read_manifest(e)
+        assert (a["blob_crc32"], a["blob_bytes"]) \
+            == (b["blob_crc32"], b["blob_bytes"])
+        # the sidecar rode along with every committed version
+        with open(pub.store.sidecar_path(e)) as f:
+            spec = json.load(f)
+        assert spec["input_schema"] == SCHEMA
