@@ -53,3 +53,14 @@ from .modelstream import (  # noqa: E402,F401
     ModelStreamStore,
     modelstream_summary,
 )
+
+
+def __getattr__(name):
+    # the serving tier (and the fleet on top of it) pulls in the pipeline
+    # layer and jax — resolve lazily so `import alink_tpu` stays light
+    if name in ("ServingFleet", "FleetConfig", "ModelServer",
+                "ServingConfig", "serving_summary"):
+        from . import serving
+
+        return getattr(serving, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

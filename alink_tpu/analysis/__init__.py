@@ -17,6 +17,7 @@ from .diagnostics import INFO, ERROR, RULES, WARNING, Diagnostic, Report  # noqa
 from .plancheck import (  # noqa: F401
     last_plan_report,
     preflight,
+    preflight_fleet_models,
     preflight_train_config,
     suppress_preflight,
     validate_plan,

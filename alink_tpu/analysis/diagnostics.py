@@ -96,6 +96,12 @@ RULES: Dict[str, tuple] = {
                "state_snapshot/state_restore hooks — after a crash the "
                "retrain diverges from the published version history, so "
                "the republish-bit-identical contract cannot hold"),
+    "ALK110": ("fleet-model-without-warmup-sidecar", WARNING,
+               "model loaded into a serving fleet without a readable "
+               ".ak.warmup.json sidecar — a respawned replica would fall "
+               "back to trace-on-first-traffic bring-up, breaking the "
+               "fleet's zero-trace steady-state contract (error severity "
+               "when the fleet respawns replicas)"),
 }
 
 

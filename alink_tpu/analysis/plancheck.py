@@ -749,3 +749,52 @@ def preflight(target, data=None, *, where: str = "execute",
     if mode == "error" and report.errors():
         raise AkPlanValidationException(report)
     return report
+
+
+def preflight_fleet_models(models: Sequence, *, recovery: bool = False,
+                           where: str = "fleet.load"
+                           ) -> Optional[Report]:
+    """Pre-flight for models entering a serving fleet (**ALK110**):
+    each ``(name, path)`` must carry a readable ``.ak.warmup.json``
+    sidecar, or a respawned replica would silently fall back to
+    trace-on-first-traffic bring-up. Warning severity by default;
+    ``recovery=True`` (a fleet that respawns replicas — the production
+    shape) escalates to error, refusing the load under
+    ``ALINK_VALIDATE_PLAN=error``. Same conventions as :func:`preflight`:
+    ``off`` skips, findings are counted, a validator crash is counted and
+    never propagated."""
+    from ..common.exceptions import AkPlanValidationException
+
+    mode = validation_mode()
+    if mode == "off" or getattr(_suppressed, "depth", 0):
+        return None
+    report = Report(engine="plan", target="ServingFleet")
+    try:
+        from ..serving.warmup_store import load_warmup_spec
+
+        for name, path in models:
+            spec = load_warmup_spec(path) if isinstance(path, str) else None
+            if spec is None:
+                report.add(
+                    "ALK110",
+                    f"model {name!r} ({path}) has no readable warmup "
+                    "sidecar — a respawned replica would warm from live "
+                    "traffic instead of disk, tracing on its first "
+                    "requests",
+                    where=f"fleet:{name}",
+                    severity=ERROR if recovery else "",
+                    hint="persist one by loading the model through "
+                         "ModelServer.load(..., persist_warmup=True) "
+                         "once, or write it with "
+                         "serving.save_warmup_spec()")
+    except Exception as e:
+        metrics.incr("analysis.validator_errors")
+        logger.debug("fleet pre-flight failed at %s: %r", where, e)
+        return None
+    _record_report(report, mode)
+    if report.diagnostics:
+        logger.warning("plan validation (%s, %s):\n%s",
+                       where, mode, report.render())
+    if mode == "error" and report.errors():
+        raise AkPlanValidationException(report)
+    return report

@@ -36,6 +36,22 @@ Injection points wired into the runtime:
   kills the job exactly once with a fully-written-but-uncommitted version
   on disk — the drill then asserts readers skip the torn version and the
   restarted job republishes it bit-identically.
+- ``replica``   — the serving-fleet worker processes (``serving/fleet.py``),
+  labels ``<replica>.g<gen>.batch`` (tapped inside the worker's predict
+  handling, between accept and reply) and ``<replica>.g<gen>.heartbeat``
+  (the worker's heartbeat loop). The generation qualifier lets a drill
+  target one incarnation: fault counters are per-process, so a spec
+  matching the bare replica id would re-fire in every respawn.
+  Uses the replica-specific kinds below:
+  ``kill_mid_batch`` (the worker process dies with requests in flight —
+  the front-end must re-dispatch them to a healthy replica),
+  ``hang`` (the worker stops answering heartbeats AND data-plane calls
+  while staying alive — the supervisor must declare it unhealthy and
+  replace it), ``refuse_health`` (heartbeats stop but the data plane
+  still answers — exercises health-based routing without a real death).
+  ``replica:count=1,kinds=kill_mid_batch,match=r1.g2.batch`` kills the
+  first incarnation of replica r1 exactly once, mid-load,
+  deterministically; its respawn (a later generation) serves normally.
 
 Spec grammar (``ALINK_FAULT_SPEC``)::
 
@@ -60,6 +76,11 @@ Spec grammar (``ALINK_FAULT_SPEC``)::
   the inner retry layers, so it takes the whole job down, but the
   supervised restart driver (``common/recovery.py run_with_recovery``)
   classifies it restartable and resumes from the last epoch snapshot).
+  The ``replica`` point additionally accepts
+  ``kill_mid_batch``/``hang``/``refuse_health`` (raises
+  :class:`InjectedReplicaFault` carrying the behavior — the fleet worker
+  runtime translates it into the corresponding process-level misbehavior
+  instead of a plain exception).
 
 Usage::
 
@@ -112,6 +133,30 @@ class InjectedCrashError(AkException):
     restartable — a fresh job instance resumes from the last snapshot."""
 
     code = "AK_INJECTED_CRASH"
+
+
+#: Replica-misbehavior kinds accepted at the ``replica`` point. Unlike the
+#: generic kinds these do not map to the retry taxonomy — the fleet worker
+#: runtime catches :class:`InjectedReplicaFault` and *acts out* the
+#: behavior (process exit / freeze / heartbeat silence).
+REPLICA_BEHAVIORS = ("kill_mid_batch", "hang", "refuse_health")
+
+
+class InjectedReplicaFault(AkException):
+    """Synthetic replica misbehavior for serving-fleet chaos drills.
+
+    Carries the requested behavior in :attr:`behavior`; raised by the
+    injection tap and translated by ``serving/fleet.py``'s worker runtime
+    into the real thing (``kill_mid_batch`` → ``os._exit`` with requests
+    in flight, ``hang`` → stop heartbeating and stall the data plane,
+    ``refuse_health`` → stop heartbeating only). If one escapes outside a
+    fleet worker it propagates as a plain fatal error."""
+
+    code = "AK_INJECTED_REPLICA_FAULT"
+
+    def __init__(self, behavior: str, message: str = ""):
+        super().__init__(message or f"injected replica fault: {behavior}")
+        self.behavior = behavior
 
 
 class _Rule:
@@ -181,9 +226,11 @@ class FaultSpec:
                         f"bad fault spec item {item!r} in segment {part!r}")
                 kw[k.strip()] = v.strip()
             kind = kw.get("kinds", kw.get("kind", "transient"))
-            if kind not in ("transient", "fatal", "crash"):
+            if kind not in ("transient", "fatal", "crash") \
+                    and kind not in REPLICA_BEHAVIORS:
                 raise AkParseErrorException(
-                    f"fault kind must be transient|fatal|crash, got {kind!r}")
+                    f"fault kind must be transient|fatal|crash or one of "
+                    f"{'|'.join(REPLICA_BEHAVIORS)}, got {kind!r}")
             try:
                 rate = float(kw.get("rate", "0"))
                 count = int(kw.get("count", "0"))
@@ -212,6 +259,9 @@ class FaultSpec:
             raise InjectedFatalError(f"injected fatal fault at {where}")
         if kind == "crash":
             raise InjectedCrashError(f"injected crash at {where}")
+        if kind in REPLICA_BEHAVIORS:
+            raise InjectedReplicaFault(
+                kind, f"injected replica fault ({kind}) at {where}")
         raise InjectedFaultError(f"injected transient fault at {where}")
 
     def __repr__(self):

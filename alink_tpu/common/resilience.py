@@ -263,6 +263,16 @@ class CircuitBreaker:
         with cls._registry_lock:
             cls._registry.clear()
 
+    @classmethod
+    def endpoint_states(cls, prefix: str = "") -> Dict[str, str]:
+        """``{key: "open"|"closed"}`` for registered endpoints matching
+        ``prefix`` — the fleet summary surfaces its ``fleet:<replica>``
+        breakers through this without holding breaker internals."""
+        with cls._registry_lock:
+            items = [(k, b) for k, b in cls._registry.items()
+                     if k.startswith(prefix)]
+        return {k: ("open" if b.is_open else "closed") for k, b in items}
+
 
 # ---------------------------------------------------------------------------
 # Dead-letter buffer

@@ -7,7 +7,12 @@ publisher asks its bound train op for a servable model
 to a :class:`~alink_tpu.modelstream.store.ModelStreamStore` (blob →
 warmup sidecar → manifest, the manifest rename being the atomic point),
 and hot-swaps the committed version into a live :class:`ModelServer` —
-continuously, under traffic, with bounded staleness
+or, when ``server`` is a :class:`~alink_tpu.serving.ServingFleet`,
+broadcasts it into every replica (per-replica outcomes counted as
+``modelstream.fleet_swap_ok``/``fleet_swap_missed``; a replica that
+misses the swap re-syncs from ``store.latest()`` at health-recheck via
+the bound model source) — continuously, under traffic, with bounded
+staleness
 (``ALINK_MODELSTREAM_MIN_EPOCH_S`` rate-limits publishes; ``0`` publishes
 every epoch).
 
@@ -80,6 +85,11 @@ class ModelStreamPublisher:
         self.input_schema = input_schema
         self.warmup_rows = [tuple(r) for r in warmup_rows] \
             if warmup_rows else None
+        if server is not None and hasattr(server, "bind_model_source"):
+            # fleet target: a replica that missed a broadcast swap (or a
+            # fresh respawn) re-syncs from the newest committed store
+            # version at its next health-recheck
+            server.bind_model_source(name, self._latest_blob)
         self.stage_params = dict(stage_params or {"predictionCol": "pred"})
         self.serving_config = serving_config
         self.min_epoch_s = float(min_epoch_s) if min_epoch_s is not None \
@@ -171,9 +181,15 @@ class ModelStreamPublisher:
         return epoch
 
     # -- internals -----------------------------------------------------------
+    def _latest_blob(self) -> Optional[str]:
+        latest = self.store.latest()
+        return self.store.blob_path(latest[0]) if latest else None
+
     def _server_has_model(self) -> bool:
         if self.server is None:
             return True
+        if hasattr(self.server, "has_model"):
+            return bool(self.server.has_model(self.name))
         return self.name in getattr(self.server, "_entries", {})
 
     def _wrap(self, model_table):
@@ -226,9 +242,16 @@ class ModelStreamPublisher:
         before = metrics.counter("jit.trace")
         t0 = time.perf_counter()
         with trace_span("modelstream.swap", epoch=epoch, model=self.name):
-            self.server.load(self.name, blob, self.input_schema,
-                             config=self.serving_config)
+            out = self.server.load(self.name, blob, self.input_schema,
+                                   config=self.serving_config)
         metrics.add_time("modelstream.swap_s", time.perf_counter() - t0)
+        if isinstance(out, dict) and "replicas" in out:
+            # fleet-wide broadcast: per-replica outcome accounting; a
+            # replica that missed it re-syncs from the bound store source
+            for rep_out in out["replicas"].values():
+                metrics.incr("modelstream.fleet_swap_ok"
+                             if rep_out.get("ok")
+                             else "modelstream.fleet_swap_missed")
         delta = metrics.counter("jit.trace") - before
         if self._first_swap_done and delta:
             # traces during a hot-swap mean the ladder keys were NOT

@@ -87,6 +87,22 @@ def init_multi_host(
     }
 
 
+#: Topology knobs consumed by :func:`init_multi_host`. A process spawned for
+#: a *different* purpose (a serving-fleet replica, a helper subprocess) must
+#: not inherit them — it would try to join the training cluster and block at
+#: the coordinator instead of coming up standalone.
+CLUSTER_ENV_KEYS = ("COORDINATOR_ADDRESS", "NUM_PROCESSES", "PROCESS_ID")
+
+
+def scrub_cluster_env(env: dict) -> dict:
+    """Return a copy of *env* with the multi-host topology knobs removed.
+
+    Used by spawners of standalone worker processes (``serving/fleet.py``)
+    so a fleet launched from inside a training pod does not hand its
+    replicas the pod's cluster identity."""
+    return {k: v for k, v in env.items() if k not in CLUSTER_ENV_KEYS}
+
+
 def global_data_mesh():
     """1-D data mesh over ALL devices in the cluster (every process sees the
     same global mesh; shard_map/pjit place per-host shards automatically)."""

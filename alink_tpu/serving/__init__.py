@@ -9,6 +9,14 @@ deadlines. Admission control sheds load past a bounded queue's high-water
 mark, a per-model circuit breaker degrades a failing model to fast rejects,
 and the whole path is instrumented with ``serving.*`` spans, histograms, and
 counters exported at ``GET /metrics``.
+
+Above the single-process server sits the fault-tolerant fleet
+(:mod:`.fleet`): N worker processes each running a ModelServer behind a
+real socket, a failover load balancer (:mod:`.fleet_frontend`) that
+re-dispatches requests off dead replicas, health-driven respawn with
+zero-trace sidecar warmup, graceful drain, fleet-wide hot-swap, and
+backpressure autoscaling — results bit-identical to the single-process
+server.
 """
 
 from .router import (  # noqa: F401
@@ -18,6 +26,16 @@ from .router import (  # noqa: F401
     default_server,
     serving_bucket_ladder,
     serving_summary,
+)
+from .fleet import (  # noqa: F401
+    FleetConfig,
+    ServingFleet,
+    active_fleet_summary,
+)
+from .fleet_frontend import (  # noqa: F401
+    FleetFrontend,
+    FrontendListener,
+    ReplicaClient,
 )
 from .warmup_store import (  # noqa: F401
     load_warmup_spec,

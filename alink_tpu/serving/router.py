@@ -743,4 +743,14 @@ def serving_summary(server: Optional[ModelServer] = None) -> Dict[str, Any]:
         {"models": [], "histograms": {}, "counters": metrics.counters("serving.")}
     out["jit"] = {k: v for k, v in metrics.counters("jit.").items()
                   if k in ("jit.trace", "jit.compile")}
+    try:
+        # lazy import: fleet imports this module; the join must not cycle
+        from .fleet import active_fleet_summary
+
+        fleet_block = active_fleet_summary()
+    except Exception:
+        metrics.incr("serving.summary_fleet_errors")
+        fleet_block = None
+    if fleet_block is not None:
+        out["fleet"] = fleet_block
     return out

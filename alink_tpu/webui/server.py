@@ -424,7 +424,12 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._send_json({"error": "no such experiment"}, 404)
             return self._send_json(exp)
         if parts == ["serving"]:
-            return self._send_json(self._serving().stats())
+            # full summary (not bare stats): joins the jit trace counters
+            # and, when a ServingFleet is live in this process, the fleet
+            # block with per-replica health
+            from ..serving.router import serving_summary
+
+            return self._send_json(serving_summary(self._serving()))
         return self._send_json({"error": "not found"}, 404)
 
     # -- serving endpoints --

@@ -1612,6 +1612,168 @@ def bench_serving(clients=8, rows_per_client=400):
         srv.close()
 
 
+def bench_fleet(clients=6, rows_per_client=60):
+    """Fault-tolerant serving fleet (alink_tpu/serving/fleet): multi-process
+    replica scaling at N∈{1,2,4} (rows/s + request p99 per N, bit-parity vs
+    the single-process ModelServer over the same rows), then a chaos drill —
+    one replica killed mid-batch at load via the ``replica`` fault point —
+    reporting failover count, recovery time back to full ready strength,
+    and the delivery gate: every accepted request either completed with the
+    serial answer or shed with a typed error; none lost. Zero-trace gate:
+    replica trace deltas stay 0 (all warmup from the ``.ak.warmup.json``
+    sidecar, never live traffic)."""
+    import shutil
+    import tempfile
+    import threading
+
+    from alink_tpu.common.exceptions import (AkCircuitOpenException,
+                                             AkDeadlineExceededException)
+    from alink_tpu.common.mtable import MTable
+    from alink_tpu.pipeline import (NaiveBayes, Pipeline, StandardScaler,
+                                    VectorAssembler)
+    from alink_tpu.serving import (AkServingOverloadException, FleetConfig,
+                                   ModelServer, ServingFleet)
+
+    rng = np.random.RandomState(0)
+    X = np.concatenate([rng.normal(c, 0.4, size=(200, 4))
+                        for c in [(0, 0, 0, 0), (2, 2, 2, 2)]])
+    y = np.repeat(["neg", "pos"], 200)
+    feats = ["f0", "f1", "f2", "f3"]
+    t = MTable({f"f{i}": X[:, i] for i in range(4)}).with_column("label", y)
+    model = Pipeline(
+        StandardScaler(selectedCols=feats),
+        VectorAssembler(selectedCols=feats, outputCol="vec"),
+        NaiveBayes(vectorCol="vec", labelCol="label", predictionCol="pred"),
+    ).fit(t)
+    schema = "f0 double, f1 double, f2 double, f3 double"
+    tmp = tempfile.mkdtemp(prefix="alink_bench_fleet_")
+    rows = [tuple(r) for r in X]
+    try:
+        path = os.path.join(tmp, "model.ak")
+        model.save(path)
+        # single-process ground truth; the load also writes the warmup
+        # sidecar every fleet replica warms from
+        srv = ModelServer()
+        srv.load("m", path, schema, warmup_rows=[tuple(X[0])])
+        serial = [srv.predict("m", r) for r in rows[:32]]
+        srv.close()
+
+        typed = (AkServingOverloadException, AkCircuitOpenException,
+                 AkDeadlineExceededException)
+
+        def drill(fleet, lat, mismatches):
+            shed, lost = [0], []
+
+            def client(cid):
+                for i in range(rows_per_client):
+                    k = (cid * 131 + i * 7) % len(rows)
+                    t0 = time.perf_counter()
+                    try:
+                        got = fleet.predict("m", rows[k], timeout=60)
+                        lat.append(time.perf_counter() - t0)
+                        if k < 32 and got != serial[k]:
+                            mismatches.append(k)
+                    except typed:
+                        shed[0] += 1
+                    except Exception as e:
+                        lost.append(f"{type(e).__name__}: {e}"[:120])
+
+            threads = [threading.Thread(target=client, args=(c,))
+                       for c in range(clients)]
+            t0 = time.perf_counter()
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+            return time.perf_counter() - t0, shed[0], lost
+
+        def trace_deltas(fleet):
+            time.sleep(3 * fleet._cfg.heartbeat_s + 0.2)  # let hbs land
+            return [r["trace_delta"]
+                    for r in fleet.fleet_summary()["replicas"]]
+
+        scales, parity_ok, zero_trace, all_lost = {}, True, True, []
+        for n in (1, 2, 4):
+            lat, mism = [], []
+            with ServingFleet(FleetConfig(
+                    replicas=n, heartbeat_s=0.2,
+                    heartbeat_timeout_s=1.5)) as fleet:
+                fleet.load("m", path, schema)
+                wall, shed, lost = drill(fleet, lat, mism)
+                deltas = trace_deltas(fleet)
+            total = clients * rows_per_client
+            parity_ok = parity_ok and not mism
+            zero_trace = zero_trace and all(d == 0 for d in deltas)
+            all_lost += lost
+            scales[str(n)] = {
+                "rows_per_sec": round((total - shed - len(lost)) / wall, 1),
+                "request_p99_ms": round(
+                    float(np.percentile(lat, 99)) * 1e3, 3) if lat else None,
+                "shed": shed,
+                "trace_deltas": deltas,
+            }
+
+        # chaos drill: r1's first incarnation (gen 2) dies on its first
+        # routed batch; the front-end re-dispatches, the supervisor
+        # respawns it warm from the sidecar
+        lat, mism = [], []
+        with ServingFleet(FleetConfig(
+                replicas=2, heartbeat_s=0.2, heartbeat_timeout_s=1.0,
+                worker_env={"ALINK_FAULT_SPEC":
+                            "replica:count=1,kinds=kill_mid_batch,"
+                            "match=r1.g2.batch"})) as fleet:
+            fleet.load("m", path, schema)
+            t_drill0 = time.perf_counter()
+            wall, shed, lost = drill(fleet, lat, mism)
+            all_lost += lost
+            recovery_s = None
+            deadline = time.perf_counter() + 60
+            while time.perf_counter() < deadline:
+                s = fleet.fleet_summary()
+                if s["states"].get("ready") == 2 and all(
+                        r["synced"].get("m") for r in s["replicas"]):
+                    recovery_s = time.perf_counter() - t_drill0
+                    break
+                time.sleep(0.1)
+            for k in range(16):  # post-recovery parity
+                if fleet.predict("m", rows[k], timeout=60) != serial[k]:
+                    mism.append(k)
+            deltas = trace_deltas(fleet)
+            summary = fleet.fleet_summary()
+        parity_ok = parity_ok and not mism
+        zero_trace = zero_trace and all(d == 0 for d in deltas)
+        counters = summary["counters"]
+        respawn_loads = [ld for r in summary["replicas"]
+                         for ld in (r["loads"] or []) if r["gen"] > 2]
+        kill = {
+            "shed": shed,
+            "lost": all_lost,
+            "failovers": counters.get("fleet.failovers", 0),
+            "respawns": counters.get("fleet.respawns", 0),
+            "recovery_s": round(recovery_s, 2) if recovery_s else None,
+            "respawn_warmup": [ld.get("warmup_source")
+                               for ld in respawn_loads],
+        }
+        out = {
+            "clients": clients,
+            "rows_per_client": rows_per_client,
+            "scales": scales,
+            "kill_drill": kill,
+            "gate": {
+                "parity": parity_ok,
+                "zero_trace": zero_trace,
+                "clean_shed": not all_lost,
+                "recovered": (recovery_s is not None
+                              and kill["respawns"] >= 1
+                              and kill["respawn_warmup"] == ["sidecar"]),
+            },
+        }
+        out["gate"]["ok"] = all(out["gate"].values())
+        return out
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def bench_observability(repeats=3):
     """Unified tracing & telemetry layer (common/tracing.py + the metrics
     histogram/Prometheus export): run kmeans_iris with ALINK_TRACING=off vs
@@ -2268,6 +2430,7 @@ def main(argv=None):
         ("profiling", bench_profiling),
         ("kernels", bench_kernels),
         ("serving", bench_serving),
+        ("fleet", bench_fleet),
         ("aps", bench_aps),
         ("huge", bench_huge),
         # LAST on purpose: train_scale compiles its own program family, and
