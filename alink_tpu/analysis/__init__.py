@@ -18,6 +18,7 @@ from .plancheck import (  # noqa: F401
     last_plan_report,
     preflight,
     preflight_fleet_models,
+    preflight_quantized_load,
     preflight_train_config,
     suppress_preflight,
     validate_plan,

@@ -102,6 +102,11 @@ RULES: Dict[str, tuple] = {
                "back to trace-on-first-traffic bring-up, breaking the "
                "fleet's zero-trace steady-state contract (error severity "
                "when the fleet respawns replicas)"),
+    "ALK111": ("quantized-load-unproven", WARNING,
+               "quantized serving load without a real calibration sample "
+               "or with the accuracy band disabled — int8/bf16 numerics "
+               "would serve with nothing proving them against the fp32 "
+               "baseline (error severity for respawn/recovery loads)"),
 }
 
 

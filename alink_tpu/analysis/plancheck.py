@@ -751,6 +751,56 @@ def preflight(target, data=None, *, where: str = "execute",
     return report
 
 
+def preflight_quantized_load(name: str, *, policy: str, real_sample: bool,
+                             band_enabled: bool, recovery: bool = False,
+                             where: str = "serving.load"
+                             ) -> Optional[Report]:
+    """Pre-flight for quantized serving loads (**ALK111**): a load
+    requesting a quantization policy with no real calibration sample
+    (caller/sidecar rows — synthesized zero rows never count) or with the
+    accuracy band disabled serves numerics nothing has proven. Warning
+    severity by default; ``recovery=True`` (respawn/recovery loads)
+    escalates to error, refusing the load under
+    ``ALINK_VALIDATE_PLAN=error``. Same conventions as :func:`preflight`:
+    ``off`` skips, findings are counted, a validator crash is counted and
+    never propagated."""
+    from ..common.exceptions import AkPlanValidationException
+
+    mode = validation_mode()
+    if mode == "off" or getattr(_suppressed, "depth", 0):
+        return None
+    report = Report(engine="plan", target="ModelServer")
+    try:
+        problems = []
+        if not real_sample:
+            problems.append("no real calibration sample (caller or "
+                            "sidecar rows)")
+        if not band_enabled:
+            problems.append("the accuracy-band gate is disabled")
+        if problems:
+            report.add(
+                "ALK111",
+                f"model {name!r} requests precision={policy} with "
+                f"{' and '.join(problems)} — the quantized numerics "
+                "would serve unproven",
+                where=f"serving:{name}",
+                severity=ERROR if recovery else "",
+                hint="pass real warmup_rows to ModelServer.load (they "
+                     "seed calibration AND the accuracy gate), or keep "
+                     "quant_band/quant_tol >= 0")
+    except Exception as e:
+        metrics.incr("analysis.validator_errors")
+        logger.debug("quantized-load pre-flight failed at %s: %r", where, e)
+        return None
+    _record_report(report, mode)
+    if report.diagnostics:
+        logger.warning("plan validation (%s, %s):\n%s",
+                       where, mode, report.render())
+    if mode == "error" and report.errors():
+        raise AkPlanValidationException(report)
+    return report
+
+
 def preflight_fleet_models(models: Sequence, *, recovery: bool = False,
                            where: str = "fleet.load"
                            ) -> Optional[Report]:

@@ -155,6 +155,11 @@ def metric_direction(path: str) -> Optional[str]:
         # ci_pct): a relative delta between two near-zero noise readings
         # is meaningless and would false-flag healthy rounds
         return None
+    if "accuracy_delta" in p or "accuracy_band" in p:
+        # quantized-serving gate readouts: near-zero diffs against the
+        # fp32 baseline, directionless for the same reason parity_max_diff
+        # is — must be classified BEFORE the "accuracy"→higher substring
+        return None
     for s in ("per_sec", "accuracy", "purity", "mfu", "hit_rate",
               "speedup", "tflops", "batch_fill", "bandwidth", "mb_per_s",
               "efficiency"):

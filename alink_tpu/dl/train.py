@@ -379,6 +379,36 @@ def _apply_program(model, key: Any = None):
                       key_extra=key if key is not None else _model_key(model))
 
 
+def _apply_program_int8(model, scales, key: Any = None):
+    """Weight-only int8 twin of :func:`_apply_program`: the int8 parameter
+    tree dequantizes in-kernel against per-channel ``scales`` (closed over
+    as constants — they are tiny) before ``model.apply``. Keyed under its
+    own ``dl.apply_logits.int8`` kernel id AND by the scale contents, so
+    fp32 and int8 programs — and two differently-quantized fine-tunes of
+    one config — coexist in the ProgramCache."""
+    import jax as _jax
+
+    from ..common.jitcache import cached_jit
+
+    def _build_apply():
+        import jax
+
+        def run(qparams, batch):
+            params = jax.tree_util.tree_map(
+                lambda q, s: q if s is None else q.astype("float32") * s,
+                qparams, scales)
+            return model.apply(params, **batch, deterministic=True)
+
+        return jax.jit(run)
+
+    scale_leaves = tuple(np.asarray(s, np.float32)
+                         for s in _jax.tree_util.tree_leaves(scales))
+    return cached_jit(
+        "dl.apply_logits.int8", _build_apply,
+        key_extra=(key if key is not None else _model_key(model),
+                   scale_leaves))
+
+
 def _feed(build: Callable[[int], Sequence[np.ndarray]],
           place: Callable[[Sequence[np.ndarray]], Sequence[Any]],
           steps: int, *, mode: str = "async",
@@ -876,17 +906,37 @@ def _batched_apply(fn, params, inputs: Dict[str, np.ndarray], mesh, in_shard,
 def predict_model(
     model, params, inputs: Dict[str, np.ndarray], *, mesh=None,
     batch_size: int = 256, seq_axis: Optional[int] = 1,
+    precision: Optional[str] = None,
 ) -> np.ndarray:
-    """Batched inference returning logits (n, out_dim)."""
+    """Batched inference returning logits (n, out_dim).
+
+    ``precision`` applies the serving quantization policy to the encoder:
+    ``int8`` quantizes every >=2-D float parameter per-channel (weight-only
+    — dequantized in-kernel by the ``dl.apply_logits.int8`` program);
+    ``bf16`` rounds float parameters through bfloat16. Unset leaves the
+    fp32 path byte-identical."""
     import jax
 
+    from ..common import quant
     from ..parallel.mesh import default_mesh
 
     mesh = mesh or default_mesh()
-    p_shard = param_shardings(params, mesh)
-    params = jax.device_put(params, p_shard)
-
-    apply = _apply_program(model)
+    policy = quant.resolve_policy(precision)
+    if policy == quant.BF16:
+        params = jax.tree_util.tree_map(
+            lambda a: quant.bf16_round(a)
+            if np.issubdtype(np.asarray(a).dtype, np.floating) else a,
+            params)
+        policy = None
+    if policy == quant.INT8:
+        qparams, scales = quant.quantize_tree(params)
+        p_shard = param_shardings(qparams, mesh)
+        params = jax.device_put(qparams, p_shard)
+        apply = _apply_program_int8(model, scales)
+    else:
+        p_shard = param_shardings(params, mesh)
+        params = jax.device_put(params, p_shard)
+        apply = _apply_program(model)
 
     def in_shard(arr):
         sa = seq_axis if arr.ndim > (seq_axis or 0) else None

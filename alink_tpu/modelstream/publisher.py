@@ -231,6 +231,7 @@ class ModelStreamPublisher:
                          warmup_rows=rows,
                          max_batch_rows=mbr,
                          ladder=serving_bucket_ladder(mbr),
+                         synthetic_rows=not bool(self.warmup_rows),
                          path=sidecar_path,
                          fsync=True)
 

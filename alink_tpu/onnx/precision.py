@@ -3,6 +3,12 @@
 One source of truth for the policy all ingest formats apply: float weights
 load in the compute dtype, float inputs cast on device, float outputs
 return fp32 (integer tensors pass through untouched).
+
+The jit wrappers route through ``common/jitcache.cached_jit`` so two
+converted models of the same graph family share ONE traced program (keyed
+by the wrapped fn's code + captured weights and the policy dtype) instead
+of rebuilding a ``jax.jit`` closure per conversion; converter fns whose
+captured state cannot be content-keyed fall back to a per-call build.
 """
 
 from __future__ import annotations
@@ -33,10 +39,11 @@ def cast_float_state(state: Dict[str, np.ndarray], dtype) -> Dict[str, Any]:
     }
 
 
-def wrap_positional(fn, dtype):
-    """jit-wrap a positional fn returning a LIST of arrays under the policy."""
+def _build_wrap_positional(fn, dtype_s: str):
     import jax
     import jax.numpy as jnp
+
+    dtype = jnp.dtype(dtype_s)
 
     def wrapped(*args):
         cast = [a.astype(dtype)
@@ -50,27 +57,29 @@ def wrap_positional(fn, dtype):
     return jax.jit(wrapped)
 
 
-def wrap_named(fn, dtype):
-    """jit-wrap a kwargs fn returning a DICT of arrays under the policy."""
+def _build_wrap_named(fn, dtype_s: str):
+    # positional form with the input-name tuple as a static: the program
+    # cache counts call signatures positionally, so the kwargs surface
+    # lives in _NamedAdapter, not the traced function
     import jax
     import jax.numpy as jnp
 
-    def wrapped(**inputs):
+    dtype = jnp.dtype(dtype_s)
+
+    def wrapped(names, *values):
         cast = {k: (v.astype(dtype)
                     if jnp.issubdtype(v.dtype, jnp.floating) else v)
                 for k, v in ((k, jnp.asarray(v))
-                             for k, v in inputs.items())}
+                             for k, v in zip(names, values))}
         out = fn(**cast)
         return {k: (v.astype(jnp.float32)
                     if jnp.issubdtype(v.dtype, jnp.floating) else v)
                 for k, v in out.items()}
 
-    return jax.jit(wrapped)
+    return jax.jit(wrapped, static_argnums=0)
 
 
-def wrap_pinned_positional(fn):
-    """jit-wrap a positional fn with the fp32 numerics-parity pin (full-
-    precision matmuls, so TPU results match the source runtime)."""
+def _build_wrap_pinned_positional(fn):
     import jax
 
     def wrapped(*args):
@@ -80,12 +89,67 @@ def wrap_pinned_positional(fn):
     return jax.jit(wrapped)
 
 
-def wrap_pinned_named(fn):
-    """Named-argument twin of :func:`wrap_pinned_positional`."""
+def _build_wrap_pinned_named(fn):
     import jax
 
-    def wrapped(**inputs):
+    def wrapped(names, *values):
         with jax.default_matmul_precision("highest"):
-            return fn(**inputs)
+            return fn(**dict(zip(names, values)))
 
-    return jax.jit(wrapped)
+    return jax.jit(wrapped, static_argnums=0)
+
+
+class _NamedAdapter:
+    """kwargs façade over a positional program (the ProgramCache counts
+    call signatures positionally; the sorted key tuple rides as a jit
+    static, so any one key set traces once)."""
+
+    __slots__ = ("_prog",)
+
+    def __init__(self, prog):
+        self._prog = prog
+
+    def __call__(self, **inputs):
+        names = tuple(sorted(inputs))
+        return self._prog(names, *(inputs[k] for k in names))
+
+
+def _cached_wrap(kernel_id: str, builder, fn, *static):
+    from ..common.jitcache import Unkeyable, cached_jit, fn_content_key
+
+    try:
+        return cached_jit(kernel_id, builder, fn, *static,
+                          key_extra=fn_content_key(fn))
+    except Unkeyable:
+        # the converter fn closes over state the key cannot digest: fall
+        # back to the per-call build — correctness first, reuse elsewhere
+        return builder(fn, *static)
+
+
+def wrap_positional(fn, dtype):
+    """jit-wrap a positional fn returning a LIST of arrays under the policy."""
+    return _cached_wrap("onnx.wrap_positional", _build_wrap_positional,
+                        fn, str(np.dtype(dtype)) if dtype is not None
+                        else "float32")
+
+
+def wrap_named(fn, dtype):
+    """jit-wrap a kwargs fn returning a DICT of arrays under the policy."""
+    return _NamedAdapter(
+        _cached_wrap("onnx.wrap_named", _build_wrap_named,
+                     fn, str(np.dtype(dtype)) if dtype is not None
+                     else "float32"))
+
+
+def wrap_pinned_positional(fn):
+    """jit-wrap a positional fn with the fp32 numerics-parity pin (full-
+    precision matmuls, so TPU results match the source runtime)."""
+    return _cached_wrap("onnx.wrap_pinned_positional",
+                        _build_wrap_pinned_positional, fn)
+
+
+def wrap_pinned_named(fn):
+    """Named-argument twin of :func:`wrap_pinned_positional`."""
+    return _NamedAdapter(
+        _cached_wrap("onnx.wrap_pinned_named",
+                     _build_wrap_pinned_named, fn))

@@ -197,13 +197,28 @@ class NaiveBayesModelMapper(RichModelMapper):
             c = log1mp.sum(1) + prior
         # staged to device ONCE — arguments to a shared program, without a
         # per-predict host→device re-transfer of the model factors
+        from ...common import quant
         from ...common.jitcache import device_constants
 
-        self._score_factors = device_constants(
-            np.asarray(a, np.float32), np.asarray(b, np.float32),
-            np.asarray(c, np.float32))
+        self._mtype = mtype
+        self._policy = quant.policy_of(self.get_params())
+        site = quant.site_of(self.get_params(), "naivebayes")
+        self._site_x, self._site_xx = site + ".x", site + ".xx"
+        a = np.asarray(a, np.float32)
+        b = np.asarray(b, np.float32)
+        c = np.asarray(c, np.float32)
+        if self._policy == quant.BF16:
+            a, b, c = (quant.bf16_round(v) for v in (a, b, c))
+        self._score_factors = device_constants(a, b, c)
         self._score_jit = cached_jit("naivebayes.score", _build_nb_score,
                                      mtype)
+        if self._policy == quant.INT8:
+            aq, sa = quant.quantize_per_channel(a)
+            bq, sb = quant.quantize_per_channel(b)
+            self._q_factors = device_constants(
+                aq, bq, c, np.asarray(sa, np.float32),
+                np.asarray(sb, np.float32))
+            self._score_q = quant.int8_nb_program(mtype)
         return self
 
     def _pred_type(self) -> str:
@@ -214,10 +229,27 @@ class NaiveBayesModelMapper(RichModelMapper):
 
         from ...common.jitcache import call_row_bucketed
 
+        from ...common import quant
+
         X = get_feature_block(
             t, merge_feature_params(self.get_params(), self.meta),
             vector_size=self.meta["dim"],
         ).astype(np.float32)
+        if quant.capturing():
+            quant.observe(self._site_x, X)
+            if self._mtype == "GAUSSIAN":
+                quant.observe(self._site_xx, X * X)
+        if self._policy == quant.BF16:
+            X = quant.bf16_round(X)
+        if self._policy == quant.INT8:
+            params = self.get_params()
+            sx = np.float32(quant.calib_scale(params, self._site_x)
+                            if self._mtype != "BERNOULLI" else 1.0)
+            sxx = np.float32(quant.calib_scale(params, self._site_xx)
+                             if self._mtype == "GAUSSIAN" else 1.0)
+            s = np.asarray(jax.device_get(call_row_bucketed(
+                self._score_q, (X,), self._q_factors + (sxx, sx))))
+            return softmax_np(s)
         s = np.asarray(jax.device_get(call_row_bucketed(
             self._score_jit, (X,), self._score_factors)))
         return softmax_np(s)
@@ -558,13 +590,27 @@ class FmModelMapper(RichModelMapper):
 
         from ...common.jitcache import device_constants
 
+        from ...common import quant
+
         self.meta, arrays = table_to_model(model)
-        self._fm_params = device_constants(
-            arrays["w0"].astype(np.float32), arrays["w"].astype(np.float32),
-            arrays["V"].astype(np.float32))
+        self._policy = quant.policy_of(self.get_params())
+        self._site = quant.site_of(self.get_params(), "fm") + ".x"
+        w0 = arrays["w0"].astype(np.float32)
+        w = arrays["w"].astype(np.float32)
+        V = arrays["V"].astype(np.float32)
+        if self._policy == quant.BF16:
+            w0, w, V = (quant.bf16_round(v) for v in (w0, w, V))
+        self._fm_params = device_constants(w0, w, V)
         # one process-wide FM scoring program (parameters as arguments):
         # every FM model load — batch predict or stream hot-swap — shares it
         self._score_jit = cached_jit("fm.score", _build_fm_score)
+        if self._policy == quant.INT8:
+            wq, sw = quant.quantize_per_channel(w)
+            Vq, sv = quant.quantize_per_channel(V)
+            self._fm_q = device_constants(
+                w0, wq, Vq, np.asarray(sw, np.float32),
+                np.asarray(sv, np.float32))
+            self._score_q = quant.int8_fm_program()
         return self
 
     def _pred_type(self) -> str:
@@ -577,10 +623,21 @@ class FmModelMapper(RichModelMapper):
 
         from ...common.jitcache import call_row_bucketed
 
+        from ...common import quant
+
         X = get_feature_block(
             t, merge_feature_params(self.get_params(), self.meta),
             vector_size=self.meta["dim"],
         ).astype(np.float32)
+        if quant.capturing():
+            quant.observe(self._site, X)
+        if self._policy == quant.BF16:
+            X = quant.bf16_round(X)
+        if self._policy == quant.INT8:
+            sx = np.float32(quant.calib_scale(self.get_params(),
+                                              self._site))
+            return np.asarray(jax.device_get(call_row_bucketed(
+                self._score_q, (X,), self._fm_q + (sx,))))
         return np.asarray(jax.device_get(call_row_bucketed(
             self._score_jit, (X,), self._fm_params)))
 
@@ -688,10 +745,32 @@ class MlpModelMapper(RichModelMapper):
 
         from ...common.jitcache import device_constants
 
+        from ...common import quant
+
         self.meta, arrays = table_to_model(model)
-        (self._mlp_w,) = device_constants(arrays["weights"].astype(np.float32))
+        self._policy = quant.policy_of(self.get_params())
+        self._site = quant.site_of(self.get_params(), "mlp") + ".x"
+        w = arrays["weights"].astype(np.float32)
+        if self._policy == quant.BF16:
+            w = quant.bf16_round(w)
+        (self._mlp_w,) = device_constants(w)
         sizes = tuple(int(s) for s in self.meta["layerSizes"])
         self._score_jit = cached_jit("mlp.score", _build_mlp_score, sizes)
+        if self._policy == quant.INT8:
+            # unpack the flat LBFGS weight vector per mlp_forward's layout
+            # ((fan_in, fan_out) matrix then (fan_out,) bias per layer) and
+            # quantize each matrix per output channel
+            packed = []
+            off = 0
+            for fi, fo in zip(sizes[:-1], sizes[1:]):
+                W = w[off:off + fi * fo].reshape(fi, fo)
+                off += fi * fo
+                b = w[off:off + fo]
+                off += fo
+                Wq, s = quant.quantize_per_channel(W)
+                packed += [Wq, np.asarray(s, np.float32), b]
+            self._mlp_q = device_constants(*packed)
+            self._score_q = quant.int8_mlp_program(sizes)
         return self
 
     def _pred_type(self) -> str:
@@ -702,12 +781,22 @@ class MlpModelMapper(RichModelMapper):
 
         from ...common.jitcache import call_row_bucketed
 
+        from ...common import quant
+
         X = get_feature_block(
             t, merge_feature_params(self.get_params(), self.meta),
             vector_size=self.meta["dim"],
         ).astype(np.float32)
-        logits = np.asarray(jax.device_get(call_row_bucketed(
-            self._score_jit, (X,), (self._mlp_w,))))
+        if quant.capturing():
+            quant.observe(self._site, X)
+        if self._policy == quant.BF16:
+            X = quant.bf16_round(X)
+        if self._policy == quant.INT8:
+            logits = np.asarray(jax.device_get(call_row_bucketed(
+                self._score_q, (X,), self._mlp_q)))
+        else:
+            logits = np.asarray(jax.device_get(call_row_bucketed(
+                self._score_jit, (X,), (self._mlp_w,))))
         return softmax_np(logits)
 
     def predict_block(self, t: MTable):

@@ -456,6 +456,9 @@ class BertTextModelMapper(RichModelMapper):
         }
         template = self.model.init(jax.random.PRNGKey(0), **sample)
         self.params = _params_from_bytes(arrays["params"], template)
+        from ...common import quant
+
+        self._policy = quant.policy_of(self.get_params())
         return self
 
     def _pred_type(self) -> str:
@@ -474,7 +477,8 @@ class BertTextModelMapper(RichModelMapper):
         enc = self.tokenizer.encode_batch(
             texts, pairs, max_len=int(meta["maxSeqLength"])
         )
-        logits = predict_model(self.model, self.params, enc)
+        logits = predict_model(self.model, self.params, enc,
+                               precision=self._policy)
         if meta["regression"]:
             return logits[:, 0].astype(np.float64), AlinkTypes.DOUBLE, None
         probs = softmax_np(logits)

@@ -355,15 +355,26 @@ class LinearModelMapper(RichModelMapper):
     STREAM_CHUNK_BYTES = 4 * 1024 * 1024
 
     def load_model(self, model: MTable):
+        from ...common import quant
         from ...common.jitcache import cached_jit, device_constants
 
         self.meta, arrays = table_to_model(model)
         self.weights = arrays["weights"]      # host copies: sparse path +
         self.intercept = arrays["intercept"]  # ndim checks stay numpy
+        self._policy = quant.policy_of(self.get_params())
+        self._site = quant.site_of(self.get_params(), "linear") + ".x"
+        if self._policy == quant.BF16:
+            self.weights = quant.bf16_round(self.weights)
+            self.intercept = quant.bf16_round(self.intercept)
         self._wb_dev = device_constants(self.weights, self.intercept)
         # one process-wide scoring program (weights ride as arguments):
         # every linear model load shares it, per shape bucket
         self._score_jit = cached_jit("linear.score", _build_linear_score)
+        if self._policy == quant.INT8:
+            wq, sw = quant.quantize_per_channel(self.weights)
+            self._wq_dev = device_constants(wq, self.intercept,
+                                            np.asarray(sw, np.float32))
+            self._score_q = quant.int8_linear_program()
         return self
 
     def _pred_type(self) -> str:
@@ -397,9 +408,15 @@ class LinearModelMapper(RichModelMapper):
                                         pad_rows)
         from ...common.staging import stage_replicated
 
+        from ...common import quant
+
         X = get_feature_block(
             t, merged, vector_size=self.meta["dim"],
         ).astype(np.float32, copy=False)
+        if quant.capturing():
+            quant.observe(self._site, X)
+        if self._policy == quant.BF16:
+            X = quant.bf16_round(X)
         if X.nbytes >= self.STREAM_THRESHOLD_BYTES:
             # big blocks stream in double-buffered micro-batches: device_put
             # of chunk k+1 (through the content-keyed staging cache, so
@@ -433,6 +450,16 @@ class LinearModelMapper(RichModelMapper):
         # scores back to n is bit-identical to the unpadded run).
         n = X.shape[0]
         Xd = stage_replicated(pad_rows(X, bucket_rows(n)))
+        if self._policy == quant.INT8:
+            # static W8A8 on the dense staged path (sparse + streaming
+            # blocks above stay fp32); the activation scale was fixed by
+            # the load-time calibration pass and rides as an np scalar so
+            # the program signature — and the trace count — is stable
+            # across model versions with different ranges
+            sx = np.float32(quant.calib_scale(self.get_params(),
+                                              self._site))
+            return np.asarray(jax.device_get(
+                self._score_q(Xd, *self._wq_dev, sx)))[:n]
         return np.asarray(jax.device_get(
             self._score_jit(Xd, *self._wb_dev)))[:n]
 

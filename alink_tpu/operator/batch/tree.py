@@ -268,8 +268,12 @@ class CartRegTrainBatchOp(DecisionTreeRegTrainBatchOp):
 
 class TreeModelMapper(RichModelMapper):
     def load_model(self, model: MTable):
+        from ...common import quant
+
         self.meta, arrays = table_to_model(model)
         self.ensemble = TreeEnsemble.from_arrays(self.meta, arrays)
+        self._policy = quant.policy_of(self.get_params())
+        self._site = quant.site_of(self.get_params(), "tree") + ".x"
         return self
 
     def _pred_type(self) -> str:
@@ -281,7 +285,12 @@ class TreeModelMapper(RichModelMapper):
         meta = self.meta
         p = merge_feature_params(self.get_params(), meta)
         X = get_feature_block(t, p, vector_size=meta["dim"]).astype(np.float32)
-        scores = self.ensemble.raw_predict(X)  # (n, K)
+        from ...common import quant
+
+        if quant.capturing():
+            quant.observe(self._site, X)
+        scores = self.ensemble.raw_predict(
+            X, precision=self._policy)  # (n, K)
         task = meta["task"]
         if task == "regression":
             return scores[:, 0].astype(np.float64), AlinkTypes.DOUBLE, None

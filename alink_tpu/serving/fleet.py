@@ -335,7 +335,7 @@ class ServingFleet:
             return rid
 
     # -- spawning ------------------------------------------------------------
-    def _spawn(self, rid: str) -> _Replica:
+    def _spawn(self, rid: str, *, respawn: bool = False) -> _Replica:
         from ..parallel.distributed import scrub_cluster_env
 
         with self._lock:
@@ -354,6 +354,9 @@ class ServingFleet:
             "serving": dataclasses.asdict(self._cfg.serving)
             if self._cfg.serving else None,
             "models": models,
+            # a respawned replica's boot loads are recovery loads: an
+            # unproven quantized policy escalates ALK111 to error there
+            "recovery": bool(respawn),
         }
         env = scrub_cluster_env(dict(os.environ))
         env.update(self._cfg.worker_env or {})
@@ -567,19 +570,23 @@ class ServingFleet:
                 or not self._cfg.respawn):
             return
         metrics.incr("fleet.respawns")
-        self._spawn(rep.rid)
+        self._spawn(rep.rid, respawn=True)
 
     # -- model lifecycle -----------------------------------------------------
     def load(self, name: str, model: str,
-             input_schema=None, *, config: Optional[ServingConfig] = None
-             ) -> Dict[str, Any]:
+             input_schema=None, *, config: Optional[ServingConfig] = None,
+             precision: Optional[str] = None) -> Dict[str, Any]:
         """Broadcast one committed model version into every replica
         (fleet-wide hot-swap). ``model`` must be a saved ``.ak`` path —
         workers are separate processes and load from the shared store,
-        warming from the ``.ak.warmup.json`` sidecar. Per-replica
-        outcomes are counted (``fleet.swap_ok`` / ``fleet.swap_failed``)
-        and returned; a replica that misses the swap re-syncs at its
-        next health-recheck or respawn."""
+        warming from the ``.ak.warmup.json`` sidecar. ``precision``
+        overlays the serving precision policy (``"int8"``/``"bf16"``)
+        onto every replica's load — each worker calibrates/gates
+        independently (or adopts the sidecar's proven block) and refuses
+        to fp32 on its own counted terms. Per-replica outcomes are
+        counted (``fleet.swap_ok`` / ``fleet.swap_failed``) and returned;
+        a replica that misses the swap re-syncs at its next
+        health-recheck or respawn."""
         if not isinstance(model, str):
             raise AkIllegalArgumentException(
                 "fleet load requires a saved .ak model path (workers are "
@@ -594,6 +601,10 @@ class ServingFleet:
         cfg_dict = dataclasses.asdict(config) if config is not None else (
             dataclasses.asdict(self._cfg.serving)
             if self._cfg.serving else None)
+        if precision is not None:
+            base = cfg_dict if cfg_dict is not None \
+                else dataclasses.asdict(ServingConfig.default())
+            cfg_dict = {**base, "precision": str(precision)}
         with self._lock:
             self._swap_seq += 1
             seq = self._swap_seq
@@ -616,7 +627,9 @@ class ServingFleet:
                     metrics.incr("fleet.swap_ok")
                     info = resp.get("value") or {}
                     out = {"ok": True,
-                           "warmup_source": info.get("warmup_source")}
+                           "warmup_source": info.get("warmup_source"),
+                           "precision": (info.get("precision")
+                                         or {}).get("policy")}
                 else:
                     metrics.incr("fleet.swap_failed")
                     out = {"ok": False, "error": resp.get("msg")}
@@ -691,7 +704,7 @@ class ServingFleet:
                 resp = rep.client.call(
                     {"op": "load", "name": name, "path": path,
                      "schema": d["schema"], "config": d["config"],
-                     "seq": d["seq"]},
+                     "seq": d["seq"], "resync": True},
                     timeout=self._cfg.swap_timeout_s)
             except Exception:
                 metrics.incr("fleet.swap_failed")
@@ -985,6 +998,7 @@ class _WorkerRuntime:
             else ServingConfig.default()
         self.server = ModelServer(self.serving_cfg)
         self.models: List[Dict[str, Any]] = cfg.get("models") or []
+        self.recovery: bool = bool(cfg.get("recovery"))
         self._synced: Dict[str, int] = {}
         self._synced_lock = threading.Lock()
         self._hung = threading.Event()
@@ -1072,7 +1086,8 @@ class _WorkerRuntime:
                 cdict = op.get("config")
                 scfg = ServingConfig(**cdict) if cdict else self.serving_cfg
                 info = self.server.load(op["name"], op["path"],
-                                        op.get("schema"), config=scfg)
+                                        op.get("schema"), config=scfg,
+                                        recovery=bool(op.get("resync")))
                 with self._synced_lock:
                     self._synced[op["name"]] = int(op.get("seq") or 0)
                 # re-base the zero-trace pin: load-time warmup traces are
@@ -1156,11 +1171,14 @@ class _WorkerRuntime:
                 cdict = m.get("config")
                 scfg = ServingConfig(**cdict) if cdict else self.serving_cfg
                 info = self.server.load(m["name"], m["path"],
-                                        m.get("schema"), config=scfg)
+                                        m.get("schema"), config=scfg,
+                                        recovery=self.recovery)
                 with self._synced_lock:
                     self._synced[m["name"]] = int(m.get("seq") or 0)
                 loads.append({"model": m["name"], "ok": True,
-                              "warmup_source": info.get("warmup_source")})
+                              "warmup_source": info.get("warmup_source"),
+                              "precision": (info.get("precision")
+                                            or {}).get("policy")})
             except Exception as e:
                 metrics.incr("fleet.worker_load_errors")
                 loads.append({"model": m["name"], "ok": False,

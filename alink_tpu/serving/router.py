@@ -33,6 +33,7 @@ contract (``common/jitcache.py``) already pins as parity-safe.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from collections import deque
@@ -55,6 +56,8 @@ from ..common.tracing import trace_span
 from ..pipeline.local_predictor import LocalPredictor
 from ..pipeline.pipeline import PipelineModel
 from .warmup_store import load_warmup_spec, save_warmup_spec
+
+logger = logging.getLogger("alink_tpu.serving")
 
 _ROW_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0,
                 512.0, 1024.0, 2048.0, 4096.0)
@@ -99,6 +102,15 @@ class ServingConfig:
       ``"oldest"`` (drop the oldest queued normal-lane request instead).
     - ``breaker_threshold`` / ``breaker_reset_s`` — consecutive batch
       failures that open the model's circuit, and the half-open probe delay.
+    - ``precision`` — inference precision policy (``"fp32"`` | ``"bf16"`` |
+      ``"int8"``). Opt-in and never silent: ``"fp32"`` (the default) leaves
+      every scoring path byte-identical to an unquantized server; ``"int8"``
+      requires a real calibration sample and passes an accuracy-band gate
+      or the load falls back to fp32 with a counted reason.
+    - ``quant_band`` / ``quant_tol`` — the accuracy band a quantized load
+      must stay inside versus its fp32 baseline: label-like output columns
+      may disagree on at most ``quant_band`` of the gate rows, numeric
+      output columns may deviate relatively by at most ``quant_tol``.
     """
 
     queue_depth: int = 256
@@ -108,6 +120,9 @@ class ServingConfig:
     shed_policy: str = "reject"
     breaker_threshold: int = 5
     breaker_reset_s: float = 30.0
+    precision: str = "fp32"
+    quant_band: float = 0.005
+    quant_tol: float = 0.05
 
     @classmethod
     def default(cls) -> "ServingConfig":
@@ -123,6 +138,10 @@ class ServingConfig:
             breaker_threshold=max(
                 1, env_int("ALINK_SERVING_BREAKER_THRESHOLD", 5)),
             breaker_reset_s=env_float("ALINK_SERVING_BREAKER_RESET_S", 30.0),
+            precision=(env_str("ALINK_SERVING_PRECISION", "fp32")
+                       or "fp32").lower(),
+            quant_band=env_float("ALINK_SERVING_QUANT_BAND", 0.005),
+            quant_tol=env_float("ALINK_SERVING_QUANT_TOL", 0.05),
         )
 
 
@@ -171,9 +190,10 @@ class _ModelEntry:
     """One loaded model: predictor + two-lane bounded queue + batcher."""
 
     def __init__(self, name: str, predictor: LocalPredictor,
-                 config: ServingConfig):
+                 config: ServingConfig, precision: str = "fp32"):
         self.name = name
         self.predictor = predictor
+        self.precision = precision  # the EFFECTIVE policy after gating
         # snap the batch cap onto the ladder: full batches ship unpadded
         self.config = replace(config,
                               max_batch_rows=bucket_rows(config.max_batch_rows))
@@ -414,6 +434,7 @@ class _ModelEntry:
                 "rows": self.rows_total,
                 "breaker_open": self.breaker.is_open,
                 "loaded_at": self.loaded_at,
+                "precision": self.precision,
             }
         d["batch_fill"] = (
             round(d["rows"] / (d["batches"] * d["max_batch_rows"]), 4)
@@ -451,7 +472,9 @@ class ModelServer:
              input_schema: "TableSchema | str | None" = None, *,
              config: Optional[ServingConfig] = None,
              warmup_rows: Optional[Sequence[Sequence]] = None,
-             persist_warmup: Optional[bool] = None) -> Dict[str, Any]:
+             persist_warmup: Optional[bool] = None,
+             precision: Optional[str] = None,
+             recovery: bool = False) -> Dict[str, Any]:
         """Load (or hot-swap) ``name``. ``model`` is a PipelineModel, a saved
         ``.ak`` path, or a ready LocalPredictor. ``warmup_rows`` (sample
         input rows) drives AOT warmup: every bucket rung up to
@@ -469,7 +492,21 @@ class ModelServer:
         After a successful live warmup the sidecar is (re)written for the
         next replica (``persist_warmup``, default on, env
         ``ALINK_SERVING_PERSIST_WARMUP``). Predictions are bit-identical
-        whichever side warmed — warmup only populates caches."""
+        whichever side warmed — warmup only populates caches.
+
+        ``precision`` opts the load into a quantized serving policy
+        (``"int8"`` | ``"bf16"``; unset falls through to
+        ``config.precision``, then to the sidecar's proven policy). An
+        int8 load calibrates activation ranges over REAL warmup rows
+        (synthetic zero rows are refused), then must pass the
+        ``quant_band``/``quant_tol`` accuracy gate against its own fp32
+        baseline — a failing gate refuses loudly and serves fp32 with a
+        counted reason (``serving.precision_fallback``). An explicit
+        ``precision="fp32"`` blocks sidecar policy adoption AND rolls the
+        sidecar's precision block back on its rewrite (last-writer-wins),
+        so later respawns serve fp32 again. ``recovery``
+        marks respawn/recovery loads: plan rule ALK111 escalates from
+        warning to error severity there."""
         cfg = config or self._config
         with self._lock:
             self._load_seq += 1
@@ -507,6 +544,27 @@ class ModelServer:
         kernels_before = {(kid, tuple(sigs))
                           for kid, sigs in seen_warmup_specs()} \
             if model_path and persist_warmup else set()
+        # ---- precision policy (before warmup: the ladder must trace the
+        # QUANTIZED programs) ------------------------------------------------
+        prec_requested = precision if precision is not None else (
+            cfg.precision if cfg.precision and cfg.precision != "fp32"
+            else None)
+        adopted = False
+        if precision is None and prec_requested is None \
+                and sidecar is not None \
+                and (sidecar.get("precision") or {}).get("policy"):
+            # a respawning replica adopts the policy a previous replica
+            # proved out (an explicit precision="fp32" arg blocks this)
+            prec_requested = sidecar["precision"]["policy"]
+            adopted = True
+            metrics.incr("serving.precision_sidecar_adopted")
+            logger.info("serving: model %r adopting precision=%s from "
+                        "warmup sidecar", name, prec_requested)
+        policy, prec_info = self._setup_precision(
+            name, predictor, prec_requested, warmup_rows, source, cfg,
+            sidecar, recovery=recovery)
+        if adopted and prec_info is not None:
+            prec_info["adopted_from_sidecar"] = True
         if warmup_rows:
             try:
                 warm = self._warmup(predictor, warmup_rows,
@@ -533,9 +591,21 @@ class ModelServer:
                             metrics.incr("serving.warmup_errors")
         else:
             metrics.incr("serving.warmup_skipped")
+        prec_block = None
+        if policy is not None:
+            prec_block = {"policy": policy,
+                          "calib": (prec_info or {}).get("calib"),
+                          "band": {"band": cfg.quant_band,
+                                   "tol": cfg.quant_tol}}
+        # a sidecar whose precision block no longer matches the effective
+        # policy (first quantized load, or a gated-out policy) must be
+        # rewritten even for sidecar-sourced warmups — respawns reproduce
+        # THIS load's quantized program from the sidecar alone
+        precision_stale = sidecar is not None and \
+            sidecar.get("precision") != prec_block
         sidecar_written = None
         if warmed and model_path and persist_warmup \
-                and source != "sidecar":
+                and (source != "sidecar" or precision_stale):
             # a sidecar-sourced warmup would rewrite byte-identical content
             # — skipping keeps replica loads read-only against the model
             # store (the expected production rollout shape)
@@ -562,14 +632,22 @@ class ModelServer:
                     max_batch_rows=bucket_rows(cfg.max_batch_rows),
                     ladder=serving_bucket_ladder(
                         bucket_rows(cfg.max_batch_rows)),
-                    kernels=kernels)
+                    kernels=kernels,
+                    precision=prec_block,
+                    # preserve the marker across precision-block rewrites
+                    # of a synthetic-rows sidecar
+                    synthetic_rows=(source == "synthesized"
+                                    or (source == "sidecar"
+                                        and bool((sidecar or {})
+                                                 .get("synthetic_rows")))))
             except OSError:
                 # read-only model store: the replica still serves, the
                 # next one just warms live again (counted apart from
                 # corruption so a healthy read-only fleet stays
                 # distinguishable on dashboards)
                 metrics.incr("serving.warmup_spec_write_errors")
-        entry = _ModelEntry(name, predictor, cfg)
+        entry = _ModelEntry(name, predictor, cfg,
+                            precision=policy or "fp32")
         entry._load_seq = load_seq
         stale = old = None
         with self._lock:
@@ -590,6 +668,7 @@ class ModelServer:
                     "warmup_source": source if warmed else None,
                     "warmup_sidecar": sidecar_written,
                     "superseded": True,
+                    "precision": prec_info or {"policy": "fp32"},
                     "max_batch_rows": entry.config.max_batch_rows}
         if old is not None:
             old.shutdown(drain=True)
@@ -597,7 +676,189 @@ class ModelServer:
         return {"model": name, "warmup": warm,
                 "warmup_source": source if warmed else None,
                 "warmup_sidecar": sidecar_written,
+                "precision": prec_info or {"policy": "fp32"},
                 "max_batch_rows": entry.config.max_batch_rows}
+
+    @staticmethod
+    def _strip_precision(predictor: LocalPredictor) -> None:
+        """Remove stamped precision/calibration params from the cached plan
+        — the fp32-fallback path must serve EXACTLY today's unquantized
+        numerics (the site prefixes stay: they are inert metadata)."""
+        from ..common import quant
+
+        plan = getattr(predictor, "_plan", None)
+        if not plan:
+            return
+        for op in plan[2]:
+            p = op.get_params()
+            for key in (quant.PRECISION_KEY, quant.CALIB_KEY):
+                if p.contains(key):
+                    p.remove(key)
+
+    def _setup_precision(self, name: str, predictor: LocalPredictor,
+                         requested: Optional[str],
+                         warmup_rows: Optional[Sequence[Sequence]],
+                         source: Optional[str], cfg: ServingConfig,
+                         sidecar: Optional[Dict[str, Any]], *,
+                         recovery: bool = False
+                         ) -> Tuple[Optional[str], Optional[Dict[str, Any]]]:
+        """Resolve and apply the quantization policy for one load.
+
+        int8: calibrate per-site activation ranges with an fp32 predict
+        over REAL warmup rows (or reuse the sidecar's proven calibration —
+        deterministic respawns), stamp ``inferencePrecision``/
+        ``quantCalib``/``quantSite`` onto the cached plan's op params, and
+        gate the quantized predict against the fp32 baseline inside the
+        ``quant_band``/``quant_tol`` accuracy band. Every refusal path is
+        loud: a counted reason, a warning log, and a guaranteed-clean fp32
+        fallback. Returns ``(effective_policy_or_None, info_or_None)``."""
+        from ..common import quant
+
+        policy = quant.resolve_policy(requested)
+        if policy is None:
+            return None, None
+        metrics.incr("serving.precision_loads")
+        info: Dict[str, Any] = {"policy": policy,
+                                "requested": str(requested)}
+        # sidecar rows count as real only when they were SAMPLED, not
+        # synthesized schema probes a previous replica persisted
+        real_sample = bool(warmup_rows) and (
+            source == "caller"
+            or (source == "sidecar"
+                and not (sidecar or {}).get("synthetic_rows")))
+        side_prec = (sidecar or {}).get("precision") or {}
+        side_calib = side_prec.get("calib") \
+            if side_prec.get("policy") == policy else None
+
+        def _fallback(reason: str, counter: str):
+            metrics.incr(counter)
+            metrics.incr("serving.precision_fallback")
+            self._strip_precision(predictor)
+            logger.warning(
+                "serving: model %r requested precision=%s but %s — "
+                "REFUSING the quantized load and serving fp32",
+                name, policy, reason)
+            info.update(policy="fp32", fallback=reason)
+            return None, info
+
+        # plan rule ALK111: a quantized load with no real calibration
+        # sample or a disabled accuracy band serves unproven numerics —
+        # warn (error in recovery mode / error validation mode)
+        from ..analysis.plancheck import preflight_quantized_load
+
+        preflight_quantized_load(
+            name, policy=policy,
+            real_sample=real_sample or bool(side_calib),
+            band_enabled=cfg.quant_band >= 0.0 and cfg.quant_tol >= 0.0,
+            recovery=recovery, where="serving.load")
+
+        if not getattr(predictor, "_cache_plan", False):
+            return _fallback(
+                "the predictor does not cache its transform plan "
+                "(precision policies ride stamped plan params)",
+                "serving.precision_plan_uncached")
+
+        with predictor._plan_lock:
+            if predictor._plan is None:
+                predictor._plan = predictor._build_plan()
+            ops = list(predictor._plan[2])
+        # deterministic DFS order -> stable per-op calibration sites
+        # across replicas and respawns; the model-name prefix keeps
+        # concurrent fp32 traffic from other models out of this record
+        # (capture is process-wide — the predict fans out across the DAG
+        # executor pool, so it cannot be scoped by thread)
+        site_prefix = f"{name}:op"
+        for i, op in enumerate(ops):
+            op.get_params().set(quant.SITE_KEY, f"{site_prefix}{i}")
+
+        calib: Optional[Dict[str, float]] = None
+        base_rows = gate_rows = None
+        if policy == quant.INT8:
+            if side_calib and not quant.degenerate_sites(side_calib):
+                # deterministic respawn: reuse the proven calibration and
+                # skip the gate the first replica already passed. Sites are
+                # model-name-prefixed, so REKEY them onto this load's name
+                # (a second serving name over the same .ak adopts the same
+                # proven ranges; op order is deterministic DFS, so indices
+                # line up) — an unkeyable site falls through to live
+                # calibration instead of stamping ranges no site will find
+                calib = {}
+                for k, v in side_calib.items():
+                    cut = str(k).rfind(":op")
+                    if cut < 0:
+                        calib = None
+                        break
+                    calib[f"{name}{str(k)[cut:]}"] = float(v)
+            if calib:
+                metrics.incr("serving.calib_reused_sidecar")
+                info["calib_source"] = "sidecar"
+            elif not real_sample:
+                return _fallback(
+                    "its calibration sample is synthetic or absent "
+                    "(all-zero rows must never seed activation ranges)",
+                    "serving.calib_skipped_synthetic")
+            else:
+                gate_rows = [tuple(r) for r in warmup_rows]
+                t = MTable.from_rows(gate_rows, predictor.input_schema)
+                rec: Dict[str, float] = {}
+                with quant.calibration(rec):
+                    base_out = predictor.predict_table(t)
+                base_rows = [base_out.get_row(i)
+                             for i in range(base_out.num_rows)]
+                rec = {k: v for k, v in rec.items()
+                       if k.startswith(site_prefix)}
+                if not rec:
+                    return _fallback(
+                        "the calibration predict recorded no activation "
+                        "ranges (no quantizable op observed its input)",
+                        "serving.calib_degenerate")
+                bad = quant.degenerate_sites(rec)
+                if bad:
+                    return _fallback(
+                        f"calibration produced degenerate activation "
+                        f"ranges at {sorted(bad)} (zero or non-finite)",
+                        "serving.calib_degenerate")
+                calib = rec
+                info["calib_source"] = "live"
+            info["calib"] = dict(calib)
+        elif real_sample and cfg.quant_band >= 0.0 and cfg.quant_tol >= 0.0:
+            # bf16 needs no calibration but still proves its band when a
+            # real sample exists
+            gate_rows = [tuple(r) for r in warmup_rows]
+            t = MTable.from_rows(gate_rows, predictor.input_schema)
+            base_out = predictor.predict_table(t)
+            base_rows = [base_out.get_row(i)
+                         for i in range(base_out.num_rows)]
+
+        for op in ops:
+            p = op.get_params()
+            if calib is not None:
+                p.set(quant.CALIB_KEY, dict(calib))
+            p.set(quant.PRECISION_KEY, policy)
+
+        if base_rows is not None and cfg.quant_band >= 0.0 \
+                and cfg.quant_tol >= 0.0:
+            t = MTable.from_rows(gate_rows, predictor.input_schema)
+            try:
+                q_out = predictor.predict_table(t)
+            except Exception as e:
+                return _fallback(f"the quantized predict failed: {e}",
+                                 "serving.band_gate_failed")
+            report = quant.accuracy_band_report(
+                base_rows,
+                [q_out.get_row(i) for i in range(q_out.num_rows)],
+                list(q_out.schema.types),
+                band=cfg.quant_band, tol=cfg.quant_tol)
+            info["band_report"] = report
+            if not report["ok"]:
+                return _fallback(
+                    f"it failed its accuracy band "
+                    f"(agreement={report['agreement']}, "
+                    f"max_rel_diff={report['max_rel_diff']}, "
+                    f"band={report['band']}, tol={report['tol']})",
+                    "serving.band_gate_failed")
+        logger.info("serving: model %r serving precision=%s", name, policy)
+        return policy, info
 
     @staticmethod
     def _warmup(predictor: LocalPredictor,

@@ -88,11 +88,25 @@ def save_warmup_spec(model_path: str, *,
                      max_batch_rows: int,
                      ladder: Sequence[int],
                      kernels: Optional[Sequence[Tuple[str, list]]] = None,
+                     precision: Optional[Dict[str, Any]] = None,
+                     synthetic_rows: bool = False,
                      path: Optional[str] = None,
                      fsync: bool = False) -> Optional[str]:
     """Persist one model's warmup spec next to its ``.ak``. Returns the
     sidecar path, or None when the rows cannot be JSON-persisted (exotic
-    cell types) — never raises on content, only on unwritable storage."""
+    cell types) — never raises on content, only on unwritable storage.
+
+    ``precision`` optionally records the serving quantization policy the
+    loading replica proved out (``{"policy", "calib", "band"}``) so fleet
+    respawns and modelstream hot-swaps reproduce the exact quantized
+    program — same policy, same calibrated activation scales — with zero
+    traces and no re-gating. Readers without the block (or older sidecars)
+    see plain fp32 specs; the spec version is unchanged.
+
+    ``synthetic_rows`` marks warmup rows that were SYNTHESIZED (all-zero
+    schema probes), not sampled from real inputs — a quantized load must
+    never seed activation ranges from them, so readers refuse int8
+    calibration off a sidecar carrying this flag."""
     try:
         rows = [[_json_cell(c) for c in row] for row in warmup_rows]
     except TypeError:
@@ -114,6 +128,10 @@ def save_warmup_spec(model_path: str, *,
         "kernels": [[kid, [[list(map(int, s)), str(d)] for s, d in sigs]]
                     for kid, sigs in (kernels or [])],
     }
+    if precision is not None:
+        spec["precision"] = precision
+    if synthetic_rows:
+        spec["synthetic_rows"] = True
     out = path or warmup_sidecar_path(model_path)
     tmp = f"{out}.tmp.{os.getpid()}"
     with open(tmp, "w") as f:

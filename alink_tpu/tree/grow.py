@@ -239,19 +239,42 @@ class TreeEnsemble:
     feature_cols: Optional[list] = None
     vector_col: Optional[str] = None
 
-    def raw_predict(self, X: np.ndarray) -> np.ndarray:
+    def raw_predict(self, X: np.ndarray, precision=None) -> np.ndarray:
         """(n, K) raw scores — sum of leaf values + base. The jitted traversal
         takes the tree arrays as arguments (not constants) and is cached per
         depth, so repeat predicts and different ensembles share one compile;
         rows are bucket-padded (tree routing is row-wise, so the sliced
-        result is bit-identical) so batch-size sweeps reuse one program."""
+        result is bit-identical) so batch-size sweeps reuse one program.
+
+        ``precision`` is the serving quantization policy: int8 runs the
+        weight-only leaf-table twin (features/thresholds stay f32 so split
+        routing is bit-identical); bf16 rounds the leaf values and reuses
+        the fp32 program. Each variant stages its own device arrays, so
+        mixed-precision serving of one ensemble never cross-contaminates."""
+        from ..common import quant
         from ..common.jitcache import call_row_bucketed, device_constants
 
+        if precision == quant.INT8:
+            run = quant.int8_tree_program(self.depth)
+            dev = getattr(self, "_dev_arrays_q", None)
+            if dev is None:
+                lq, ls = quant.quantize_last_axis(self.leaves)
+                dev = self._dev_arrays_q = device_constants(
+                    self.feats, self.thrs, lq, ls, self.base_score)
+            return np.asarray(call_row_bucketed(
+                run, (np.asarray(X, np.float32),), dev))
         run = _predict_fn(self.depth)
-        dev = getattr(self, "_dev_arrays", None)
-        if dev is None:  # tree arrays staged once per ensemble, not per call
-            dev = self._dev_arrays = device_constants(
-                self.feats, self.thrs, self.leaves, self.base_score)
+        if precision == quant.BF16:
+            dev = getattr(self, "_dev_arrays_b", None)
+            if dev is None:
+                dev = self._dev_arrays_b = device_constants(
+                    self.feats, self.thrs, quant.bf16_round(self.leaves),
+                    quant.bf16_round(self.base_score))
+        else:
+            dev = getattr(self, "_dev_arrays", None)
+            if dev is None:  # staged once per ensemble, not per call
+                dev = self._dev_arrays = device_constants(
+                    self.feats, self.thrs, self.leaves, self.base_score)
         return np.asarray(call_row_bucketed(
             run, (np.asarray(X, np.float32),), dev))
 

@@ -434,7 +434,10 @@ class _Handler(BaseHTTPRequestHandler):
 
     # -- serving endpoints --
     def _serving_post(self, parts: List[str]):
-        """POST /api/serving/models — load (or hot-swap) a saved pipeline;
+        """POST /api/serving/models — load (or hot-swap) a saved pipeline
+        (optional "precision": "int8"/"bf16" requests a quantized load —
+        the response's "precision" block reports the effective policy and
+        any counted fallback reason);
         POST /api/serving/predict/<name> — synchronous predict of one row
         ({"row": [...]}) or a row set ({"rows": [[...], ...]}).
 
@@ -450,7 +453,8 @@ class _Handler(BaseHTTPRequestHandler):
                 out = srv.load(
                     body["name"], body["path"],
                     body.get("inputSchema"),
-                    warmup_rows=body.get("warmupRows"))
+                    warmup_rows=body.get("warmupRows"),
+                    precision=body.get("precision"))
                 return self._send_json(out)
             if len(parts) == 2 and parts[0] == "predict":
                 body = self._body()

@@ -1526,7 +1526,16 @@ def bench_serving(clients=8, rows_per_client=400):
     coalesces them into bucket-ladder micro-batches. Reports rows/s,
     batch-fill ratio, request-latency p50/p90/p99, the jit trace delta over
     the sustained window (target: 0 after load-time warmup), and a
-    past-capacity shed probe (bounded queue, counted rejections)."""
+    past-capacity shed probe (bounded queue, counted rejections).
+
+    A second pass re-runs the same drill against the SAME model loaded
+    with ``precision="int8"`` (calibrated + accuracy-band-gated at load):
+    the ``precision`` block reports fp32-vs-int8 rows/s and client-side
+    p99, the load's band-gate verdict (``band_ok`` must be green), the
+    label ``accuracy_delta`` / numeric ``accuracy_band`` readouts
+    (directionless in ``--compare``, like ``parity_max_diff``), and the
+    bit-identity gate: the precision-unset fp32 load must serve
+    byte-identical rows to a serial LocalPredictor."""
     import threading
 
     from alink_tpu.common.metrics import metrics
@@ -1560,23 +1569,73 @@ def bench_serving(clients=8, rows_per_client=400):
         traces0 = metrics.counter("jit.trace")
         rows = [tuple(r) for r in X]
 
-        def client(cid):
-            for i in range(rows_per_client):
-                srv.predict("bench", rows[(cid * 131 + i * 7) % len(rows)],
-                            timeout=120)
+        def drill(server, mname):
+            lat: list = []
+            lat_lock = threading.Lock()
 
-        threads = [threading.Thread(target=client, args=(c,))
+            def client(cid):
+                mine = []
+                for i in range(rows_per_client):
+                    r0 = time.perf_counter()
+                    server.predict(mname,
+                                   rows[(cid * 131 + i * 7) % len(rows)],
+                                   timeout=120)
+                    mine.append(time.perf_counter() - r0)
+                with lat_lock:
+                    lat.extend(mine)
+
+            ths = [threading.Thread(target=client, args=(c,))
                    for c in range(clients)]
-        t0 = time.perf_counter()
-        for th in threads:
-            th.start()
-        for th in threads:
-            th.join()
-        wall = time.perf_counter() - t0
+            w0 = time.perf_counter()
+            for th in ths:
+                th.start()
+            for th in ths:
+                th.join()
+            return time.perf_counter() - w0, np.asarray(lat)
+
+        wall, lat_f = drill(srv, "bench")
         traces_delta = metrics.counter("jit.trace") - traces0
         stats = serving_summary(srv)
         mstat = stats["models"][0]
         req_hist = stats["histograms"].get("serving.request_s") or {}
+
+        # ---- quantized pass: same model, same drill, int8 policy --------
+        from alink_tpu.pipeline import LocalPredictor
+
+        calib_rows = [tuple(r) for r in X[::25]]  # spans both clusters
+        info8 = srv.load("bench8", model, schema, warmup_rows=calib_rows,
+                         precision="int8")
+        band = (info8.get("precision") or {}).get("band_report") or {}
+        traces8_0 = metrics.counter("jit.trace")
+        wall8, lat_q = drill(srv, "bench8")
+        traces8_delta = metrics.counter("jit.trace") - traces8_0
+        # label agreement + bit-identity gate over one deterministic sweep
+        lp = LocalPredictor(model, schema, cache_plan=False)
+        serial = [lp.predict_table(
+            MTable.from_rows([r], schema)).get_row(0) for r in rows[:100]]
+        out_f = [srv.predict("bench", r, timeout=120) for r in rows[:100]]
+        out_q = [srv.predict("bench8", r, timeout=120) for r in rows[:100]]
+        agree = float(np.mean([a[-1] == b[-1]
+                               for a, b in zip(out_q, out_f)]))
+        total = clients * rows_per_client
+        precision_block = {
+            "policy": (info8.get("precision") or {}).get("policy"),
+            "band_ok": band.get("ok"),
+            # directionless in --compare (metric_direction → None), like
+            # parity_max_diff: near-zero diffs vs the fp32 baseline
+            "accuracy_delta": round(1.0 - agree, 6),
+            "accuracy_band": band.get("max_rel_diff"),
+            "fp32_rows_per_sec": round(total / wall, 1),
+            "int8_rows_per_sec": round(total / wall8, 1),
+            "fp32_request_p99_ms": round(
+                float(np.percentile(lat_f, 99)) * 1e3, 3),
+            "int8_request_p99_ms": round(
+                float(np.percentile(lat_q, 99)) * 1e3, 3),
+            "int8_traces_during_drill": traces8_delta,
+            # knob-off gate: the precision-unset load serves byte-identical
+            # rows to a serial LocalPredictor
+            "bit_identical_fp32": out_f == serial,
+        }
 
         # saturation probe: flood far past the queue bound with async
         # submits; shed must be counted and accepted work must complete
@@ -1592,7 +1651,6 @@ def bench_serving(clients=8, rows_per_client=400):
         completed = sum(1 for f in futs if f.result(120) is not None)
         srv2.close()
 
-        total = clients * rows_per_client
         return {
             "clients": clients,
             "rows": total,
@@ -1605,6 +1663,7 @@ def bench_serving(clients=8, rows_per_client=400):
             "request_p90_ms": round((req_hist.get("p90") or 0) * 1e3, 3),
             "request_p99_ms": round((req_hist.get("p99") or 0) * 1e3, 3),
             "traces_during_drill": traces_delta,  # sustained window; 0 = contract held
+            "precision": precision_block,
             "saturation": {"submitted": 2000, "shed": shed,
                            "accepted_completed": completed},
         }
