@@ -107,6 +107,15 @@ RULES: Dict[str, tuple] = {
                "or with the accuracy band disabled — int8/bf16 numerics "
                "would serve with nothing proving them against the fp32 "
                "baseline (error severity for respawn/recovery loads)"),
+    # ALK112 is a source-lint rule despite the 1xx id: the ids are stable
+    # for life, and it shipped alongside the fleet observability plane's
+    # plan-era siblings — renumbering would orphan baselines.
+    "ALK112": ("untraced-frame-send", WARNING,
+               "frame-protocol request dict (an {'op': ...} literal in "
+               "serving/) built without a 'trace' field — the request "
+               "crosses the process boundary invisible to the stitched "
+               "waterfall; stamp wire_context() so the replica-side spans "
+               "join the caller's trace"),
 }
 
 

@@ -71,8 +71,13 @@ _THREADED_MODULES = (
     "common/executor.py", "common/metrics.py", "common/jitcache.py",
     "common/staging.py", "common/streaming.py", "common/tracing.py",
     "common/recovery.py", "common/resilience.py", "common/profiling.py",
-    "common/faults.py", "serving/router.py", "analysis/plancheck.py",
+    "common/faults.py", "common/telemetry.py", "serving/router.py",
+    "analysis/plancheck.py",
 )
+
+# ALK112 scope: frame-protocol request dicts are built in the serving
+# tier (fleet front-end + supervisor broadcast sites)
+_SERVING_DIR = "serving/"
 
 # the knob-parser module itself — the one place raw environ reads belong
 _ENV_MODULE = "common/env.py"
@@ -138,6 +143,8 @@ class _FileLinter(ast.NodeVisitor):
         self.is_shardmap_shim = relpath.endswith(_SHARDMAP_SHIM)
         self.is_kernel_module = _NATIVE_DIR in relpath or any(
             relpath.endswith(m) for m in _KERNEL_MODULES)
+        self.is_serving = f"/{_SERVING_DIR}" in relpath \
+            or relpath.startswith(_SERVING_DIR)
         self.threaded = any(relpath.endswith(m) for m in _THREADED_MODULES)
         self.shared_dicts = self._module_dicts(tree) if self.threaded else set()
 
@@ -453,6 +460,28 @@ class _FileLinter(ast.NodeVisitor):
                 f"{node.value.func.attr}() outside a lock in a threaded "
                 "module",
                 hint="take the module's lock around the mutation")
+        self.generic_visit(node)
+
+    # -- ALK112 untraced frame-protocol sends ------------------------------
+    def visit_Dict(self, node: ast.Dict):
+        # a frame-protocol request is an {'op': ...} dict literal; in the
+        # serving tier every one must carry a 'trace' field so the
+        # replica-side spans stitch into the caller's waterfall. A dict
+        # spread (**base, key is None) may supply it — can't prove absence
+        # statically, so those are skipped rather than false-positived.
+        if self.is_serving and not any(k is None for k in node.keys):
+            consts = {k.value for k in node.keys
+                      if isinstance(k, ast.Constant)
+                      and isinstance(k.value, str)}
+            if "op" in consts and "trace" not in consts:
+                self._add(
+                    "ALK112", node,
+                    "frame-protocol request dict built without a 'trace' "
+                    "field — the request crosses the process boundary "
+                    "invisible to the stitched trace",
+                    hint="add \"trace\": wire_context() "
+                         "(common/tracing.py); replicas adopt it around "
+                         "the dispatched op")
         self.generic_visit(node)
 
     # -- ALK005 except swallows --------------------------------------------

@@ -106,6 +106,60 @@ class _Histogram:
                                         self.min, self.max)
         return h
 
+    def state(self) -> Dict[str, Any]:
+        """JSON-serializable full state — the unit the cross-process
+        telemetry relay ships. Same ``le`` edges on both sides make the
+        merge a per-bucket count sum, i.e. EXACT (fleet-wide quantiles
+        are quantiles of the true pooled distribution, not averages of
+        per-replica quantiles)."""
+        return {"buckets": list(self.buckets), "counts": list(self.counts),
+                "count": self.count, "sum": self.sum,
+                "min": self.min, "max": self.max}
+
+    @classmethod
+    def from_state(cls, state: Any) -> "_Histogram":
+        """Rebuild from :meth:`state` output; raises ``ValueError`` on any
+        malformed shape (wire payloads are untrusted — the caller counts
+        and drops)."""
+        if not isinstance(state, dict):
+            raise ValueError("histogram state is not a dict")
+        buckets = state.get("buckets")
+        counts = state.get("counts")
+        if not isinstance(buckets, (list, tuple)) \
+                or not isinstance(counts, (list, tuple)) \
+                or len(counts) != len(buckets) + 1:
+            raise ValueError("histogram state buckets/counts mismatch")
+        try:
+            h = cls([float(b) for b in buckets])
+            h.counts = [int(c) for c in counts]
+            h.count = int(state.get("count", 0))
+            h.sum = float(state.get("sum", 0.0))
+            mn, mx = state.get("min"), state.get("max")
+            h.min = float(mn) if mn is not None else None
+            h.max = float(mx) if mx is not None else None
+        except (TypeError, ValueError):
+            raise ValueError("histogram state fields are not numeric")
+        if any(c < 0 for c in h.counts) or h.count < 0:
+            raise ValueError("histogram state counts are negative")
+        return h
+
+    def merge(self, other: "_Histogram") -> None:
+        """Exact in-place merge: per-bucket count sum. Raises
+        ``ValueError`` on differing bucket edges — summing misaligned
+        buckets would fabricate a distribution."""
+        if other.buckets != self.buckets:
+            raise ValueError("cannot merge histograms with different "
+                             f"buckets ({len(self.buckets)} vs "
+                             f"{len(other.buckets)} edges)")
+        self.counts = [a + b for a, b in zip(self.counts, other.counts)]
+        self.count += other.count
+        self.sum += other.sum
+        for attr, pick in (("min", min), ("max", max)):
+            o = getattr(other, attr)
+            if o is not None:
+                mine = getattr(self, attr)
+                setattr(self, attr, o if mine is None else pick(mine, o))
+
 
 def _prom_name(name: str, *, seconds: bool = False) -> str:
     """Stable ``alink_`` exposition name: dots/dashes to underscores,
@@ -137,6 +191,10 @@ class StepMetrics:
         self._series: Dict[str, List[Dict[str, Any]]] = defaultdict(list)
         self._timers: Dict[str, List[float]] = defaultdict(list)
         self._hists: Dict[str, _Histogram] = {}
+        # labeled histogram families: name -> label-key tuple -> histogram;
+        # fed by merge_histogram (cross-process telemetry), exported as
+        # labeled series of the same Prometheus family
+        self._labeled_hists: Dict[str, Dict[tuple, _Histogram]] = {}
         self._gauges: Dict[str, Dict[tuple, float]] = {}
         self._export_hooks: List[Any] = []
         self._counters: Dict[str, int] = defaultdict(int)
@@ -253,6 +311,56 @@ class StepMetrics:
         with self._data_lock:
             return sorted(self._hists)
 
+    def histogram_states(self) -> Dict[str, Dict[str, Any]]:
+        """Raw serializable state of every (unlabeled) histogram — the
+        worker-side source the telemetry relay diffs and ships."""
+        with self._data_lock:
+            return {n: h.state() for n, h in self._hists.items()}
+
+    def merge_histogram(self, name: str, state: Any, **labels) -> None:
+        """Merge a serialized histogram state delta (from another
+        process's :meth:`histogram_states`) into the labeled family
+        ``name`` — per-bucket count sums, so the labeled series stays an
+        exact histogram of that sender's observations. Raises
+        ``ValueError`` on malformed state or bucket-edge mismatch; the
+        caller decides how loudly to drop."""
+        incoming = _Histogram.from_state(state)
+        key = tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+        with self._data_lock:
+            fam = self._labeled_hists.setdefault(name, {})
+            h = fam.get(key)
+            if h is None:
+                fam[key] = incoming
+            else:
+                h.merge(incoming)
+
+    def labeled_histogram(self, name: str, **labels
+                          ) -> Optional[Dict[str, Any]]:
+        key = tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+        with self._data_lock:
+            h = self._labeled_hists.get(name, {}).get(key)
+            h = h.snapshot() if h is not None else None
+        return h.stats() if h is not None else None
+
+    def merged_histogram(self, name: str, include_local: bool = False
+                         ) -> Optional[Dict[str, Any]]:
+        """Stats of the EXACT merge of every labeled series of ``name``
+        (optionally folding in the local unlabeled histogram): bucket
+        counts sum across senders, so p50/p90/p99 are quantiles of the
+        pooled distribution — never averaged averages. None when nothing
+        was ever merged."""
+        with self._data_lock:
+            parts = [h.snapshot()
+                     for h in self._labeled_hists.get(name, {}).values()]
+            if include_local and name in self._hists:
+                parts.append(self._hists[name].snapshot())
+        if not parts:
+            return None
+        out = parts[0]
+        for h in parts[1:]:
+            out.merge(h)
+        return out.stats()
+
     def summary(self) -> Dict[str, Any]:
         out: Dict[str, Any] = {}
         with self._data_lock:
@@ -304,6 +412,8 @@ class StepMetrics:
             timers = {n: (len(ts), sum(ts))
                       for n, ts in self._timers.items() if ts}
             hists = {n: h.snapshot() for n, h in self._hists.items()}
+            lhists = {n: {k: h.snapshot() for k, h in fam.items()}
+                      for n, fam in self._labeled_hists.items()}
             gauges = {n: dict(vals) for n, vals in self._gauges.items()}
 
         for name, vals in sorted(gauges.items()):
@@ -318,21 +428,34 @@ class StepMetrics:
                     if lkey else "")
                 lines.append(f"{m}{lbl} {_prom_float(v)}")
 
-        for name, h in sorted(hists.items()):
+        # one exposition family per histogram name: the local unlabeled
+        # series first, then every labeled (e.g. replica="r1") series —
+        # a single # TYPE header covers them all, as the format requires
+        fams: Dict[str, List[tuple]] = {}
+        for name, h in hists.items():
+            fams.setdefault(name, []).append(((), h))
+        for name, fam in lhists.items():
+            for lkey, h in sorted(fam.items()):
+                fams.setdefault(name, []).append((lkey, h))
+        for name, series in sorted(fams.items()):
             m = _prom_name(name, seconds=True)
             if m in seen:
                 continue
             seen.add(m)
             lines.append(f"# TYPE {m} histogram")
-            cum = 0
-            for edge, c in zip(h.buckets, h.counts):
-                cum += c
-                lines.append(
-                    f'{m}_bucket{{le="{_prom_float(edge)}"}} {cum}')
-            cum += h.counts[-1]
-            lines.append(f'{m}_bucket{{le="+Inf"}} {cum}')
-            lines.append(f"{m}_sum {_prom_float(h.sum)}")
-            lines.append(f"{m}_count {cum}")
+            for lkey, h in series:
+                base = [f'{k}="{_prom_label_value(x)}"' for k, x in lkey]
+                sfx = "{" + ",".join(base) + "}" if base else ""
+                cum = 0
+                for edge, c in zip(h.buckets, h.counts):
+                    cum += c
+                    lbl = ",".join(base + [f'le="{_prom_float(edge)}"'])
+                    lines.append(f"{m}_bucket{{{lbl}}} {cum}")
+                cum += h.counts[-1]
+                lbl = ",".join(base + ['le="+Inf"'])
+                lines.append(f"{m}_bucket{{{lbl}}} {cum}")
+                lines.append(f"{m}_sum{sfx} {_prom_float(h.sum)}")
+                lines.append(f"{m}_count{sfx} {cum}")
 
         for name, (count, total) in sorted(timers.items()):
             m = _prom_name(name, seconds=True)
@@ -350,6 +473,7 @@ class StepMetrics:
             self._series.clear()
             self._timers.clear()
             self._hists.clear()
+            self._labeled_hists.clear()
             self._gauges.clear()
         with self._counter_lock:
             self._counters.clear()

@@ -621,7 +621,15 @@ def train_model(
     eval_prog = _apply_program(model)
 
     from ..common.metrics import metrics as _metrics
+    from ..common.tracing import set_process_identity
+    from ..common.tracing import trace_span as _trace_span
     import time as _time
+
+    if num_shards > 1:
+        # label this rank's spans so a 2-process drill stitches into one
+        # waterfall with a lane per rank (single-process stays untagged —
+        # trace output is byte-stable there)
+        set_process_identity(f"rank{shard_idx}")
 
     ckpt = None
     start_epoch = 0
@@ -725,142 +733,146 @@ def train_model(
                             samples_per_sec=step * bs / max(elapsed, 1e-9))
 
     for epoch in range(start_epoch, cfg.num_epochs):
-        # per-(seed, epoch) generator, NOT the sequentially-consumed rng: a
-        # crash-resumed run must replay the exact shuffle of the epochs it
-        # skipped past (dropout keys already align via fold_in(key, step))
-        order = np.random.default_rng((cfg.seed, epoch)).permutation(n_train)
-        if n_train < bs:  # tile tiny datasets up to one full batch
-            order = np.resize(order, bs)
+        # one rank-tagged span per epoch: in a multi-process drill each
+        # rank exports its own train.epoch lane into the stitched trace
+        with _trace_span("train.epoch", epoch=epoch, rank=shard_idx,
+                         shards=num_shards):
+            # per-(seed, epoch) generator, NOT the sequentially-consumed rng: a
+            # crash-resumed run must replay the exact shuffle of the epochs it
+            # skipped past (dropout keys already align via fold_in(key, step))
+            order = np.random.default_rng((cfg.seed, epoch)).permutation(n_train)
+            if n_train < bs:  # tile tiny datasets up to one full batch
+                order = np.resize(order, bs)
 
-        if not scale:
-            def build(s, _order=order):
-                idx = _order[s * bs:(s + 1) * bs]
-                arrs = [tr_inputs[k][idx] for k in names] + [tr_y[idx]]
-                w = np.ones(len(idx), np.float32)
-                if len(idx) < padded_bs:
-                    arrs = _pad_tail(arrs, padded_bs)
-                    w = np.concatenate(
-                        [w, np.zeros(padded_bs - len(idx), np.float32)])
-                return arrs + [w]
+            if not scale:
+                def build(s, _order=order):
+                    idx = _order[s * bs:(s + 1) * bs]
+                    arrs = [tr_inputs[k][idx] for k in names] + [tr_y[idx]]
+                    w = np.ones(len(idx), np.float32)
+                    if len(idx) < padded_bs:
+                        arrs = _pad_tail(arrs, padded_bs)
+                        w = np.concatenate(
+                            [w, np.zeros(padded_bs - len(idx), np.float32)])
+                    return arrs + [w]
 
-            t_step = _time.perf_counter()
-            for s, devs in _timed_feed(_feed(
-                    build, place, steps_per_epoch, mode=cfg.feed,
-                    depth=cfg.feed_depth, phases=feed_phases)):
-                batch = dict(zip(names, devs[:-2]))
-                yb, wb = devs[-2], devs[-1]
-                params, opt_state, l = train_step(
-                    params, opt_state, batch, yb, wb,
-                    jax.random.fold_in(key, step)
-                )
-                _metrics.observe("train.step_s",
-                                 _time.perf_counter() - t_step)
                 t_step = _time.perf_counter()
-                _after_step(s, l, epoch)
-        elif cfg.accum_mode == "fused":
-            def build_full(s, _order=order):
-                idx = _order[s * bs:(s + 1) * bs]
-                arrs = [tr_inputs[k][idx] for k in names] + [tr_y[idx]]
-                w = np.ones(len(idx), np.float32)
-                if len(idx) < padded_bs:
-                    arrs = _pad_tail(arrs, padded_bs)
-                    w = np.concatenate(
-                        [w, np.zeros(padded_bs - len(idx), np.float32)])
-                # pre-chunk host-side: (accum, micro, ...) — the scan's
-                # chunk layout is decided HERE, not by an in-program
-                # reshard (see chunked_batch_sharding)
-                return [a.reshape((accum, micro_rows) + a.shape[1:])
-                        for a in arrs + [w]]
-
-            t_step = _time.perf_counter()
-            for s, devs in _timed_feed(_feed(
-                    build_full, place_chunked, steps_per_epoch,
-                    mode=cfg.feed, depth=cfg.feed_depth,
-                    phases=feed_phases)):
-                batch = dict(zip(names, devs[:-2]))
-                yb, wb = devs[-2], devs[-1]
-                skey = jax.random.fold_in(key, step)
-                dkeys = jnp.stack([jax.random.fold_in(skey, k)
-                                   for k in range(accum)])
-                params, opt_state, l = fused_prog(
-                    params, opt_state, batch, yb, wb, dkeys)
-                _metrics.observe("train.step_s",
-                                 _time.perf_counter() - t_step)
-                t_step = _time.perf_counter()
-                _after_step(s, l, epoch)
-        else:
-            def build_micro(m, _order=order):
-                s, k = divmod(m, accum)
-                start = s * bs
-                m_real = min(bs, len(_order) - start)
-                lo = k * micro_rows + shard_idx * shard_rows
-                pos = np.arange(lo, lo + shard_rows)
-                # positions past the real rows pad by repeating the LAST
-                # real row of the effective batch with zero loss-weight —
-                # the same exact-padding contract as the fused reference
-                idx = _order[start + np.minimum(pos, m_real - 1)]
-                arrs = [tr_inputs[k2][idx] for k2 in names] + [tr_y[idx]]
-                return arrs + [(pos < m_real).astype(np.float32)]
-
-            t_step = _time.perf_counter()
-            skey = None
-            for m, devs in _timed_feed(_feed(
-                    build_micro, place, steps_per_epoch * accum,
-                    mode=cfg.feed, depth=cfg.feed_depth,
-                    phases=feed_phases)):
-                s, k = divmod(m, accum)
-                if k == 0:
-                    skey = jax.random.fold_in(key, step)
-                batch = dict(zip(names, devs[:-2]))
-                yb, wb = devs[-2], devs[-1]
-                gacc, wacc, lacc = micro_prog(
-                    gacc, wacc, lacc, params, batch, yb, wb,
-                    jax.random.fold_in(skey, k))
-                _metrics.incr("train.micro_steps")
-                if k == accum - 1:
-                    ga, wa, la = gacc, wacc, lacc
-                    if num_shards > 1:
-                        # rank-ordered sum of the per-process chunk
-                        # accumulators — bit-identical on every process
-                        ga, wa, la = ordered_cross_process_sum(
-                            (gacc, wacc, lacc))
-                    t_f = _time.perf_counter()
-                    params, opt_state, l, gacc, wacc, lacc = apply_prog(
-                        params, opt_state, ga, wa, la)
-                    _metrics.observe("train.accum_flush_s",
-                                     _time.perf_counter() - t_f)
+                for s, devs in _timed_feed(_feed(
+                        build, place, steps_per_epoch, mode=cfg.feed,
+                        depth=cfg.feed_depth, phases=feed_phases)):
+                    batch = dict(zip(names, devs[:-2]))
+                    yb, wb = devs[-2], devs[-1]
+                    params, opt_state, l = train_step(
+                        params, opt_state, batch, yb, wb,
+                        jax.random.fold_in(key, step)
+                    )
                     _metrics.observe("train.step_s",
                                      _time.perf_counter() - t_step)
                     t_step = _time.perf_counter()
                     _after_step(s, l, epoch)
-        if not cfg.log_every:
-            lv = float(l)
-            history["loss"].append(lv)
-            elapsed = _time.perf_counter() - t_start
-            _metrics.record(
-                "dl.train", step=step, loss=lv,
-                samples_per_sec=(step - start_step) * bs / max(elapsed, 1e-9))
+            elif cfg.accum_mode == "fused":
+                def build_full(s, _order=order):
+                    idx = _order[s * bs:(s + 1) * bs]
+                    arrs = [tr_inputs[k][idx] for k in names] + [tr_y[idx]]
+                    w = np.ones(len(idx), np.float32)
+                    if len(idx) < padded_bs:
+                        arrs = _pad_tail(arrs, padded_bs)
+                        w = np.concatenate(
+                            [w, np.zeros(padded_bs - len(idx), np.float32)])
+                    # pre-chunk host-side: (accum, micro, ...) — the scan's
+                    # chunk layout is decided HERE, not by an in-program
+                    # reshard (see chunked_batch_sharding)
+                    return [a.reshape((accum, micro_rows) + a.shape[1:])
+                            for a in arrs + [w]]
 
-        if save_ckpt:
-            ckpt.save(step, jax.device_get(params), jax.device_get(opt_state),
-                      {"step": step, "epoch": epoch})
-        if n_eval:
-            logits = _batched_apply(eval_prog, params, ev_inputs, mesh,
-                                    in_shard, bs)
-            if regression:
-                metric = -float(np.mean((logits.squeeze(-1) - ev_y) ** 2))
+                t_step = _time.perf_counter()
+                for s, devs in _timed_feed(_feed(
+                        build_full, place_chunked, steps_per_epoch,
+                        mode=cfg.feed, depth=cfg.feed_depth,
+                        phases=feed_phases)):
+                    batch = dict(zip(names, devs[:-2]))
+                    yb, wb = devs[-2], devs[-1]
+                    skey = jax.random.fold_in(key, step)
+                    dkeys = jnp.stack([jax.random.fold_in(skey, k)
+                                       for k in range(accum)])
+                    params, opt_state, l = fused_prog(
+                        params, opt_state, batch, yb, wb, dkeys)
+                    _metrics.observe("train.step_s",
+                                     _time.perf_counter() - t_step)
+                    t_step = _time.perf_counter()
+                    _after_step(s, l, epoch)
             else:
-                metric = float(np.mean(np.argmax(logits, -1) == ev_y))
-            history["eval_metric"].append(metric)
-            if best_metric is None or metric > best_metric:
-                # host copy: the next train_step DONATES the live buffers, so
-                # stashing the device tree directly would dangle
-                best_metric, best_params = metric, jax.device_get(params)
-                patience_left = cfg.early_stopping_patience
-            elif cfg.early_stopping_patience:
-                patience_left -= 1
-                if patience_left <= 0:
-                    break
+                def build_micro(m, _order=order):
+                    s, k = divmod(m, accum)
+                    start = s * bs
+                    m_real = min(bs, len(_order) - start)
+                    lo = k * micro_rows + shard_idx * shard_rows
+                    pos = np.arange(lo, lo + shard_rows)
+                    # positions past the real rows pad by repeating the LAST
+                    # real row of the effective batch with zero loss-weight —
+                    # the same exact-padding contract as the fused reference
+                    idx = _order[start + np.minimum(pos, m_real - 1)]
+                    arrs = [tr_inputs[k2][idx] for k2 in names] + [tr_y[idx]]
+                    return arrs + [(pos < m_real).astype(np.float32)]
+
+                t_step = _time.perf_counter()
+                skey = None
+                for m, devs in _timed_feed(_feed(
+                        build_micro, place, steps_per_epoch * accum,
+                        mode=cfg.feed, depth=cfg.feed_depth,
+                        phases=feed_phases)):
+                    s, k = divmod(m, accum)
+                    if k == 0:
+                        skey = jax.random.fold_in(key, step)
+                    batch = dict(zip(names, devs[:-2]))
+                    yb, wb = devs[-2], devs[-1]
+                    gacc, wacc, lacc = micro_prog(
+                        gacc, wacc, lacc, params, batch, yb, wb,
+                        jax.random.fold_in(skey, k))
+                    _metrics.incr("train.micro_steps")
+                    if k == accum - 1:
+                        ga, wa, la = gacc, wacc, lacc
+                        if num_shards > 1:
+                            # rank-ordered sum of the per-process chunk
+                            # accumulators — bit-identical on every process
+                            ga, wa, la = ordered_cross_process_sum(
+                                (gacc, wacc, lacc))
+                        t_f = _time.perf_counter()
+                        params, opt_state, l, gacc, wacc, lacc = apply_prog(
+                            params, opt_state, ga, wa, la)
+                        _metrics.observe("train.accum_flush_s",
+                                         _time.perf_counter() - t_f)
+                        _metrics.observe("train.step_s",
+                                         _time.perf_counter() - t_step)
+                        t_step = _time.perf_counter()
+                        _after_step(s, l, epoch)
+            if not cfg.log_every:
+                lv = float(l)
+                history["loss"].append(lv)
+                elapsed = _time.perf_counter() - t_start
+                _metrics.record(
+                    "dl.train", step=step, loss=lv,
+                    samples_per_sec=(step - start_step) * bs / max(elapsed, 1e-9))
+
+            if save_ckpt:
+                ckpt.save(step, jax.device_get(params), jax.device_get(opt_state),
+                          {"step": step, "epoch": epoch})
+            if n_eval:
+                logits = _batched_apply(eval_prog, params, ev_inputs, mesh,
+                                        in_shard, bs)
+                if regression:
+                    metric = -float(np.mean((logits.squeeze(-1) - ev_y) ** 2))
+                else:
+                    metric = float(np.mean(np.argmax(logits, -1) == ev_y))
+                history["eval_metric"].append(metric)
+                if best_metric is None or metric > best_metric:
+                    # host copy: the next train_step DONATES the live buffers, so
+                    # stashing the device tree directly would dangle
+                    best_metric, best_params = metric, jax.device_get(params)
+                    patience_left = cfg.early_stopping_patience
+                elif cfg.early_stopping_patience:
+                    patience_left -= 1
+                    if patience_left <= 0:
+                        break
 
     if best_params is not None:
         params = best_params

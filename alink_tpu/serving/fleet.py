@@ -85,6 +85,15 @@ from ..common.exceptions import (
 )
 from ..common.metrics import metrics
 from ..common.resilience import CircuitBreaker, RetryPolicy
+from ..common.telemetry import TelemetrySink, TelemetrySource
+from ..common.tracing import (
+    adopt_context,
+    attach_context,
+    capture_context,
+    set_process_identity,
+    tracer,
+    wire_context,
+)
 from .fleet_frontend import (
     DRAINING,
     FleetFrontend,
@@ -273,6 +282,9 @@ class ServingFleet:
         # with no traffic yet has no meaningful pressure signal)
         self._last_as_tick = time.time()
         self._prev_queue = (0.0, 0.0)
+        # replica metric deltas merge here under a replica label;
+        # fleet-wide quantiles come out exact (bucket-count sums)
+        self._telemetry = TelemetrySink()
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "ServingFleet":
@@ -483,6 +495,7 @@ class ServingFleet:
             stats = _validate_hb_stats(msg.get("stats"))
             rep.hb_stats = stats
             rep.last_hb = time.monotonic()
+            self._ingest_telemetry(rep, msg)
             if "trace_delta" in stats:
                 # worker-computed, re-based after every model (re)load so
                 # only traces provoked by live traffic count
@@ -501,6 +514,30 @@ class ServingFleet:
                 self._resync_if_stale(rep)
         else:
             raise ValueError(f"unknown control message {t!r}")
+
+    def _ingest_telemetry(self, rep: _Replica, msg: Dict[str, Any]) -> None:
+        """Merge the heartbeat's piggybacked telemetry delta and finished
+        span batch. Garbage is dropped WHOLE and counted loudly
+        (``fleet.bad_telemetry``) — never half-merged, never silently
+        truncated — and does not poison the heartbeat itself: a replica
+        with a telemetry bug is still serving."""
+        tele = msg.get("telemetry")
+        if tele is not None:
+            try:
+                self._telemetry.ingest(tele, replica=rep.rid)
+            except ValueError as e:
+                metrics.incr("fleet.bad_telemetry")
+                logger.warning("dropped telemetry from %s: %s", rep.rid, e)
+        spans = msg.get("spans")
+        if spans is not None:
+            try:
+                n = tracer.ingest(spans, proc=rep.rid, pid=rep.proc.pid)
+                if n:
+                    metrics.incr("fleet.spans_ingested", n)
+            except ValueError as e:
+                metrics.incr("fleet.bad_telemetry")
+                logger.warning("dropped span batch from %s: %s",
+                               rep.rid, e)
 
     def _mark_unhealthy(self, rep: _Replica, why: str) -> None:
         with self._lock:
@@ -615,13 +652,19 @@ class ServingFleet:
                        and rep.state in ("ready", "unhealthy")]
         outcomes: Dict[str, Dict[str, Any]] = {}
         out_lock = threading.Lock()
+        # carry the caller's span (e.g. modelstream.swap) onto the
+        # broadcast threads so every replica-side load lands in the
+        # publish trace
+        ctx = capture_context()
 
         def _swap_one(rep: _Replica) -> None:
             try:
-                resp = rep.client.call(
-                    {"op": "load", "name": name, "path": model,
-                     "schema": schema_str, "config": cfg_dict, "seq": seq},
-                    timeout=self._cfg.swap_timeout_s)
+                with attach_context(ctx):
+                    resp = rep.client.call(
+                        {"op": "load", "name": name, "path": model,
+                         "schema": schema_str, "config": cfg_dict,
+                         "seq": seq, "trace": wire_context()},
+                        timeout=self._cfg.swap_timeout_s)
                 if resp.get("ok"):
                     rep.synced[name] = seq
                     metrics.incr("fleet.swap_ok")
@@ -673,7 +716,8 @@ class ServingFleet:
                        if rep.client is not None and rep.state == "ready"]
         for rep in targets:
             try:
-                rep.client.call({"op": "unload", "name": name},
+                rep.client.call({"op": "unload", "name": name,
+                                 "trace": wire_context()},
                                 timeout=self._cfg.swap_timeout_s)
                 rep.synced.pop(name, None)
             except Exception:
@@ -704,7 +748,8 @@ class ServingFleet:
                 resp = rep.client.call(
                     {"op": "load", "name": name, "path": path,
                      "schema": d["schema"], "config": d["config"],
-                     "seq": d["seq"], "resync": True},
+                     "seq": d["seq"], "resync": True,
+                     "trace": wire_context()},
                     timeout=self._cfg.swap_timeout_s)
             except Exception:
                 metrics.incr("fleet.swap_failed")
@@ -730,7 +775,8 @@ class ServingFleet:
             metrics.incr("fleet.drains")
             if not force and rep.client is not None:
                 try:
-                    rep.client.call({"op": "drain"},
+                    rep.client.call({"op": "drain",
+                                     "trace": wire_context()},
                                     timeout=self._cfg.drain_timeout_s)
                 except Exception:
                     metrics.incr("fleet.drain_errors")
@@ -888,6 +934,14 @@ class ServingFleet:
                 "request_s": hb.get("request_s"),
             })
         ctl = self._controller
+        # fleet-wide distributions: EXACT merges of the per-replica
+        # bucket counts relayed over heartbeats (p99 of the pooled
+        # distribution, not an average of per-replica p99s)
+        fleet_wide: Dict[str, Any] = {}
+        for h in ("serving.request_s", "serving.queue_s"):
+            merged = metrics.merged_histogram(h)
+            if merged is not None:
+                fleet_wide[h] = merged
         return {
             "replicas": replicas,
             "states": states,
@@ -898,6 +952,11 @@ class ServingFleet:
                 h: metrics.histogram(h)
                 for h in ("fleet.request_s",)
                 if metrics.histogram(h) is not None
+            },
+            "fleet_wide": fleet_wide,
+            "replica_counters": {
+                rep.rid: self._telemetry.counters_for(rep.rid)
+                for rep in reps
             },
             "autoscale": {
                 "enabled": ctl is not None,
@@ -928,6 +987,14 @@ class ServingFleet:
                 metrics.set_gauge("fleet.replica_queued",
                                   float(rep.hb_stats["queued"]),
                                   replica=rep.rid)
+        # fleet-wide quantile gauges off the exact bucket merge — the
+        # labeled per-replica histogram series export alongside them
+        merged = metrics.merged_histogram("serving.request_s")
+        if merged:
+            for q in ("p50", "p90", "p99"):
+                if merged.get(q) is not None:
+                    metrics.set_gauge(f"fleet.serving_request_s_{q}",
+                                      float(merged[q]))
 
 
 # ---------------------------------------------------------------------------
@@ -1010,6 +1077,12 @@ class _WorkerRuntime:
         self._csock: Optional[socket.socket] = None
         self._send_lock = threading.Lock()
         self._trace_base = 0
+        # observability plane: every span finished here is tagged with
+        # this replica's identity and queued (bounded) for the heartbeat
+        # relay; metric deltas ride the same channel
+        set_process_identity(self.rid)
+        tracer.enable_export()
+        self._telemetry_src = TelemetrySource()
 
     # -- wire helpers --------------------------------------------------------
     def _send_line(self, msg: Dict[str, Any]) -> None:
@@ -1067,13 +1140,19 @@ class _WorkerRuntime:
                 self._active += 1
             try:
                 self._tap(f"{self.rid}.g{self.gen}.batch")
-                if kind == "predict":
-                    val = self.server.predict(op["name"], op["row"],
-                                              timeout=op.get("deadline_s"))
-                else:
-                    val = self.server.predict_many(
-                        op["name"], op["rows"],
-                        timeout=op.get("deadline_s"))
+                # the frontend's wire context parents this replica's
+                # serving.request/serving.batch spans — one stitched
+                # trace per frontdoor request. None/garbage tolerated
+                # (old frontends): spans become local roots instead.
+                with adopt_context(op.get("trace")):
+                    if kind == "predict":
+                        val = self.server.predict(
+                            op["name"], op["row"],
+                            timeout=op.get("deadline_s"))
+                    else:
+                        val = self.server.predict_many(
+                            op["name"], op["rows"],
+                            timeout=op.get("deadline_s"))
                 return {"ok": True, "value": val}
             except BaseException as e:
                 return encode_error(e)
@@ -1085,9 +1164,10 @@ class _WorkerRuntime:
             try:
                 cdict = op.get("config")
                 scfg = ServingConfig(**cdict) if cdict else self.serving_cfg
-                info = self.server.load(op["name"], op["path"],
-                                        op.get("schema"), config=scfg,
-                                        recovery=bool(op.get("resync")))
+                with adopt_context(op.get("trace")):
+                    info = self.server.load(op["name"], op["path"],
+                                            op.get("schema"), config=scfg,
+                                            recovery=bool(op.get("resync")))
                 with self._synced_lock:
                     self._synced[op["name"]] = int(op.get("seq") or 0)
                 # re-base the zero-trace pin: load-time warmup traces are
@@ -1208,7 +1288,18 @@ class _WorkerRuntime:
                     break
                 os._exit(23)  # kill_mid_batch at the heartbeat label
             try:
-                self._send_line({"t": "hb", "stats": self._stats_payload()})
+                hb: Dict[str, Any] = {"t": "hb",
+                                      "stats": self._stats_payload()}
+                # piggyback bounded telemetry deltas and finished-span
+                # batches — absent keys mean "nothing new", so idle
+                # heartbeats stay as small as before
+                tele = self._telemetry_src.delta()
+                if tele is not None:
+                    hb["telemetry"] = tele
+                spans = tracer.drain_export()
+                if spans:
+                    hb["spans"] = spans
+                self._send_line(hb)
             except OSError:
                 # supervisor is gone — an orphan replica must not outlive
                 # its fleet

@@ -55,6 +55,8 @@ from ..common.exceptions import (
 )
 from ..common.metrics import metrics
 from ..common.resilience import CircuitBreaker, RetryPolicy
+from ..common.tracing import (adopt_context, note_retry, trace_span,
+                              wire_context)
 
 #: Upper bound on one frame — a corrupt length prefix must not make the
 #: reader try to allocate gigabytes.
@@ -281,6 +283,7 @@ class FleetFrontend:
             except TRANSPORT_ERRORS as e:
                 breaker.record_failure()
                 metrics.incr("fleet.failovers")
+                note_retry()  # the request span reads ``retried``
                 last, last_rid = e, rid
                 continue
             if resp.get("ok"):
@@ -302,17 +305,26 @@ class FleetFrontend:
             + (f" (last replica error: {last!r})" if last else "")) from last
 
     # -- request API ---------------------------------------------------------
+    # Every request opens a ``fleet.request`` span and stamps its wire
+    # context into the frame, so the replica-side batcher spans parent
+    # under THIS span in one stitched trace. With tracing off the field
+    # is None — the request dict shape (and the served bits) never change.
     def predict(self, name: str, row: Sequence, *,
                 timeout: float) -> Tuple:
-        return self.call({"op": "predict", "name": name,
-                          "row": tuple(row)},
-                         deadline_s=timeout, model=name)
+        with trace_span("fleet.request", model=name):
+            return self.call({"op": "predict", "name": name,
+                              "row": tuple(row),
+                              "trace": wire_context()},
+                             deadline_s=timeout, model=name)
 
     def predict_many(self, name: str, rows: Sequence[Sequence], *,
                      timeout: float) -> List[Tuple]:
-        return self.call({"op": "predict_many", "name": name,
-                          "rows": [tuple(r) for r in rows]},
-                         deadline_s=timeout, model=name)
+        with trace_span("fleet.request", model=name,
+                        rows=len(rows)):
+            return self.call({"op": "predict_many", "name": name,
+                              "rows": [tuple(r) for r in rows],
+                              "trace": wire_context()},
+                             deadline_s=timeout, model=name)
 
 
 # ---------------------------------------------------------------------------
@@ -360,17 +372,21 @@ class FrontendListener:
                     kind = op.get("op")
                     timeout = float(op.get("deadline_s")
                                     or self._default_timeout_s)
-                    if kind == "predict":
-                        val = self._frontend.predict(
-                            op["name"], op["row"], timeout=timeout)
-                    elif kind == "predict_many":
-                        val = self._frontend.predict_many(
-                            op["name"], op["rows"], timeout=timeout)
-                    elif kind == "ping":
-                        val = True
-                    else:
-                        raise AkIllegalArgumentException(
-                            f"unknown fleet op {kind!r}")
+                    # a tracing client's context parents the whole fleet
+                    # request tree; absent/None (old clients) or garbage
+                    # is tolerated — spans fall back to local roots
+                    with adopt_context(op.get("trace")):
+                        if kind == "predict":
+                            val = self._frontend.predict(
+                                op["name"], op["row"], timeout=timeout)
+                        elif kind == "predict_many":
+                            val = self._frontend.predict_many(
+                                op["name"], op["rows"], timeout=timeout)
+                        elif kind == "ping":
+                            val = True
+                        else:
+                            raise AkIllegalArgumentException(
+                                f"unknown fleet op {kind!r}")
                     send_frame(conn, {"ok": True, "value": val})
                 except TRANSPORT_ERRORS:
                     raise  # the CLIENT connection broke — stop serving it

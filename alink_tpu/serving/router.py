@@ -52,7 +52,7 @@ from ..common.jitcache import bucket_rows, seen_warmup_specs
 from ..common.metrics import metrics
 from ..common.mtable import MTable, TableSchema
 from ..common.resilience import CircuitBreaker
-from ..common.tracing import trace_span
+from ..common.tracing import attach_context, capture_context, trace_span
 from ..pipeline.local_predictor import LocalPredictor
 from ..pipeline.pipeline import PipelineModel
 from .warmup_store import load_warmup_spec, save_warmup_spec
@@ -179,11 +179,15 @@ class PredictFuture:
 
 
 class _Request:
-    __slots__ = ("row", "future")
+    __slots__ = ("row", "future", "ctx")
 
     def __init__(self, row: Sequence, future: PredictFuture):
         self.row = tuple(row)
         self.future = future
+        # the submitter's open span (None with tracing off): the batcher
+        # thread re-attaches it so the coalesced ``serving.batch`` span
+        # lands in the same trace as the request that triggered it
+        self.ctx = capture_context()
 
 
 class _ModelEntry:
@@ -336,8 +340,13 @@ class _ModelEntry:
             return
         n = len(live)
         metrics.observe("serving.batch_rows", float(n), buckets=_ROW_BUCKETS)
+        # parent the batch span under the oldest live request's trace —
+        # a coalesced batch belongs to many traces; Dapper convention is
+        # to follow the request that opened it
+        ctx = next((r.ctx for r in live if r.ctx is not None), None)
         try:
-            with trace_span("serving.batch", model=self.name, rows=n):
+            with attach_context(ctx), \
+                    trace_span("serving.batch", model=self.name, rows=n):
                 out = self.predictor.predict_table(t)
                 if out.num_rows != n:
                     raise AkIllegalStateException(

@@ -1680,7 +1680,10 @@ def bench_fleet(clients=6, rows_per_client=60):
     and the delivery gate: every accepted request either completed with the
     serial answer or shed with a typed error; none lost. Zero-trace gate:
     replica trace deltas stay 0 (all warmup from the ``.ak.warmup.json``
-    sidecar, never live traffic)."""
+    sidecar, never live traffic). Observability phase: tracing off-vs-on
+    through the full frontdoor→replica path (interleaved, benchstats-judged
+    delta + bit-parity) and the stitched-trace gate — the frontdoor trace
+    must contain at least one replica-process-tagged span."""
     import shutil
     import tempfile
     import threading
@@ -1813,11 +1816,90 @@ def bench_fleet(clients=6, rows_per_client=60):
             "respawn_warmup": [ld.get("warmup_source")
                                for ld in respawn_loads],
         }
+        # ---- observability phase: tracing off vs on through the SAME
+        # frontdoor→replica path. Two fleets (workers inherit the flag at
+        # spawn), thunks interleaved so container drift charges both flags
+        # equally; the supervisor-side flag flips with the thunk so the
+        # frontend span + wire context toggle together with the replicas.
+        from alink_tpu.common.benchstats import (compare_samples,
+                                                 measure_interleaved)
+        from alink_tpu.common.tracing import job_report, tracer
+
+        prev_flag = os.environ.get("ALINK_TRACING")
+        tfleets, touts = {}, {}
+        try:
+            for flag in ("off", "on"):
+                tfleets[flag] = ServingFleet(FleetConfig(
+                    replicas=2, heartbeat_s=0.2, heartbeat_timeout_s=1.5,
+                    worker_env={"ALINK_TRACING": flag}))
+                tfleets[flag].start()
+                tfleets[flag].load("m", path, schema)
+
+            def traced(flag):
+                def thunk():
+                    os.environ["ALINK_TRACING"] = flag
+                    touts[flag] = [tfleets[flag].predict("m", rows[k],
+                                                         timeout=60)
+                                   for k in range(32)]
+                return thunk
+
+            for flag in ("off", "on"):  # warmup outside both windows
+                traced(flag)()
+            walls = measure_interleaved(
+                {"off": traced("off"), "on": traced("on")},
+                repeats=5, warmup=0)
+            trace_overhead = compare_samples(walls["off"], walls["on"])
+            trace_parity = (touts["off"] == touts["on"]
+                            and touts["off"] == serial[:32])
+
+            # stitched-trace gate: one more traced predict, then poll the
+            # frontdoor trace until a replica-proc-tagged span lands in it
+            # (the replica batch spans ride the heartbeat relay)
+            os.environ["ALINK_TRACING"] = "on"
+            assert tfleets["on"].predict("m", rows[0],
+                                         timeout=60) == serial[0]
+            # newest fleet.request root, not last_trace_id(): relayed
+            # replica load spans are local roots and can land right
+            # after the predict, shadowing it
+            tid = next(s["trace_id"] for s in reversed(tracer.spans())
+                       if s["name"] == "fleet.request")
+
+            def _stitched():
+                def walk(nodes):
+                    for nd in nodes:
+                        yield nd
+                        yield from walk(nd.get("children") or [])
+                return any(nd.get("proc")
+                           for nd in walk(job_report(tid).get("tree") or []))
+
+            stitched = False
+            deadline = time.perf_counter() + 20
+            while time.perf_counter() < deadline:
+                if _stitched():
+                    stitched = True
+                    break
+                time.sleep(0.1)
+        finally:
+            for fl in tfleets.values():
+                try:
+                    fl.stop()
+                except Exception:
+                    pass
+            if prev_flag is None:
+                os.environ.pop("ALINK_TRACING", None)
+            else:
+                os.environ["ALINK_TRACING"] = prev_flag
+
         out = {
             "clients": clients,
             "rows_per_client": rows_per_client,
             "scales": scales,
             "kill_drill": kill,
+            "tracing": {
+                "overhead": trace_overhead,
+                "bit_parity_on_vs_off": trace_parity,
+                "stitched_trace_id": tid,
+            },
             "gate": {
                 "parity": parity_ok,
                 "zero_trace": zero_trace,
@@ -1825,6 +1907,8 @@ def bench_fleet(clients=6, rows_per_client=60):
                 "recovered": (recovery_s is not None
                               and kill["respawns"] >= 1
                               and kill["respawn_warmup"] == ["sidecar"]),
+                "tracing_parity": trace_parity,
+                "stitched": stitched,
             },
         }
         out["gate"]["ok"] = all(out["gate"].values())

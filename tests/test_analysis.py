@@ -678,6 +678,56 @@ def test_lint_alk008_exempts_registered_modules(tmp_path):
     assert [d.rule for d in caller] == []
 
 
+def test_lint_untraced_frame_send_alk112(tmp_path):
+    """A frame-protocol request dict (an {'op': ...} literal) built in
+    serving/ without a 'trace' field crosses the process boundary
+    invisible to the stitched waterfall. A ``**spread`` may supply the
+    field, so spread-bearing dicts are skipped, and the rule only
+    patrols the serving tier."""
+    src = """
+        def send(client, name, row):
+            client.call({"op": "predict", "name": name, "row": row})
+            return {"ok": True}
+    """
+    diags = _lint_src(tmp_path, "serving/fleet_frontend.py", src)
+    assert [d.rule for d in diags] == ["ALK112"]
+    assert diags[0].line == 3
+    assert "wire_context" in diags[0].hint
+    # out of scope: the same dict outside serving/ is someone else's
+    # protocol, not a fleet frame
+    assert _lint_src(tmp_path, "common/whatever.py", src) == []
+    clean = _lint_src(tmp_path, "serving/fleet.py", """
+        def send(client, name, ctx, base):
+            client.call({"op": "predict", "name": name, "trace": ctx})
+            client.call({**base, "name": name})
+            return {"ok": True, "value": 1}
+    """)
+    assert clean == []
+
+
+def test_alk112_absent_from_baseline():
+    """Untraced frame sends are banned from day one: every serving-tier
+    request dict carries its wire context, so no ALK112 budget exists and
+    the first regression fails ``--check``."""
+    with open(os.path.join(
+            REPO_ROOT, "alink_tpu", "analysis", "lint_baseline.json")) as f:
+        baseline = json.load(f)
+    assert "ALK112" not in baseline["counts"]
+
+
+def test_telemetry_module_in_alk004_scope(tmp_path):
+    """common/telemetry.py is a threaded module (heartbeat thread writes,
+    supervisor thread reads) — unlocked module-dict mutation there is
+    ALK004 drift like in the other relay modules."""
+    diags = _lint_src(tmp_path, "common/telemetry.py", """
+        _SEEN = {}
+
+        def bad(k, v):
+            _SEEN[k] = v
+    """)
+    assert [d.rule for d in diags] == ["ALK004"]
+
+
 def test_alk008_absent_from_baseline():
     """Pallas containment is banned from day one: no ALK008 budget exists,
     so the first unregistered pallas_call anywhere fails ``--check``."""
